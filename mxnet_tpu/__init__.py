@@ -1,0 +1,40 @@
+"""mxnet_tpu — a TPU-native deep learning framework.
+
+A ground-up re-design of Apache MXNet 1.5's capability surface
+(reference: loochao/incubator-mxnet) for TPU hardware: JAX/XLA is the
+compute substrate, whole graphs lower to single XLA computations,
+parallelism is expressed as shardings over a device mesh, and
+collectives ride ICI — see SURVEY.md §7 for the architecture
+translation table.
+
+Typical use mirrors MXNet:
+
+    import mxnet_tpu as mx
+    x = mx.nd.ones((2, 3), ctx=mx.tpu())
+    net = mx.gluon.nn.Dense(10)
+"""
+__version__ = "0.1.0"
+
+from .base import MXNetError
+from .context import Context, cpu, gpu, tpu, cpu_pinned, current_context, \
+    num_gpus, num_tpus, gpu_memory_info
+from .name import NameManager
+from .attribute import AttrScope
+from . import base
+from . import ops
+from . import ndarray
+from . import ndarray as nd
+from . import random
+from . import autograd
+from . import engine
+from . import util
+from . import runtime
+
+from .ndarray import NDArray
+
+from . import symbol
+from . import symbol as sym
+from .symbol import Symbol
+from .executor import Executor
+
+from . import test_utils

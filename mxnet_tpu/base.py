@@ -1,0 +1,157 @@
+"""Base utilities: errors, environment config, registries, common helpers.
+
+TPU-native re-design of the dmlc-core substrate the reference builds on:
+- ``MXNetError`` mirrors the error type surfaced through the C ABI
+  (reference: src/c_api/c_api_error.cc).
+- ``get_env`` mirrors ``dmlc::GetEnv`` point-of-use env config
+  (reference: docs/faq/env_var.md).
+- ``Registry`` mirrors ``dmlc::Registry`` used for ops, iterators,
+  optimizers, initializers and metrics.
+
+No C library is loaded: the framework's compute substrate is JAX/XLA, and
+the stable internal boundary that the reference's C ABI provided is the
+``mxnet_tpu.ops`` registry instead.
+"""
+from __future__ import annotations
+
+import os
+import threading
+from typing import Any, Callable, Dict, Generic, Optional, TypeVar
+
+__all__ = [
+    "MXNetError", "NotImplementedForSymbol", "get_env", "Registry",
+    "string_types", "numeric_types", "integer_types", "classproperty",
+]
+
+string_types = (str,)
+numeric_types = (float, int)
+integer_types = (int,)
+
+
+class MXNetError(RuntimeError):
+    """Error raised by the framework (parity with mxnet.base.MXNetError)."""
+
+
+class NotImplementedForSymbol(MXNetError):
+    def __init__(self, function, alias, *args):
+        super().__init__()
+        self.function = function.__name__
+        self.alias = alias
+        self.args = [str(type(a)) for a in args]
+
+    def __str__(self):
+        msg = 'Function {}'.format(self.function)
+        if self.alias:
+            msg += ' (namely operator "{}")'.format(self.alias)
+        if self.args:
+            msg += ' with arguments ({})'.format(', '.join(self.args))
+        msg += ' is not supported for Symbol and only available in NDArray.'
+        return msg
+
+
+_TRUE = ("1", "true", "True", "TRUE", "yes", "on")
+
+
+def get_env(name: str, default=None, dtype=None):
+    """dmlc::GetEnv equivalent: typed environment variable lookup.
+
+    Reads ``MXNET_*`` knobs at point of use, like the reference does
+    (reference: docs/faq/env_var.md:35-269).
+    """
+    val = os.environ.get(name)
+    if val is None:
+        return default
+    if dtype is None and default is not None:
+        dtype = type(default)
+    if dtype is bool:
+        return val in _TRUE
+    if dtype is not None:
+        try:
+            return dtype(val)
+        except ValueError:
+            return default
+    return val
+
+
+T = TypeVar("T")
+
+
+class Registry(Generic[T]):
+    """Name → object registry with alias support.
+
+    Equivalent of ``dmlc::Registry`` (used for ops/io/optimizers/metrics in
+    the reference). Lookup is case-insensitive for creation-by-name
+    registries (optimizer/metric/initializer) to match reference behavior.
+    """
+
+    def __init__(self, name: str, case_sensitive: bool = True):
+        self.name = name
+        self._case_sensitive = case_sensitive
+        self._entries: Dict[str, T] = {}
+        self._lock = threading.Lock()
+
+    def _key(self, name: str) -> str:
+        return name if self._case_sensitive else name.lower()
+
+    def register(self, name: Optional[str] = None, allow_override: bool = False):
+        def _do(obj, reg_name):
+            key = self._key(reg_name)
+            with self._lock:
+                if key in self._entries and not allow_override:
+                    raise ValueError(
+                        "%s '%s' already registered in registry '%s'"
+                        % (self.name, reg_name, self.name))
+                self._entries[key] = obj
+            return obj
+
+        if callable(name):  # used as bare decorator
+            obj, name_ = name, getattr(name, "__name__", None)
+            return _do(obj, name_)
+
+        def deco(obj):
+            reg_name = name or getattr(obj, "__name__", None)
+            return _do(obj, reg_name)
+        return deco
+
+    def get(self, name: str) -> T:
+        key = self._key(name)
+        if key not in self._entries:
+            raise KeyError(
+                "%s '%s' is not registered. Known: %s"
+                % (self.name, name, sorted(self._entries)))
+        return self._entries[key]
+
+    def find(self, name: str) -> Optional[T]:
+        return self._entries.get(self._key(name))
+
+    def __contains__(self, name: str) -> bool:
+        return self._key(name) in self._entries
+
+    def keys(self):
+        return list(self._entries.keys())
+
+    def items(self):
+        return list(self._entries.items())
+
+
+class classproperty:
+    def __init__(self, fget):
+        self.fget = fget
+
+    def __get__(self, obj, owner):
+        return self.fget(owner)
+
+
+def build_param_doc(arg_names, arg_types, arg_descs, remove_dup=True):
+    """Build parameter docstring block (parity with mxnet.base.build_param_doc)."""
+    param_keys = set()
+    param_str = []
+    for key, type_info, desc in zip(arg_names, arg_types, arg_descs):
+        if key in param_keys and remove_dup:
+            continue
+        param_keys.add(key)
+        ret = '%s : %s' % (key, type_info)
+        if len(desc) != 0:
+            ret += '\n    ' + desc
+        param_str.append(ret)
+    return 'Parameters\n----------\n%s\n' % str.join('\n', param_str)

@@ -1,0 +1,58 @@
+"""Execution engine shim.
+
+Reference: src/engine/ (ThreadedEnginePerDevice and friends) +
+python/mxnet/engine.py. On TPU, op ordering and async dispatch are
+provided by JAX/XLA: every dispatched computation returns a
+future-backed array and XLA serializes device work per stream, which is
+exactly the ordering guarantee the reference's Var read/write dependency
+tracking provides for single-stream programs. What remains host-side:
+
+- ``NaiveEngine`` ≙ ``jax.disable_jit()`` (synchronous debug mode,
+  selected with MXNET_ENGINE_TYPE like the reference, engine.cc:33).
+- bulking context managers (engine.h set_bulk_size) are accepted and
+  no-op: whole-graph jit already executes fused programs.
+- ``wait_for_all`` / per-array ``wait_to_read`` are the sync points.
+"""
+from __future__ import annotations
+
+import contextlib
+
+from .base import get_env
+
+__all__ = ["bulk", "set_bulk_size", "wait_for_all", "engine_type",
+           "naive_engine"]
+
+_bulk_size = 15
+
+
+def engine_type():
+    return get_env("MXNET_ENGINE_TYPE", "ThreadedEnginePerDevice")
+
+
+def set_bulk_size(size):
+    global _bulk_size
+    prev = _bulk_size
+    _bulk_size = size
+    return prev
+
+
+@contextlib.contextmanager
+def bulk(size):
+    prev = set_bulk_size(size)
+    try:
+        yield
+    finally:
+        set_bulk_size(prev)
+
+
+def wait_for_all():
+    from .ndarray import waitall
+    waitall()
+
+
+@contextlib.contextmanager
+def naive_engine():
+    """Synchronous, uncompiled execution for debugging (NaiveEngine)."""
+    import jax
+    with jax.disable_jit():
+        yield

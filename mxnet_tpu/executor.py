@@ -1,0 +1,388 @@
+"""Executor — binds a Symbol to devices and runs it.
+
+Parity target: include/mxnet/executor.h + src/executor/graph_executor.cc.
+
+TPU-native design (SURVEY §7): ``bind`` lowers the ENTIRE symbolic graph
+to one jitted XLA computation. This single design move replaces the
+reference's NNVM pass pipeline:
+- PlanMemory/inplace/pooling  → XLA buffer assignment + donation
+- AttachOpExecs + engine push per node → one compiled executable
+- op bulking (BulkTrainingOpSegs)      → whole-program fusion
+- InferShape pass → jax.eval_shape at trace time (+ symbol/infer hooks)
+- gradient graph (pass::Gradient)      → jax.vjp over the traced program
+
+``forward`` runs the forward executable; ``backward`` / the fused
+``forward_backward`` run a forward+vjp executable (compiled once per
+train/eval mode and input-shape signature; the shape-signature cache is
+jax.jit's own, which is what CachedOp::SetForwardGraph re-implemented).
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence
+
+from .base import MXNetError
+from .context import Context
+from . import ops as _ops
+
+__all__ = ["Executor"]
+
+
+class Executor:
+    def __init__(self, symbol, ctx, args, args_grad=None, grad_req="write",
+                 aux_states=None):
+        from .ndarray import NDArray, zeros as nd_zeros
+
+        self._symbol = symbol
+        self._ctx = ctx if isinstance(ctx, Context) else Context(ctx)
+        self.arg_names = symbol.list_arguments()
+        self.aux_names = symbol.list_auxiliary_states()
+        self.output_names = symbol.list_outputs()
+
+        # normalize args
+        if isinstance(args, dict):
+            missing = [n for n in self.arg_names if n not in args]
+            if missing:
+                raise MXNetError("bind: missing arguments %s" % missing)
+            self.arg_arrays = [args[n] for n in self.arg_names]
+        else:
+            args = list(args)
+            if len(args) != len(self.arg_names):
+                raise MXNetError(
+                    "bind: expected %d args, got %d"
+                    % (len(self.arg_names), len(args)))
+            self.arg_arrays = args
+
+        # grad_req normalize
+        if isinstance(grad_req, str):
+            self._grad_req = {n: grad_req for n in self.arg_names}
+        elif isinstance(grad_req, (list, tuple)):
+            self._grad_req = dict(zip(self.arg_names, grad_req))
+        else:
+            self._grad_req = {n: grad_req.get(n, "null")
+                              for n in self.arg_names}
+        if args_grad is None:
+            args_grad = {}
+            for n in self.arg_names:
+                if self._grad_req[n] != "null":
+                    self._grad_req[n] = "null"
+        if isinstance(args_grad, dict):
+            self.grad_arrays = [args_grad.get(n) for n in self.arg_names]
+        else:
+            args_grad = list(args_grad)
+            self.grad_arrays = list(args_grad) + \
+                [None] * (len(self.arg_names) - len(args_grad))
+        for n, g in zip(self.arg_names, self.grad_arrays):
+            if g is None and self._grad_req.get(n, "null") != "null":
+                self._grad_req[n] = "null"
+
+        # aux states
+        if aux_states is None:
+            aux_states = []
+        if isinstance(aux_states, dict):
+            self.aux_arrays = [aux_states[n] for n in self.aux_names]
+        else:
+            self.aux_arrays = list(aux_states)
+        if len(self.aux_arrays) != len(self.aux_names):
+            raise MXNetError("bind: expected %d aux states, got %d"
+                             % (len(self.aux_names), len(self.aux_arrays)))
+
+        self.arg_dict = dict(zip(self.arg_names, self.arg_arrays))
+        self.grad_dict = {n: g for n, g in zip(self.arg_names,
+                                               self.grad_arrays)}
+        self.aux_dict = dict(zip(self.aux_names, self.aux_arrays))
+
+        # persistent output buffers
+        self.outputs = [None] * len(self._symbol._outputs)
+        self._fns: Dict[Any, Any] = {}
+        self._rng_count = sum(
+            1 for n in symbol._topo_nodes()
+            if n.op is not None and n.op.needs_rng)
+        self._monitor_callback = None
+        self._build_plan()
+
+    # -- graph plan ------------------------------------------------------
+    def _build_plan(self):
+        nodes = self._symbol._topo_nodes()
+        self._nodes = nodes
+        arg_pos = {n: i for i, n in enumerate(self.arg_names)}
+        aux_pos = {n: i for i, n in enumerate(self.aux_names)}
+        self._plan = []
+        node_slot = {}
+        slot = 0
+        rng_slot = 0
+        for nd_ in nodes:
+            if nd_.is_variable():
+                if nd_.name in aux_pos:
+                    src = ("aux", aux_pos[nd_.name])
+                elif nd_.name in arg_pos:
+                    src = ("arg", arg_pos[nd_.name])
+                else:
+                    raise MXNetError("unbound variable %s" % nd_.name)
+                node_slot[id(nd_)] = ("var", src)
+            else:
+                nattrs = _ops.normalize_attrs(nd_.op, nd_.attrs)
+                bindings = []
+                for (s, i) in nd_.inputs:
+                    kind, ref = node_slot[id(s)]
+                    if kind == "var":
+                        bindings.append(ref)
+                    else:
+                        bindings.append(("res", ref, i))
+                rs = None
+                if nd_.op.needs_rng:
+                    rs = rng_slot
+                    rng_slot += 1
+                # aux writeback mapping: mutable input idx → aux slot
+                aux_wb = []
+                for mi in nd_.op.mutable_inputs:
+                    if mi < len(nd_.inputs):
+                        src, _ = nd_.inputs[mi]
+                        if src.is_variable() and src.name in aux_pos:
+                            aux_wb.append(aux_pos[src.name])
+                        else:
+                            aux_wb.append(None)
+                self._plan.append((nd_.op, nattrs, tuple(bindings), rs,
+                                   aux_wb, slot))
+                node_slot[id(nd_)] = ("res", slot)
+                slot += 1
+        self._head_refs = []
+        for (n, i) in self._symbol._outputs:
+            kind, ref = node_slot[id(n)]
+            if kind == "var":
+                self._head_refs.append((ref[0], ref[1], 0))
+            else:
+                self._head_refs.append(("res", ref, i))
+        self._grad_positions = [i for i, n in enumerate(self.arg_names)
+                                if self._grad_req.get(n, "null") != "null"]
+
+    def _make_graph_fn(self, is_train):
+        plan = self._plan
+        head_refs = self._head_refs
+        n_aux = len(self.aux_names)
+
+        def run(arg_vals, aux_vals, rng_keys):
+            results: List[tuple] = []
+            new_aux = list(aux_vals)
+            for (op, nattrs, bindings, rs, aux_wb, slot) in plan:
+                vals = []
+                for b in bindings:
+                    if b[0] == "arg":
+                        vals.append(arg_vals[b[1]])
+                    elif b[0] == "aux":
+                        vals.append(new_aux[b[1]])
+                    else:
+                        vals.append(results[b[1]][b[2]])
+                attrs = nattrs
+                if "__train__" in op.defaults:
+                    attrs = dict(nattrs, __train__=is_train)
+                if rs is not None:
+                    out = op.forward(attrs, *vals, rng=rng_keys[rs])
+                else:
+                    out = op.forward(attrs, *vals)
+                if not isinstance(out, (tuple, list)):
+                    out = (out,)
+                n_out = op.resolve_num_outputs(attrs)
+                results.append(tuple(out[:n_out]))
+                extras = out[n_out:]
+                for wb, val in zip(aux_wb, extras):
+                    if wb is not None:
+                        new_aux[wb] = val
+            outs = []
+            for h in head_refs:
+                if h[0] == "arg":
+                    outs.append(arg_vals[h[1]])
+                elif h[0] == "aux":
+                    outs.append(new_aux[h[1]])
+                else:
+                    outs.append(results[h[1]][h[2]])
+            return tuple(outs), tuple(new_aux)
+
+        return run
+
+    def _get_fn(self, kind, is_train):
+        import jax
+        key = (kind, is_train)
+        fn = self._fns.get(key)
+        if fn is not None:
+            return fn
+        run = self._make_graph_fn(is_train)
+        if kind == "fwd":
+            fn = jax.jit(run)
+        else:
+            gpos = self._grad_positions
+
+            def fwdbwd(arg_vals, aux_vals, rng_keys, out_grads):
+                def f(gvals):
+                    full = list(arg_vals)
+                    for p, v in zip(gpos, gvals):
+                        full[p] = v
+                    outs, new_aux = run(tuple(full), aux_vals, rng_keys)
+                    return outs, new_aux
+                outs, vjp_fn, new_aux = jax.vjp(
+                    f, [arg_vals[p] for p in gpos], has_aux=True)
+                grads, = vjp_fn(tuple(out_grads))
+                return outs, new_aux, grads
+
+            fn = jax.jit(fwdbwd)
+        self._fns[key] = fn
+        return fn
+
+    # -- execution -------------------------------------------------------
+    def _gather_inputs(self, kwargs):
+        from .ndarray import NDArray
+        if kwargs:
+            for k, v in kwargs.items():
+                if k not in self.arg_dict:
+                    raise MXNetError("unknown argument %s" % k)
+                if isinstance(v, NDArray):
+                    self.arg_dict[k]._set_data(v._data)
+                else:
+                    import jax.numpy as jnp
+                    self.arg_dict[k]._set_data(
+                        jnp.asarray(v, dtype=self.arg_dict[k].dtype))
+        args = tuple(a._data for a in self.arg_arrays)
+        aux = tuple(a._data for a in self.aux_arrays)
+        return args, aux
+
+    def _rngs(self):
+        from . import random as _random
+        return tuple(_random.new_key() for _ in range(self._rng_count))
+
+    def _store_outputs(self, outs):
+        from .ndarray import NDArray
+        for i, o in enumerate(outs):
+            if self.outputs[i] is None:
+                self.outputs[i] = NDArray(o, ctx=self._ctx)
+            else:
+                self.outputs[i]._set_data(o)
+
+    def _store_aux(self, new_aux):
+        for arr, val in zip(self.aux_arrays, new_aux):
+            arr._set_data(val)
+
+    def forward(self, is_train=False, **kwargs):
+        args, aux = self._gather_inputs(kwargs)
+        fn = self._get_fn("fwd", bool(is_train))
+        outs, new_aux = fn(args, aux, self._rngs())
+        self._store_outputs(outs)
+        if is_train:
+            self._store_aux(new_aux)
+        if self._monitor_callback is not None:
+            self._run_monitor()
+        return self.outputs
+
+    def backward(self, out_grads=None, is_train=True):
+        self.forward_backward(out_grads=out_grads, is_train=is_train,
+                              _refresh_outputs=True)
+
+    def forward_backward(self, out_grads=None, is_train=True,
+                         _refresh_outputs=True, **kwargs):
+        """Fused forward+backward in ONE XLA computation (the TPU
+        replacement for the reference's overlap of backprop with engine-
+        scheduled gradient reduction)."""
+        import jax.numpy as jnp
+        from .ndarray import NDArray
+        if not self._grad_positions:
+            # nothing requires grad: just forward
+            self.forward(is_train=is_train, **kwargs)
+            return
+        args, aux = self._gather_inputs(kwargs)
+        fn = self._get_fn("fwdbwd", bool(is_train))
+        if out_grads is None:
+            ogs = tuple(
+                jnp.ones(tuple(s.shape), s.dtype)
+                for s in self._out_structs(args, aux))
+        else:
+            if isinstance(out_grads, NDArray):
+                out_grads = [out_grads]
+            ogs = tuple(g._data for g in out_grads)
+        outs, new_aux, grads = fn(args, aux, self._rngs(), ogs)
+        if _refresh_outputs:
+            self._store_outputs(outs)
+        if is_train:
+            self._store_aux(new_aux)
+        for p, g in zip(self._grad_positions, grads):
+            name = self.arg_names[p]
+            tgt = self.grad_arrays[p]
+            if tgt is None:
+                continue
+            if self._grad_req[name] == "add":
+                tgt._set_data(tgt._data + g)
+            else:
+                tgt._set_data(g)
+        if self._monitor_callback is not None:
+            self._run_monitor()
+
+    def _out_structs(self, args, aux):
+        import jax
+        key = ("ostruct", tuple((a.shape, str(a.dtype)) for a in args))
+        cached = self._fns.get(key)
+        if cached is None:
+            run = self._make_graph_fn(True)
+            rngs = self._rngs() if self._rng_count else ()
+            outs, _ = jax.eval_shape(run, args, aux, rngs)
+            cached = outs
+            self._fns[key] = cached
+        return cached
+
+    # -- misc API parity -------------------------------------------------
+    def reshape(self, partial_shaping=False, allow_up_sizing=False, **kwargs):
+        """Return an executor for new input shapes, sharing parameters."""
+        from .ndarray import zeros as nd_zeros
+        arg_shapes, _, aux_shapes = self._symbol.infer_shape(**kwargs)
+        new_args = []
+        for name, arr, shape in zip(self.arg_names, self.arg_arrays,
+                                    arg_shapes):
+            if tuple(arr.shape) == tuple(shape):
+                new_args.append(arr)
+            else:
+                new_args.append(nd_zeros(shape, ctx=self._ctx,
+                                         dtype=arr.dtype))
+        grads = {}
+        for name, g in zip(self.arg_names, self.grad_arrays):
+            if g is not None:
+                idx = self.arg_names.index(name)
+                if tuple(g.shape) == tuple(arg_shapes[idx]):
+                    grads[name] = g
+                else:
+                    grads[name] = nd_zeros(arg_shapes[idx], ctx=self._ctx,
+                                           dtype=g.dtype)
+        return Executor(self._symbol, self._ctx, new_args, grads,
+                        self._grad_req, self.aux_arrays)
+
+    def copy_params_from(self, arg_params, aux_params=None,
+                         allow_extra_params=False):
+        for name, arr in arg_params.items():
+            if name in self.arg_dict:
+                self.arg_dict[name]._set_data(
+                    arr.astype(self.arg_dict[name].dtype)._data)
+            elif not allow_extra_params:
+                raise MXNetError("Found name \"%s\" that is not in the "
+                                 "arguments" % name)
+        if aux_params:
+            for name, arr in aux_params.items():
+                if name in self.aux_dict:
+                    self.aux_dict[name]._set_data(
+                        arr.astype(self.aux_dict[name].dtype)._data)
+                elif not allow_extra_params:
+                    raise MXNetError("Found name \"%s\" that is not in the "
+                                     "auxiliary states" % name)
+
+    def set_monitor_callback(self, callback, monitor_all=False):
+        self._monitor_callback = callback
+
+    def _run_monitor(self):
+        for name, out in zip(self.output_names, self.outputs):
+            self._monitor_callback(name, out)
+
+    @property
+    def output_dict(self):
+        return dict(zip(self.output_names, self.outputs))
+
+    def debug_str(self):
+        lines = ["Symbol Outputs:"]
+        for n in self.output_names:
+            lines.append("\toutput=%s" % n)
+        for op, nattrs, bindings, rs, aux_wb, slot in self._plan:
+            lines.append("Op:%s" % op.name)
+        return "\n".join(lines)

@@ -1,0 +1,23 @@
+"""NDArray namespace: the imperative API surface (``mx.nd``).
+
+Op functions are code-generated from the registry at import time,
+mirroring python/mxnet/ndarray/register.py in the reference.
+"""
+from .ndarray import (NDArray, invoke_nd, array, zeros, ones, full, empty,
+                      arange, linspace, eye, moveaxis, concatenate, save,
+                      load, waitall, add, subtract, multiply, divide, modulo,
+                      power, maximum, minimum, hypot, equal, not_equal,
+                      greater, greater_equal, lesser, lesser_equal,
+                      logical_and, logical_or, logical_xor, true_divide)
+from . import random
+from .register import install_ops as _install_ops
+
+_install_ops(globals())
+
+# `op` alias module-like access (mx.nd.op.FullyConnected)
+import types as _types
+
+op = _types.ModuleType(__name__ + ".op")
+_install_ops(op.__dict__)
+
+# sparse is populated by the sparse module when imported

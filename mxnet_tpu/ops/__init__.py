@@ -1,0 +1,19 @@
+"""Operator library: importing this package registers all operators.
+
+The registry (``mxnet_tpu.ops.registry``) is the TPU-native replacement
+for the reference's NNVM op registry + C ABI op listing
+(MXSymbolGetAtomicSymbolInfo): frontends code-generate their namespaces
+from it, exactly as python/mxnet/ndarray/register.py does.
+"""
+from .registry import (OpDef, register, get_op, find_op, list_ops, invoke,
+                       normalize_attrs, attr_key)
+
+from . import elemwise      # noqa: F401
+from . import reduce        # noqa: F401
+from . import matrix        # noqa: F401
+from . import indexing      # noqa: F401
+from . import init_ops      # noqa: F401
+from . import random_ops    # noqa: F401
+from . import nn            # noqa: F401
+from . import optimizer_ops  # noqa: F401
+from . import linalg        # noqa: F401

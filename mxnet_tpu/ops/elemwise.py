@@ -1,0 +1,236 @@
+"""Elementwise operators: unary, binary (broadcasting), scalar, logical.
+
+Reference coverage: src/operator/tensor/elemwise_unary_op_basic.cc,
+elemwise_unary_op_trig.cc, elemwise_binary_op_basic.cc,
+elemwise_binary_broadcast_op_*.cc, elemwise_binary_scalar_op_*.cc.
+
+TPU design: each op is one jnp expression; XLA fuses chains of these into
+single VPU loops, which is what the reference's mshadow expression
+templates and manual kernel fusion were for. MXNet's dtype conventions
+are preserved: comparisons and logical ops return 0/1 in the *input*
+dtype (not bool), scalar operands are cast to the array dtype.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register
+
+_D = ("data",)
+_LR = ("lhs", "rhs")
+
+
+# ---------------------------------------------------------------------------
+# Unary
+# ---------------------------------------------------------------------------
+
+def _reg_unary(name, fn, aliases=()):
+    register(name, lambda attrs, x, _f=fn: _f(x), arg_names=_D, aliases=aliases)
+
+
+def _erfinv(x):
+    from jax.scipy.special import erfinv
+    return erfinv(x)
+
+
+def _gamma(x):
+    try:
+        from jax.scipy.special import gamma as _g
+        return _g(x)
+    except ImportError:  # older jax: positive-domain fallback
+        from jax.scipy.special import gammaln
+        return jnp.exp(gammaln(x)) * jnp.where(x > 0, 1.0, jnp.nan)
+
+
+_UNARY = {
+    "abs": jnp.abs, "sign": jnp.sign, "ceil": jnp.ceil, "floor": jnp.floor,
+    "trunc": jnp.trunc, "round": jnp.round, "rint": jnp.rint,
+    "fix": lambda x: jnp.trunc(x),
+    "square": jnp.square, "sqrt": jnp.sqrt,
+    "rsqrt": lambda x: jax.lax.rsqrt(x),
+    "cbrt": jnp.cbrt, "rcbrt": lambda x: 1.0 / jnp.cbrt(x),
+    "exp": jnp.exp, "log": jnp.log, "log10": jnp.log10, "log2": jnp.log2,
+    "log1p": jnp.log1p, "expm1": jnp.expm1,
+    "sin": jnp.sin, "cos": jnp.cos, "tan": jnp.tan,
+    "arcsin": jnp.arcsin, "arccos": jnp.arccos, "arctan": jnp.arctan,
+    "sinh": jnp.sinh, "cosh": jnp.cosh, "tanh": jnp.tanh,
+    "arcsinh": jnp.arcsinh, "arccosh": jnp.arccosh, "arctanh": jnp.arctanh,
+    "degrees": jnp.degrees, "radians": jnp.radians,
+    "relu": lambda x: jnp.maximum(x, 0),
+    "sigmoid": jax.nn.sigmoid,
+    "softsign": lambda x: x / (1 + jnp.abs(x)),
+    "erf": jax.scipy.special.erf,
+    "erfinv": _erfinv,
+    "gamma": _gamma,
+    "gammaln": jax.scipy.special.gammaln,
+    "reciprocal": lambda x: 1.0 / x,
+    "negative": jnp.negative,
+    "logical_not": lambda x: (x == 0).astype(x.dtype),
+    "_copy": lambda x: x,
+    "identity": lambda x: x,
+}
+
+for _name, _fn in _UNARY.items():
+    _reg_unary(_name, _fn)
+
+register("BlockGrad", lambda attrs, x: jax.lax.stop_gradient(x),
+         arg_names=_D, aliases=("stop_gradient",))
+register("zeros_like", lambda attrs, x: jnp.zeros_like(x), arg_names=_D)
+register("ones_like", lambda attrs, x: jnp.ones_like(x), arg_names=_D)
+register("shape_array",
+         lambda attrs, x: jnp.asarray(x.shape, dtype=jnp.int64
+                                      if jax.config.jax_enable_x64 else jnp.int32),
+         arg_names=_D)
+register("size_array",
+         lambda attrs, x: jnp.asarray([x.size], dtype=jnp.int32), arg_names=_D)
+register("Cast",
+         lambda attrs, x: x.astype(jnp.dtype(attrs["dtype"])),
+         arg_names=_D, defaults={"dtype": "float32"}, aliases=("cast",))
+register("clip",
+         lambda attrs, x: jnp.clip(x, float(attrs["a_min"]), float(attrs["a_max"])),
+         arg_names=_D, defaults={"a_min": 0.0, "a_max": 1.0})
+
+
+def _smooth_l1(attrs, x):
+    sigma = float(attrs.get("scalar", 1.0))
+    s2 = sigma * sigma
+    return jnp.where(jnp.abs(x) < 1.0 / s2,
+                     0.5 * s2 * jnp.square(x),
+                     jnp.abs(x) - 0.5 / s2)
+
+
+register("smooth_l1", _smooth_l1, arg_names=_D, defaults={"scalar": 1.0})
+
+
+# make_loss: forward identity; backward injects grad_scale regardless of
+# the incoming cotangent (reference: src/operator/make_loss.cc semantics).
+def _make_loss(attrs, x):
+    scale = float(attrs.get("grad_scale", 1.0))
+
+    @jax.custom_vjp
+    def f(v):
+        return v
+
+    def f_fwd(v):
+        return v, v.shape
+
+    def f_bwd(res, g):
+        del g
+        return (jnp.full(res, scale),)
+
+    f.defvjp(f_fwd, f_bwd)
+    return f(x)
+
+
+register("make_loss", _make_loss, arg_names=_D,
+         defaults={"grad_scale": 1.0, "valid_thresh": 0.0,
+                   "normalization": "null"}, aliases=("MakeLoss",))
+
+
+# ---------------------------------------------------------------------------
+# Binary broadcasting
+# ---------------------------------------------------------------------------
+
+def _cmp_cast(fn):
+    def run(x, y):
+        out_dtype = jnp.result_type(x.dtype, y.dtype)
+        return fn(x, y).astype(out_dtype)
+    return run
+
+
+_BINARY = {
+    "broadcast_add": jnp.add,
+    "broadcast_sub": jnp.subtract,
+    "broadcast_mul": jnp.multiply,
+    "broadcast_div": jnp.divide,
+    "broadcast_mod": jnp.mod,
+    "broadcast_power": jnp.power,
+    "broadcast_maximum": jnp.maximum,
+    "broadcast_minimum": jnp.minimum,
+    "broadcast_hypot": jnp.hypot,
+    "broadcast_equal": _cmp_cast(jnp.equal),
+    "broadcast_not_equal": _cmp_cast(jnp.not_equal),
+    "broadcast_greater": _cmp_cast(jnp.greater),
+    "broadcast_greater_equal": _cmp_cast(jnp.greater_equal),
+    "broadcast_lesser": _cmp_cast(jnp.less),
+    "broadcast_lesser_equal": _cmp_cast(jnp.less_equal),
+    "broadcast_logical_and": _cmp_cast(lambda x, y: (x != 0) & (y != 0)),
+    "broadcast_logical_or": _cmp_cast(lambda x, y: (x != 0) | (y != 0)),
+    "broadcast_logical_xor": _cmp_cast(lambda x, y: (x != 0) ^ (y != 0)),
+}
+
+_BINARY_ALIASES = {
+    "broadcast_add": ("broadcast_plus", "elemwise_add", "_plus", "_add"),
+    "broadcast_sub": ("broadcast_minus", "elemwise_sub", "_minus", "_sub"),
+    "broadcast_mul": ("elemwise_mul", "_mul"),
+    "broadcast_div": ("elemwise_div", "_div"),
+    "broadcast_mod": ("_mod",),
+    "broadcast_power": ("_power", "_pow"),
+    "broadcast_maximum": ("_maximum",),
+    "broadcast_minimum": ("_minimum",),
+    "broadcast_hypot": ("_hypot",),
+    "broadcast_equal": ("_equal",),
+    "broadcast_not_equal": ("_not_equal",),
+    "broadcast_greater": ("_greater",),
+    "broadcast_greater_equal": ("_greater_equal",),
+    "broadcast_lesser": ("_lesser",),
+    "broadcast_lesser_equal": ("_lesser_equal",),
+    "broadcast_logical_and": ("_logical_and",),
+    "broadcast_logical_or": ("_logical_or",),
+    "broadcast_logical_xor": ("_logical_xor",),
+}
+
+for _name, _fn in _BINARY.items():
+    register(_name, (lambda attrs, x, y, _f=_fn: _f(x, y)),
+             arg_names=_LR, aliases=_BINARY_ALIASES.get(_name, ()))
+
+
+# ---------------------------------------------------------------------------
+# Scalar ops (attr "scalar"; scalar cast to array dtype, MXNet semantics)
+# ---------------------------------------------------------------------------
+
+def _sc(x, attrs):
+    s = attrs.get("scalar", 0.0)
+    if jnp.issubdtype(jnp.asarray(x).dtype, jnp.integer):
+        return jnp.asarray(int(s), dtype=x.dtype)
+    return jnp.asarray(s, dtype=x.dtype)
+
+
+_SCALAR = {
+    "_plus_scalar": lambda x, s: x + s,
+    "_minus_scalar": lambda x, s: x - s,
+    "_rminus_scalar": lambda x, s: s - x,
+    "_mul_scalar": lambda x, s: x * s,
+    "_div_scalar": lambda x, s: x / s,
+    "_rdiv_scalar": lambda x, s: s / x,
+    "_mod_scalar": lambda x, s: jnp.mod(x, s),
+    "_rmod_scalar": lambda x, s: jnp.mod(s, x),
+    "_power_scalar": lambda x, s: jnp.power(x, s),
+    "_rpower_scalar": lambda x, s: jnp.power(s, x),
+    "_maximum_scalar": lambda x, s: jnp.maximum(x, s),
+    "_minimum_scalar": lambda x, s: jnp.minimum(x, s),
+    "_hypot_scalar": lambda x, s: jnp.hypot(x, s),
+    "_equal_scalar": lambda x, s: (x == s).astype(x.dtype),
+    "_not_equal_scalar": lambda x, s: (x != s).astype(x.dtype),
+    "_greater_scalar": lambda x, s: (x > s).astype(x.dtype),
+    "_greater_equal_scalar": lambda x, s: (x >= s).astype(x.dtype),
+    "_lesser_scalar": lambda x, s: (x < s).astype(x.dtype),
+    "_lesser_equal_scalar": lambda x, s: (x <= s).astype(x.dtype),
+    "_logical_and_scalar": lambda x, s: ((x != 0) & (s != 0)).astype(x.dtype),
+    "_logical_or_scalar": lambda x, s: ((x != 0) | (s != 0)).astype(x.dtype),
+    "_logical_xor_scalar": lambda x, s: ((x != 0) ^ (s != 0)).astype(x.dtype),
+}
+
+for _name, _fn in _SCALAR.items():
+    register(_name,
+             (lambda attrs, x, _f=_fn: _f(x, _sc(x, attrs))),
+             arg_names=_D, defaults={"scalar": 0.0})
+
+register("_scatter_elemwise_div",
+         lambda attrs, x, y: x / y, arg_names=_LR)
+
+
+# where / maximum-like ternaries
+register("where", lambda attrs, c, x, y: jnp.where(c != 0, x, y),
+         arg_names=("condition", "x", "y"))

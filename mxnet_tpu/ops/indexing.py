@@ -1,0 +1,207 @@
+"""Indexing / gather / ordering operators.
+
+Reference: src/operator/tensor/indexing_op.{h,cc} (take, batch_take,
+one_hot, gather_nd, scatter_nd, Embedding), ordering_op.cc (topk, sort,
+argsort). XLA lowers gathers/scatters natively; no hand-written kernels
+needed.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register
+
+_D = ("data",)
+
+
+def _take(attrs, a, indices):
+    axis = int(attrs.get("axis", 0))
+    mode = attrs.get("mode", "clip")
+    idx = indices.astype(jnp.int32)
+    n = a.shape[axis]
+    if mode == "wrap":
+        idx = jnp.mod(idx, n)
+    else:  # clip
+        idx = jnp.clip(idx, 0, n - 1)
+    return jnp.take(a, idx, axis=axis)
+
+
+register("take", _take, arg_names=("a", "indices"),
+         defaults={"axis": 0, "mode": "clip"})
+
+
+def _batch_take(attrs, a, indices):
+    idx = jnp.clip(indices.astype(jnp.int32), 0, a.shape[1] - 1)
+    return jnp.take_along_axis(a, idx.reshape(-1, 1), axis=1).reshape(idx.shape)
+
+
+register("batch_take", _batch_take, arg_names=("a", "indices"))
+
+
+def _one_hot(attrs, indices):
+    depth = int(attrs["depth"])
+    on = float(attrs.get("on_value", 1.0))
+    off = float(attrs.get("off_value", 0.0))
+    dtype = jnp.dtype(attrs.get("dtype", "float32"))
+    idx = indices.astype(jnp.int32)
+    eye = jax.nn.one_hot(idx, depth, dtype=dtype)
+    return eye * jnp.asarray(on - off, dtype) + jnp.asarray(off, dtype)
+
+
+register("one_hot", _one_hot, arg_names=("indices",),
+         defaults={"depth": 1, "on_value": 1.0, "off_value": 0.0,
+                   "dtype": "float32"})
+
+
+def _embedding(attrs, data, weight):
+    idx = data.astype(jnp.int32)
+    idx = jnp.clip(idx, 0, weight.shape[0] - 1)
+    return jnp.take(weight, idx, axis=0)
+
+
+register("Embedding", _embedding, arg_names=("data", "weight"),
+         defaults={"input_dim": 0, "output_dim": 0, "dtype": "float32",
+                   "sparse_grad": False})
+
+
+def _gather_nd(attrs, data, indices):
+    idx = indices.astype(jnp.int32)
+    m = idx.shape[0]
+    return data[tuple(idx[i] for i in range(m))]
+
+
+register("gather_nd", _gather_nd, arg_names=("data", "indices"))
+
+
+def _scatter_nd(attrs, data, indices):
+    shape = tuple(attrs["shape"])
+    idx = indices.astype(jnp.int32)
+    m = idx.shape[0]
+    out = jnp.zeros(shape, dtype=data.dtype)
+    return out.at[tuple(idx[i] for i in range(m))].set(data)
+
+
+register("scatter_nd", _scatter_nd, arg_names=("data", "indices"),
+         defaults={"shape": ()})
+
+
+def _pick(attrs, data, index):
+    axis = attrs.get("axis", -1)
+    axis = data.ndim - 1 if axis is None else int(axis)
+    keepdims = bool(attrs.get("keepdims", False))
+    mode = attrs.get("mode", "clip")
+    idx = index.astype(jnp.int32)
+    n = data.shape[axis]
+    idx = jnp.mod(idx, n) if mode == "wrap" else jnp.clip(idx, 0, n - 1)
+    idxe = jnp.expand_dims(idx, axis % data.ndim)
+    out = jnp.take_along_axis(data, idxe, axis=axis)
+    if not keepdims:
+        out = jnp.squeeze(out, axis=axis % data.ndim)
+    return out
+
+
+register("pick", _pick, arg_names=("data", "index"),
+         defaults={"axis": -1, "keepdims": False, "mode": "clip"},
+         aliases=("choose_element_0index",))
+
+
+# ---------------------------------------------------------------------------
+# ordering
+# ---------------------------------------------------------------------------
+
+def _sort(attrs, x):
+    axis = attrs.get("axis", -1)
+    is_ascend = bool(attrs.get("is_ascend", True))
+    if axis is None:
+        x = x.reshape(-1)
+        axis = 0
+    out = jnp.sort(x, axis=int(axis))
+    if not is_ascend:
+        out = jnp.flip(out, axis=int(axis))
+    return out
+
+
+register("sort", _sort, arg_names=_D, defaults={"axis": -1, "is_ascend": True})
+
+
+def _argsort(attrs, x):
+    axis = attrs.get("axis", -1)
+    is_ascend = bool(attrs.get("is_ascend", True))
+    dtype = jnp.dtype(attrs.get("dtype", "float32"))
+    if axis is None:
+        x = x.reshape(-1)
+        axis = 0
+    idx = jnp.argsort(x, axis=int(axis))
+    if not is_ascend:
+        idx = jnp.flip(idx, axis=int(axis))
+    return idx.astype(dtype)
+
+
+register("argsort", _argsort, arg_names=_D,
+         defaults={"axis": -1, "is_ascend": True, "dtype": "float32"})
+
+
+def _topk_outputs(attrs):
+    ret_typ = attrs.get("ret_typ", "indices")
+    return 2 if ret_typ == "both" else 1
+
+
+def _topk(attrs, x):
+    axis = attrs.get("axis", -1)
+    k = int(attrs.get("k", 1))
+    ret_typ = attrs.get("ret_typ", "indices")
+    is_ascend = bool(attrs.get("is_ascend", False))
+    dtype = jnp.dtype(attrs.get("dtype", "float32"))
+    if axis is None:
+        x = x.reshape(-1)
+        axis = 0
+    axis = int(axis) % x.ndim
+    xs = jnp.moveaxis(x, axis, -1)
+    neg = xs if is_ascend else -xs
+    # lax.top_k returns largest; negate for ascending
+    vals, idx = jax.lax.top_k(-neg, k)
+    vals = vals if is_ascend else -(-vals)  # placeholder symmetry
+    sel_vals = jnp.take_along_axis(xs, idx, axis=-1)
+    sel_vals = jnp.moveaxis(sel_vals, -1, axis)
+    idx_o = jnp.moveaxis(idx, -1, axis)
+    if ret_typ == "value":
+        return sel_vals
+    if ret_typ == "indices":
+        return idx_o.astype(dtype)
+    if ret_typ == "mask":
+        mask = jnp.zeros(xs.shape, dtype=x.dtype)
+        mask = mask.at[..., 0].set(0)  # shape anchor
+        onehots = jax.nn.one_hot(idx, xs.shape[-1], dtype=x.dtype).sum(-2)
+        return jnp.moveaxis(onehots, -1, axis)
+    # both
+    return sel_vals, idx_o.astype(dtype)
+
+
+register("topk", _topk, arg_names=_D,
+         defaults={"axis": -1, "k": 1, "ret_typ": "indices",
+                   "is_ascend": False, "dtype": "float32"},
+         num_outputs=_topk_outputs)
+
+
+def _boolean_mask(attrs, data, index):
+    # Dynamic-shape op: XLA needs static shapes, so we return data rows
+    # where mask!=0 compacted to the front and zero-padded (documented
+    # divergence); host fallback in NDArray layer gives exact semantics.
+    axis = int(attrs.get("axis", 0))
+    mask = (index != 0)
+    order = jnp.argsort(~mask, stable=True)
+    return jnp.take(data, order, axis=axis) * jnp.expand_dims(
+        jnp.sort(mask)[::-1], tuple(range(1, data.ndim))).astype(data.dtype)
+
+
+register("_contrib_boolean_mask", _boolean_mask, arg_names=("data", "index"),
+         defaults={"axis": 0})
+
+
+def _index_copy(attrs, old, idx, new):
+    return old.at[idx.astype(jnp.int32)].set(new)
+
+
+register("_contrib_index_copy", _index_copy,
+         arg_names=("old_tensor", "index_vector", "new_tensor"))

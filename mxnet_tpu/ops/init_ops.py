@@ -1,0 +1,95 @@
+"""Tensor-creation (nullary) operators.
+
+Reference: src/operator/tensor/init_op.cc (_zeros/_ones/_full/_arange/
+_linspace/_eye). The ``ctx`` attribute is honored by the NDArray layer
+(device placement), not by the op body — placement is a jax.device_put,
+not an allocator concern as in the reference's storage managers.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .registry import register
+
+
+def _dtype(attrs, default="float32"):
+    return jnp.dtype(attrs.get("dtype") or default)
+
+
+register("_zeros",
+         lambda attrs: jnp.zeros(tuple(attrs.get("shape", ())), _dtype(attrs)),
+         arg_names=(), defaults={"shape": (), "dtype": "float32", "ctx": None})
+
+register("_ones",
+         lambda attrs: jnp.ones(tuple(attrs.get("shape", ())), _dtype(attrs)),
+         arg_names=(), defaults={"shape": (), "dtype": "float32", "ctx": None})
+
+register("_full",
+         lambda attrs: jnp.full(tuple(attrs.get("shape", ())),
+                                attrs.get("value", 0.0), _dtype(attrs)),
+         arg_names=(),
+         defaults={"shape": (), "value": 0.0, "dtype": "float32", "ctx": None})
+
+
+def _arange(attrs):
+    start = float(attrs.get("start", 0.0))
+    stop = attrs.get("stop", None)
+    step = float(attrs.get("step", 1.0))
+    repeat = int(attrs.get("repeat", 1))
+    dt = _dtype(attrs)
+    if stop is None:
+        out = jnp.arange(0.0, start, step)
+    else:
+        out = jnp.arange(start, float(stop), step)
+    if repeat > 1:
+        out = jnp.repeat(out, repeat)
+    return out.astype(dt)
+
+
+register("_arange", _arange, arg_names=(),
+         defaults={"start": 0.0, "stop": None, "step": 1.0, "repeat": 1,
+                   "infer_range": False, "dtype": "float32", "ctx": None})
+
+
+def _linspace(attrs):
+    return jnp.linspace(float(attrs.get("start", 0.0)),
+                        float(attrs.get("stop", 1.0)),
+                        int(attrs.get("num", 50)),
+                        endpoint=bool(attrs.get("endpoint", True)),
+                        dtype=_dtype(attrs))
+
+
+register("_linspace", _linspace, arg_names=(),
+         defaults={"start": 0.0, "stop": 1.0, "num": 50, "endpoint": True,
+                   "dtype": "float32", "ctx": None})
+
+
+def _eye(attrs):
+    N = int(attrs.get("N", 0))
+    M = attrs.get("M", 0)
+    M = N if not M else int(M)
+    return jnp.eye(N, M, k=int(attrs.get("k", 0)), dtype=_dtype(attrs))
+
+
+register("_eye", _eye, arg_names=(),
+         defaults={"N": 0, "M": 0, "k": 0, "dtype": "float32", "ctx": None})
+
+
+def _arange_like(attrs, x):
+    axis = attrs.get("axis", None)
+    start = float(attrs.get("start", 0.0))
+    step = float(attrs.get("step", 1.0))
+    repeat = int(attrs.get("repeat", 1))
+    if axis is None:
+        n = x.size
+        out = (start + step * jnp.arange(n, dtype=x.dtype)).reshape(x.shape)
+    else:
+        n = x.shape[int(axis)]
+        out = start + step * jnp.arange(n, dtype=x.dtype)
+    if repeat > 1:
+        out = jnp.repeat(out, repeat)
+    return out
+
+
+register("_contrib_arange_like", _arange_like, arg_names=("data",),
+         defaults={"start": 0.0, "step": 1.0, "repeat": 1, "axis": None})

@@ -1,0 +1,207 @@
+"""Linear-algebra operators (``linalg_*`` namespace).
+
+Reference: src/operator/tensor/la_op.cc (gemm/gemm2/potrf/potri/trsm/trmm/
+syrk/gelqf/sumlogdiag/extractdiag/maketrian...). Bodies map to
+jnp.linalg / lax.linalg — XLA has native TPU lowerings for these.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register
+
+
+def _t(x, transpose):
+    return jnp.swapaxes(x, -1, -2) if transpose else x
+
+
+def _gemm2(attrs, a, b):
+    alpha = float(attrs.get("alpha", 1.0))
+    return alpha * jnp.matmul(_t(a, attrs.get("transpose_a", False)),
+                              _t(b, attrs.get("transpose_b", False)))
+
+
+register("_linalg_gemm2", _gemm2, arg_names=("A", "B"),
+         defaults={"alpha": 1.0, "transpose_a": False, "transpose_b": False,
+                   "axis": -2}, aliases=("linalg_gemm2",))
+
+
+def _gemm(attrs, a, b, c):
+    alpha = float(attrs.get("alpha", 1.0))
+    beta = float(attrs.get("beta", 1.0))
+    return alpha * jnp.matmul(_t(a, attrs.get("transpose_a", False)),
+                              _t(b, attrs.get("transpose_b", False))) \
+        + beta * c
+
+
+register("_linalg_gemm", _gemm, arg_names=("A", "B", "C"),
+         defaults={"alpha": 1.0, "beta": 1.0, "transpose_a": False,
+                   "transpose_b": False, "axis": -2},
+         aliases=("linalg_gemm",))
+
+
+def _potrf(attrs, a):
+    lower = bool(attrs.get("lower", True))
+    L = jnp.linalg.cholesky(a)
+    return L if lower else jnp.swapaxes(L, -1, -2)
+
+
+register("_linalg_potrf", _potrf, arg_names=("A",),
+         defaults={"lower": True}, aliases=("linalg_potrf",))
+
+
+def _potri(attrs, a):
+    lower = bool(attrs.get("lower", True))
+    L = a if lower else jnp.swapaxes(a, -1, -2)
+    eye = jnp.broadcast_to(jnp.eye(a.shape[-1], dtype=a.dtype), a.shape)
+    Linv = lax.linalg.triangular_solve(L, eye, lower=True, left_side=True)
+    return jnp.matmul(jnp.swapaxes(Linv, -1, -2), Linv)
+
+
+register("_linalg_potri", _potri, arg_names=("A",),
+         defaults={"lower": True}, aliases=("linalg_potri",))
+
+
+def _trsm(attrs, a, b):
+    alpha = float(attrs.get("alpha", 1.0))
+    transpose = bool(attrs.get("transpose", False))
+    rightside = bool(attrs.get("rightside", False))
+    lower = bool(attrs.get("lower", True))
+    out = lax.linalg.triangular_solve(
+        a, alpha * b, left_side=not rightside, lower=lower,
+        transpose_a=transpose)
+    return out
+
+
+register("_linalg_trsm", _trsm, arg_names=("A", "B"),
+         defaults={"alpha": 1.0, "transpose": False, "rightside": False,
+                   "lower": True}, aliases=("linalg_trsm",))
+
+
+def _trmm(attrs, a, b):
+    alpha = float(attrs.get("alpha", 1.0))
+    transpose = bool(attrs.get("transpose", False))
+    rightside = bool(attrs.get("rightside", False))
+    lower = bool(attrs.get("lower", True))
+    n = a.shape[-1]
+    tri = jnp.tril(a) if lower else jnp.triu(a)
+    tri = _t(tri, transpose)
+    if rightside:
+        return alpha * jnp.matmul(b, tri)
+    return alpha * jnp.matmul(tri, b)
+
+
+register("_linalg_trmm", _trmm, arg_names=("A", "B"),
+         defaults={"alpha": 1.0, "transpose": False, "rightside": False,
+                   "lower": True}, aliases=("linalg_trmm",))
+
+
+def _syrk(attrs, a):
+    alpha = float(attrs.get("alpha", 1.0))
+    transpose = bool(attrs.get("transpose", False))
+    at = _t(a, transpose)
+    return alpha * jnp.matmul(at, jnp.swapaxes(at, -1, -2))
+
+
+register("_linalg_syrk", _syrk, arg_names=("A",),
+         defaults={"alpha": 1.0, "transpose": False},
+         aliases=("linalg_syrk",))
+
+
+def _sumlogdiag(attrs, a):
+    d = jnp.diagonal(a, axis1=-2, axis2=-1)
+    return jnp.sum(jnp.log(d), axis=-1)
+
+
+register("_linalg_sumlogdiag", _sumlogdiag, arg_names=("A",),
+         aliases=("linalg_sumlogdiag",))
+
+
+def _extractdiag(attrs, a):
+    offset = int(attrs.get("offset", 0))
+    return jnp.diagonal(a, offset=offset, axis1=-2, axis2=-1)
+
+
+register("_linalg_extractdiag", _extractdiag, arg_names=("A",),
+         defaults={"offset": 0}, aliases=("linalg_extractdiag",))
+
+
+def _makediag(attrs, a):
+    offset = int(attrs.get("offset", 0))
+    n = a.shape[-1] + abs(offset)
+    eye = jnp.eye(n, k=offset, dtype=a.dtype)
+    return jnp.expand_dims(a, -1) * eye[jnp.abs(jnp.arange(n) - max(offset, 0)).argsort()[:a.shape[-1]]] \
+        if False else _makediag_simple(a, offset)
+
+
+def _makediag_simple(a, offset):
+    n = a.shape[-1] + abs(offset)
+    out = jnp.zeros(a.shape[:-1] + (n, n), dtype=a.dtype)
+    idx = jnp.arange(a.shape[-1])
+    if offset >= 0:
+        return out.at[..., idx, idx + offset].set(a)
+    return out.at[..., idx - offset, idx].set(a)
+
+
+register("_linalg_makediag",
+         lambda attrs, a: _makediag_simple(a, int(attrs.get("offset", 0))),
+         arg_names=("A",), defaults={"offset": 0},
+         aliases=("linalg_makediag",))
+
+
+def _extracttrian(attrs, a):
+    offset = int(attrs.get("offset", 0))
+    lower = bool(attrs.get("lower", True))
+    n = a.shape[-1]
+    rows, cols = jnp.tril_indices(n, k=offset) if lower \
+        else jnp.triu_indices(n, k=offset)
+    return a[..., rows, cols]
+
+
+register("_linalg_extracttrian", _extracttrian, arg_names=("A",),
+         defaults={"offset": 0, "lower": True},
+         aliases=("linalg_extracttrian",))
+
+
+def _gelqf(attrs, a):
+    # LQ factorization: A = L Q. Via QR of A^T: A^T = Q' R'  =>  A = R'^T Q'^T
+    q, r = jnp.linalg.qr(jnp.swapaxes(a, -1, -2))
+    return jnp.swapaxes(r, -1, -2), jnp.swapaxes(q, -1, -2)
+
+
+register("_linalg_gelqf", _gelqf, arg_names=("A",), num_outputs=2,
+         aliases=("linalg_gelqf",))
+
+
+def _syevd(attrs, a):
+    w, v = jnp.linalg.eigh(a)
+    return jnp.swapaxes(v, -1, -2), w
+
+
+register("_linalg_syevd", _syevd, arg_names=("A",), num_outputs=2,
+         aliases=("linalg_syevd",))
+
+
+def _inverse(attrs, a):
+    return jnp.linalg.inv(a)
+
+
+register("_linalg_inverse", _inverse, arg_names=("A",),
+         aliases=("linalg_inverse",))
+
+
+def _det(attrs, a):
+    return jnp.linalg.det(a)
+
+
+register("_linalg_det", _det, arg_names=("A",), aliases=("linalg_det",))
+
+
+def _slogdet(attrs, a):
+    sign, logabs = jnp.linalg.slogdet(a)
+    return sign, logabs
+
+
+register("_linalg_slogdet", _slogdet, arg_names=("A",), num_outputs=2,
+         aliases=("linalg_slogdet",))

@@ -1,0 +1,379 @@
+"""Matrix / shape-manipulation operators.
+
+Reference: src/operator/tensor/matrix_op.cc (+ matrix_op-inl.h), dot-inl.h,
+slice/concat/stack/split/pad/tile/repeat/reverse/depth-space ops.
+MXNet's Reshape special codes (0, -1, -2, -3, -4, reverse) are implemented
+faithfully (reference: matrix_op-inl.h InferReshapeShape).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register
+
+_D = ("data",)
+_LR = ("lhs", "rhs")
+
+
+# ---------------------------------------------------------------------------
+# Reshape with MXNet special codes
+# ---------------------------------------------------------------------------
+
+def infer_reshape(src_shape, target, reverse=False):
+    """Resolve an MXNet reshape spec against a concrete input shape."""
+    src = list(src_shape)
+    tgt = list(target)
+    if reverse:
+        src = src[::-1]
+        tgt = tgt[::-1]
+        # -4's two split dims travel with it; reversing swaps their order.
+        out = _infer_reshape_fwd(src, _reverse_neg4(tgt))
+        return tuple(out[::-1])
+    return tuple(_infer_reshape_fwd(src, tgt))
+
+
+def _reverse_neg4(tgt):
+    # after list reversal, "-4 a b" appears as "b a -4"; rewrite to -4 b a
+    out = []
+    i = 0
+    while i < len(tgt):
+        if i + 2 < len(tgt) and tgt[i + 2] == -4:
+            out.extend([-4, tgt[i], tgt[i + 1]])
+            i += 3
+        else:
+            out.append(tgt[i])
+            i += 1
+    return out
+
+
+def _infer_reshape_fwd(src, tgt):
+    out = []
+    src_idx = 0
+    inf_idx = -1
+    i = 0
+    while i < len(tgt):
+        t = tgt[i]
+        if t > 0:
+            out.append(int(t))
+            src_idx += 1
+        elif t == 0:
+            out.append(src[src_idx])
+            src_idx += 1
+        elif t == -1:
+            inf_idx = len(out)
+            out.append(-1)
+            src_idx += 1
+        elif t == -2:
+            out.extend(src[src_idx:])
+            src_idx = len(src)
+        elif t == -3:
+            out.append(src[src_idx] * src[src_idx + 1])
+            src_idx += 2
+        elif t == -4:
+            d1, d2 = int(tgt[i + 1]), int(tgt[i + 2])
+            s = src[src_idx]
+            if d1 == -1 and d2 == -1:
+                raise ValueError("reshape: -4 with two -1s")
+            if d1 == -1:
+                d1 = s // d2
+            if d2 == -1:
+                d2 = s // d1
+            out.extend([d1, d2])
+            src_idx += 1
+            i += 2
+        else:
+            raise ValueError("reshape: invalid code %d" % t)
+        i += 1
+    if inf_idx >= 0:
+        known = 1
+        for v in out:
+            if v != -1:
+                known *= v
+        total = 1
+        for v in src:
+            total *= v
+        out[inf_idx] = total // known
+    return out
+
+
+def _reshape(attrs, x):
+    shape = attrs.get("shape", None)
+    if shape is None or shape == ():
+        return x.reshape(-1)
+    if isinstance(shape, int):
+        shape = (shape,)
+    new_shape = infer_reshape(x.shape, shape, bool(attrs.get("reverse", False)))
+    return x.reshape(new_shape)
+
+
+register("Reshape", _reshape, arg_names=_D,
+         defaults={"shape": None, "reverse": False}, aliases=("reshape",))
+
+register("reshape_like", lambda attrs, x, y: x.reshape(y.shape), arg_names=_LR)
+register("Flatten",
+         lambda attrs, x: x.reshape(x.shape[0], -1),
+         arg_names=_D, aliases=("flatten",))
+
+
+def _expand_dims(attrs, x):
+    return jnp.expand_dims(x, int(attrs["axis"]))
+
+
+register("expand_dims", _expand_dims, arg_names=_D, defaults={"axis": 0})
+
+
+def _squeeze(attrs, x):
+    axis = attrs.get("axis", None)
+    if axis is None:
+        return jnp.squeeze(x)
+    if isinstance(axis, int):
+        axis = (axis,)
+    return jnp.squeeze(x, axis=tuple(axis))
+
+
+register("squeeze", _squeeze, arg_names=_D, defaults={"axis": None})
+
+
+def _transpose(attrs, x):
+    axes = attrs.get("axes", None)
+    if not axes:
+        axes = None
+    return jnp.transpose(x, axes)
+
+
+register("transpose", _transpose, arg_names=_D, defaults={"axes": None})
+
+
+def _swapaxis(attrs, x):
+    return jnp.swapaxes(x, int(attrs.get("dim1", 0)), int(attrs.get("dim2", 0)))
+
+
+register("SwapAxis", _swapaxis, arg_names=_D,
+         defaults={"dim1": 0, "dim2": 0}, aliases=("swapaxes",))
+
+
+# ---------------------------------------------------------------------------
+# slice family
+# ---------------------------------------------------------------------------
+
+def _slice(attrs, x):
+    begin = attrs["begin"]
+    end = attrs["end"]
+    step = attrs.get("step", None) or (None,) * len(begin)
+    idx = []
+    for i in range(x.ndim):
+        if i < len(begin):
+            idx.append(slice(begin[i], end[i] if i < len(end) else None,
+                             step[i] if i < len(step) else None))
+        else:
+            idx.append(slice(None))
+    return x[tuple(idx)]
+
+
+register("slice", _slice, arg_names=_D,
+         defaults={"begin": (), "end": (), "step": None})
+
+
+def _slice_axis(attrs, x):
+    axis = int(attrs["axis"])
+    begin = attrs.get("begin", 0)
+    end = attrs.get("end", None)
+    idx = [slice(None)] * x.ndim
+    idx[axis] = slice(begin, end)
+    return x[tuple(idx)]
+
+
+register("slice_axis", _slice_axis, arg_names=_D,
+         defaults={"axis": 0, "begin": 0, "end": None})
+
+
+def _slice_like(attrs, x, shape_like):
+    axes = attrs.get("axes", ())
+    if not axes:
+        axes = tuple(range(min(x.ndim, shape_like.ndim)))
+    idx = [slice(None)] * x.ndim
+    for a in axes:
+        a = a % x.ndim
+        idx[a] = slice(0, shape_like.shape[a])
+    return x[tuple(idx)]
+
+
+register("slice_like", _slice_like, arg_names=_LR, defaults={"axes": ()})
+
+
+# ---------------------------------------------------------------------------
+# concat / stack / split
+# ---------------------------------------------------------------------------
+
+def _concat(attrs, *inputs):
+    return jnp.concatenate(inputs, axis=int(attrs.get("dim", 1)))
+
+
+register("Concat", _concat, arg_names=("arg",),
+         defaults={"dim": 1, "num_args": 1}, key_var_num_args="num_args",
+         aliases=("concat",))
+
+register("_rnn_param_concat", lambda attrs, *inputs: jnp.concatenate(
+    inputs, axis=int(attrs.get("dim", 0))),
+    arg_names=("arg",), defaults={"dim": 0, "num_args": 1},
+    key_var_num_args="num_args")
+
+
+def _stack(attrs, *inputs):
+    return jnp.stack(inputs, axis=int(attrs.get("axis", 0)))
+
+
+register("stack", _stack, arg_names=("arg",),
+         defaults={"axis": 0, "num_args": 1}, key_var_num_args="num_args")
+
+
+def _split(attrs, x):
+    axis = int(attrs.get("axis", 1))
+    n = int(attrs["num_outputs"])
+    squeeze_axis = bool(attrs.get("squeeze_axis", False))
+    parts = jnp.split(x, n, axis=axis)
+    if squeeze_axis:
+        parts = [jnp.squeeze(p, axis=axis) for p in parts]
+    return tuple(parts)
+
+
+register("SliceChannel", _split, arg_names=_D,
+         defaults={"num_outputs": 1, "axis": 1, "squeeze_axis": False},
+         num_outputs=lambda attrs: int(attrs.get("num_outputs", 1)),
+         aliases=("split",))
+
+
+# ---------------------------------------------------------------------------
+# tile / repeat / reverse / pad
+# ---------------------------------------------------------------------------
+
+def _repeat(attrs, x):
+    reps = int(attrs["repeats"])
+    axis = attrs.get("axis", None)
+    return jnp.repeat(x, reps, axis=None if axis is None else int(axis))
+
+
+register("repeat", _repeat, arg_names=_D, defaults={"repeats": 1, "axis": None})
+
+
+def _tile(attrs, x):
+    return jnp.tile(x, tuple(attrs["reps"]))
+
+
+register("tile", _tile, arg_names=_D, defaults={"reps": ()})
+
+
+def _reverse(attrs, x):
+    axis = attrs.get("axis", 0)
+    if isinstance(axis, int):
+        axis = (axis,)
+    return jnp.flip(x, axis=tuple(axis))
+
+
+register("reverse", _reverse, arg_names=_D, defaults={"axis": 0},
+         aliases=("flip",))
+
+
+def _pad(attrs, x):
+    mode = attrs.get("mode", "constant")
+    pw = attrs["pad_width"]
+    pairs = [(int(pw[2 * i]), int(pw[2 * i + 1])) for i in range(x.ndim)]
+    if mode == "constant":
+        return jnp.pad(x, pairs, mode="constant",
+                       constant_values=float(attrs.get("constant_value", 0.0)))
+    if mode == "edge":
+        return jnp.pad(x, pairs, mode="edge")
+    if mode == "reflect":
+        return jnp.pad(x, pairs, mode="reflect")
+    raise ValueError("Pad: unknown mode %r" % mode)
+
+
+register("Pad", _pad, arg_names=_D,
+         defaults={"mode": "constant", "pad_width": (), "constant_value": 0.0},
+         aliases=("pad",))
+
+
+# ---------------------------------------------------------------------------
+# dot / batch_dot  (MXU-bound — the FLOPs live here)
+# ---------------------------------------------------------------------------
+
+def _dot(attrs, x, y):
+    if bool(attrs.get("transpose_a", False)):
+        x = jnp.transpose(x)
+    if bool(attrs.get("transpose_b", False)):
+        y = jnp.transpose(y)
+    if x.ndim == 1 and y.ndim == 1:
+        return jnp.dot(x, y)
+    # MXNet dot: contract last axis of lhs with first axis of rhs
+    return jnp.tensordot(x, y, axes=1)
+
+
+register("dot", _dot, arg_names=_LR,
+         defaults={"transpose_a": False, "transpose_b": False,
+                   "forward_stype": None})
+
+
+def _batch_dot(attrs, x, y):
+    if bool(attrs.get("transpose_a", False)):
+        x = jnp.swapaxes(x, -1, -2)
+    if bool(attrs.get("transpose_b", False)):
+        y = jnp.swapaxes(y, -1, -2)
+    return jnp.matmul(x, y)
+
+
+register("batch_dot", _batch_dot, arg_names=_LR,
+         defaults={"transpose_a": False, "transpose_b": False,
+                   "forward_stype": None})
+
+
+register("khatri_rao", lambda attrs, *inputs: _khatri_rao(inputs),
+         arg_names=("args",), defaults={"num_args": 1},
+         key_var_num_args="num_args")
+
+
+def _khatri_rao(mats):
+    out = mats[0]
+    for m in mats[1:]:
+        out = jnp.einsum("ik,jk->ijk", out, m).reshape(-1, out.shape[-1])
+    return out
+
+
+# ---------------------------------------------------------------------------
+# depth/space, diag
+# ---------------------------------------------------------------------------
+
+def _depth_to_space(attrs, x):
+    b = int(attrs["block_size"])
+    n, c, h, w = x.shape
+    x = x.reshape(n, b, b, c // (b * b), h, w)
+    x = x.transpose(0, 3, 4, 1, 5, 2)
+    return x.reshape(n, c // (b * b), h * b, w * b)
+
+
+register("depth_to_space", _depth_to_space, arg_names=_D,
+         defaults={"block_size": 1})
+
+
+def _space_to_depth(attrs, x):
+    b = int(attrs["block_size"])
+    n, c, h, w = x.shape
+    x = x.reshape(n, c, h // b, b, w // b, b)
+    x = x.transpose(0, 3, 5, 1, 2, 4)
+    return x.reshape(n, c * b * b, h // b, w // b)
+
+
+register("space_to_depth", _space_to_depth, arg_names=_D,
+         defaults={"block_size": 1})
+
+
+def _diag(attrs, x):
+    k = int(attrs.get("k", 0))
+    if x.ndim == 1:
+        return jnp.diag(x, k=k)
+    a1 = int(attrs.get("axis1", 0))
+    a2 = int(attrs.get("axis2", 1))
+    return jnp.diagonal(x, offset=k, axis1=a1, axis2=a2)
+
+
+register("diag", _diag, arg_names=_D, defaults={"k": 0, "axis1": 0, "axis2": 1})

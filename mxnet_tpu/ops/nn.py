@@ -1,0 +1,678 @@
+"""Neural-network operators.
+
+Reference: src/operator/nn/ (fully_connected.cc, convolution.cc,
+deconvolution.cc, activation.cc, batch_norm.cc, layer_norm.cc, pooling.cc,
+softmax.cc, dropout.cc, lrn.cc, upsampling.cc), src/operator/
+(softmax_output.cc, regression_output.cc, sequence_*.cc, instance_norm.cc,
+l2_normalization.cc, leaky_relu.cc).
+
+TPU design notes:
+- Convs/matmuls go straight to lax.conv_general_dilated / jnp.dot: XLA
+  tiles them onto the MXU; there is no cuDNN-autotune analogue to build.
+- Train/eval behavior (BatchNorm, Dropout) is selected by the static
+  ``__train__`` attribute injected by the imperative/executor layers —
+  two jit specializations, matching the reference's is_train OpContext.
+- Loss layers (SoftmaxOutput, *RegressionOutput, make_loss) implement the
+  reference's "ignore incoming head gradient" semantics via
+  jax.custom_vjp.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register
+
+_D = ("data",)
+
+
+def _is_train(attrs):
+    return bool(attrs.get("__train__", False))
+
+
+# ---------------------------------------------------------------------------
+# FullyConnected
+# ---------------------------------------------------------------------------
+
+def _fully_connected(attrs, data, weight, bias=None):
+    flatten = bool(attrs.get("flatten", True))
+    if flatten:
+        x = data.reshape(data.shape[0], -1)
+    else:
+        x = data
+    out = jnp.dot(x, weight.T)
+    if bias is not None and not bool(attrs.get("no_bias", False)):
+        out = out + bias
+    return out
+
+
+def _bias_args(names):
+    def fn(attrs):
+        return names[:-1] if attrs.get("no_bias", False) else names
+    return fn
+
+
+register("FullyConnected", _fully_connected,
+         arg_names=("data", "weight", "bias"),
+         defaults={"num_hidden": 0, "no_bias": False, "flatten": True},
+         arg_names_fn=_bias_args(["data", "weight", "bias"]))
+
+
+# ---------------------------------------------------------------------------
+# Convolution / Deconvolution
+# ---------------------------------------------------------------------------
+
+_CONV_DN = {1: ("NCW", "OIW", "NCW"),
+            2: ("NCHW", "OIHW", "NCHW"),
+            3: ("NCDHW", "OIDHW", "NCDHW")}
+
+
+def _tup(v, nd, default=1):
+    if v is None or v == ():
+        return (default,) * nd
+    if isinstance(v, int):
+        return (v,) * nd
+    t = tuple(int(x) for x in v)
+    return t if len(t) == nd else t + (default,) * (nd - len(t))
+
+
+def _convolution(attrs, data, weight, bias=None):
+    kernel = tuple(attrs["kernel"])
+    nd = len(kernel)
+    stride = _tup(attrs.get("stride"), nd, 1)
+    dilate = _tup(attrs.get("dilate"), nd, 1)
+    pad = _tup(attrs.get("pad"), nd, 0)
+    groups = int(attrs.get("num_group", 1))
+    dn = lax.conv_dimension_numbers(data.shape, weight.shape, _CONV_DN[nd])
+    out = lax.conv_general_dilated(
+        data, weight, window_strides=stride,
+        padding=[(p, p) for p in pad],
+        rhs_dilation=dilate, dimension_numbers=dn,
+        feature_group_count=groups)
+    if bias is not None and not bool(attrs.get("no_bias", False)):
+        out = out + bias.reshape((1, -1) + (1,) * nd)
+    return out
+
+
+register("Convolution", _convolution, arg_names=("data", "weight", "bias"),
+         defaults={"kernel": (), "stride": (), "dilate": (), "pad": (),
+                   "num_filter": 0, "num_group": 1, "workspace": 1024,
+                   "no_bias": False, "cudnn_tune": None, "cudnn_off": False,
+                   "layout": None},
+         arg_names_fn=_bias_args(["data", "weight", "bias"]))
+
+
+def _deconvolution(attrs, data, weight, bias=None):
+    kernel = tuple(attrs["kernel"])
+    nd = len(kernel)
+    stride = _tup(attrs.get("stride"), nd, 1)
+    dilate = _tup(attrs.get("dilate"), nd, 1)
+    pad = _tup(attrs.get("pad"), nd, 0)
+    adj = _tup(attrs.get("adj"), nd, 0)
+    groups = int(attrs.get("num_group", 1))
+    # MXNet deconv weight: (C_in, C_out/g, *kernel). Gradient-of-conv
+    # formulation: lhs-dilate by stride, pad by k-1-p.
+    pads = [(k - 1 - p + (k - 1) * (d - 1), k - 1 - p + (k - 1) * (d - 1) + a)
+            for k, p, d, a in zip(kernel, pad, dilate, adj)]
+    dn = lax.conv_dimension_numbers(data.shape, weight.shape,
+                                    _CONV_DN[nd])
+    if groups > 1:
+        ins = jnp.split(data, groups, axis=1)
+        ws = jnp.split(weight, groups, axis=0)
+        outs = [_deconv_one(i, w, stride, pads, dilate, dn)
+                for i, w in zip(ins, ws)]
+        out = jnp.concatenate(outs, axis=1)
+    else:
+        out = _deconv_one(data, weight, stride, pads, dilate, dn)
+    if bias is not None and not bool(attrs.get("no_bias", True)):
+        out = out + bias.reshape((1, -1) + (1,) * nd)
+    return out
+
+
+def _deconv_one(x, w, stride, pads, dilate, dn):
+    # transpose weight (I, O, *k) -> (O, I, *k) and flip spatial dims
+    w = jnp.swapaxes(w, 0, 1)
+    w = jnp.flip(w, axis=tuple(range(2, w.ndim)))
+    return lax.conv_general_dilated(
+        x, w, window_strides=(1,) * (x.ndim - 2), padding=pads,
+        lhs_dilation=stride, rhs_dilation=dilate, dimension_numbers=dn)
+
+
+register("Deconvolution", _deconvolution, arg_names=("data", "weight", "bias"),
+         defaults={"kernel": (), "stride": (), "dilate": (), "pad": (),
+                   "adj": (), "target_shape": (), "num_filter": 0,
+                   "num_group": 1, "workspace": 512, "no_bias": True,
+                   "cudnn_tune": None, "cudnn_off": False, "layout": None},
+         arg_names_fn=_bias_args(["data", "weight", "bias"]))
+
+
+# ---------------------------------------------------------------------------
+# Activations
+# ---------------------------------------------------------------------------
+
+def _activation(attrs, x):
+    t = attrs.get("act_type", "relu")
+    if t == "relu":
+        return jnp.maximum(x, 0)
+    if t == "sigmoid":
+        return jax.nn.sigmoid(x)
+    if t == "tanh":
+        return jnp.tanh(x)
+    if t == "softrelu":
+        return jax.nn.softplus(x)
+    if t == "softsign":
+        return x / (1 + jnp.abs(x))
+    raise ValueError("Activation: unknown act_type %r" % t)
+
+
+register("Activation", _activation, arg_names=_D,
+         defaults={"act_type": "relu"})
+
+
+def _leaky_relu_outputs(attrs):
+    return 2 if attrs.get("act_type", "leaky") == "rrelu" else 1
+
+
+def _leaky_relu(attrs, data, gamma=None, rng=None):
+    t = attrs.get("act_type", "leaky")
+    slope = float(attrs.get("slope", 0.25))
+    if t == "leaky":
+        return jnp.where(data >= 0, data, slope * data)
+    if t == "elu":
+        return jnp.where(data >= 0, data, slope * jnp.expm1(data))
+    if t == "prelu":
+        g = gamma.reshape((1, -1) + (1,) * (data.ndim - 2)) \
+            if gamma.ndim == 1 and data.ndim > 1 else gamma
+        return jnp.where(data >= 0, data, g * data)
+    if t == "selu":
+        alpha, scale = 1.6732632423543772, 1.0507009873554805
+        return scale * jnp.where(data >= 0, data, alpha * jnp.expm1(data))
+    if t == "gelu":
+        return jax.nn.gelu(data, approximate=False)
+    if t == "rrelu":
+        lo = float(attrs.get("lower_bound", 0.125))
+        hi = float(attrs.get("upper_bound", 0.334))
+        if _is_train(attrs) and rng is not None:
+            mask = jax.random.uniform(rng, data.shape, dtype=data.dtype,
+                                      minval=lo, maxval=hi)
+        else:
+            mask = jnp.full(data.shape, (lo + hi) / 2.0, dtype=data.dtype)
+        return jnp.where(data >= 0, data, mask * data), mask
+    raise ValueError("LeakyReLU: unknown act_type %r" % t)
+
+
+register("LeakyReLU", _leaky_relu, arg_names=("data", "gamma"),
+         needs_rng=True,
+         defaults={"act_type": "leaky", "slope": 0.25, "lower_bound": 0.125,
+                   "upper_bound": 0.334, "__train__": False},
+         num_outputs=_leaky_relu_outputs,
+         arg_names_fn=lambda attrs: ["data", "gamma"]
+         if attrs.get("act_type") == "prelu" else ["data"])
+
+
+# ---------------------------------------------------------------------------
+# Normalization
+# ---------------------------------------------------------------------------
+
+def _batch_norm_outputs(attrs):
+    return 3 if attrs.get("output_mean_var", False) else 1
+
+
+def _batch_norm(attrs, data, gamma, beta, moving_mean, moving_var):
+    eps = float(attrs.get("eps", 1e-3))
+    momentum = float(attrs.get("momentum", 0.9))
+    axis = int(attrs.get("axis", 1)) % data.ndim
+    fix_gamma = bool(attrs.get("fix_gamma", True))
+    use_global = bool(attrs.get("use_global_stats", False))
+    train = _is_train(attrs) and not use_global
+
+    red_axes = tuple(i for i in range(data.ndim) if i != axis)
+    bshape = tuple(data.shape[axis] if i == axis else 1
+                   for i in range(data.ndim))
+
+    g = jnp.ones_like(gamma) if fix_gamma else gamma
+    if train:
+        mean = jnp.mean(data, axis=red_axes)
+        var = jnp.var(data, axis=red_axes)
+        new_mean = momentum * moving_mean + (1 - momentum) * mean
+        new_var = momentum * moving_var + (1 - momentum) * var
+    else:
+        mean, var = moving_mean, moving_var
+        new_mean, new_var = moving_mean, moving_var
+    mean_s = lax.stop_gradient(mean) if not train else mean
+    inv = lax.rsqrt(var.reshape(bshape) + eps)
+    out = (data - mean.reshape(bshape)) * inv * g.reshape(bshape) \
+        + beta.reshape(bshape)
+    outs = (out, mean, var) if attrs.get("output_mean_var", False) else (out,)
+    # aux updates (moving_mean, moving_var) appended per mutable_inputs
+    return outs + (lax.stop_gradient(new_mean), lax.stop_gradient(new_var))
+
+
+register("BatchNorm", _batch_norm,
+         arg_names=("data", "gamma", "beta", "moving_mean", "moving_var"),
+         defaults={"eps": 1e-3, "momentum": 0.9, "fix_gamma": True,
+                   "use_global_stats": False, "output_mean_var": False,
+                   "axis": 1, "cudnn_off": False, "__train__": False},
+         num_outputs=_batch_norm_outputs, mutable_inputs=(3, 4))
+
+
+def _layer_norm(attrs, data, gamma, beta):
+    axis = int(attrs.get("axis", -1)) % data.ndim
+    eps = float(attrs.get("eps", 1e-5))
+    mean = jnp.mean(data, axis=axis, keepdims=True)
+    var = jnp.var(data, axis=axis, keepdims=True)
+    out = (data - mean) * lax.rsqrt(var + eps)
+    bshape = tuple(data.shape[axis] if i == axis else 1
+                   for i in range(data.ndim))
+    out = out * gamma.reshape(bshape) + beta.reshape(bshape)
+    if attrs.get("output_mean_var", False):
+        return out, jnp.squeeze(mean, axis), jnp.squeeze(var, axis)
+    return out
+
+
+register("LayerNorm", _layer_norm, arg_names=("data", "gamma", "beta"),
+         defaults={"axis": -1, "eps": 1e-5, "output_mean_var": False},
+         num_outputs=lambda a: 3 if a.get("output_mean_var", False) else 1)
+
+
+def _instance_norm(attrs, data, gamma, beta):
+    eps = float(attrs.get("eps", 1e-3))
+    red = tuple(range(2, data.ndim))
+    mean = jnp.mean(data, axis=red, keepdims=True)
+    var = jnp.var(data, axis=red, keepdims=True)
+    out = (data - mean) * lax.rsqrt(var + eps)
+    bshape = (1, -1) + (1,) * (data.ndim - 2)
+    return out * gamma.reshape(bshape) + beta.reshape(bshape)
+
+
+register("InstanceNorm", _instance_norm, arg_names=("data", "gamma", "beta"),
+         defaults={"eps": 1e-3})
+
+
+def _l2_normalization(attrs, data):
+    eps = float(attrs.get("eps", 1e-10))
+    mode = attrs.get("mode", "instance")
+    if mode == "instance":
+        red = tuple(range(1, data.ndim))
+        norm = jnp.sqrt(jnp.sum(jnp.square(data), axis=red, keepdims=True) + eps)
+    elif mode == "channel":
+        norm = jnp.sqrt(jnp.sum(jnp.square(data), axis=1, keepdims=True) + eps)
+    elif mode == "spatial":
+        red = tuple(range(2, data.ndim))
+        norm = jnp.sqrt(jnp.sum(jnp.square(data), axis=red, keepdims=True) + eps)
+    else:
+        raise ValueError("L2Normalization: unknown mode %r" % mode)
+    return data / norm
+
+
+register("L2Normalization", _l2_normalization, arg_names=_D,
+         defaults={"eps": 1e-10, "mode": "instance"})
+
+
+def _lrn(attrs, data):
+    nsize = int(attrs.get("nsize", 5))
+    alpha = float(attrs.get("alpha", 1e-4))
+    beta = float(attrs.get("beta", 0.75))
+    knorm = float(attrs.get("knorm", 2.0))
+    sq = jnp.square(data)
+    half = nsize // 2
+    sq_pad = jnp.pad(sq, [(0, 0), (half, half)] + [(0, 0)] * (data.ndim - 2))
+    windows = sum(sq_pad[:, i:i + data.shape[1]] for i in range(nsize))
+    return data / jnp.power(knorm + (alpha / nsize) * windows, beta)
+
+
+register("LRN", _lrn, arg_names=_D,
+         defaults={"nsize": 5, "alpha": 1e-4, "beta": 0.75, "knorm": 2.0})
+
+
+# ---------------------------------------------------------------------------
+# Pooling
+# ---------------------------------------------------------------------------
+
+def _pooling(attrs, data):
+    kernel = tuple(attrs.get("kernel", ()))
+    nd = data.ndim - 2
+    pool_type = attrs.get("pool_type", "max")
+    global_pool = bool(attrs.get("global_pool", False))
+    if global_pool or not kernel:
+        kernel = data.shape[2:]
+        stride = (1,) * nd
+        pad = (0,) * nd
+    else:
+        kernel = _tup(kernel, nd, 1)
+        stride = _tup(attrs.get("stride"), nd, 1)
+        pad = _tup(attrs.get("pad"), nd, 0)
+    convention = attrs.get("pooling_convention", "valid")
+
+    pads = []
+    for i in range(nd):
+        lo = hi = pad[i]
+        if convention == "full" and not global_pool:
+            inp = data.shape[2 + i]
+            out = -(-(inp + 2 * pad[i] - kernel[i]) // stride[i]) + 1  # ceil
+            need = (out - 1) * stride[i] + kernel[i] - (inp + 2 * pad[i])
+            hi += max(need, 0)
+        pads.append((lo, hi))
+
+    window = (1, 1) + kernel
+    strides = (1, 1) + stride
+    padding = [(0, 0), (0, 0)] + pads
+
+    if pool_type == "max":
+        init = -jnp.inf if jnp.issubdtype(data.dtype, jnp.floating) \
+            else jnp.iinfo(data.dtype).min
+        return lax.reduce_window(data, init, lax.max, window, strides, padding)
+    if pool_type in ("avg", "sum"):
+        s = lax.reduce_window(data, 0.0, lax.add, window, strides, padding)
+        if pool_type == "sum":
+            return s
+        if bool(attrs.get("count_include_pad", True)):
+            denom = 1.0
+            for k in kernel:
+                denom *= k
+            return s / denom
+        ones = jnp.ones(data.shape, data.dtype)
+        cnt = lax.reduce_window(ones, 0.0, lax.add, window, strides, padding)
+        return s / cnt
+    if pool_type == "lp":
+        p = float(attrs.get("p_value", 2))
+        s = lax.reduce_window(jnp.power(jnp.abs(data), p), 0.0, lax.add,
+                              window, strides, padding)
+        return jnp.power(s, 1.0 / p)
+    raise ValueError("Pooling: unknown pool_type %r" % pool_type)
+
+
+register("Pooling", _pooling, arg_names=_D,
+         defaults={"kernel": (), "pool_type": "max", "global_pool": False,
+                   "stride": (), "pad": (), "pooling_convention": "valid",
+                   "count_include_pad": True, "p_value": 2,
+                   "cudnn_off": False})
+
+
+# ---------------------------------------------------------------------------
+# Softmax family
+# ---------------------------------------------------------------------------
+
+def _softmax(attrs, x, length=None):
+    axis = int(attrs.get("axis", -1))
+    temp = attrs.get("temperature", None)
+    if temp:
+        x = x / float(temp)
+    return jax.nn.softmax(x, axis=axis)
+
+
+register("softmax", _softmax, arg_names=_D,
+         defaults={"axis": -1, "temperature": None, "dtype": None})
+
+
+def _log_softmax(attrs, x):
+    axis = int(attrs.get("axis", -1))
+    temp = attrs.get("temperature", None)
+    if temp:
+        x = x / float(temp)
+    return jax.nn.log_softmax(x, axis=axis)
+
+
+register("log_softmax", _log_softmax, arg_names=_D,
+         defaults={"axis": -1, "temperature": None, "dtype": None})
+
+register("softmin",
+         lambda attrs, x: jax.nn.softmax(-x, axis=int(attrs.get("axis", -1))),
+         arg_names=_D, defaults={"axis": -1, "temperature": None})
+
+
+def _softmax_activation(attrs, x):
+    mode = attrs.get("mode", "instance")
+    if mode == "channel":
+        return jax.nn.softmax(x, axis=1)
+    return jax.nn.softmax(x.reshape(x.shape[0], -1), axis=-1).reshape(x.shape)
+
+
+register("SoftmaxActivation", _softmax_activation, arg_names=_D,
+         defaults={"mode": "instance"})
+
+
+def _softmax_output(attrs, data, label):
+    grad_scale = float(attrs.get("grad_scale", 1.0))
+    ignore_label = float(attrs.get("ignore_label", -1.0))
+    use_ignore = bool(attrs.get("use_ignore", False))
+    multi_output = bool(attrs.get("multi_output", False))
+    preserve_shape = bool(attrs.get("preserve_shape", False))
+    normalization = attrs.get("normalization", "null")
+    smooth_alpha = float(attrs.get("smooth_alpha", 0.0))
+
+    @jax.custom_vjp
+    def f(d, l):
+        return _so_fwd(d)
+
+    def _so_fwd(d):
+        if multi_output:
+            return jax.nn.softmax(d, axis=1)
+        if preserve_shape:
+            return jax.nn.softmax(d, axis=-1)
+        return jax.nn.softmax(d.reshape(d.shape[0], -1),
+                              axis=-1).reshape(d.shape)
+
+    def f_fwd(d, l):
+        return _so_fwd(d), (d, l)
+
+    def f_bwd(res, g):
+        del g  # loss layer: implicit CE gradient, head grad ignored
+        d, l = res
+        p = _so_fwd(d)
+        axis = 1 if multi_output else (d.ndim - 1)
+        nclass = d.shape[axis]
+        li = l.astype(jnp.int32)
+        onehot = jax.nn.one_hot(li, nclass, dtype=d.dtype, axis=axis)
+        if smooth_alpha > 0:
+            onehot = onehot * (1 - smooth_alpha) + smooth_alpha / (nclass - 1) \
+                * (1 - onehot)
+        grad = p - onehot
+        valid = jnp.ones_like(l, dtype=d.dtype)
+        if use_ignore:
+            valid = (l != ignore_label).astype(d.dtype)
+            grad = grad * jnp.expand_dims(valid, axis)
+        if normalization == "batch":
+            grad = grad / d.shape[0]
+        elif normalization == "valid":
+            grad = grad / jnp.maximum(jnp.sum(valid), 1.0)
+        return (grad * grad_scale, jnp.zeros_like(l))
+
+    f.defvjp(f_fwd, f_bwd)
+    return f(data, label)
+
+
+register("SoftmaxOutput", _softmax_output, arg_names=("data", "label"),
+         defaults={"grad_scale": 1.0, "ignore_label": -1.0,
+                   "multi_output": False, "use_ignore": False,
+                   "preserve_shape": False, "normalization": "null",
+                   "out_grad": False, "smooth_alpha": 0.0},
+         aliases=("Softmax",))
+
+
+def _regression_output(kind):
+    def fwd(attrs, data, label):
+        grad_scale = float(attrs.get("grad_scale", 1.0))
+
+        @jax.custom_vjp
+        def f(d, l):
+            return jax.nn.sigmoid(d) if kind == "logistic" else d
+
+        def f_fwd(d, l):
+            return f(d, l), (d, l)
+
+        def f_bwd(res, g):
+            del g
+            d, l = res
+            out = jax.nn.sigmoid(d) if kind == "logistic" else d
+            lr = l.reshape(d.shape)
+            if kind == "mae":
+                grad = jnp.sign(out - lr)
+            else:
+                grad = out - lr
+            num_out = 1
+            for s in d.shape[1:]:
+                num_out *= s
+            return (grad * grad_scale / num_out, jnp.zeros_like(l))
+
+        f.defvjp(f_fwd, f_bwd)
+        return f(data, label)
+    return fwd
+
+
+register("LinearRegressionOutput", _regression_output("linear"),
+         arg_names=("data", "label"), defaults={"grad_scale": 1.0})
+register("LogisticRegressionOutput", _regression_output("logistic"),
+         arg_names=("data", "label"), defaults={"grad_scale": 1.0})
+register("MAERegressionOutput", _regression_output("mae"),
+         arg_names=("data", "label"), defaults={"grad_scale": 1.0})
+
+
+def _softmax_cross_entropy(attrs, data, label):
+    logp = jax.nn.log_softmax(data, axis=-1)
+    li = label.astype(jnp.int32)
+    picked = jnp.take_along_axis(logp, li.reshape(-1, 1), axis=-1)
+    return -jnp.sum(picked)
+
+
+register("softmax_cross_entropy", _softmax_cross_entropy,
+         arg_names=("data", "label"))
+
+
+# ---------------------------------------------------------------------------
+# Dropout
+# ---------------------------------------------------------------------------
+
+def _dropout(attrs, data, rng=None):
+    p = float(attrs.get("p", 0.5))
+    mode = attrs.get("mode", "training")
+    axes = tuple(attrs.get("axes", ()) or ())
+    train = _is_train(attrs) or mode == "always"
+    if not train or p == 0.0:
+        return data
+    shape = list(data.shape)
+    for a in axes:
+        shape[a] = 1
+    keep = 1.0 - p
+    mask = jax.random.bernoulli(rng, keep, tuple(shape)).astype(data.dtype)
+    return data * mask / keep
+
+
+register("Dropout", _dropout, arg_names=_D, needs_rng=True,
+         defaults={"p": 0.5, "mode": "training", "axes": (),
+                   "cudnn_off": False, "__train__": False})
+
+
+# ---------------------------------------------------------------------------
+# UpSampling
+# ---------------------------------------------------------------------------
+
+def _upsampling(attrs, *inputs):
+    scale = int(attrs.get("scale", 1))
+    sample_type = attrs.get("sample_type", "nearest")
+    data = inputs[0]
+    if sample_type == "nearest":
+        out = jnp.repeat(jnp.repeat(data, scale, axis=2), scale, axis=3)
+        if len(inputs) > 1:
+            outs = [out]
+            for extra in inputs[1:]:
+                s = out.shape[2] // extra.shape[2]
+                outs.append(jnp.repeat(jnp.repeat(extra, s, axis=2), s, axis=3))
+            out = jnp.concatenate(outs, axis=1)
+        return out
+    # bilinear: resize via jax.image
+    n, c, h, w = data.shape
+    return jax.image.resize(data, (n, c, h * scale, w * scale), "bilinear")
+
+
+register("UpSampling", _upsampling, arg_names=("data",),
+         defaults={"scale": 1, "sample_type": "nearest", "num_args": 1,
+                   "num_filter": 0, "multi_input_mode": "concat",
+                   "workspace": 512},
+         key_var_num_args="num_args")
+
+
+# ---------------------------------------------------------------------------
+# Sequence ops
+# ---------------------------------------------------------------------------
+
+def _seq_iota(data, axis):
+    return lax.broadcasted_iota(jnp.int32, data.shape, axis)
+
+
+def _sequence_mask(attrs, data, sequence_length=None):
+    use_len = bool(attrs.get("use_sequence_length", False))
+    value = float(attrs.get("value", 0.0))
+    axis = int(attrs.get("axis", 0))
+    if not use_len or sequence_length is None:
+        return data
+    # data: (T, B, ...) if axis==0 else (B, T, ...)
+    t_iota = _seq_iota(data, axis)
+    batch_axis = 1 - axis
+    lens = sequence_length.astype(jnp.int32)
+    bshape = [1] * data.ndim
+    bshape[batch_axis] = data.shape[batch_axis]
+    lens_b = lens.reshape(bshape)
+    mask = t_iota < lens_b
+    return jnp.where(mask, data, jnp.asarray(value, data.dtype))
+
+
+def _seq_args(attrs):
+    return ["data", "sequence_length"] \
+        if attrs.get("use_sequence_length", False) else ["data"]
+
+
+register("SequenceMask", _sequence_mask,
+         arg_names=("data", "sequence_length"),
+         defaults={"use_sequence_length": False, "value": 0.0, "axis": 0},
+         arg_names_fn=_seq_args)
+
+
+def _sequence_last(attrs, data, sequence_length=None):
+    use_len = bool(attrs.get("use_sequence_length", False))
+    axis = int(attrs.get("axis", 0))
+    if not use_len or sequence_length is None:
+        idx = [slice(None)] * data.ndim
+        idx[axis] = -1
+        return data[tuple(idx)]
+    lens = sequence_length.astype(jnp.int32) - 1
+    moved = jnp.moveaxis(data, axis, 0)  # (T, B, ...)
+    return jnp.take_along_axis(
+        moved, lens.reshape((1, -1) + (1,) * (moved.ndim - 2)), axis=0)[0]
+
+
+register("SequenceLast", _sequence_last,
+         arg_names=("data", "sequence_length"),
+         defaults={"use_sequence_length": False, "axis": 0},
+         arg_names_fn=_seq_args)
+
+
+def _sequence_reverse(attrs, data, sequence_length=None):
+    use_len = bool(attrs.get("use_sequence_length", False))
+    axis = int(attrs.get("axis", 0))
+    if not use_len or sequence_length is None:
+        return jnp.flip(data, axis=axis)
+    moved = jnp.moveaxis(data, axis, 0)  # (T, B, ...)
+    T = moved.shape[0]
+    lens = sequence_length.astype(jnp.int32)
+    t = lax.broadcasted_iota(jnp.int32, moved.shape, 0)
+    lens_b = lens.reshape((1, -1) + (1,) * (moved.ndim - 2))
+    src = jnp.where(t < lens_b, lens_b - 1 - t, t)
+    out = jnp.take_along_axis(moved, src, axis=0)
+    return jnp.moveaxis(out, 0, axis)
+
+
+register("SequenceReverse", _sequence_reverse,
+         arg_names=("data", "sequence_length"),
+         defaults={"use_sequence_length": False, "axis": 0},
+         arg_names_fn=_seq_args)
+
+
+# ---------------------------------------------------------------------------
+# contrib transformer helper (reference: src/operator/contrib/transformer.cc)
+# ---------------------------------------------------------------------------
+
+register("_contrib_div_sqrt_dim",
+         lambda attrs, x: x / jnp.sqrt(jnp.asarray(x.shape[-1], x.dtype)),
+         arg_names=_D)

@@ -1,0 +1,261 @@
+"""Optimizer update operators.
+
+Reference: src/operator/optimizer_op.cc (sgd/adam/rmsprop/ftrl/adagrad/
+signum/nag/ftml update kernels, multi-precision variants).
+
+Contract: each op returns the new weight as its visible output (the
+frontend calls with ``out=weight``), and optimizer states (momentum,
+mean/var, ...) are mutable inputs updated in place by the NDArray layer's
+aux-writeback. The whole update is one fused XLA computation — the role
+the reference's hand-fused CUDA update kernels play.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register
+
+
+def _prep_grad(grad, attrs):
+    g = grad * float(attrs.get("rescale_grad", 1.0))
+    clip = float(attrs.get("clip_gradient", -1.0))
+    if clip > 0:
+        g = jnp.clip(g, -clip, clip)
+    return g
+
+
+_COMMON = {"lr": 0.01, "wd": 0.0, "rescale_grad": 1.0, "clip_gradient": -1.0,
+           "lazy_update": True}
+
+
+def _sgd_update(attrs, weight, grad):
+    g = _prep_grad(grad, attrs)
+    lr = float(attrs["lr"])
+    wd = float(attrs.get("wd", 0.0))
+    return weight - lr * (g + wd * weight)
+
+
+register("sgd_update", _sgd_update, arg_names=("weight", "grad"),
+         defaults=dict(_COMMON))
+
+
+def _sgd_mom_update(attrs, weight, grad, mom):
+    g = _prep_grad(grad, attrs)
+    lr = float(attrs["lr"])
+    wd = float(attrs.get("wd", 0.0))
+    mu = float(attrs.get("momentum", 0.0))
+    new_mom = mu * mom - lr * (g + wd * weight)
+    return weight + new_mom, new_mom
+
+
+register("sgd_mom_update", _sgd_mom_update,
+         arg_names=("weight", "grad", "mom"),
+         defaults=dict(_COMMON, momentum=0.0), mutable_inputs=(2,))
+
+
+def _mp_sgd_update(attrs, weight, grad, weight32):
+    g = _prep_grad(grad.astype(jnp.float32), attrs)
+    lr = float(attrs["lr"])
+    wd = float(attrs.get("wd", 0.0))
+    new_w32 = weight32 - lr * (g + wd * weight32)
+    return new_w32.astype(weight.dtype), new_w32
+
+
+register("mp_sgd_update", _mp_sgd_update,
+         arg_names=("weight", "grad", "weight32"),
+         defaults=dict(_COMMON), mutable_inputs=(2,))
+
+
+def _mp_sgd_mom_update(attrs, weight, grad, mom, weight32):
+    g = _prep_grad(grad.astype(jnp.float32), attrs)
+    lr = float(attrs["lr"])
+    wd = float(attrs.get("wd", 0.0))
+    mu = float(attrs.get("momentum", 0.0))
+    new_mom = mu * mom - lr * (g + wd * weight32)
+    new_w32 = weight32 + new_mom
+    return new_w32.astype(weight.dtype), new_mom, new_w32
+
+
+register("mp_sgd_mom_update", _mp_sgd_mom_update,
+         arg_names=("weight", "grad", "mom", "weight32"),
+         defaults=dict(_COMMON, momentum=0.0), mutable_inputs=(2, 3))
+
+
+def _nag_mom_update(attrs, weight, grad, mom):
+    g = _prep_grad(grad, attrs)
+    lr = float(attrs["lr"])
+    wd = float(attrs.get("wd", 0.0))
+    mu = float(attrs.get("momentum", 0.0))
+    g = g + wd * weight
+    new_mom = mu * mom + g
+    return weight - lr * (g + mu * new_mom), new_mom
+
+
+register("nag_mom_update", _nag_mom_update,
+         arg_names=("weight", "grad", "mom"),
+         defaults=dict(_COMMON, momentum=0.0), mutable_inputs=(2,))
+
+
+def _adam_update(attrs, weight, grad, mean, var):
+    g = _prep_grad(grad, attrs)
+    lr = float(attrs["lr"])
+    wd = float(attrs.get("wd", 0.0))
+    b1 = float(attrs.get("beta1", 0.9))
+    b2 = float(attrs.get("beta2", 0.999))
+    eps = float(attrs.get("epsilon", 1e-8))
+    g = g + wd * weight
+    new_mean = b1 * mean + (1 - b1) * g
+    new_var = b2 * var + (1 - b2) * jnp.square(g)
+    new_w = weight - lr * new_mean / (jnp.sqrt(new_var) + eps)
+    return new_w, new_mean, new_var
+
+
+register("adam_update", _adam_update,
+         arg_names=("weight", "grad", "mean", "var"),
+         defaults=dict(_COMMON, beta1=0.9, beta2=0.999, epsilon=1e-8),
+         mutable_inputs=(2, 3))
+
+
+def _rmsprop_update(attrs, weight, grad, n):
+    g = _prep_grad(grad, attrs)
+    lr = float(attrs["lr"])
+    wd = float(attrs.get("wd", 0.0))
+    rho = float(attrs.get("gamma1", 0.95))
+    eps = float(attrs.get("epsilon", 1e-8))
+    g = g + wd * weight
+    new_n = rho * n + (1 - rho) * jnp.square(g)
+    return weight - lr * g / jnp.sqrt(new_n + eps), new_n
+
+
+register("rmsprop_update", _rmsprop_update,
+         arg_names=("weight", "grad", "n"),
+         defaults=dict(_COMMON, gamma1=0.95, epsilon=1e-8),
+         mutable_inputs=(2,))
+
+
+def _rmspropalex_update(attrs, weight, grad, n, g_acc, delta):
+    g = _prep_grad(grad, attrs)
+    lr = float(attrs["lr"])
+    wd = float(attrs.get("wd", 0.0))
+    rho = float(attrs.get("gamma1", 0.95))
+    mu = float(attrs.get("gamma2", 0.9))
+    eps = float(attrs.get("epsilon", 1e-8))
+    g = g + wd * weight
+    new_n = rho * n + (1 - rho) * jnp.square(g)
+    new_g = rho * g_acc + (1 - rho) * g
+    new_delta = mu * delta - lr * g / jnp.sqrt(new_n - jnp.square(new_g) + eps)
+    return weight + new_delta, new_n, new_g, new_delta
+
+
+register("rmspropalex_update", _rmspropalex_update,
+         arg_names=("weight", "grad", "n", "g", "delta"),
+         defaults=dict(_COMMON, gamma1=0.95, gamma2=0.9, epsilon=1e-8),
+         mutable_inputs=(2, 3, 4))
+
+
+def _ftrl_update(attrs, weight, grad, z, n):
+    g = _prep_grad(grad, attrs)
+    lr = float(attrs["lr"])
+    wd = float(attrs.get("wd", 0.0))
+    lamda1 = float(attrs.get("lamda1", 0.01))
+    beta = float(attrs.get("beta", 1.0))
+    new_n = n + jnp.square(g)
+    sigma = (jnp.sqrt(new_n) - jnp.sqrt(n)) / lr
+    new_z = z + g - sigma * weight
+    new_w = jnp.where(
+        jnp.abs(new_z) <= lamda1,
+        jnp.zeros_like(weight),
+        -(new_z - jnp.sign(new_z) * lamda1)
+        / ((beta + jnp.sqrt(new_n)) / lr + wd))
+    return new_w, new_z, new_n
+
+
+register("ftrl_update", _ftrl_update, arg_names=("weight", "grad", "z", "n"),
+         defaults=dict(_COMMON, lamda1=0.01, beta=1.0),
+         mutable_inputs=(2, 3))
+
+
+def _adagrad_update(attrs, weight, grad, history):
+    g = _prep_grad(grad, attrs)
+    lr = float(attrs["lr"])
+    wd = float(attrs.get("wd", 0.0))
+    eps = float(attrs.get("epsilon", 1e-7))
+    new_h = history + jnp.square(g)
+    return weight - lr * (g / jnp.sqrt(new_h + eps) + wd * weight), new_h
+
+
+register("_sparse_adagrad_update", _adagrad_update,
+         arg_names=("weight", "grad", "history"),
+         defaults=dict(_COMMON, epsilon=1e-7), mutable_inputs=(2,),
+         aliases=("adagrad_update",))
+
+
+def _signsgd_update(attrs, weight, grad):
+    g = _prep_grad(grad, attrs)
+    lr = float(attrs["lr"])
+    wd = float(attrs.get("wd", 0.0))
+    return weight - lr * (jnp.sign(g) + wd * weight)
+
+
+register("signsgd_update", _signsgd_update, arg_names=("weight", "grad"),
+         defaults=dict(_COMMON))
+
+
+def _signum_update(attrs, weight, grad, mom):
+    g = _prep_grad(grad, attrs)
+    lr = float(attrs["lr"])
+    wd = float(attrs.get("wd", 0.0))
+    mu = float(attrs.get("momentum", 0.0))
+    wd_lh = float(attrs.get("wd_lh", 0.0))
+    new_mom = mu * mom - (1 - mu) * (g + wd * weight)
+    new_w = (1 - lr * wd_lh) * weight + lr * jnp.sign(new_mom)
+    return new_w, new_mom
+
+
+register("signum_update", _signum_update, arg_names=("weight", "grad", "mom"),
+         defaults=dict(_COMMON, momentum=0.0, wd_lh=0.0), mutable_inputs=(2,))
+
+
+def _ftml_update(attrs, weight, grad, d, v, z):
+    g = _prep_grad(grad, attrs)
+    lr = float(attrs["lr"])
+    wd = float(attrs.get("wd", 0.0))
+    b1 = float(attrs.get("beta1", 0.6))
+    b2 = float(attrs.get("beta2", 0.999))
+    eps = float(attrs.get("epsilon", 1e-8))
+    t = int(attrs.get("t", 1))
+    g = g + wd * weight
+    new_v = b2 * v + (1 - b2) * jnp.square(g)
+    d_t = (1 - b1 ** t) / lr * (jnp.sqrt(new_v / (1 - b2 ** t)) + eps)
+    sigma = d_t - b1 * d
+    new_z = b1 * z + (1 - b1) * g - sigma * weight
+    new_w = -new_z / d_t
+    return new_w, d_t, new_v, new_z
+
+
+register("ftml_update", _ftml_update,
+         arg_names=("weight", "grad", "d", "v", "z"),
+         defaults=dict(_COMMON, beta1=0.6, beta2=0.999, epsilon=1e-8, t=1),
+         mutable_inputs=(2, 3, 4))
+
+
+def _adamw_update(attrs, weight, grad, mean, var):
+    g = _prep_grad(grad, attrs)
+    lr = float(attrs["lr"])
+    eta = float(attrs.get("eta", 1.0))
+    wd = float(attrs.get("wd", 0.0))
+    b1 = float(attrs.get("beta1", 0.9))
+    b2 = float(attrs.get("beta2", 0.999))
+    eps = float(attrs.get("epsilon", 1e-8))
+    new_mean = b1 * mean + (1 - b1) * g
+    new_var = b2 * var + (1 - b2) * jnp.square(g)
+    new_w = weight - eta * (lr * new_mean / (jnp.sqrt(new_var) + eps)
+                            + wd * weight)
+    return new_w, new_mean, new_var
+
+
+register("_contrib_adamw_update", _adamw_update,
+         arg_names=("weight", "grad", "mean", "var"),
+         defaults=dict(_COMMON, beta1=0.9, beta2=0.999, epsilon=1e-8, eta=1.0),
+         mutable_inputs=(2, 3))

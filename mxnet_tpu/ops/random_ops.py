@@ -1,0 +1,221 @@
+"""Random sampling operators.
+
+Reference: src/operator/random/sample_op.cc (_random_*), multisample_op.cc
+(_sample_* tensor-parameter variants), sample_multinomial_op.cc, shuffle.
+All draw from the framework's counter-based PRNG chain (mxnet_tpu.random)
+— the TPU-native replacement for the reference's per-device random
+resource (src/resource.cc kParallelRandom).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register
+
+
+def _shape_dtype(attrs):
+    shape = attrs.get("shape", ())
+    if shape is None:
+        shape = ()
+    if isinstance(shape, int):
+        shape = (shape,)
+    return tuple(shape), jnp.dtype(attrs.get("dtype") or "float32")
+
+
+def _random_uniform(attrs, rng=None):
+    shape, dt = _shape_dtype(attrs)
+    lo = float(attrs.get("low", 0.0))
+    hi = float(attrs.get("high", 1.0))
+    return jax.random.uniform(rng, shape, dtype=dt, minval=lo, maxval=hi)
+
+
+register("_random_uniform", _random_uniform, arg_names=(), needs_rng=True,
+         defaults={"low": 0.0, "high": 1.0, "shape": (), "dtype": "float32",
+                   "ctx": None})
+
+
+def _random_normal(attrs, rng=None):
+    shape, dt = _shape_dtype(attrs)
+    loc = float(attrs.get("loc", 0.0))
+    scale = float(attrs.get("scale", 1.0))
+    return loc + scale * jax.random.normal(rng, shape, dtype=dt)
+
+
+register("_random_normal", _random_normal, arg_names=(), needs_rng=True,
+         defaults={"loc": 0.0, "scale": 1.0, "shape": (), "dtype": "float32",
+                   "ctx": None})
+
+
+def _random_gamma(attrs, rng=None):
+    shape, dt = _shape_dtype(attrs)
+    alpha = float(attrs.get("alpha", 1.0))
+    beta = float(attrs.get("beta", 1.0))
+    return jax.random.gamma(rng, alpha, shape, dtype=dt) * beta
+
+
+register("_random_gamma", _random_gamma, arg_names=(), needs_rng=True,
+         defaults={"alpha": 1.0, "beta": 1.0, "shape": (), "dtype": "float32",
+                   "ctx": None})
+
+
+def _random_exponential(attrs, rng=None):
+    shape, dt = _shape_dtype(attrs)
+    lam = float(attrs.get("lam", 1.0))
+    return jax.random.exponential(rng, shape, dtype=dt) / lam
+
+
+register("_random_exponential", _random_exponential, arg_names=(),
+         needs_rng=True,
+         defaults={"lam": 1.0, "shape": (), "dtype": "float32", "ctx": None})
+
+
+def _random_poisson(attrs, rng=None):
+    shape, dt = _shape_dtype(attrs)
+    lam = float(attrs.get("lam", 1.0))
+    return jax.random.poisson(rng, lam, shape).astype(dt)
+
+
+register("_random_poisson", _random_poisson, arg_names=(), needs_rng=True,
+         defaults={"lam": 1.0, "shape": (), "dtype": "float32", "ctx": None})
+
+
+def _random_randint(attrs, rng=None):
+    shape, _ = _shape_dtype(attrs)
+    dt = jnp.dtype(attrs.get("dtype") or "int32")
+    lo = int(attrs.get("low", 0))
+    hi = int(attrs.get("high", 1))
+    return jax.random.randint(rng, shape, lo, hi).astype(dt)
+
+
+register("_random_randint", _random_randint, arg_names=(), needs_rng=True,
+         defaults={"low": 0, "high": 1, "shape": (), "dtype": "int32",
+                   "ctx": None})
+
+
+def _random_negative_binomial(attrs, rng=None):
+    shape, dt = _shape_dtype(attrs)
+    k = float(attrs.get("k", 1))
+    p = float(attrs.get("p", 1.0))
+    # NB(k, p) = Poisson(Gamma(k, (1-p)/p))
+    g = jax.random.gamma(rng, k, shape) * ((1.0 - p) / p)
+    return jax.random.poisson(jax.random.fold_in(rng, 1), g, shape).astype(dt)
+
+
+register("_random_negative_binomial", _random_negative_binomial,
+         arg_names=(), needs_rng=True,
+         defaults={"k": 1, "p": 1.0, "shape": (), "dtype": "float32",
+                   "ctx": None})
+
+
+def _random_generalized_negative_binomial(attrs, rng=None):
+    shape, dt = _shape_dtype(attrs)
+    mu = float(attrs.get("mu", 1.0))
+    alpha = float(attrs.get("alpha", 1.0))
+    if alpha == 0.0:
+        return jax.random.poisson(rng, mu, shape).astype(dt)
+    k = 1.0 / alpha
+    p = k / (k + mu)
+    g = jax.random.gamma(rng, k, shape) * ((1.0 - p) / p)
+    return jax.random.poisson(jax.random.fold_in(rng, 1), g, shape).astype(dt)
+
+
+register("_random_generalized_negative_binomial",
+         _random_generalized_negative_binomial, arg_names=(), needs_rng=True,
+         defaults={"mu": 1.0, "alpha": 1.0, "shape": (), "dtype": "float32",
+                   "ctx": None})
+
+
+# ---- tensor-parameter samplers (_sample_*) --------------------------------
+
+def _bshape(param, extra):
+    extra = tuple(extra) if extra else ()
+    return tuple(param.shape) + extra
+
+
+def _sample_uniform(attrs, low, high, rng=None):
+    shape = _bshape(low, attrs.get("shape", ()))
+    dt = jnp.dtype(attrs.get("dtype") or "float32")
+    u = jax.random.uniform(rng, shape, dtype=dt)
+    nd_extra = len(shape) - low.ndim
+    lo = low.reshape(low.shape + (1,) * nd_extra)
+    hi = high.reshape(high.shape + (1,) * nd_extra)
+    return lo + u * (hi - lo)
+
+
+register("_sample_uniform", _sample_uniform, arg_names=("low", "high"),
+         needs_rng=True, defaults={"shape": (), "dtype": "float32"})
+
+
+def _sample_normal(attrs, mu, sigma, rng=None):
+    shape = _bshape(mu, attrs.get("shape", ()))
+    dt = jnp.dtype(attrs.get("dtype") or "float32")
+    z = jax.random.normal(rng, shape, dtype=dt)
+    nd_extra = len(shape) - mu.ndim
+    m = mu.reshape(mu.shape + (1,) * nd_extra)
+    s = sigma.reshape(sigma.shape + (1,) * nd_extra)
+    return m + z * s
+
+
+register("_sample_normal", _sample_normal, arg_names=("mu", "sigma"),
+         needs_rng=True, defaults={"shape": (), "dtype": "float32"})
+
+
+def _sample_gamma(attrs, alpha, beta, rng=None):
+    shape = _bshape(alpha, attrs.get("shape", ()))
+    dt = jnp.dtype(attrs.get("dtype") or "float32")
+    nd_extra = len(shape) - alpha.ndim
+    a = alpha.reshape(alpha.shape + (1,) * nd_extra)
+    b = beta.reshape(beta.shape + (1,) * nd_extra)
+    g = jax.random.gamma(rng, jnp.broadcast_to(a, shape).astype(dt), shape)
+    return g * b
+
+
+register("_sample_gamma", _sample_gamma, arg_names=("alpha", "beta"),
+         needs_rng=True, defaults={"shape": (), "dtype": "float32"})
+
+
+def _sample_multinomial(attrs, data, rng=None):
+    shape = attrs.get("shape", ())
+    if shape is None:
+        shape = ()
+    if isinstance(shape, int):
+        shape = (shape,)
+    n = 1
+    for s in shape:
+        n *= s
+    n = max(n, 1)
+    get_prob = bool(attrs.get("get_prob", False))
+    dt = jnp.dtype(attrs.get("dtype") or "int32")
+    logits = jnp.log(jnp.clip(data, 1e-20, None))
+    if data.ndim == 1:
+        draws = jax.random.categorical(rng, logits, shape=(n,))
+        out = draws.reshape(shape).astype(dt) if shape else draws[0].astype(dt)
+    else:
+        draws = jax.random.categorical(rng, logits[:, None, :], axis=-1,
+                                       shape=(data.shape[0], n))
+        out = draws.reshape((data.shape[0],) + tuple(shape)).astype(dt)
+    if get_prob:
+        lp = jnp.take_along_axis(
+            jax.nn.log_softmax(logits),
+            out.reshape(data.shape[0], -1).astype(jnp.int32)
+            if data.ndim > 1 else out.reshape(-1).astype(jnp.int32)[None],
+            axis=-1)
+        lp = lp.reshape(out.shape).astype(jnp.float32)
+        return out, lp
+    return out
+
+
+register("_sample_multinomial", _sample_multinomial, arg_names=("data",),
+         needs_rng=True,
+         defaults={"shape": (), "get_prob": False, "dtype": "int32"},
+         num_outputs=lambda attrs: 2 if attrs.get("get_prob", False) else 1,
+         aliases=("multinomial",))
+
+
+def _shuffle(attrs, data, rng=None):
+    return jax.random.permutation(rng, data, axis=0)
+
+
+register("_shuffle", _shuffle, arg_names=("data",), needs_rng=True,
+         aliases=("shuffle",))

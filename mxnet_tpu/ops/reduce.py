@@ -1,0 +1,120 @@
+"""Reduction and broadcast-shape operators.
+
+Reference: src/operator/tensor/broadcast_reduce_op_value.cc,
+broadcast_reduce_op_index.cc (argmax/argmin), L2 norm in
+broadcast_reduce_op.h. Attribute semantics preserved: ``axis`` may be
+None/int/tuple, ``exclude=True`` reduces over the complement, ``keepdims``
+keeps reduced dims as 1.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .registry import register
+
+_D = ("data",)
+
+
+def _norm_axis(attrs, ndim):
+    axis = attrs.get("axis", None)
+    if axis is None or axis == () or axis == []:
+        axes = tuple(range(ndim))
+    elif isinstance(axis, int):
+        axes = (axis % ndim,)
+    else:
+        axes = tuple(a % ndim for a in axis)
+    if attrs.get("exclude", False):
+        axes = tuple(a for a in range(ndim) if a not in axes)
+    return axes
+
+
+def _reg_reduce(name, fn, aliases=()):
+    def fwd(attrs, x, _f=fn):
+        axes = _norm_axis(attrs, x.ndim)
+        return _f(x, axes, bool(attrs.get("keepdims", False)))
+    register(name, fwd, arg_names=_D,
+             defaults={"axis": None, "keepdims": False, "exclude": False},
+             aliases=aliases)
+
+
+_reg_reduce("sum", lambda x, a, k: jnp.sum(x, axis=a, keepdims=k),
+            aliases=("sum_axis",))
+_reg_reduce("mean", lambda x, a, k: jnp.mean(x, axis=a, keepdims=k))
+_reg_reduce("prod", lambda x, a, k: jnp.prod(x, axis=a, keepdims=k))
+_reg_reduce("nansum", lambda x, a, k: jnp.nansum(x, axis=a, keepdims=k))
+_reg_reduce("nanprod", lambda x, a, k: jnp.nanprod(x, axis=a, keepdims=k))
+_reg_reduce("max", lambda x, a, k: jnp.max(x, axis=a, keepdims=k),
+            aliases=("max_axis",))
+_reg_reduce("min", lambda x, a, k: jnp.min(x, axis=a, keepdims=k),
+            aliases=("min_axis",))
+
+
+def _norm(attrs, x):
+    axes = _norm_axis(attrs, x.ndim)
+    ord_ = int(attrs.get("ord", 2))
+    k = bool(attrs.get("keepdims", False))
+    if ord_ == 1:
+        return jnp.sum(jnp.abs(x), axis=axes, keepdims=k)
+    return jnp.sqrt(jnp.sum(jnp.square(x), axis=axes, keepdims=k))
+
+
+register("norm", _norm, arg_names=_D,
+         defaults={"axis": None, "keepdims": False, "exclude": False, "ord": 2})
+
+
+def _reg_argminmax(name, fn):
+    def fwd(attrs, x, _f=fn):
+        axis = attrs.get("axis", None)
+        k = bool(attrs.get("keepdims", False))
+        # MXNet returns float dtype indices (same dtype family as input)
+        if axis is None:
+            r = _f(x.reshape(-1), axis=0)
+            return r.astype(jnp.float32)
+        r = _f(x, axis=int(axis))
+        if k:
+            r = jnp.expand_dims(r, int(axis))
+        return r.astype(jnp.float32)
+    register(name, fwd, arg_names=_D, defaults={"axis": None, "keepdims": False})
+
+
+_reg_argminmax("argmax", jnp.argmax)
+_reg_argminmax("argmin", jnp.argmin)
+
+register("argmax_channel",
+         lambda attrs, x: jnp.argmax(x, axis=1).astype(jnp.float32),
+         arg_names=_D)
+
+
+# ---------------------------------------------------------------------------
+# Broadcast shape manipulation
+# ---------------------------------------------------------------------------
+
+def _broadcast_to(attrs, x):
+    shape = tuple(attrs["shape"])
+    # 0 in target shape means "keep input dim" (MXNet convention)
+    shape = tuple(x.shape[i] if s == 0 else s for i, s in enumerate(shape))
+    return jnp.broadcast_to(x, shape)
+
+
+register("broadcast_to", _broadcast_to, arg_names=_D, defaults={"shape": ()})
+
+
+def _broadcast_axis(attrs, x):
+    axis = attrs.get("axis", ())
+    size = attrs.get("size", ())
+    if isinstance(axis, int):
+        axis = (axis,)
+    if isinstance(size, int):
+        size = (size,)
+    shape = list(x.shape)
+    for a, s in zip(axis, size):
+        shape[a] = s
+    return jnp.broadcast_to(x, tuple(shape))
+
+
+register("broadcast_axis", _broadcast_axis, arg_names=_D,
+         defaults={"axis": (), "size": ()}, aliases=("broadcast_axes",))
+
+register("broadcast_like",
+         lambda attrs, x, y: jnp.broadcast_to(x, y.shape),
+         arg_names=("lhs", "rhs"))

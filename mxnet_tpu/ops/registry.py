@@ -1,0 +1,221 @@
+"""Operator registry — the TPU-native equivalent of the NNVM op registry.
+
+Reference model (include/mxnet/op_attr_types.h, src/operator/*): each op
+registers FCompute kernels per device plus attribute functors
+(FInferShape/FInferType/FGradient/FMutateInputs...). On TPU the design
+collapses dramatically:
+
+- An op's body is ONE pure JAX function ``forward(attrs, *inputs)`` —
+  XLA compiles it for any backend, so there is no per-device kernel pair
+  (``X.cc``/``X.cu``) and no mshadow expression layer.
+- Gradients come from ``jax.vjp`` over the traced graph — no per-op
+  FGradient registration.
+- Shape/type inference comes from ``jax.eval_shape`` over the same
+  function — no per-op FInferShape/FInferType.
+
+What remains per-op, and is registered here: the forward body, input arg
+names (for Symbol ``list_arguments``), number of outputs, RNG needs
+(counter-based like the reference's parallel-random resource), mutable
+input indices (BatchNorm aux-state writeback, optimizer update ops), and
+attribute parsing (the dmlc ``Parameter`` struct role).
+
+Eager dispatch mirrors ``Imperative::Invoke``
+(src/imperative/imperative.cc:87): op + static attrs → a cached
+``jax.jit`` callable (the analogue of the per-signature CachedOp cache).
+"""
+from __future__ import annotations
+
+import ast
+import functools
+import threading
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple, Union
+
+from ..base import MXNetError, Registry
+
+__all__ = ["OpDef", "register", "get_op", "find_op", "list_ops", "invoke",
+           "normalize_attrs", "attr_key"]
+
+_OP_REGISTRY: Registry = Registry("operator")
+
+
+class OpDef:
+    """A registered operator.
+
+    Parameters
+    ----------
+    name : canonical op name (e.g. ``FullyConnected``, ``_plus_scalar``).
+    forward : ``forward(attrs: dict, *inputs, rng=None) -> array | tuple``.
+        Pure JAX function. If ``mutable_inputs`` is set, the returned tuple
+        carries ``num_outputs`` real outputs followed by one updated value
+        per mutable input (in order).
+    arg_names : names of tensor inputs (Symbol ``list_arguments`` order).
+    defaults : attribute name → default value (dmlc Parameter struct role).
+    num_outputs : int, or callable ``attrs -> int`` for variadic outputs.
+    key_var_num_args : attr holding the variadic input count (Concat's
+        ``num_args``), mirroring nnvm's ``key_var_num_args``.
+    needs_rng : op consumes a PRNG key (samplers, Dropout).
+    mutable_inputs : indices of inputs updated in place (FMutateInputs).
+    """
+
+    def __init__(self, name: str, forward: Callable,
+                 arg_names: Sequence[str] = ("data",),
+                 defaults: Optional[Dict[str, Any]] = None,
+                 num_outputs: Union[int, Callable] = 1,
+                 key_var_num_args: Optional[str] = None,
+                 needs_rng: bool = False,
+                 mutable_inputs: Sequence[int] = (),
+                 arg_names_fn: Optional[Callable] = None,
+                 description: str = ""):
+        self.name = name
+        self.forward = forward
+        self.arg_names = list(arg_names)
+        self.defaults = dict(defaults or {})
+        self.num_outputs = num_outputs
+        self.key_var_num_args = key_var_num_args
+        self.needs_rng = needs_rng
+        self.mutable_inputs = tuple(mutable_inputs)
+        self.arg_names_fn = arg_names_fn  # attrs -> effective input names
+        self.description = description or (forward.__doc__ or "")
+
+    # -- helpers ---------------------------------------------------------
+    def resolve_num_outputs(self, attrs: Dict[str, Any]) -> int:
+        if callable(self.num_outputs):
+            return self.num_outputs(attrs)
+        return self.num_outputs
+
+    def resolve_arg_names(self, attrs: Dict[str, Any], num_inputs=None) -> List[str]:
+        if self.key_var_num_args:
+            n = int(attrs.get(self.key_var_num_args,
+                              num_inputs if num_inputs is not None else 1))
+            base = self.arg_names[0] if self.arg_names else "arg"
+            return ["%s%d" % (base, i) for i in range(n)]
+        if self.arg_names_fn is not None:
+            return list(self.arg_names_fn(normalize_attrs(self, attrs)))
+        return list(self.arg_names)
+
+    def __repr__(self):
+        return "OpDef(%s)" % self.name
+
+
+def register(name: str, forward: Optional[Callable] = None, *,
+             aliases: Sequence[str] = (), **kwargs) -> Union[OpDef, Callable]:
+    """Register an operator; usable as function or decorator."""
+    def _do(fwd):
+        op = OpDef(name, fwd, **kwargs)
+        _OP_REGISTRY.register(name)(op)
+        for a in aliases:
+            _OP_REGISTRY.register(a)(op)
+        return op
+    if forward is not None:
+        return _do(forward)
+    return _do
+
+
+def get_op(name: str) -> OpDef:
+    try:
+        return _OP_REGISTRY.get(name)
+    except KeyError:
+        raise MXNetError("Operator '%s' is not registered" % name)
+
+
+def find_op(name: str) -> Optional[OpDef]:
+    return _OP_REGISTRY.find(name)
+
+
+def list_ops() -> List[str]:
+    return sorted(_OP_REGISTRY.keys())
+
+
+# ---------------------------------------------------------------------------
+# Attribute normalization (dmlc Parameter parsing role)
+# ---------------------------------------------------------------------------
+
+_BOOL_STR = {"true": True, "True": True, "1": True,
+             "false": False, "False": False, "0": False}
+
+
+def _parse_attr_value(v):
+    if not isinstance(v, str):
+        return v
+    if v in _BOOL_STR:
+        return _BOOL_STR[v]
+    if v == "None":
+        return None
+    try:
+        return ast.literal_eval(v)
+    except (ValueError, SyntaxError):
+        return v
+
+
+def normalize_attrs(op: OpDef, attrs: Dict[str, Any]) -> Dict[str, Any]:
+    """Merge with defaults and parse stringly-typed values (from Symbol
+    JSON or frontend kwargs), mirroring dmlc Parameter::Init."""
+    out = dict(op.defaults)
+    for k, v in attrs.items():
+        if v is None and k in out:
+            continue
+        out[k] = _parse_attr_value(v)
+    return out
+
+
+def _hashable(v):
+    if isinstance(v, (list, tuple)):
+        return tuple(_hashable(x) for x in v)
+    if isinstance(v, dict):
+        return tuple(sorted((k, _hashable(x)) for k, x in v.items()))
+    return v
+
+
+def attr_key(attrs: Dict[str, Any]):
+    return tuple(sorted((k, _hashable(v)) for k, v in attrs.items()))
+
+
+# ---------------------------------------------------------------------------
+# Eager dispatch with jit cache (Imperative::Invoke analogue)
+# ---------------------------------------------------------------------------
+
+_jit_cache: Dict[Tuple, Callable] = {}
+_jit_lock = threading.Lock()
+
+
+def _get_jitted(op: OpDef, nattrs: Dict[str, Any], n_inputs: int):
+    import jax
+    key = (op.name, attr_key(nattrs), n_inputs, op.needs_rng)
+    fn = _jit_cache.get(key)
+    if fn is None:
+        if op.needs_rng:
+            def raw(rng, *arrays):
+                return op.forward(nattrs, *arrays, rng=rng)
+        else:
+            def raw(*arrays):
+                return op.forward(nattrs, *arrays)
+        fn = jax.jit(raw)
+        with _jit_lock:
+            _jit_cache[key] = fn
+    return fn
+
+
+def invoke(op: OpDef, input_arrays: Sequence[Any], attrs: Dict[str, Any],
+           rng=None):
+    """Eagerly execute ``op`` on raw jax arrays; returns tuple
+    ``(outputs, aux_updates)`` where aux_updates is a list of (input_index,
+    new_value) for mutable inputs."""
+    nattrs = normalize_attrs(op, attrs)
+    fn = _get_jitted(op, nattrs, len(input_arrays))
+    if op.needs_rng:
+        if rng is None:
+            from .. import random as _random
+            rng = _random.new_key()
+        result = fn(rng, *input_arrays)
+    else:
+        result = fn(*input_arrays)
+    if not isinstance(result, (tuple, list)):
+        result = (result,)
+    n_out = op.resolve_num_outputs(nattrs)
+    outputs = tuple(result[:n_out])
+    aux_updates = []
+    if op.mutable_inputs:
+        extras = result[n_out:]
+        for idx, val in zip(op.mutable_inputs, extras):
+            aux_updates.append((idx, val))
+    return outputs, aux_updates

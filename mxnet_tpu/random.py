@@ -1,0 +1,48 @@
+"""Global random state.
+
+Reference: src/resource.cc kParallelRandom + python/mxnet/random.py.
+On TPU randomness is explicit: a process-global counter-based PRNG key
+chain seeds every random op. ``seed(n)`` resets the chain (parity with
+``mx.random.seed``); each random-op invocation consumes a fresh subkey.
+Recorded autograd tapes stash the subkey used so backward replays are
+bit-exact (the role the reference's saved RNG resource states play).
+"""
+from __future__ import annotations
+
+import threading
+
+__all__ = ["seed", "new_key", "current_seed"]
+
+_state = threading.local()
+_DEFAULT_SEED = 0
+
+
+def _get():
+    if not hasattr(_state, "key"):
+        import jax
+        _state.key = jax.random.PRNGKey(_DEFAULT_SEED)
+        _state.seed_val = _DEFAULT_SEED
+    return _state
+
+
+def seed(seed_state, ctx="all"):
+    """Seed the global RNG (reference: python/mxnet/random.py:36).
+
+    ``ctx`` accepted for API parity; on TPU the key chain is global.
+    """
+    import jax
+    st = _get()
+    st.key = jax.random.PRNGKey(int(seed_state))
+    st.seed_val = int(seed_state)
+
+
+def current_seed():
+    return _get().seed_val
+
+
+def new_key():
+    """Split and return a fresh PRNG subkey."""
+    import jax
+    st = _get()
+    st.key, sub = jax.random.split(st.key)
+    return sub

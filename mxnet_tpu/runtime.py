@@ -1,0 +1,52 @@
+"""Runtime feature detection (parity: python/mxnet/runtime.py +
+src/libinfo.cc). Features reflect the TPU-native build."""
+from __future__ import annotations
+
+__all__ = ["Features", "feature_list"]
+
+
+class Feature:
+    def __init__(self, name, enabled):
+        self.name = name
+        self.enabled = enabled
+
+    def __repr__(self):
+        return "%s %s" % ("✔" if self.enabled else "✖", self.name)
+
+
+def _detect():
+    import jax
+    feats = {
+        "TPU": any(d.platform != "cpu" for d in jax.devices()),
+        "XLA": True,
+        "PALLAS": True,
+        "CUDA": False, "CUDNN": False, "NCCL": False, "TENSORRT": False,
+        "MKLDNN": False,
+        "OPENCV": _has("cv2"),
+        "DIST_KVSTORE": True,
+        "INT64_TENSOR_SIZE": True,
+        "SIGNAL_HANDLER": True,
+        "F16C": True,
+        "JAX_DISTRIBUTED": True,
+    }
+    return {k: Feature(k, v) for k, v in feats.items()}
+
+
+def _has(mod):
+    try:
+        __import__(mod)
+        return True
+    except ImportError:
+        return False
+
+
+class Features(dict):
+    def __init__(self):
+        super().__init__(_detect())
+
+    def is_enabled(self, name):
+        return self[name.upper()].enabled
+
+
+def feature_list():
+    return list(Features().values())

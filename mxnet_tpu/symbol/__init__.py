@@ -1,0 +1,12 @@
+"""Symbol namespace: the symbolic API surface (``mx.sym``)."""
+from .symbol import (Symbol, var, Variable, Group, load, load_json, create,
+                     zeros, ones, full, arange, pow, maximum, minimum, hypot)
+from . import random
+from .register import install_ops as _install_ops
+
+_install_ops(globals())
+
+import types as _types
+
+op = _types.ModuleType(__name__ + ".op")
+_install_ops(op.__dict__)

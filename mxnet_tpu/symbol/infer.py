@@ -1,0 +1,122 @@
+"""Parameter-shape inference hooks for symbolic binding.
+
+The reference infers ALL shapes through per-op FInferShape functors
+(include/mxnet/op_attr_types.h). TPU-native design: *output* shapes come
+free from ``jax.eval_shape`` over the op body; what still needs per-op
+knowledge is inferring **learnable parameter shapes backward from the
+data shape** (e.g. FullyConnected weight = (num_hidden, in_dim)), which
+``simple_bind`` depends on. Only the ~10 param-bearing ops need a hook.
+
+Hook signature: ``hook(attrs, in_shapes) -> {input_index: shape}`` where
+``in_shapes`` has concrete tuples for known inputs and None for unknown.
+"""
+from __future__ import annotations
+
+PARAM_SHAPE_HOOKS = {}
+
+
+def hook(op_name):
+    def deco(fn):
+        PARAM_SHAPE_HOOKS[op_name] = fn
+        return fn
+    return deco
+
+
+def _prod(xs):
+    n = 1
+    for x in xs:
+        n *= x
+    return n
+
+
+@hook("FullyConnected")
+def _fc(attrs, in_shapes):
+    data = in_shapes[0]
+    if data is None:
+        return {}
+    num_hidden = int(attrs["num_hidden"])
+    flatten = bool(attrs.get("flatten", True))
+    in_dim = _prod(data[1:]) if flatten else data[-1]
+    out = {1: (num_hidden, in_dim)}
+    if not bool(attrs.get("no_bias", False)):
+        out[2] = (num_hidden,)
+    return out
+
+
+@hook("Convolution")
+def _conv(attrs, in_shapes):
+    data = in_shapes[0]
+    if data is None:
+        return {}
+    kernel = tuple(attrs["kernel"])
+    num_filter = int(attrs["num_filter"])
+    groups = int(attrs.get("num_group", 1))
+    out = {1: (num_filter, data[1] // groups) + kernel}
+    if not bool(attrs.get("no_bias", False)):
+        out[2] = (num_filter,)
+    return out
+
+
+@hook("Deconvolution")
+def _deconv(attrs, in_shapes):
+    data = in_shapes[0]
+    if data is None:
+        return {}
+    kernel = tuple(attrs["kernel"])
+    num_filter = int(attrs["num_filter"])
+    groups = int(attrs.get("num_group", 1))
+    out = {1: (data[1], num_filter // groups) + kernel}
+    if not bool(attrs.get("no_bias", True)):
+        out[2] = (num_filter,)
+    return out
+
+
+def _channel_param(axis_default=1):
+    def fn(attrs, in_shapes):
+        data = in_shapes[0]
+        if data is None:
+            return {}
+        axis = int(attrs.get("axis", axis_default)) % len(data)
+        c = data[axis]
+        return {i: (c,) for i in range(1, len(in_shapes))}
+    return fn
+
+
+PARAM_SHAPE_HOOKS["BatchNorm"] = _channel_param(1)
+PARAM_SHAPE_HOOKS["InstanceNorm"] = _channel_param(1)
+PARAM_SHAPE_HOOKS["LayerNorm"] = _channel_param(-1)
+
+
+@hook("Embedding")
+def _embedding(attrs, in_shapes):
+    return {1: (int(attrs["input_dim"]), int(attrs["output_dim"]))}
+
+
+@hook("LeakyReLU")
+def _leaky(attrs, in_shapes):
+    if attrs.get("act_type", "leaky") != "prelu":
+        return {}
+    data = in_shapes[0]
+    if data is None:
+        return {}
+    return {1: (data[1] if len(data) > 1 else 1,)}
+
+
+@hook("RNN")
+def _rnn(attrs, in_shapes):
+    data = in_shapes[0]
+    if data is None:
+        return {}
+    mode = attrs.get("mode", "lstm")
+    num_layers = int(attrs.get("num_layers", 1))
+    state_size = int(attrs["state_size"])
+    bidirectional = bool(attrs.get("bidirectional", False))
+    d = 2 if bidirectional else 1
+    input_size = data[2]
+    ngates = {"rnn_relu": 1, "rnn_tanh": 1, "lstm": 4, "gru": 3}[mode]
+    size = 0
+    for layer in range(num_layers):
+        for _ in range(d):
+            in_sz = input_size if layer == 0 else state_size * d
+            size += ngates * state_size * (in_sz + state_size + 2)
+    return {1: (size,)}

@@ -1,0 +1,50 @@
+"""Code-generated Symbol op namespace (parity: python/mxnet/symbol/register.py)."""
+from __future__ import annotations
+
+from .. import ops as _ops
+from .symbol import Symbol, create
+
+__all__ = ["make_stub", "install_ops"]
+
+
+def make_stub(op):
+    def stub(*args, **kwargs):
+        name = kwargs.pop("name", None)
+        kwargs.pop("out", None)
+        attr = kwargs.pop("attr", None)
+        symbols = []
+        for a in args:
+            if isinstance(a, Symbol):
+                symbols.append(a)
+            elif isinstance(a, (list, tuple)) and a \
+                    and all(isinstance(x, Symbol) for x in a):
+                symbols.extend(a)
+            else:
+                raise TypeError(
+                    "%s: positional arguments must be Symbols; pass operator"
+                    " parameters as keywords (got %r)" % (op.name, type(a)))
+        named = {k: kwargs.pop(k) for k in list(kwargs)
+                 if isinstance(kwargs[k], Symbol)}
+        if named:
+            arg_names = op.resolve_arg_names(kwargs, num_inputs=len(named))
+            bound = dict(zip(arg_names, symbols))
+            bound.update(named)
+            symbols = [bound[n] for n in arg_names if n in bound]
+        out = create(op, symbols, kwargs, name=name)
+        if attr:
+            out._set_attr(**attr)
+        return out
+
+    stub.__name__ = op.name
+    stub.__doc__ = op.description
+    return stub
+
+
+def install_ops(namespace):
+    seen = {}
+    for name in _ops.list_ops():
+        op = _ops.get_op(name)
+        if id(op) not in seen:
+            seen[id(op)] = make_stub(op)
+        namespace.setdefault(name, seen[id(op)])
+    return namespace

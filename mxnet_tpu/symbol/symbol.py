@@ -1,0 +1,692 @@
+"""Symbol — the symbolic graph IR.
+
+Parity target: python/mxnet/symbol/symbol.py + nnvm Symbol/Graph.
+
+TPU-native design (SURVEY §7): Symbol stays a light DAG of op nodes;
+``bind``/``simple_bind`` lowers the ENTIRE graph to one jitted XLA
+computation (the Executor), replacing the reference's NNVM pass pipeline
+(PlanMemory/AttachOpExecs/per-node engine push). Shape inference walks
+the graph once using ``jax.eval_shape`` per node plus backward
+param-shape hooks (symbol/infer.py) — no per-op FInferShape functors.
+JSON serialization follows the nnvm graph format so the two-file deploy
+artifact (symbol.json + params) survives.
+"""
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from ..base import MXNetError, numeric_types
+from ..name import NameManager
+from ..attribute import AttrScope
+from .. import ops as _ops
+from .infer import PARAM_SHAPE_HOOKS
+
+__all__ = ["Symbol", "var", "Variable", "Group", "load", "load_json",
+           "pow", "maximum", "minimum", "hypot", "zeros", "ones", "full",
+           "arange"]
+
+
+class _Node:
+    __slots__ = ("op", "name", "attrs", "inputs", "_extra_attrs")
+
+    def __init__(self, op, name, attrs, inputs):
+        self.op = op                 # OpDef or None for variables
+        self.name = name
+        self.attrs = attrs or {}     # op params (normalized python values)
+        self.inputs = inputs or []   # list[(node, out_idx)]
+        self._extra_attrs = {}       # user attrs (__shape__, ctx_group, ...)
+
+    def num_outputs(self):
+        if self.op is None:
+            return 1
+        return self.op.resolve_num_outputs(
+            _ops.normalize_attrs(self.op, self.attrs))
+
+    def is_variable(self):
+        return self.op is None
+
+
+def _topo(nodes_or_entries):
+    """Topological order of nodes reachable from output entries."""
+    order = []
+    visited = set()
+
+    def dfs(node):
+        if id(node) in visited:
+            return
+        visited.add(id(node))
+        for (n, _) in node.inputs:
+            dfs(n)
+        order.append(node)
+
+    for (n, _) in nodes_or_entries:
+        dfs(n)
+    return order
+
+
+class Symbol:
+    """Symbolic graph handle: a list of output entries into a node DAG."""
+
+    __array_priority__ = 1000.0
+
+    def __init__(self, outputs: Sequence[Tuple[_Node, int]]):
+        self._outputs = list(outputs)
+
+    # -- identity --------------------------------------------------------
+    @property
+    def name(self):
+        if len(self._outputs) == 1:
+            return self._outputs[0][0].name
+        return None
+
+    def __repr__(self):
+        name = self.name
+        if name is None:
+            name = ', '.join(n.name for (n, _) in self._outputs)
+            return '<%s group [%s]>' % (type(self).__name__, name)
+        return '<%s %s>' % (type(self).__name__, name)
+
+    def __iter__(self):
+        return (self[i] for i in range(len(self)))
+
+    def __len__(self):
+        return len(self.list_outputs())
+
+    def __copy__(self):
+        return Symbol(list(self._outputs))
+
+    def __deepcopy__(self, memo):
+        # graph nodes are immutable-by-convention; shallow is fine
+        return Symbol(list(self._outputs))
+
+    def __getitem__(self, index):
+        outputs = self.list_outputs()
+        if isinstance(index, str):
+            idx = None
+            for i, nm in enumerate(outputs):
+                if nm == index:
+                    if idx is not None:
+                        raise ValueError("duplicate output name %s" % index)
+                    idx = i
+            if idx is None:
+                raise ValueError("cannot find output %s" % index)
+            index = idx
+        if isinstance(index, slice):
+            return Group([self[i]
+                          for i in range(*index.indices(len(outputs)))])
+        if index >= len(outputs):
+            raise IndexError("index out of range")
+        return Symbol([self._outputs[index]])
+
+    # -- graph inspection ------------------------------------------------
+    def _topo_nodes(self):
+        return _topo(self._outputs)
+
+    def list_arguments(self):
+        args = []
+        aux = set(self._aux_node_ids())
+        for n in self._topo_nodes():
+            if n.is_variable() and id(n) not in aux:
+                args.append(n.name)
+        return args
+
+    def _aux_node_ids(self):
+        aux_ids = []
+        for n in self._topo_nodes():
+            if n.op is not None and n.op.mutable_inputs:
+                for idx in n.op.mutable_inputs:
+                    if idx < len(n.inputs):
+                        src, _ = n.inputs[idx]
+                        if src.is_variable():
+                            aux_ids.append(id(src))
+        return aux_ids
+
+    def list_auxiliary_states(self):
+        aux = set(self._aux_node_ids())
+        return [n.name for n in self._topo_nodes()
+                if n.is_variable() and id(n) in aux]
+
+    def list_inputs(self):
+        return [n.name for n in self._topo_nodes() if n.is_variable()]
+
+    def list_outputs(self):
+        names = []
+        for (n, i) in self._outputs:
+            if n.is_variable():
+                names.append(n.name)
+            elif n.num_outputs() == 1:
+                names.append(n.name + "_output")
+            else:
+                names.append("%s_output%d" % (n.name, i))
+        return names
+
+    def get_internals(self):
+        entries = []
+        for n in self._topo_nodes():
+            for i in range(n.num_outputs()):
+                entries.append((n, i))
+        return Symbol(entries)
+
+    def get_children(self):
+        children = []
+        for (n, _) in self._outputs:
+            children.extend(n.inputs)
+        if not children:
+            return None
+        return Symbol(children)
+
+    # -- attributes ------------------------------------------------------
+    def attr(self, key):
+        if len(self._outputs) == 1:
+            node = self._outputs[0][0]
+            v = node._extra_attrs.get(key)
+            if v is None and node.op is not None and key in node.attrs:
+                v = str(node.attrs[key])
+            return v
+        return None
+
+    def list_attr(self, recursive=False):
+        if recursive:
+            return self.attr_dict()
+        node = self._outputs[0][0]
+        out = {k: str(v) for k, v in node.attrs.items()}
+        out.update(node._extra_attrs)
+        return out
+
+    def attr_dict(self):
+        ret = {}
+        for n in self._topo_nodes():
+            d = {k: str(v) for k, v in n.attrs.items()}
+            d.update(n._extra_attrs)
+            if d:
+                ret[n.name] = d
+        return ret
+
+    def _set_attr(self, **kwargs):
+        node = self._outputs[0][0]
+        node._extra_attrs.update(kwargs)
+
+    # -- shape/type inference -------------------------------------------
+    def infer_shape(self, *args, **kwargs):
+        arg_shapes, out_shapes, aux_shapes, unknown = \
+            self._infer_shape_impl(False, *args, **kwargs)
+        if unknown:
+            raise MXNetError(
+                "infer_shape: cannot determine shapes for argument(s) %s; "
+                "provide them explicitly" % (unknown,))
+        return arg_shapes, out_shapes, aux_shapes
+
+    def infer_shape_partial(self, *args, **kwargs):
+        a, o, x, _ = self._infer_shape_impl(True, *args, **kwargs)
+        return a, o, x
+
+    def _infer_shape_impl(self, partial, *args, **kwargs):
+        import jax
+        import numpy as _np
+
+        arg_names = self.list_arguments()
+        known: Dict[str, tuple] = {}
+        if args:
+            for name, shape in zip(arg_names, args):
+                if shape is not None:
+                    known[name] = tuple(shape)
+        known.update({k: tuple(v) for k, v in kwargs.items()
+                      if v is not None})
+
+        dtypes: Dict[int, Any] = {}
+        shapes: Dict[int, Optional[tuple]] = {}   # id(node),idx → shape
+        node_dtype: Dict[Tuple[int, int], Any] = {}
+        unknown_vars = []
+
+        nodes = self._topo_nodes()
+        var_shape_of = {}
+        for n in nodes:
+            if n.is_variable():
+                shape = known.get(n.name)
+                if shape is None:
+                    sh_attr = n._extra_attrs.get("__shape__")
+                    if sh_attr:
+                        shape = tuple(json.loads(sh_attr.replace("(", "[")
+                                                 .replace(")", "]")))
+                dt = n._extra_attrs.get("__dtype__") or "float32"
+                var_shape_of[id(n)] = shape
+                shapes[(id(n), 0)] = shape
+                node_dtype[(id(n), 0)] = _np.dtype(dt)
+            else:
+                nattrs = _ops.normalize_attrs(n.op, n.attrs)
+                in_shapes = []
+                in_dtypes = []
+                for (src, idx) in n.inputs:
+                    in_shapes.append(shapes.get((id(src), idx)))
+                    in_dtypes.append(node_dtype.get((id(src), idx),
+                                                    _np.dtype("float32")))
+                # resolve unknown learnable params via hooks
+                hook = PARAM_SHAPE_HOOKS.get(n.op.name)
+                if hook and any(s is None for s in in_shapes):
+                    try:
+                        resolved = hook(nattrs, in_shapes)
+                    except Exception:
+                        resolved = {}
+                    for i, shp in resolved.items():
+                        if i < len(n.inputs) and in_shapes[i] is None:
+                            in_shapes[i] = tuple(shp)
+                            src, sidx = n.inputs[i]
+                            shapes[(id(src), sidx)] = tuple(shp)
+                            if src.is_variable():
+                                var_shape_of[id(src)] = tuple(shp)
+                if any(s is None for s in in_shapes):
+                    for (src, _), s in zip(n.inputs, in_shapes):
+                        if s is None and src.is_variable():
+                            unknown_vars.append(src.name)
+                    for i in range(n.num_outputs()):
+                        shapes[(id(n), i)] = None
+                    continue
+                structs = [jax.ShapeDtypeStruct(s, d)
+                           for s, d in zip(in_shapes, in_dtypes)]
+                try:
+                    if n.op.needs_rng:
+                        key_s = jax.ShapeDtypeStruct((2,), _np.uint32)
+                        out = jax.eval_shape(
+                            lambda k, *xs: n.op.forward(nattrs, *xs, rng=k),
+                            key_s, *structs)
+                    else:
+                        out = jax.eval_shape(
+                            lambda *xs: n.op.forward(nattrs, *xs), *structs)
+                except Exception as e:
+                    raise MXNetError(
+                        "infer_shape failed at op %s(%s): %s"
+                        % (n.op.name, n.name, e))
+                if not isinstance(out, (tuple, list)):
+                    out = (out,)
+                for i in range(n.num_outputs()):
+                    shapes[(id(n), i)] = tuple(out[i].shape)
+                    node_dtype[(id(n), i)] = out[i].dtype
+
+        aux_set = set(self._aux_node_ids())
+        arg_shapes = [var_shape_of.get(id(n)) for n in nodes
+                      if n.is_variable() and id(n) not in aux_set]
+        aux_shapes = [var_shape_of.get(id(n)) for n in nodes
+                      if n.is_variable() and id(n) in aux_set]
+        out_shapes = [shapes.get((id(n), i)) for (n, i) in self._outputs]
+        return arg_shapes, out_shapes, aux_shapes, sorted(set(unknown_vars))
+
+    def infer_type(self, *args, **kwargs):
+        import numpy as _np
+        # dtype inference: defaults float32; honor __dtype__ attrs & kwargs
+        arg_names = self.list_arguments()
+        known = {}
+        if args:
+            for name, dt in zip(arg_names, args):
+                if dt is not None:
+                    known[name] = _np.dtype(dt)
+        known.update({k: _np.dtype(v) for k, v in kwargs.items()
+                      if v is not None})
+        arg_types = []
+        for n in self.list_arguments():
+            arg_types.append(known.get(n, _np.dtype("float32")))
+        out_types = [_np.dtype("float32")] * len(self._outputs)
+        aux_types = [_np.dtype("float32")] * len(self.list_auxiliary_states())
+        return arg_types, out_types, aux_types
+
+    # -- evaluation ------------------------------------------------------
+    def bind(self, ctx, args, args_grad=None, grad_req="write",
+             aux_states=None, group2ctx=None, shared_exec=None):
+        from ..executor import Executor
+        return Executor(self, ctx, args, args_grad, grad_req, aux_states)
+
+    def simple_bind(self, ctx, grad_req="write", type_dict=None,
+                    stype_dict=None, group2ctx=None, shared_arg_names=None,
+                    shared_exec=None, shared_buffer=None, **kwargs):
+        from ..executor import Executor
+        from ..ndarray import zeros as nd_zeros
+        arg_shapes, _, aux_shapes = self.infer_shape(**kwargs)
+        arg_names = self.list_arguments()
+        aux_names = self.list_auxiliary_states()
+        type_dict = type_dict or {}
+        args = [nd_zeros(s, ctx=ctx, dtype=type_dict.get(n, "float32"))
+                for n, s in zip(arg_names, arg_shapes)]
+        if isinstance(grad_req, str):
+            reqs = {n: grad_req for n in arg_names}
+        elif isinstance(grad_req, (list, tuple)):
+            reqs = dict(zip(arg_names, grad_req))
+        else:
+            reqs = {n: grad_req.get(n, "null") for n in arg_names}
+        args_grad = {n: nd_zeros(s, ctx=ctx,
+                                 dtype=type_dict.get(n, "float32"))
+                     for n, s in zip(arg_names, arg_shapes)
+                     if reqs.get(n, "null") != "null"}
+        aux = [nd_zeros(s, ctx=ctx) for s in aux_shapes]
+        return Executor(self, ctx, args, args_grad, reqs, aux)
+
+    def eval(self, ctx=None, **kwargs):
+        from ..context import current_context
+        ctx = ctx or current_context()
+        ex = self.bind(ctx, kwargs)
+        return ex.forward()
+
+    # -- serialization ---------------------------------------------------
+    def tojson(self):
+        nodes = self._topo_nodes()
+        nid = {id(n): i for i, n in enumerate(nodes)}
+        jnodes = []
+        arg_nodes = []
+        for i, n in enumerate(nodes):
+            if n.is_variable():
+                arg_nodes.append(i)
+            jn = {
+                "op": "null" if n.is_variable() else n.op.name,
+                "name": n.name,
+                "inputs": [[nid[id(s)], idx, 0] for (s, idx) in n.inputs],
+            }
+            attrs = {k: str(v) for k, v in n.attrs.items()}
+            attrs.update(n._extra_attrs)
+            if attrs:
+                jn["attrs"] = attrs
+            jnodes.append(jn)
+        heads = [[nid[id(n)], i, 0] for (n, i) in self._outputs]
+        return json.dumps({
+            "nodes": jnodes,
+            "arg_nodes": arg_nodes,
+            "node_row_ptr": list(range(len(jnodes) + 1)),
+            "heads": heads,
+            "attrs": {"mxnet_version": ["int", 10500],
+                      "framework": ["str", "mxnet_tpu"]},
+        }, indent=2)
+
+    def save(self, fname):
+        with open(fname, "w") as f:
+            f.write(self.tojson())
+
+    # -- composition helpers --------------------------------------------
+    def _binary(self, other, op, scalar_op, reverse=False):
+        if isinstance(other, Symbol):
+            args = [other, self] if reverse else [self, other]
+            return create(op, args, {})
+        if isinstance(other, numeric_types):
+            sname = _RSCALAR.get(scalar_op, scalar_op) if reverse \
+                else scalar_op
+            return create(sname, [self], {"scalar": other})
+        raise TypeError("type %s not supported" % str(type(other)))
+
+    def __add__(self, other):
+        return self._binary(other, "broadcast_add", "_plus_scalar")
+
+    def __radd__(self, other):
+        return self.__add__(other)
+
+    def __sub__(self, other):
+        return self._binary(other, "broadcast_sub", "_minus_scalar")
+
+    def __rsub__(self, other):
+        return self._binary(other, "broadcast_sub", "_minus_scalar", True)
+
+    def __mul__(self, other):
+        return self._binary(other, "broadcast_mul", "_mul_scalar")
+
+    def __rmul__(self, other):
+        return self.__mul__(other)
+
+    def __truediv__(self, other):
+        return self._binary(other, "broadcast_div", "_div_scalar")
+
+    def __rtruediv__(self, other):
+        return self._binary(other, "broadcast_div", "_div_scalar", True)
+
+    __div__ = __truediv__
+    __rdiv__ = __rtruediv__
+
+    def __mod__(self, other):
+        return self._binary(other, "broadcast_mod", "_mod_scalar")
+
+    def __pow__(self, other):
+        return self._binary(other, "broadcast_power", "_power_scalar")
+
+    def __neg__(self):
+        return create("negative", [self], {})
+
+    def __abs__(self):
+        return create("abs", [self], {})
+
+    def __eq__(self, other):
+        return self._binary(other, "broadcast_equal", "_equal_scalar")
+
+    def __ne__(self, other):
+        return self._binary(other, "broadcast_not_equal", "_not_equal_scalar")
+
+    def __gt__(self, other):
+        return self._binary(other, "broadcast_greater", "_greater_scalar")
+
+    def __ge__(self, other):
+        return self._binary(other, "broadcast_greater_equal",
+                            "_greater_equal_scalar")
+
+    def __lt__(self, other):
+        return self._binary(other, "broadcast_lesser", "_lesser_scalar")
+
+    def __le__(self, other):
+        return self._binary(other, "broadcast_lesser_equal",
+                            "_lesser_equal_scalar")
+
+    def __hash__(self):
+        return id(self)
+
+    # common method shortcuts (parity with generated symbol methods)
+    def reshape(self, *shape, **kwargs):
+        if len(shape) == 1 and isinstance(shape[0], (list, tuple)):
+            shape = tuple(shape[0])
+        if not shape:
+            shape = kwargs.get("shape")
+        return create("Reshape", [self],
+                      {"shape": tuple(shape),
+                       "reverse": kwargs.get("reverse", False)})
+
+    def transpose(self, *axes):
+        if len(axes) == 1 and isinstance(axes[0], (list, tuple)):
+            axes = tuple(axes[0])
+        return create("transpose", [self], {"axes": axes or None})
+
+    def flatten(self):
+        return create("Flatten", [self], {})
+
+    def sum(self, axis=None, keepdims=False):
+        return create("sum", [self], {"axis": axis, "keepdims": keepdims})
+
+    def mean(self, axis=None, keepdims=False):
+        return create("mean", [self], {"axis": axis, "keepdims": keepdims})
+
+    def astype(self, dtype):
+        import numpy as _np
+        return create("Cast", [self], {"dtype": _np.dtype(dtype).name})
+
+    def slice_axis(self, axis, begin, end):
+        return create("slice_axis", [self],
+                      {"axis": axis, "begin": begin, "end": end})
+
+    def expand_dims(self, axis):
+        return create("expand_dims", [self], {"axis": axis})
+
+    def softmax(self, axis=-1):
+        return create("softmax", [self], {"axis": axis})
+
+    def dot(self, other, transpose_a=False, transpose_b=False):
+        return create("dot", [self, other],
+                      {"transpose_a": transpose_a, "transpose_b": transpose_b})
+
+
+_RSCALAR = {"_minus_scalar": "_rminus_scalar", "_div_scalar": "_rdiv_scalar",
+            "_mod_scalar": "_rmod_scalar", "_power_scalar": "_rpower_scalar"}
+
+
+# ---------------------------------------------------------------------------
+# Construction
+# ---------------------------------------------------------------------------
+
+def create(op_name, input_syms, attrs, name=None):
+    """Create a Symbol applying ``op_name`` to inputs (the role of
+    MXSymbolCreateAtomicSymbol + composition)."""
+    op = _ops.get_op(op_name) if isinstance(op_name, str) else op_name
+    attrs = {k: v for k, v in attrs.items() if v is not None}
+    hint = op.name.lower().strip("_")
+    name = NameManager.current().get(name, hint)
+    entries = []
+    for s in input_syms:
+        if not isinstance(s, Symbol):
+            raise TypeError("inputs must be Symbols, got %s" % type(s))
+        # multi-output symbols spread across input slots (MXNet composition)
+        entries.extend(s._outputs)
+    if op.key_var_num_args and op.key_var_num_args not in attrs:
+        attrs[op.key_var_num_args] = len(entries)
+    # Auto-create variables for missing learnable inputs, named
+    # "<opname>_<argname>" — MXNet composition semantics (nnvm
+    # Symbol::Compose auto-variable creation).
+    if not op.key_var_num_args:
+        full_names = op.resolve_arg_names(attrs)
+        while len(entries) < len(full_names):
+            vname = "%s_%s" % (name, full_names[len(entries)])
+            vnode = _Node(None, vname, {}, [])
+            vnode._extra_attrs = dict(AttrScope.current().get(None))
+            entries.append((vnode, 0))
+    node = _Node(op, name, attrs, entries)
+    node._extra_attrs = dict(AttrScope.current().get(None))
+    n_out = node.num_outputs()
+    return Symbol([(node, i) for i in range(n_out)])
+
+
+def var(name, attr=None, shape=None, lr_mult=None, wd_mult=None, dtype=None,
+        init=None, stype=None, **kwargs):
+    """Create a variable symbol (reference: symbol.py var/Variable)."""
+    if not isinstance(name, str):
+        raise TypeError("Expect a string for variable name")
+    node = _Node(None, name, {}, [])
+    extra = dict(AttrScope.current().get(attr))
+    if shape is not None:
+        extra["__shape__"] = str(tuple(shape))
+    if lr_mult is not None:
+        extra["__lr_mult__"] = str(lr_mult)
+    if wd_mult is not None:
+        extra["__wd_mult__"] = str(wd_mult)
+    if dtype is not None:
+        import numpy as _np
+        extra["__dtype__"] = _np.dtype(dtype).name
+    if init is not None:
+        if not isinstance(init, str):
+            init = init.dumps()
+        extra["__init__"] = init
+    for k, v in kwargs.items():
+        if k.startswith("__") and k.endswith("__"):
+            extra[k] = str(v)
+    node._extra_attrs = extra
+    return Symbol([(node, 0)])
+
+
+Variable = var
+
+
+def Group(symbols):
+    entries = []
+    for s in symbols:
+        entries.extend(s._outputs)
+    return Symbol(entries)
+
+
+def load(fname):
+    with open(fname, "r") as f:
+        return load_json(f.read())
+
+
+def load_json(json_str):
+    data = json.loads(json_str)
+    jnodes = data["nodes"]
+    nodes: List[_Node] = []
+    for jn in jnodes:
+        attrs = dict(jn.get("attrs", jn.get("param", {})))
+        if jn["op"] == "null":
+            node = _Node(None, jn["name"], {}, [])
+            node._extra_attrs = attrs
+        else:
+            op = _ops.get_op(jn["op"])
+            op_attrs = {}
+            extra = {}
+            for k, v in attrs.items():
+                if k.startswith("__") or k == "ctx_group":
+                    extra[k] = v
+                else:
+                    op_attrs[k] = v
+            inputs = [(nodes[e[0]], e[1]) for e in jn["inputs"]]
+            node = _Node(op, jn["name"],
+                         _ops.normalize_attrs(op, op_attrs), inputs)
+            node.attrs = {k: node.attrs[k] for k in op_attrs}
+            node._extra_attrs = extra
+        nodes.append(node)
+    heads = [(nodes[h[0]], h[1]) for h in data["heads"]]
+    return Symbol(heads)
+
+
+def _symbol_from_tape(x):
+    """Build a Symbol from an autograd tape head (autograd.get_symbol)."""
+    memo: Dict[int, _Node] = {}
+    counter = [0]
+
+    def conv(h):
+        t = h._tape_node
+        if t is None:
+            key = id(h)
+            if key not in memo:
+                memo[key] = _Node(None, "var%d" % counter[0], {}, [])
+                counter[0] += 1
+            return (memo[key], 0)
+        if id(t) not in memo:
+            inputs = [conv(i) for i in t.inputs]
+            memo[id(t)] = _Node(t.op, "%s%d" % (t.op.name.lower().strip("_"),
+                                                counter[0]),
+                                dict(t.attrs), inputs)
+            counter[0] += 1
+        return (memo[id(t)], h._tape_index)
+
+    return Symbol([conv(x)])
+
+
+# convenience creators matching mx.sym namespace
+def zeros(shape, dtype="float32", **kwargs):
+    return create("_zeros", [], {"shape": tuple(shape), "dtype": dtype})
+
+
+def ones(shape, dtype="float32", **kwargs):
+    return create("_ones", [], {"shape": tuple(shape), "dtype": dtype})
+
+
+def full(shape, val, dtype="float32", **kwargs):
+    return create("_full", [],
+                  {"shape": tuple(shape), "value": val, "dtype": dtype})
+
+
+def arange(start, stop=None, step=1.0, repeat=1, dtype="float32", **kwargs):
+    return create("_arange", [], {"start": start, "stop": stop, "step": step,
+                                  "repeat": repeat, "dtype": dtype})
+
+
+def pow(base, exp):
+    if isinstance(base, Symbol):
+        return base.__pow__(exp)
+    raise TypeError("pow: unsupported types")
+
+
+def maximum(lhs, rhs):
+    if isinstance(lhs, Symbol) and isinstance(rhs, Symbol):
+        return create("broadcast_maximum", [lhs, rhs], {})
+    if isinstance(lhs, Symbol):
+        return create("_maximum_scalar", [lhs], {"scalar": rhs})
+    return create("_maximum_scalar", [rhs], {"scalar": lhs})
+
+
+def minimum(lhs, rhs):
+    if isinstance(lhs, Symbol) and isinstance(rhs, Symbol):
+        return create("broadcast_minimum", [lhs, rhs], {})
+    if isinstance(lhs, Symbol):
+        return create("_minimum_scalar", [lhs], {"scalar": rhs})
+    return create("_minimum_scalar", [rhs], {"scalar": lhs})
+
+
+def hypot(lhs, rhs):
+    return create("broadcast_hypot", [lhs, rhs], {})

@@ -1,0 +1,41 @@
+"""Misc utilities (parity: python/mxnet/util.py)."""
+from __future__ import annotations
+
+import functools
+import inspect
+
+__all__ = ["use_np_shape", "np_shape", "is_np_shape", "makedirs"]
+
+
+def makedirs(d):
+    import os
+    os.makedirs(d, exist_ok=True)
+
+
+_np_shape = [False]
+
+
+def is_np_shape():
+    return _np_shape[0]
+
+
+class np_shape:
+    def __init__(self, active=True):
+        self._active = active
+        self._prev = None
+
+    def __enter__(self):
+        self._prev = _np_shape[0]
+        _np_shape[0] = self._active
+        return self
+
+    def __exit__(self, *args):
+        _np_shape[0] = self._prev
+
+
+def use_np_shape(func):
+    @functools.wraps(func)
+    def wrapper(*args, **kwargs):
+        with np_shape(True):
+            return func(*args, **kwargs)
+    return wrapper

@@ -1,0 +1,151 @@
+"""Autograd tests (mirrors tests/python/unittest/test_autograd.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd as ag
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def test_simple_backward():
+    x = mx.nd.array([1., 2., 3.])
+    x.attach_grad()
+    with ag.record():
+        y = (x * x + 2 * x).sum()
+    y.backward()
+    assert_almost_equal(x.grad.asnumpy(), 2 * np.array([1, 2, 3]) + 2)
+
+
+def test_chain_and_reuse():
+    x = mx.nd.array([[1., 2.], [3., 4.]])
+    x.attach_grad()
+    with ag.record():
+        y = x * 2
+        z = y * x  # 2x^2
+        loss = z.sum()
+    loss.backward()
+    assert_almost_equal(x.grad.asnumpy(), 4 * x.asnumpy())
+
+
+def test_grad_add_accumulation():
+    x = mx.nd.ones((3,))
+    x.attach_grad(grad_req="add")
+    for _ in range(3):
+        with ag.record():
+            y = (x * x).sum()
+        y.backward()
+    assert_almost_equal(x.grad.asnumpy(), 6 * np.ones(3))
+    # write mode overwrites
+    x.attach_grad(grad_req="write")
+    for _ in range(2):
+        with ag.record():
+            y = (x * x).sum()
+        y.backward()
+    assert_almost_equal(x.grad.asnumpy(), 2 * np.ones(3))
+
+
+def test_multiple_variables():
+    a = mx.nd.array([2.])
+    b = mx.nd.array([3.])
+    a.attach_grad()
+    b.attach_grad()
+    with ag.record():
+        c = a * b + a
+    c.backward()
+    assert_almost_equal(a.grad.asnumpy(), [4.])  # b + 1
+    assert_almost_equal(b.grad.asnumpy(), [2.])  # a
+
+
+def test_head_gradient():
+    x = mx.nd.array([1., 2.])
+    x.attach_grad()
+    with ag.record():
+        y = x * 3
+    y.backward(mx.nd.array([10., 100.]))
+    assert_almost_equal(x.grad.asnumpy(), [30., 300.])
+
+
+def test_detach_and_stop_gradient():
+    x = mx.nd.array([2.])
+    x.attach_grad()
+    with ag.record():
+        y = x * x
+        z = mx.nd.BlockGrad(y) + x
+    z.backward()
+    assert_almost_equal(x.grad.asnumpy(), [1.])
+
+
+def test_grad_function():
+    x = mx.nd.array([1., 2., 3.])
+    with ag.record():
+        y = (x * x).sum()
+    g = ag.grad(y, x)
+    assert_almost_equal(g.asnumpy(), 2 * x.asnumpy())
+    # original variable grad_req restored
+    assert x._grad_req == "null"
+
+
+def test_training_modes():
+    assert not ag.is_training()
+    with ag.record():
+        assert ag.is_training()
+        assert ag.is_recording()
+        with ag.predict_mode():
+            assert not ag.is_training()
+    assert not ag.is_recording()
+    with ag.pause():
+        assert not ag.is_recording()
+    with ag.train_mode():
+        assert ag.is_training()
+
+
+def test_dropout_backward_consistency():
+    # the recorded rng key must replay identically in backward
+    x = mx.nd.ones((50, 50))
+    x.attach_grad()
+    with ag.record():
+        y = mx.nd.Dropout(x, p=0.5)
+        loss = y.sum()
+    loss.backward()
+    # grad is exactly the dropout mask scaled: either 0 or 2
+    g = x.grad.asnumpy()
+    assert set(np.unique(g)).issubset({0.0, 2.0})
+    y_np = y.asnumpy()
+    assert_almost_equal((y_np != 0).astype(np.float32) * 2, g)
+
+
+def test_mark_variables():
+    x = mx.nd.ones((2,))
+    gx = mx.nd.zeros((2,))
+    ag.mark_variables([x], [gx])
+    with ag.record():
+        y = (x * 4).sum()
+    y.backward()
+    assert_almost_equal(gx.asnumpy(), [4., 4.])
+
+
+def test_nested_ops_compile_cache():
+    # repeated identical tapes should reuse the compiled backward
+    from mxnet_tpu.autograd import _bwd_cache
+    x = mx.nd.ones((4,))
+    x.attach_grad()
+    with ag.record():
+        y = (x * x).sum()
+    y.backward()
+    n0 = len(_bwd_cache)
+    for _ in range(3):
+        with ag.record():
+            y = (x * x).sum()
+        y.backward()
+    assert len(_bwd_cache) == n0
+
+
+def test_get_symbol():
+    x = mx.nd.ones((2, 2))
+    w = mx.nd.ones((3, 2))
+    b = mx.nd.zeros((3,))
+    x.attach_grad()
+    with ag.record():
+        y = mx.nd.FullyConnected(x, w, b, num_hidden=3)
+    sym = ag.get_symbol(y)
+    assert len(sym.list_arguments()) == 3

@@ -1,0 +1,273 @@
+"""NDArray unit tests (mirrors tests/python/unittest/test_ndarray.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.test_utils import assert_almost_equal, default_context
+
+
+def test_creation():
+    x = mx.nd.zeros((3, 4))
+    assert x.shape == (3, 4)
+    assert x.dtype == np.float32
+    assert (x.asnumpy() == 0).all()
+    y = mx.nd.ones((2, 2), dtype="int32")
+    assert y.dtype == np.int32
+    z = mx.nd.full((2, 3), 7.5)
+    assert (z.asnumpy() == 7.5).all()
+    a = mx.nd.array([[1, 2], [3, 4]])
+    assert a.dtype == np.float32
+    b = mx.nd.array(np.array([1, 2], dtype=np.int32))
+    assert b.dtype == np.int32
+
+
+def test_arange_linspace_eye():
+    assert_almost_equal(mx.nd.arange(5).asnumpy(), np.arange(5,
+                        dtype=np.float32))
+    assert_almost_equal(mx.nd.arange(2, 10, 2).asnumpy(),
+                        np.arange(2, 10, 2, dtype=np.float32))
+    assert_almost_equal(mx.nd.linspace(0, 1, 5).asnumpy(),
+                        np.linspace(0, 1, 5, dtype=np.float32))
+    assert_almost_equal(mx.nd.eye(3).asnumpy(), np.eye(3, dtype=np.float32))
+
+
+def test_elementwise():
+    a = mx.nd.array([[1., 2.], [3., 4.]])
+    b = mx.nd.array([[5., 6.], [7., 8.]])
+    assert_almost_equal((a + b).asnumpy(), [[6, 8], [10, 12]])
+    assert_almost_equal((a - b).asnumpy(), [[-4, -4], [-4, -4]])
+    assert_almost_equal((a * b).asnumpy(), [[5, 12], [21, 32]])
+    assert_almost_equal((b / a).asnumpy(), [[5, 3], [7 / 3, 2]], rtol=1e-6)
+    assert_almost_equal((a ** 2).asnumpy(), [[1, 4], [9, 16]])
+    assert_almost_equal((2 ** a).asnumpy(), [[2, 4], [8, 16]])
+    assert_almost_equal((1 - a).asnumpy(), [[0, -1], [-2, -3]])
+    assert_almost_equal((10 / a).asnumpy(), [[10, 5], [10 / 3, 2.5]],
+                        rtol=1e-6)
+    assert_almost_equal((-a).asnumpy(), [[-1, -2], [-3, -4]])
+    assert_almost_equal(abs(-a).asnumpy(), a.asnumpy())
+
+
+def test_inplace_ops():
+    a = mx.nd.ones((2, 2))
+    a += 1
+    assert (a.asnumpy() == 2).all()
+    a *= 3
+    assert (a.asnumpy() == 6).all()
+    a -= 2
+    assert (a.asnumpy() == 4).all()
+    a /= 4
+    assert (a.asnumpy() == 1).all()
+
+
+def test_comparisons():
+    a = mx.nd.array([1., 2., 3.])
+    b = mx.nd.array([3., 2., 1.])
+    assert_almost_equal((a == b).asnumpy(), [0, 1, 0])
+    assert_almost_equal((a != b).asnumpy(), [1, 0, 1])
+    assert_almost_equal((a > b).asnumpy(), [0, 0, 1])
+    assert_almost_equal((a >= 2).asnumpy(), [0, 1, 1])
+    assert_almost_equal((a < b).asnumpy(), [1, 0, 0])
+    # comparison keeps input dtype (MXNet convention)
+    assert (a == b).dtype == np.float32
+
+
+def test_indexing():
+    a = mx.nd.array(np.arange(24).reshape(2, 3, 4))
+    assert_almost_equal(a[0].asnumpy(), np.arange(12).reshape(3, 4))
+    assert_almost_equal(a[1, 2].asnumpy(), np.arange(20, 24))
+    assert_almost_equal(a[:, 1].asnumpy(),
+                        np.arange(24).reshape(2, 3, 4)[:, 1])
+    assert_almost_equal(a[0, 1, 2].asnumpy(), 6)
+    assert_almost_equal(a[:, :, 1:3].asnumpy(),
+                        np.arange(24).reshape(2, 3, 4)[:, :, 1:3])
+
+
+def test_setitem():
+    a = mx.nd.zeros((3, 3))
+    a[1] = 1.0
+    assert_almost_equal(a.asnumpy(), [[0, 0, 0], [1, 1, 1], [0, 0, 0]])
+    a[0, 2] = 5.0
+    assert a.asnumpy()[0, 2] == 5.0
+    a[:] = 2.0
+    assert (a.asnumpy() == 2).all()
+    a[1:3] = mx.nd.ones((2, 3)) * 7
+    assert (a.asnumpy()[1:] == 7).all()
+
+
+def test_reshape_special_codes():
+    a = mx.nd.zeros((2, 3, 4))
+    assert a.reshape((4, 6)).shape == (4, 6)
+    assert a.reshape((-1,)).shape == (24,)
+    assert a.reshape((0, -1)).shape == (2, 12)
+    assert a.reshape((-2,)).shape == (2, 3, 4)
+    assert a.reshape((0, -2)).shape == (2, 3, 4)
+    assert a.reshape((-3, 4)).shape == (6, 4)
+    assert a.reshape((0, -4, 3, 1, 4)).shape == (2, 3, 1, 4)
+    assert a.reshape((-4, 1, 2, -2)).shape == (1, 2, 3, 4)
+    assert a.reshape((2, -4, -1, 3, 4)).shape == (2, 1, 3, 4)
+
+
+def test_transpose_ops():
+    a = mx.nd.array(np.arange(6).reshape(2, 3))
+    assert_almost_equal(a.T.asnumpy(), np.arange(6).reshape(2, 3).T)
+    b = mx.nd.array(np.arange(24).reshape(2, 3, 4))
+    assert b.transpose(2, 0, 1).shape == (4, 2, 3)
+    assert b.swapaxes(0, 2).shape == (4, 3, 2)
+
+
+def test_reductions():
+    x = np.random.uniform(-1, 1, (3, 4, 5)).astype(np.float32)
+    a = mx.nd.array(x)
+    assert_almost_equal(a.sum().asnumpy(), x.sum(), rtol=1e-5, atol=1e-5)
+    assert_almost_equal(a.sum(axis=1).asnumpy(), x.sum(axis=1), rtol=1e-5,
+                        atol=1e-5)
+    assert_almost_equal(a.mean(axis=(0, 2)).asnumpy(), x.mean(axis=(0, 2)),
+                        rtol=1e-5, atol=1e-5)
+    assert_almost_equal(a.max().asnumpy(), x.max())
+    assert_almost_equal(a.min(axis=2).asnumpy(), x.min(axis=2))
+    assert_almost_equal(a.argmax(axis=1).asnumpy(), x.argmax(axis=1))
+    assert_almost_equal(a.norm().asnumpy(), np.linalg.norm(x.reshape(-1)),
+                        rtol=1e-5)
+
+
+def test_dot():
+    x = np.random.uniform(-1, 1, (4, 5)).astype(np.float32)
+    y = np.random.uniform(-1, 1, (5, 6)).astype(np.float32)
+    a, b = mx.nd.array(x), mx.nd.array(y)
+    assert_almost_equal(mx.nd.dot(a, b).asnumpy(), x.dot(y), rtol=1e-5,
+                        atol=1e-5)
+    assert_almost_equal(mx.nd.dot(a, a, transpose_b=True).asnumpy(),
+                        x.dot(x.T), rtol=1e-5, atol=1e-5)
+    # batch dot
+    p = np.random.uniform(-1, 1, (3, 4, 5)).astype(np.float32)
+    q = np.random.uniform(-1, 1, (3, 5, 2)).astype(np.float32)
+    assert_almost_equal(
+        mx.nd.batch_dot(mx.nd.array(p), mx.nd.array(q)).asnumpy(),
+        np.matmul(p, q), rtol=1e-5, atol=1e-5)
+
+
+def test_broadcast():
+    a = mx.nd.array([[1.], [2.]])
+    b = a.broadcast_to((2, 3))
+    assert_almost_equal(b.asnumpy(), [[1, 1, 1], [2, 2, 2]])
+    c = mx.nd.broadcast_add(mx.nd.ones((2, 1)), mx.nd.ones((1, 3)))
+    assert c.shape == (2, 3)
+    assert (c.asnumpy() == 2).all()
+
+
+def test_concat_split_stack():
+    a = mx.nd.ones((2, 3))
+    b = mx.nd.zeros((2, 3))
+    c = mx.nd.concat(a, b, dim=0)
+    assert c.shape == (4, 3)
+    d = mx.nd.stack(a, b, axis=0)
+    assert d.shape == (2, 2, 3)
+    parts = mx.nd.split(c, num_outputs=2, axis=0)
+    assert len(parts) == 2
+    assert_almost_equal(parts[0].asnumpy(), a.asnumpy())
+    s = mx.nd.split(mx.nd.ones((2, 4)), num_outputs=4, axis=1,
+                    squeeze_axis=True)
+    assert s[0].shape == (2,)
+
+
+def test_astype_copy():
+    a = mx.nd.array([1.5, 2.5])
+    b = a.astype("int32")
+    assert b.dtype == np.int32
+    c = a.copy()
+    c[:] = 0
+    assert (a.asnumpy() == [1.5, 2.5]).all()
+
+
+def test_take_embedding():
+    w = np.random.uniform(-1, 1, (10, 4)).astype(np.float32)
+    idx = np.array([1, 3, 5], dtype=np.float32)
+    out = mx.nd.Embedding(mx.nd.array(idx), mx.nd.array(w), input_dim=10,
+                          output_dim=4)
+    assert_almost_equal(out.asnumpy(), w[[1, 3, 5]])
+    t = mx.nd.take(mx.nd.array(w), mx.nd.array(idx))
+    assert_almost_equal(t.asnumpy(), w[[1, 3, 5]])
+
+
+def test_one_hot_pick():
+    oh = mx.nd.one_hot(mx.nd.array([0, 2]), depth=3)
+    assert_almost_equal(oh.asnumpy(), [[1, 0, 0], [0, 0, 1]])
+    data = mx.nd.array([[1., 2., 3.], [4., 5., 6.]])
+    p = mx.nd.pick(data, mx.nd.array([1, 2]), axis=1)
+    assert_almost_equal(p.asnumpy(), [2, 6])
+
+
+def test_ordering():
+    x = np.random.permutation(20).astype(np.float32).reshape(4, 5)
+    a = mx.nd.array(x)
+    assert_almost_equal(a.sort(axis=1).asnumpy(), np.sort(x, axis=1))
+    assert_almost_equal(a.argsort(axis=1).asnumpy(), np.argsort(x, axis=1))
+    tk = mx.nd.topk(a, axis=1, k=2, ret_typ="value")
+    exp = np.sort(x, axis=1)[:, ::-1][:, :2]
+    assert_almost_equal(tk.asnumpy(), exp)
+
+
+def test_wait_and_scalar():
+    a = mx.nd.ones((1,))
+    a.wait_to_read()
+    assert a.asscalar() == 1.0
+    assert float(a) == 1.0
+    assert int(mx.nd.array([3.7])) == 3
+
+
+def test_save_load(tmp_path):
+    fname = str(tmp_path / "arrs")
+    arrs = [mx.nd.ones((2, 2)), mx.nd.zeros((3,))]
+    mx.nd.save(fname, arrs)
+    loaded = mx.nd.load(fname)
+    assert isinstance(loaded, list) and len(loaded) == 2
+    assert_almost_equal(loaded[0].asnumpy(), arrs[0].asnumpy())
+    d = {"w": mx.nd.ones((2,)), "b": mx.nd.zeros((2,))}
+    mx.nd.save(fname, d)
+    loaded = mx.nd.load(fname)
+    assert set(loaded.keys()) == {"w", "b"}
+
+
+def test_random_basic():
+    mx.random.seed(42)
+    a = mx.nd.random.uniform(0, 1, shape=(100,))
+    b = mx.nd.random.uniform(0, 1, shape=(100,))
+    assert not np.allclose(a.asnumpy(), b.asnumpy())
+    assert (a.asnumpy() >= 0).all() and (a.asnumpy() <= 1).all()
+    mx.random.seed(42)
+    a2 = mx.nd.random.uniform(0, 1, shape=(100,))
+    assert_almost_equal(a.asnumpy(), a2.asnumpy())
+    n = mx.nd.random.normal(0, 1, shape=(2000,))
+    assert abs(n.asnumpy().mean()) < 0.2
+    r = mx.nd.random.randint(0, 10, shape=(50,))
+    assert r.dtype == np.int32
+    assert (r.asnumpy() >= 0).all() and (r.asnumpy() < 10).all()
+
+
+def test_context_placement():
+    ctx = default_context()
+    x = mx.nd.ones((2, 2), ctx=ctx)
+    assert x.context == ctx
+    y = x.as_in_context(mx.cpu())
+    assert y.context == mx.cpu()
+
+
+def test_where_clip():
+    cond = mx.nd.array([1., 0., 1.])
+    x = mx.nd.array([1., 2., 3.])
+    y = mx.nd.array([4., 5., 6.])
+    assert_almost_equal(mx.nd.where(cond, x, y).asnumpy(), [1, 5, 3])
+    assert_almost_equal(mx.nd.clip(y, 4.5, 5.5).asnumpy(), [4.5, 5, 5.5])
+
+
+def test_tile_repeat_pad():
+    a = mx.nd.array([[1., 2.], [3., 4.]])
+    assert_almost_equal(a.tile((2, 1)).asnumpy(),
+                        np.tile(a.asnumpy(), (2, 1)))
+    assert_almost_equal(a.repeat(2, axis=0).asnumpy(),
+                        np.repeat(a.asnumpy(), 2, axis=0))
+    x4 = mx.nd.ones((1, 1, 2, 2))
+    p = mx.nd.Pad(x4, mode="constant", pad_width=(0, 0, 0, 0, 1, 1, 1, 1),
+                  constant_value=9)
+    assert p.shape == (1, 1, 4, 4)
+    assert p.asnumpy()[0, 0, 0, 0] == 9

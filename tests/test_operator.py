@@ -1,0 +1,336 @@
+"""Operator tests: numpy-oracle forward + numeric-gradient backward checks
+(mirrors tests/python/unittest/test_operator.py patterns)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.test_utils import (assert_almost_equal,
+                                  check_numeric_gradient,
+                                  check_symbolic_forward,
+                                  check_symbolic_backward, default_context)
+
+
+def test_unary_ops_forward():
+    x = np.random.uniform(0.1, 2.0, (3, 4)).astype(np.float32)
+    cases = {
+        "sqrt": np.sqrt, "exp": np.exp, "log": np.log, "square": np.square,
+        "sin": np.sin, "cos": np.cos, "tanh": np.tanh, "abs": np.abs,
+        "ceil": np.ceil, "floor": np.floor, "sign": np.sign,
+        "log1p": np.log1p, "expm1": np.expm1, "rsqrt": lambda v: 1/np.sqrt(v),
+        "reciprocal": lambda v: 1 / v,
+        "sigmoid": lambda v: 1 / (1 + np.exp(-v)),
+        "relu": lambda v: np.maximum(v, 0),
+    }
+    a = mx.nd.array(x)
+    for name, ref in cases.items():
+        out = getattr(mx.nd, name)(a)
+        assert_almost_equal(out.asnumpy(), ref(x), rtol=1e-5, atol=1e-5,
+                            names=(name, name + "_ref"))
+
+
+def test_scalar_ops():
+    x = np.array([[1., 2.], [3., 4.]], dtype=np.float32)
+    a = mx.nd.array(x)
+    assert_almost_equal((a + 3).asnumpy(), x + 3)
+    assert_almost_equal((3 - a).asnumpy(), 3 - x)
+    assert_almost_equal((a % 2).asnumpy(), x % 2)
+    assert_almost_equal(mx.nd.maximum(a, mx.nd.array(x * 0 + 2)).asnumpy(),
+                        np.maximum(x, 2))
+
+
+def test_fully_connected():
+    x = np.random.uniform(-1, 1, (4, 10)).astype(np.float32)
+    w = np.random.uniform(-1, 1, (5, 10)).astype(np.float32)
+    b = np.random.uniform(-1, 1, (5,)).astype(np.float32)
+    out = mx.nd.FullyConnected(mx.nd.array(x), mx.nd.array(w),
+                               mx.nd.array(b), num_hidden=5)
+    assert_almost_equal(out.asnumpy(), x.dot(w.T) + b, rtol=1e-5, atol=1e-5)
+    out = mx.nd.FullyConnected(mx.nd.array(x), mx.nd.array(w), num_hidden=5,
+                               no_bias=True)
+    assert_almost_equal(out.asnumpy(), x.dot(w.T), rtol=1e-5, atol=1e-5)
+
+
+def test_fc_gradient():
+    data = mx.sym.var("data")
+    sym = mx.sym.FullyConnected(data, num_hidden=3, name="fc")
+    check_numeric_gradient(
+        sym, {"data": np.random.uniform(-1, 1, (2, 4)),
+              "fc_weight": np.random.uniform(-1, 1, (3, 4)),
+              "fc_bias": np.zeros(3)},
+        numeric_eps=1e-3, rtol=5e-2, atol=5e-2)
+
+
+def test_convolution_forward():
+    # oracle: scipy-free direct conv via numpy
+    x = np.random.uniform(-1, 1, (2, 3, 5, 5)).astype(np.float32)
+    w = np.random.uniform(-1, 1, (4, 3, 3, 3)).astype(np.float32)
+    b = np.zeros(4, dtype=np.float32)
+    out = mx.nd.Convolution(mx.nd.array(x), mx.nd.array(w), mx.nd.array(b),
+                            kernel=(3, 3), num_filter=4)
+    ref = np.zeros((2, 4, 3, 3), dtype=np.float32)
+    for n in range(2):
+        for f in range(4):
+            for i in range(3):
+                for j in range(3):
+                    ref[n, f, i, j] = np.sum(
+                        x[n, :, i:i+3, j:j+3] * w[f])
+    assert_almost_equal(out.asnumpy(), ref, rtol=1e-4, atol=1e-4)
+
+
+def test_convolution_stride_pad_group():
+    x = np.random.uniform(-1, 1, (1, 4, 8, 8)).astype(np.float32)
+    w = np.random.uniform(-1, 1, (6, 2, 3, 3)).astype(np.float32)
+    out = mx.nd.Convolution(mx.nd.array(x), mx.nd.array(w), kernel=(3, 3),
+                            num_filter=6, stride=(2, 2), pad=(1, 1),
+                            num_group=2, no_bias=True)
+    assert out.shape == (1, 6, 4, 4)
+
+
+def test_conv_gradient():
+    sym = mx.sym.Convolution(mx.sym.var("data"), kernel=(2, 2), num_filter=2,
+                             no_bias=True, name="conv")
+    check_numeric_gradient(
+        sym, {"data": np.random.uniform(-1, 1, (1, 2, 4, 4)),
+              "conv_weight": np.random.uniform(-1, 1, (2, 2, 2, 2))},
+        numeric_eps=1e-3, rtol=5e-2, atol=5e-2)
+
+
+def test_pooling():
+    x = np.random.uniform(-1, 1, (1, 2, 4, 4)).astype(np.float32)
+    out = mx.nd.Pooling(mx.nd.array(x), kernel=(2, 2), stride=(2, 2),
+                        pool_type="max")
+    ref = x.reshape(1, 2, 2, 2, 2, 2).max(axis=(3, 5))
+    assert_almost_equal(out.asnumpy(), ref)
+    out = mx.nd.Pooling(mx.nd.array(x), kernel=(2, 2), stride=(2, 2),
+                        pool_type="avg")
+    ref = x.reshape(1, 2, 2, 2, 2, 2).mean(axis=(3, 5))
+    assert_almost_equal(out.asnumpy(), ref, rtol=1e-6)
+    out = mx.nd.Pooling(mx.nd.array(x), global_pool=True, pool_type="max",
+                        kernel=(2, 2))
+    assert out.shape == (1, 2, 1, 1)
+    assert_almost_equal(out.asnumpy().reshape(1, 2), x.max(axis=(2, 3)))
+
+
+def test_pooling_full_convention():
+    x = mx.nd.ones((1, 1, 5, 5))
+    out = mx.nd.Pooling(x, kernel=(2, 2), stride=(2, 2), pool_type="max",
+                        pooling_convention="full")
+    assert out.shape == (1, 1, 3, 3)
+    out = mx.nd.Pooling(x, kernel=(2, 2), stride=(2, 2), pool_type="max",
+                        pooling_convention="valid")
+    assert out.shape == (1, 1, 2, 2)
+
+
+def test_activation():
+    x = np.array([[-1., 0., 1.]], dtype=np.float32)
+    a = mx.nd.array(x)
+    assert_almost_equal(mx.nd.Activation(a, act_type="relu").asnumpy(),
+                        [[0, 0, 1]])
+    assert_almost_equal(mx.nd.Activation(a, act_type="tanh").asnumpy(),
+                        np.tanh(x), rtol=1e-6)
+    assert_almost_equal(
+        mx.nd.Activation(a, act_type="softrelu").asnumpy(),
+        np.log1p(np.exp(x)), rtol=1e-5)
+    assert_almost_equal(
+        mx.nd.LeakyReLU(a, act_type="leaky", slope=0.1).asnumpy(),
+        np.where(x > 0, x, 0.1 * x), rtol=1e-6)
+    assert_almost_equal(
+        mx.nd.LeakyReLU(a, act_type="elu", slope=1.0).asnumpy(),
+        np.where(x > 0, x, np.expm1(x)), rtol=1e-6)
+
+
+def test_softmax():
+    x = np.random.uniform(-1, 1, (3, 5)).astype(np.float32)
+    out = mx.nd.softmax(mx.nd.array(x))
+    e = np.exp(x - x.max(axis=-1, keepdims=True))
+    ref = e / e.sum(axis=-1, keepdims=True)
+    assert_almost_equal(out.asnumpy(), ref, rtol=1e-5, atol=1e-6)
+    out = mx.nd.log_softmax(mx.nd.array(x))
+    assert_almost_equal(out.asnumpy(), np.log(ref), rtol=1e-4, atol=1e-5)
+
+
+def test_batchnorm():
+    x = np.random.uniform(-1, 1, (4, 3, 2, 2)).astype(np.float32)
+    gamma = np.ones(3, dtype=np.float32)
+    beta = np.zeros(3, dtype=np.float32)
+    mean = np.zeros(3, dtype=np.float32)
+    var = np.ones(3, dtype=np.float32)
+    mm = mx.nd.array(mean)
+    mv = mx.nd.array(var)
+    with mx.autograd.train_mode():
+        out = mx.nd.BatchNorm(mx.nd.array(x), mx.nd.array(gamma),
+                              mx.nd.array(beta), mm, mv, fix_gamma=False,
+                              momentum=0.9, eps=1e-5)
+    bm = x.mean(axis=(0, 2, 3))
+    bv = x.var(axis=(0, 2, 3))
+    ref = (x - bm.reshape(1, 3, 1, 1)) / np.sqrt(bv.reshape(1, 3, 1, 1)
+                                                 + 1e-5)
+    assert_almost_equal(out.asnumpy(), ref, rtol=1e-4, atol=1e-4)
+    # moving stats updated in place (aux writeback)
+    assert_almost_equal(mm.asnumpy(), 0.1 * bm, rtol=1e-4, atol=1e-5)
+    assert_almost_equal(mv.asnumpy(), 0.9 * 1 + 0.1 * bv, rtol=1e-4,
+                        atol=1e-5)
+    # inference path uses moving stats
+    out_inf = mx.nd.BatchNorm(mx.nd.array(x), mx.nd.array(gamma),
+                              mx.nd.array(beta), mx.nd.array(mean),
+                              mx.nd.array(var), fix_gamma=False, eps=1e-5)
+    assert_almost_equal(out_inf.asnumpy(), x / np.sqrt(1 + 1e-5), rtol=1e-4,
+                        atol=1e-4)
+
+
+def test_layernorm():
+    x = np.random.uniform(-1, 1, (2, 5)).astype(np.float32)
+    out = mx.nd.LayerNorm(mx.nd.array(x), mx.nd.ones((5,)),
+                          mx.nd.zeros((5,)))
+    mu = x.mean(-1, keepdims=True)
+    sig = x.var(-1, keepdims=True)
+    assert_almost_equal(out.asnumpy(), (x - mu) / np.sqrt(sig + 1e-5),
+                        rtol=1e-4, atol=1e-5)
+
+
+def test_dropout():
+    x = mx.nd.ones((100, 100))
+    with mx.autograd.train_mode():
+        out = mx.nd.Dropout(x, p=0.5)
+    frac = (out.asnumpy() == 0).mean()
+    assert 0.3 < frac < 0.7
+    nz = out.asnumpy()[out.asnumpy() != 0]
+    assert_almost_equal(nz, np.full_like(nz, 2.0), rtol=1e-6)
+    # eval mode: identity
+    out = mx.nd.Dropout(x, p=0.5)
+    assert_almost_equal(out.asnumpy(), x.asnumpy())
+
+
+def test_softmax_output_grad():
+    data = mx.sym.var("data")
+    label = mx.sym.var("label")
+    sym = mx.sym.SoftmaxOutput(data, label, name="sm")
+    x = np.random.uniform(-1, 1, (4, 5)).astype(np.float32)
+    y = np.array([0, 1, 2, 3], dtype=np.float32)
+    exe = sym.bind(default_context(),
+                   {"data": mx.nd.array(x), "label": mx.nd.array(y)},
+                   args_grad={"data": mx.nd.zeros((4, 5))},
+                   grad_req={"data": "write", "label": "null"})
+    exe.forward_backward(is_train=True)
+    p = np.exp(x - x.max(-1, keepdims=True))
+    p /= p.sum(-1, keepdims=True)
+    onehot = np.eye(5, dtype=np.float32)[y.astype(int)]
+    assert_almost_equal(exe.grad_dict["data"].asnumpy(), p - onehot,
+                        rtol=1e-4, atol=1e-5)
+    assert_almost_equal(exe.outputs[0].asnumpy(), p, rtol=1e-4, atol=1e-5)
+
+
+def test_regression_outputs():
+    x = np.random.uniform(-1, 1, (4, 3)).astype(np.float32)
+    y = np.random.uniform(-1, 1, (4, 3)).astype(np.float32)
+    sym = mx.sym.LinearRegressionOutput(mx.sym.var("data"),
+                                        mx.sym.var("label"))
+    exe = sym.bind(default_context(),
+                   {"data": mx.nd.array(x), "label": mx.nd.array(y)},
+                   args_grad={"data": mx.nd.zeros((4, 3))},
+                   grad_req={"data": "write"})
+    exe.forward_backward(is_train=True)
+    assert_almost_equal(exe.outputs[0].asnumpy(), x)
+    assert_almost_equal(exe.grad_dict["data"].asnumpy(), (x - y) / 3,
+                        rtol=1e-5, atol=1e-6)
+
+
+def test_elemwise_gradients():
+    for opname in ["sqrt", "exp", "log", "sigmoid", "tanh", "square"]:
+        sym = getattr(mx.sym, opname)(mx.sym.var("data"))
+        loc = {"data": np.random.uniform(0.5, 2.0, (3, 3))}
+        check_numeric_gradient(sym, loc, numeric_eps=1e-4, rtol=5e-2,
+                               atol=5e-2)
+
+
+def test_broadcast_gradients():
+    sym = mx.sym.broadcast_mul(mx.sym.var("lhs"), mx.sym.var("rhs"))
+    check_numeric_gradient(
+        sym, {"lhs": np.random.uniform(-1, 1, (2, 3)),
+              "rhs": np.random.uniform(-1, 1, (2, 1))},
+        numeric_eps=1e-3, rtol=5e-2, atol=5e-2)
+
+
+def test_embedding_grad_and_take():
+    sym = mx.sym.Embedding(mx.sym.var("data"), mx.sym.var("weight"),
+                           input_dim=6, output_dim=3, name="emb")
+    x = np.array([1, 3], dtype=np.float32)
+    w = np.random.uniform(-1, 1, (6, 3)).astype(np.float32)
+    g = np.random.uniform(-1, 1, (2, 3)).astype(np.float32)
+    exp_wgrad = np.zeros_like(w)
+    for i, idx in enumerate(x.astype(int)):
+        exp_wgrad[idx] += g[i]
+    check_symbolic_backward(sym, {"data": x, "weight": w}, [g],
+                            {"weight": exp_wgrad},
+                            grad_req={"data": "null", "weight": "write"})
+
+
+def test_sequence_ops():
+    x = np.random.uniform(-1, 1, (4, 2, 3)).astype(np.float32)  # (T,B,E)
+    lens = np.array([2, 4], dtype=np.float32)
+    m = mx.nd.SequenceMask(mx.nd.array(x), mx.nd.array(lens),
+                           use_sequence_length=True, value=0.0)
+    ref = x.copy()
+    ref[2:, 0] = 0
+    assert_almost_equal(m.asnumpy(), ref)
+    last = mx.nd.SequenceLast(mx.nd.array(x), mx.nd.array(lens),
+                              use_sequence_length=True)
+    assert_almost_equal(last.asnumpy(), np.stack([x[1, 0], x[3, 1]]))
+    rev = mx.nd.SequenceReverse(mx.nd.array(x), mx.nd.array(lens),
+                                use_sequence_length=True)
+    exp = x.copy()
+    exp[:2, 0] = x[:2, 0][::-1]
+    exp[:, 1] = x[:, 1][::-1]
+    assert_almost_equal(rev.asnumpy(), exp)
+
+
+def test_optimizer_ops():
+    w = np.random.uniform(-1, 1, (5,)).astype(np.float32)
+    g = np.random.uniform(-1, 1, (5,)).astype(np.float32)
+    wn = mx.nd.array(w)
+    out = mx.nd.sgd_update(wn, mx.nd.array(g), lr=0.1, wd=0.0, out=wn)
+    assert_almost_equal(wn.asnumpy(), w - 0.1 * g, rtol=1e-5, atol=1e-6)
+    # momentum
+    w2 = mx.nd.array(w)
+    mom = mx.nd.zeros((5,))
+    mx.nd.sgd_mom_update(w2, mx.nd.array(g), mom, lr=0.1, momentum=0.9,
+                         out=w2)
+    assert_almost_equal(mom.asnumpy(), -0.1 * g, rtol=1e-5, atol=1e-6)
+    assert_almost_equal(w2.asnumpy(), w - 0.1 * g, rtol=1e-5, atol=1e-6)
+    # adam
+    w3 = mx.nd.array(w)
+    mean, var = mx.nd.zeros((5,)), mx.nd.zeros((5,))
+    mx.nd.adam_update(w3, mx.nd.array(g), mean, var, lr=0.01, out=w3)
+    assert not np.allclose(w3.asnumpy(), w)
+
+
+def test_linalg():
+    a = np.random.uniform(-1, 1, (3, 4)).astype(np.float32)
+    b = np.random.uniform(-1, 1, (4, 5)).astype(np.float32)
+    out = mx.nd.linalg_gemm2(mx.nd.array(a), mx.nd.array(b))
+    assert_almost_equal(out.asnumpy(), a.dot(b), rtol=1e-4, atol=1e-5)
+    spd = np.eye(3, dtype=np.float32) * 2 + 0.1
+    L = mx.nd.linalg_potrf(mx.nd.array(spd))
+    assert_almost_equal(L.asnumpy().dot(L.asnumpy().T), spd, rtol=1e-4,
+                        atol=1e-5)
+
+
+def test_control_like_ops():
+    x = np.random.uniform(-1, 1, (3, 4)).astype(np.float32)
+    assert_almost_equal(mx.nd.zeros_like(mx.nd.array(x)).asnumpy(),
+                        np.zeros_like(x))
+    assert_almost_equal(
+        mx.nd.shape_array(mx.nd.array(x)).asnumpy(), [3, 4])
+    blocked = mx.nd.BlockGrad(mx.nd.array(x))
+    assert_almost_equal(blocked.asnumpy(), x)
+
+
+def test_slice_like_ops():
+    x = np.arange(24, dtype=np.float32).reshape(4, 6)
+    a = mx.nd.array(x)
+    assert_almost_equal(
+        mx.nd.slice(a, begin=(1, 2), end=(3, 5)).asnumpy(), x[1:3, 2:5])
+    assert_almost_equal(
+        mx.nd.slice_axis(a, axis=1, begin=1, end=3).asnumpy(), x[:, 1:3])
+    y = mx.nd.zeros((2, 3))
+    assert_almost_equal(mx.nd.slice_like(a, y).asnumpy(), x[:2, :3])

@@ -1,0 +1,197 @@
+"""Symbol + Executor tests (mirrors test_symbol.py / test_executor.py /
+test_infer_shape.py in the reference suite)."""
+import json
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.test_utils import assert_almost_equal, default_context
+
+
+def _mlp():
+    data = mx.sym.var("data")
+    h = mx.sym.FullyConnected(data, num_hidden=8, name="fc1")
+    h = mx.sym.Activation(h, act_type="relu", name="relu1")
+    h = mx.sym.FullyConnected(h, num_hidden=4, name="fc2")
+    return mx.sym.SoftmaxOutput(h, mx.sym.var("label"), name="softmax")
+
+
+def test_compose_and_listing():
+    out = _mlp()
+    assert out.list_arguments() == [
+        "data", "fc1_weight", "fc1_bias", "fc2_weight", "fc2_bias", "label"]
+    assert out.list_outputs() == ["softmax_output"]
+    internals = out.get_internals()
+    assert "fc1_output" in internals.list_outputs()
+    fc1 = internals["fc1_output"]
+    assert fc1.list_arguments() == ["data", "fc1_weight", "fc1_bias"]
+
+
+def test_infer_shape():
+    out = _mlp()
+    arg_shapes, out_shapes, aux_shapes = out.infer_shape(data=(16, 30),
+                                                         label=(16,))
+    d = dict(zip(out.list_arguments(), arg_shapes))
+    assert d["fc1_weight"] == (8, 30)
+    assert d["fc1_bias"] == (8,)
+    assert d["fc2_weight"] == (4, 8)
+    assert out_shapes == [(16, 4)]
+    assert aux_shapes == []
+
+
+def test_infer_shape_partial():
+    data = mx.sym.var("data")
+    out = mx.sym.FullyConnected(data, num_hidden=4, name="fc")
+    a, o, x = out.infer_shape_partial()
+    assert o == [None]
+
+
+def test_batchnorm_aux():
+    data = mx.sym.var("data")
+    bn = mx.sym.BatchNorm(data, name="bn")
+    args = bn.list_arguments()
+    aux = bn.list_auxiliary_states()
+    assert args == ["data", "bn_gamma", "bn_beta"]
+    assert aux == ["bn_moving_mean", "bn_moving_var"]
+    arg_shapes, out_shapes, aux_shapes = bn.infer_shape(data=(2, 3, 4, 4))
+    assert aux_shapes == [(3,), (3,)]
+
+
+def test_simple_bind_forward_backward():
+    np.random.seed(0)
+    out = _mlp()
+    ex = out.simple_bind(default_context(), data=(16, 30), label=(16,))
+    for name in ["fc1_weight", "fc2_weight"]:
+        ex.arg_dict[name][:] = mx.nd.array(
+            np.random.normal(0, 0.1, ex.arg_dict[name].shape))
+    x = np.random.normal(0, 1, (16, 30)).astype(np.float32)
+    y = np.random.randint(0, 4, (16,)).astype(np.float32)
+    ex.forward(is_train=False, data=x, label=y)
+    p = ex.outputs[0].asnumpy()
+    assert p.shape == (16, 4)
+    assert_almost_equal(p.sum(axis=1), np.ones(16), rtol=1e-5)
+    ex.forward_backward(is_train=True)
+    g = ex.grad_dict["fc2_weight"].asnumpy()
+    assert np.abs(g).sum() > 0
+
+
+def test_executor_outputs_stable_and_training_updates():
+    """Train the MLP a few steps with raw executor; loss must drop."""
+    np.random.seed(0)
+    out = _mlp()
+    ex = out.simple_bind(default_context(), data=(32, 10), label=(32,))
+    rng = np.random.RandomState(0)
+    for name, arr in ex.arg_dict.items():
+        if name.endswith("weight"):
+            arr[:] = mx.nd.array(rng.normal(0, 0.2, arr.shape))
+    x = rng.normal(0, 1, (32, 10)).astype(np.float32)
+    y = (x[:, 0] > 0).astype(np.float32) + 2 * (x[:, 1] > 0)
+    ex.arg_dict["data"][:] = mx.nd.array(x)
+    ex.arg_dict["label"][:] = mx.nd.array(y)
+
+    def nll():
+        ex.forward(is_train=False)
+        p = ex.outputs[0].asnumpy()
+        return -np.log(p[np.arange(32), y.astype(int)] + 1e-8).mean()
+
+    before = nll()
+    # SoftmaxOutput default normalization='null': grads scale with batch,
+    # so step with lr/batch_size (what Module's rescale_grad does)
+    lr = 0.5 / 32
+    for _ in range(30):
+        ex.forward_backward(is_train=True)
+        for name in ex.arg_dict:
+            if name in ("data", "label"):
+                continue
+            g = ex.grad_dict[name]
+            if g is not None:
+                ex.arg_dict[name][:] = ex.arg_dict[name] - lr * g
+    after = nll()
+    assert after < before * 0.9, (before, after)
+
+
+def test_grad_req_null_and_add():
+    data = mx.sym.var("data")
+    w = mx.sym.var("w")
+    out = mx.sym.broadcast_mul(data, w)
+    x = mx.nd.array([1., 2.])
+    wv = mx.nd.array([3., 4.])
+    gw = mx.nd.zeros((2,))
+    ex = out.bind(default_context(), {"data": x, "w": wv},
+                  args_grad={"w": gw},
+                  grad_req={"data": "null", "w": "add"})
+    ex.forward_backward(is_train=True)
+    ex.forward_backward(is_train=True)
+    assert_almost_equal(gw.asnumpy(), 2 * x.asnumpy())
+
+
+def test_symbol_json_roundtrip(tmp_path):
+    out = _mlp()
+    js = out.tojson()
+    data = json.loads(js)
+    assert "nodes" in data and "heads" in data
+    out2 = mx.sym.load_json(js)
+    assert out2.list_arguments() == out.list_arguments()
+    assert out2.list_outputs() == out.list_outputs()
+    # loaded symbol is executable
+    ex = out2.simple_bind(default_context(), data=(4, 6), label=(4,))
+    ex.forward()
+    assert ex.outputs[0].shape == (4, 4)
+    f = str(tmp_path / "sym.json")
+    out.save(f)
+    out3 = mx.sym.load(f)
+    assert out3.list_arguments() == out.list_arguments()
+
+
+def test_symbol_arithmetic():
+    a = mx.sym.var("a")
+    b = mx.sym.var("b")
+    c = (a + b * 2) / (a - 1 + 3)
+    ex = c.bind(default_context(), {"a": mx.nd.array([2.]),
+                                    "b": mx.nd.array([3.])})
+    ex.forward()
+    assert_almost_equal(ex.outputs[0].asnumpy(), [(2 + 6) / (2 - 1 + 3)])
+
+
+def test_symbol_group_and_slice():
+    a = mx.sym.var("a")
+    s1 = a * 2
+    s2 = a + 1
+    g = mx.sym.Group([s1, s2])
+    assert len(g.list_outputs()) == 2
+    ex = g.bind(default_context(), {"a": mx.nd.array([1., 2.])})
+    ex.forward()
+    assert_almost_equal(ex.outputs[0].asnumpy(), [2., 4.])
+    assert_almost_equal(ex.outputs[1].asnumpy(), [2., 3.])
+
+
+def test_executor_reshape():
+    out = _mlp()
+    ex = out.simple_bind(default_context(), data=(8, 10), label=(8,))
+    ex2 = ex.reshape(data=(4, 10), label=(4,))
+    assert ex2.arg_dict["data"].shape == (4, 10)
+    # params shared (same buffers)
+    assert ex2.arg_dict["fc1_weight"] is ex.arg_dict["fc1_weight"]
+    ex2.forward()
+    assert ex2.outputs[0].shape == (4, 4)
+
+
+def test_multi_output_split_in_graph():
+    data = mx.sym.var("data")
+    parts = mx.sym.SliceChannel(data, num_outputs=2, axis=1, name="split")
+    out = parts[0] + parts[1]
+    ex = out.bind(default_context(), {"data": mx.nd.array([[1., 2.]])})
+    ex.forward()
+    assert_almost_equal(ex.outputs[0].asnumpy(), [[3.]])
+
+
+def test_attr_scope_and_var_attrs():
+    with mx.AttrScope(ctx_group="dev1"):
+        a = mx.sym.var("a")
+    assert a.attr("ctx_group") == "dev1"
+    v = mx.sym.var("v", shape=(3, 4), lr_mult=2.0)
+    assert v.attr("__shape__") == "(3, 4)"
+    # shape hint used by infer_shape
+    out = v * 2
+    _, o, _ = out.infer_shape()
+    assert o == [(3, 4)]
