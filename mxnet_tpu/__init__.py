@@ -37,4 +37,34 @@ from . import symbol as sym
 from .symbol import Symbol
 from .executor import Executor
 
+from . import initializer
+from . import initializer as init
+from . import optimizer
+from .optimizer import Optimizer
+from . import lr_scheduler
+from . import metric
+from . import kvstore as kvstore_module
+from .kvstore import KVStore
+
+from . import io
+from . import recordio
+from . import callback
+from . import monitor
+from . import visualization
+from . import visualization as viz
+from . import profiler
+from . import model
+from .model import save_checkpoint, load_checkpoint
+from . import module
+from .module import Module
+
 from . import test_utils
+
+
+def kvstore_create(name="local"):
+    from .kvstore import create as _create
+    return _create(name)
+
+
+# `mx.kv` style alias used by some reference scripts
+kv = kvstore_module

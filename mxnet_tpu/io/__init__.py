@@ -1,0 +1,3 @@
+"""IO namespace (parity: python/mxnet/io/)."""
+from .io import (DataDesc, DataBatch, DataIter, ResizeIter, PrefetchingIter,
+                 NDArrayIter, MNISTIter, CSVIter, LibSVMIter)

@@ -1,0 +1,428 @@
+"""Module — symbol + one compiled executor (parity:
+python/mxnet/module/module.py).
+
+TPU-native design: where the reference builds a
+DataParallelExecutorGroup with one executor per GPU and reduces
+gradients through KVStore (executor_group.py:143), this Module binds
+ONE executor whose compiled program can span the whole device mesh —
+batch sharding replaces batch slicing (SURVEY §2.2 row 1). The KVStore
+path is kept for API parity and multi-process training.
+"""
+from __future__ import annotations
+
+import logging
+
+import numpy as np
+
+from ..base import MXNetError
+from ..context import Context, cpu
+from .. import ndarray as nd
+from ..initializer import Uniform, InitDesc
+from .. import optimizer as opt
+from ..model import (_create_kvstore, _initialize_kvstore, _update_params,
+                     _update_params_on_kvstore, load_checkpoint,
+                     save_checkpoint)
+from .base_module import BaseModule, _check_input_names, _parse_data_desc
+
+__all__ = ["Module"]
+
+
+class Module(BaseModule):
+    def __init__(self, symbol, data_names=('data',),
+                 label_names=('softmax_label',), logger=logging,
+                 context=cpu(), work_load_list=None, fixed_param_names=None,
+                 state_names=None, group2ctxs=None,
+                 compression_params=None):
+        super().__init__(logger=logger)
+        if isinstance(context, Context):
+            context = [context]
+        self._context = context
+        self._work_load_list = work_load_list
+
+        self._symbol = symbol
+        data_names = list(data_names) if data_names is not None else []
+        label_names = list(label_names) if label_names is not None else []
+        state_names = list(state_names) if state_names is not None else []
+        fixed_param_names = list(fixed_param_names) \
+            if fixed_param_names is not None else []
+
+        _check_input_names(symbol, data_names, "data", True)
+        _check_input_names(symbol, label_names, "label", False)
+        _check_input_names(symbol, state_names, "state", True)
+        _check_input_names(symbol, fixed_param_names, "fixed_param", True)
+
+        arg_names = symbol.list_arguments()
+        input_names = data_names + label_names + state_names
+        self._param_names = [x for x in arg_names if x not in input_names]
+        self._fixed_param_names = fixed_param_names
+        self._aux_names = symbol.list_auxiliary_states()
+        self._data_names = data_names
+        self._label_names = label_names
+        self._state_names = state_names
+        self._output_names = symbol.list_outputs()
+
+        self._arg_params = None
+        self._aux_params = None
+        self._params_dirty = False
+        self._compression_params = compression_params
+        self._optimizer = None
+        self._kvstore = None
+        self._update_on_kvstore = None
+        self._updater = None
+        self._preload_opt_states = None
+        self._grad_req = None
+        self._exec = None
+
+    @staticmethod
+    def load(prefix, epoch, load_optimizer_states=False, **kwargs):
+        sym, args, auxs = load_checkpoint(prefix, epoch)
+        mod = Module(symbol=sym, **kwargs)
+        mod._arg_params = args
+        mod._aux_params = auxs
+        mod.params_initialized = True
+        if load_optimizer_states:
+            mod._preload_opt_states = '%s-%04d.states' % (prefix, epoch)
+        return mod
+
+    def save_checkpoint(self, prefix, epoch, save_optimizer_states=False,
+                        remove_amp_cast=True):
+        self._symbol.save('%s-symbol.json' % prefix)
+        param_name = '%s-%04d.params' % (prefix, epoch)
+        self.save_params(param_name)
+        logging.info('Saved checkpoint to \"%s\"', param_name)
+        if save_optimizer_states:
+            state_name = '%s-%04d.states' % (prefix, epoch)
+            self.save_optimizer_states(state_name)
+            logging.info('Saved optimizer state to \"%s\"', state_name)
+
+    # -- properties ------------------------------------------------------
+    @property
+    def data_names(self):
+        return self._data_names
+
+    @property
+    def label_names(self):
+        return self._label_names
+
+    @property
+    def output_names(self):
+        return self._output_names
+
+    @property
+    def data_shapes(self):
+        assert self.binded
+        return self._data_shapes
+
+    @property
+    def label_shapes(self):
+        assert self.binded
+        return self._label_shapes
+
+    @property
+    def output_shapes(self):
+        assert self.binded
+        return [(name, tuple(o.shape)) for name, o in
+                zip(self._output_names, self._exec.outputs)]
+
+    # -- params ----------------------------------------------------------
+    def get_params(self):
+        assert self.binded and self.params_initialized
+        if self._params_dirty:
+            self._sync_params_from_devices()
+        return (self._arg_params, self._aux_params)
+
+    def init_params(self, initializer=Uniform(0.01), arg_params=None,
+                    aux_params=None, allow_missing=False, force_init=False,
+                    allow_extra=False):
+        if self.params_initialized and not force_init:
+            return
+        assert self.binded, 'call bind before initializing the parameters'
+        attrs = self._symbol.attr_dict()
+
+        def _impl(name, arr, cache):
+            if cache is not None and name in cache:
+                cache_arr = cache[name]
+                if cache_arr is not arr:
+                    cache_arr.copyto(arr)
+            else:
+                if not allow_missing:
+                    assert initializer is not None, \
+                        "initializer required when arg/aux not provided"
+                if initializer is not None:
+                    desc = InitDesc(name, attrs.get(name, None))
+                    initializer(desc, arr)
+
+        for name in self._param_names:
+            _impl(name, self._exec.arg_dict[name], arg_params)
+        for name in self._aux_names:
+            _impl(name, self._exec.aux_dict[name], aux_params)
+
+        self.params_initialized = True
+        self._params_dirty = False
+        self._sync_params_from_devices()
+
+    def set_params(self, arg_params, aux_params, allow_missing=False,
+                   force_init=True, allow_extra=False):
+        if not allow_missing:
+            self.init_params(initializer=None, arg_params=arg_params,
+                             aux_params=aux_params,
+                             allow_missing=allow_missing,
+                             force_init=force_init, allow_extra=allow_extra)
+            return
+        if self.params_initialized and not force_init:
+            return
+        self._exec.copy_params_from(arg_params, aux_params,
+                                    allow_extra_params=allow_extra)
+        self.params_initialized = True
+        self._params_dirty = False
+
+    def _sync_params_from_devices(self):
+        self._arg_params = {n: self._exec.arg_dict[n].copy()
+                            for n in self._param_names}
+        self._aux_params = {n: self._exec.aux_dict[n].copy()
+                            for n in self._aux_names}
+        self._params_dirty = False
+
+    # -- bind ------------------------------------------------------------
+    def bind(self, data_shapes, label_shapes=None, for_training=True,
+             inputs_need_grad=False, force_rebind=False, shared_module=None,
+             grad_req='write'):
+        if force_rebind:
+            self._exec = None
+            self.binded = False
+        if self.binded:
+            self.logger.warning('Already binded, ignoring bind()')
+            return
+        self.for_training = for_training
+        self.inputs_need_grad = inputs_need_grad
+        self._grad_req = grad_req
+        if not for_training:
+            assert not inputs_need_grad
+
+        self._data_shapes, self._label_shapes = _parse_data_desc(
+            self._data_names, self._label_names, data_shapes, label_shapes)
+
+        shape_kwargs = {d.name: d.shape for d in self._data_shapes}
+        if self._label_shapes:
+            shape_kwargs.update({l.name: l.shape
+                                 for l in self._label_shapes})
+
+        arg_shapes, _, aux_shapes = self._symbol.infer_shape(**shape_kwargs)
+        arg_names = self._symbol.list_arguments()
+        aux_names = self._aux_names
+        ctx = self._context[0]
+
+        args = {}
+        shared = shared_module._exec if shared_module is not None else None
+        for name, shape in zip(arg_names, arg_shapes):
+            if shared is not None and name in shared.arg_dict \
+                    and name in self._param_names:
+                args[name] = shared.arg_dict[name]
+            else:
+                args[name] = nd.zeros(shape, ctx=ctx)
+        aux = {}
+        aux_shape_map = dict(zip(aux_names, aux_shapes))
+        for name in aux_names:
+            if shared is not None and name in shared.aux_dict:
+                aux[name] = shared.aux_dict[name]
+            else:
+                aux[name] = nd.zeros(aux_shape_map[name], ctx=ctx)
+
+        reqs = {}
+        grads = {}
+        input_names = set(self._data_names) | set(self._label_names) \
+            | set(self._state_names)
+        for name, shape in zip(arg_names, arg_shapes):
+            if not for_training:
+                reqs[name] = 'null'
+            elif name in self._fixed_param_names:
+                reqs[name] = 'null'
+            elif name in input_names:
+                if inputs_need_grad and name in self._data_names:
+                    reqs[name] = grad_req if isinstance(grad_req, str) \
+                        else grad_req.get(name, 'write')
+                else:
+                    reqs[name] = 'null'
+            else:
+                reqs[name] = grad_req if isinstance(grad_req, str) \
+                    else grad_req.get(name, 'write')
+            if reqs[name] != 'null':
+                grads[name] = nd.zeros(shape, ctx=ctx)
+
+        from ..executor import Executor
+        self._exec = Executor(self._symbol, ctx, args, grads, reqs, aux)
+        self.binded = True
+
+        if shared_module is not None and shared_module.params_initialized:
+            self.set_params(*shared_module.get_params())
+        elif self.params_initialized:
+            # params were loaded before bind (Module.load path): push the
+            # cached arg/aux params into the fresh executor buffers
+            self._exec.copy_params_from(self._arg_params, self._aux_params,
+                                        allow_extra_params=True)
+
+    # -- optimizer -------------------------------------------------------
+    def init_optimizer(self, kvstore='local', optimizer='sgd',
+                       optimizer_params=(('learning_rate', 0.01),),
+                       force_init=False):
+        assert self.binded and self.params_initialized
+        if self.optimizer_initialized and not force_init:
+            self.logger.warning('optimizer already initialized, ignoring...')
+            return
+        if self._params_dirty:
+            self._sync_params_from_devices()
+
+        (kvstore, update_on_kvstore) = _create_kvstore(
+            kvstore, len(self._context), self._arg_params)
+        batch_size = self._data_shapes[0].shape[0]
+        if kvstore and 'dist' in kvstore.type and \
+                '_async' not in kvstore.type:
+            batch_size *= kvstore.num_workers
+        rescale_grad = 1.0 / batch_size
+
+        idx2name = {i: n for i, n in enumerate(self._param_names)}
+        if isinstance(optimizer, str):
+            optimizer_params = dict(optimizer_params)
+            if 'rescale_grad' not in optimizer_params:
+                optimizer_params['rescale_grad'] = rescale_grad
+            optimizer = opt.create(optimizer, sym=self.symbol,
+                                   param_idx2name=idx2name,
+                                   **optimizer_params)
+        else:
+            assert isinstance(optimizer, opt.Optimizer)
+            if optimizer.rescale_grad != rescale_grad:
+                self.logger.warning(
+                    "Optimizer created manually outside Module but "
+                    "rescale_grad is not normalized to 1.0/batch_size/"
+                    "num_workers (%s vs. %s).",
+                    optimizer.rescale_grad, rescale_grad)
+            if not optimizer.idx2name:
+                optimizer.idx2name = idx2name.copy()
+
+        self._optimizer = optimizer
+        self._kvstore = kvstore
+        self._update_on_kvstore = update_on_kvstore
+        self._updater = None
+
+        if kvstore:
+            if self._compression_params:
+                kvstore.set_gradient_compression(self._compression_params)
+            if update_on_kvstore:
+                kvstore.set_optimizer(self._optimizer)
+            _initialize_kvstore(
+                kvstore=kvstore,
+                param_arrays=[self._exec.arg_dict[n]
+                              for n in self._param_names],
+                arg_params=self._arg_params,
+                param_names=self._param_names,
+                update_on_kvstore=update_on_kvstore)
+        if not update_on_kvstore:
+            self._updater = opt.get_updater(optimizer)
+        self.optimizer_initialized = True
+
+        if self._preload_opt_states is not None:
+            self.load_optimizer_states(self._preload_opt_states)
+            self._preload_opt_states = None
+
+    # -- computation -----------------------------------------------------
+    def forward(self, data_batch, is_train=None):
+        assert self.binded and self.params_initialized
+        if is_train is None:
+            is_train = self.for_training
+        kwargs = {}
+        for name, arr in zip(self._data_names, data_batch.data):
+            kwargs[name] = arr
+        if self._label_names and data_batch.label:
+            for name, arr in zip(self._label_names, data_batch.label):
+                kwargs[name] = arr
+        if is_train and self.for_training:
+            # defer: the fused fwd+bwd runs in backward(); stage inputs only
+            self._exec._gather_inputs(kwargs)
+            self._pending_forward = True
+        else:
+            self._exec.forward(is_train=is_train, **kwargs)
+            self._pending_forward = False
+
+    def backward(self, out_grads=None):
+        assert self.binded and self.params_initialized
+        self._exec.forward_backward(out_grads=out_grads, is_train=True)
+        self._pending_forward = False
+        self._params_dirty = True
+
+    def update(self):
+        assert self.binded and self.params_initialized \
+            and self.optimizer_initialized
+        self._params_dirty = True
+        if self._update_on_kvstore:
+            _update_params_on_kvstore(
+                [self._exec.arg_dict[n] for n in self._param_names],
+                [self._exec.grad_dict.get(n) for n in self._param_names],
+                self._kvstore, self._param_names)
+        else:
+            _update_params(
+                [self._exec.arg_dict[n] for n in self._param_names],
+                [self._exec.grad_dict.get(n) for n in self._param_names],
+                updater=self._updater, num_device=1,
+                kvstore=self._kvstore, param_names=self._param_names)
+
+    def get_outputs(self, merge_multi_context=True):
+        assert self.binded and self.params_initialized
+        if getattr(self, "_pending_forward", False):
+            self._exec.forward(is_train=True)
+            self._pending_forward = False
+        return self._exec.outputs
+
+    def get_input_grads(self, merge_multi_context=True):
+        assert self.binded and self.params_initialized \
+            and self.inputs_need_grad
+        return [self._exec.grad_dict.get(n) for n in self._data_names]
+
+    def get_states(self, merge_multi_context=True):
+        assert self.binded and self.params_initialized
+        return [self._exec.arg_dict[n] for n in self._state_names]
+
+    def set_states(self, states=None, value=None):
+        assert self.binded and self.params_initialized
+        if states is not None:
+            for name, arr in zip(self._state_names, states):
+                self._exec.arg_dict[name][:] = arr
+        else:
+            for name in self._state_names:
+                self._exec.arg_dict[name][:] = value
+
+    def update_metric(self, eval_metric, labels, pre_sliced=False):
+        eval_metric.update_dict(
+            dict(zip(self._label_names, labels)),
+            dict(zip(self._output_names, self.get_outputs())))
+
+    def install_monitor(self, mon):
+        assert self.binded
+        mon.install(self._exec)
+
+    # -- optimizer state serialization ----------------------------------
+    def save_optimizer_states(self, fname):
+        assert self.optimizer_initialized
+        if self._update_on_kvstore:
+            self._kvstore.save_optimizer_states(fname)
+        else:
+            with open(fname, 'wb') as fout:
+                fout.write(self._updater.get_states())
+
+    def load_optimizer_states(self, fname):
+        assert self.optimizer_initialized
+        if self._update_on_kvstore:
+            self._kvstore.load_optimizer_states(fname)
+        else:
+            self._updater.set_states(open(fname, 'rb').read())
+
+    def reshape(self, data_shapes, label_shapes=None):
+        assert self.binded
+        self._data_shapes, self._label_shapes = _parse_data_desc(
+            self._data_names, self._label_names, data_shapes, label_shapes)
+        kwargs = {d.name: d.shape for d in self._data_shapes}
+        if self._label_shapes:
+            kwargs.update({l.name: l.shape for l in self._label_shapes})
+        self._exec = self._exec.reshape(**kwargs)
+
+    def prepare(self, data_batch, sparse_row_id_fn=None):
+        pass

@@ -1,0 +1,7 @@
+"""Optimizer package (parity: python/mxnet/optimizer/)."""
+from .optimizer import (Optimizer, SGD, Signum, FTML, DCASGD, NAG, SGLD,
+                        Adam, AdaGrad, AdaDelta, RMSProp, Ftrl, Adamax,
+                        Nadam, LBSGD, Test, Updater, get_updater, register,
+                        create)
+
+opt_registry_create = create

@@ -1,0 +1,218 @@
+"""Profiler (parity: python/mxnet/profiler.py + src/profiler/).
+
+Two layers, mirroring the reference contract (SURVEY §5.1):
+1. chrome://tracing JSON artifact — host-side scoped events
+   (ProfileTask/Event/Counter + the ``record()`` scope) written by
+   ``dump()``, same artifact contract as DumpProfile (profiler.h:304).
+2. device profiling — delegates to the JAX/XLA profiler
+   (``jax.profiler``): set_config(profile_all=True) starts a JAX trace
+   whose XPlane output covers what the reference's engine-level op
+   instrumentation covered.
+Aggregate per-op stats (AggregateStats) are kept as a host-side table.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+
+__all__ = ["set_config", "profiler_set_config", "set_state",
+           "profiler_set_state", "dump", "dumps", "pause", "resume",
+           "Task", "Frame", "Event", "Counter", "Marker", "record",
+           "aggregate_stats"]
+
+_state = {
+    "running": False,
+    "filename": "profile.json",
+    "events": [],
+    "jax_trace_dir": None,
+    "aggregate": {},
+}
+_lock = threading.Lock()
+_t0 = time.time()
+
+
+def _now_us():
+    return int((time.time() - _t0) * 1e6)
+
+
+def set_config(**kwargs):
+    """Configure (reference: profiler.py set_config /
+    MXSetProcessProfilerConfig)."""
+    _state["filename"] = kwargs.get("filename", _state["filename"])
+    if kwargs.get("profile_all") or kwargs.get("profile_symbolic") or \
+            kwargs.get("profile_imperative"):
+        _state["jax_trace_dir"] = os.path.splitext(
+            _state["filename"])[0] + "_xplane"
+
+
+profiler_set_config = set_config
+
+
+def set_state(state='stop', profile_process='worker'):
+    """'run' | 'stop' (reference: profiler.py set_state)."""
+    if state == 'run':
+        _state["running"] = True
+        if _state["jax_trace_dir"]:
+            try:
+                import jax
+                jax.profiler.start_trace(_state["jax_trace_dir"])
+            except Exception:
+                pass
+    else:
+        if _state["running"] and _state["jax_trace_dir"]:
+            try:
+                import jax
+                jax.profiler.stop_trace()
+            except Exception:
+                pass
+        _state["running"] = False
+
+
+profiler_set_state = set_state
+
+
+def pause(profile_process='worker'):
+    _state["running"] = False
+
+
+def resume(profile_process='worker'):
+    _state["running"] = True
+
+
+def _emit(name, cat, ph, ts=None, args=None, dur=None):
+    ev = {"name": name, "cat": cat, "ph": ph,
+          "ts": ts if ts is not None else _now_us(),
+          "pid": os.getpid(), "tid": threading.get_ident()}
+    if args:
+        ev["args"] = args
+    if dur is not None:
+        ev["dur"] = dur
+    with _lock:
+        _state["events"].append(ev)
+
+
+def _aggregate(name, dur_us):
+    with _lock:
+        agg = _state["aggregate"].setdefault(
+            name, {"count": 0, "total": 0.0, "min": float("inf"),
+                   "max": 0.0})
+        agg["count"] += 1
+        agg["total"] += dur_us
+        agg["min"] = min(agg["min"], dur_us)
+        agg["max"] = max(agg["max"], dur_us)
+
+
+def dumps(reset=False, format='table', sort_by='total', ascending=False):
+    """Aggregate stats table (reference: MXAggregateProfileStatsPrint)."""
+    with _lock:
+        rows = sorted(_state["aggregate"].items(),
+                      key=lambda kv: kv[1].get(sort_by, 0),
+                      reverse=not ascending)
+        out = ["%-40s %8s %12s %12s %12s" % ("Name", "Count",
+                                             "Total(us)", "Min(us)",
+                                             "Max(us)")]
+        for name, a in rows:
+            out.append("%-40s %8d %12.1f %12.1f %12.1f"
+                       % (name, a["count"], a["total"], a["min"], a["max"]))
+        if reset:
+            _state["aggregate"] = {}
+    return "\n".join(out)
+
+
+def dump(finished=True, profile_process='worker'):
+    """Write chrome://tracing JSON (reference: DumpProfile)."""
+    with _lock:
+        events = list(_state["events"])
+        if finished:
+            _state["events"] = []
+    with open(_state["filename"], "w") as f:
+        json.dump({"traceEvents": events, "displayTimeUnit": "ms"}, f)
+    return _state["filename"]
+
+
+def aggregate_stats():
+    return dict(_state["aggregate"])
+
+
+class _Scoped:
+    def __init__(self, name, cat):
+        self.name = name
+        self.cat = cat
+        self._start = None
+
+    def start(self):
+        self._start = _now_us()
+        return self
+
+    def stop(self):
+        if self._start is None:
+            return
+        dur = _now_us() - self._start
+        _emit(self.name, self.cat, "X", ts=self._start, dur=dur)
+        _aggregate(self.name, dur)
+        self._start = None
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *a):
+        self.stop()
+
+
+class Task(_Scoped):
+    def __init__(self, name, domain=None):
+        super().__init__(name, "task")
+
+
+class Frame(_Scoped):
+    def __init__(self, name, domain=None):
+        super().__init__(name, "frame")
+
+
+class Event(_Scoped):
+    def __init__(self, name):
+        super().__init__(name, "event")
+
+
+class Marker:
+    def __init__(self, name, domain=None):
+        self.name = name
+
+    def mark(self, scope='process'):
+        _emit(self.name, "marker", "i")
+
+
+class Counter:
+    def __init__(self, name, domain=None, value=0):
+        self.name = name
+        self._v = value
+
+    def set_value(self, value):
+        self._v = value
+        _emit(self.name, "counter", "C", args={"value": value})
+
+    def increment(self, delta=1):
+        self.set_value(self._v + delta)
+
+    def decrement(self, delta=1):
+        self.set_value(self._v - delta)
+
+    __iadd__ = lambda self, d: (self.increment(d), self)[1]
+    __isub__ = lambda self, d: (self.decrement(d), self)[1]
+
+
+class record:
+    """Scoped profiling (reference: profiler.py record)."""
+
+    def __init__(self, filename=None, profile_all=True):
+        if filename:
+            set_config(filename=filename, profile_all=profile_all)
+
+    def __enter__(self):
+        set_state('run')
+        return self
+
+    def __exit__(self, *a):
+        set_state('stop')

@@ -1,0 +1,199 @@
+"""Module API tests + the MNIST-MLP end-to-end gate (SURVEY §7 build
+order step 3; mirrors tests/python/unittest/test_module.py and
+tests/python/train/test_mlp.py)."""
+import logging
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.test_utils import assert_almost_equal, default_context
+
+
+def _mlp_sym(num_hidden=64, num_classes=10):
+    data = mx.sym.var("data")
+    h = mx.sym.FullyConnected(data, num_hidden=num_hidden, name="fc1")
+    h = mx.sym.Activation(h, act_type="relu", name="relu1")
+    h = mx.sym.FullyConnected(h, num_hidden=num_classes, name="fc2")
+    return mx.sym.SoftmaxOutput(h, mx.sym.var("softmax_label"),
+                                name="softmax")
+
+
+def _synthetic_mnist(n=512, dim=64, num_classes=10, seed=0):
+    """Deterministic learnable synthetic classification data."""
+    rng = np.random.RandomState(seed)
+    centers = rng.normal(0, 1.5, (num_classes, dim))
+    y = rng.randint(0, num_classes, n)
+    x = centers[y] + rng.normal(0, 0.5, (n, dim))
+    return x.astype(np.float32), y.astype(np.float32)
+
+
+def test_module_bind_and_forward():
+    sym = _mlp_sym()
+    mod = mx.module.Module(sym, context=default_context())
+    mod.bind(data_shapes=[("data", (8, 64))],
+             label_shapes=[("softmax_label", (8,))])
+    mod.init_params()
+    batch = mx.io.DataBatch(data=[mx.nd.ones((8, 64))],
+                            label=[mx.nd.zeros((8,))])
+    mod.forward(batch, is_train=False)
+    out = mod.get_outputs()[0]
+    assert out.shape == (8, 10)
+    assert_almost_equal(out.asnumpy().sum(axis=1), np.ones(8), rtol=1e-5)
+
+
+def test_module_fit_converges():
+    x, y = _synthetic_mnist()
+    train_iter = mx.io.NDArrayIter(x, y, batch_size=64, shuffle=True,
+                                   label_name="softmax_label")
+    val_iter = mx.io.NDArrayIter(x, y, batch_size=64,
+                                 label_name="softmax_label")
+    mod = mx.module.Module(_mlp_sym(), context=default_context())
+    mod.fit(train_iter, eval_data=val_iter, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.5, "momentum": 0.9},
+            num_epoch=6, eval_metric="acc",
+            initializer=mx.init.Xavier())
+    score = mod.score(val_iter, "acc")
+    assert score[0][1] > 0.95, score
+
+
+def test_module_adam_and_metrics():
+    x, y = _synthetic_mnist(n=256)
+    train_iter = mx.io.NDArrayIter(x, y, batch_size=32, shuffle=True,
+                                   label_name="softmax_label")
+    mod = mx.module.Module(_mlp_sym(), context=default_context())
+    mod.fit(train_iter, optimizer="adam",
+            optimizer_params={"learning_rate": 0.01},
+            num_epoch=4,
+            eval_metric=mx.metric.create(["acc", "ce"]))
+    score = mod.score(train_iter, mx.metric.TopKAccuracy(top_k=3))
+    assert score[0][1] > 0.9
+
+
+def test_module_predict():
+    x, y = _synthetic_mnist(n=128)
+    it = mx.io.NDArrayIter(x, y, batch_size=32,
+                           label_name="softmax_label")
+    mod = mx.module.Module(_mlp_sym(), context=default_context())
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params()
+    preds = mod.predict(it)
+    assert preds.shape == (128, 10)
+
+
+def test_module_checkpoint_roundtrip(tmp_path):
+    x, y = _synthetic_mnist(n=128)
+    it = mx.io.NDArrayIter(x, y, batch_size=32, label_name="softmax_label")
+    mod = mx.module.Module(_mlp_sym(), context=default_context())
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params(initializer=mx.init.Xavier())
+    prefix = str(tmp_path / "mlp")
+    mod.save_checkpoint(prefix, 1)
+
+    mod2 = mx.module.Module.load(prefix, 1, context=default_context())
+    mod2.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    batch = next(iter(it))
+    mod.forward(batch, is_train=False)
+    mod2.forward(batch, is_train=False)
+    assert_almost_equal(mod.get_outputs()[0].asnumpy(),
+                        mod2.get_outputs()[0].asnumpy(), rtol=1e-5,
+                        atol=1e-6)
+
+
+def test_module_save_load_optimizer_states(tmp_path):
+    x, y = _synthetic_mnist(n=64)
+    it = mx.io.NDArrayIter(x, y, batch_size=32, label_name="softmax_label")
+    mod = mx.module.Module(_mlp_sym(), context=default_context())
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params()
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.1,
+                                         "momentum": 0.9})
+    batch = next(iter(it))
+    mod.forward_backward(batch)
+    mod.update()
+    f = str(tmp_path / "opt.states")
+    mod.save_optimizer_states(f)
+    mod.load_optimizer_states(f)
+
+
+def test_module_input_grads():
+    sym = _mlp_sym()
+    mod = mx.module.Module(sym, context=default_context())
+    mod.bind(data_shapes=[("data", (4, 64))],
+             label_shapes=[("softmax_label", (4,))],
+             inputs_need_grad=True)
+    mod.init_params()
+    batch = mx.io.DataBatch(
+        data=[mx.nd.array(np.random.randn(4, 64))],
+        label=[mx.nd.array([0, 1, 2, 3])])
+    mod.forward(batch, is_train=True)
+    mod.backward()
+    grads = mod.get_input_grads()
+    assert grads[0].shape == (4, 64)
+    assert np.abs(grads[0].asnumpy()).sum() > 0
+
+
+def test_module_kvstore_local_update():
+    """fit with explicit kvstore='local' and update_on_kvstore path."""
+    x, y = _synthetic_mnist(n=128)
+    it = mx.io.NDArrayIter(x, y, batch_size=32, label_name="softmax_label")
+    mod = mx.module.Module(_mlp_sym(), context=[default_context(),
+                                                default_context()])
+    mod.fit(it, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.3}, num_epoch=3,
+            kvstore="local")
+    score = mod.score(it, "acc")
+    assert score[0][1] > 0.5
+
+
+def test_bucketing_module():
+    """PTB-style variable-length bucketing (SURVEY config 3 scaffold)."""
+    def sym_gen(seq_len):
+        data = mx.sym.var("data")
+        h = mx.sym.FullyConnected(data, num_hidden=8, name="fc1",
+                                  flatten=False)
+        h = mx.sym.mean(h, axis=1)
+        out = mx.sym.SoftmaxOutput(h, mx.sym.var("softmax_label"),
+                                   name="softmax")
+        return out, ("data",), ("softmax_label",)
+
+    mod = mx.module.BucketingModule(sym_gen, default_bucket_key=8,
+                                    context=default_context())
+    mod.bind(data_shapes=[("data", (4, 8, 4))],
+             label_shapes=[("softmax_label", (4,))])
+    mod.init_params()
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.1})
+    for seq_len in [8, 4, 8, 6]:
+        batch = mx.io.DataBatch(
+            data=[mx.nd.ones((4, seq_len, 4))],
+            label=[mx.nd.zeros((4,))],
+            bucket_key=seq_len,
+            provide_data=[mx.io.DataDesc("data", (4, seq_len, 4))],
+            provide_label=[mx.io.DataDesc("softmax_label", (4,))])
+        mod.forward(batch, is_train=True)
+        mod.backward()
+        mod.update()
+    assert len(mod._buckets) == 3
+
+
+def test_ndarray_iter_padding():
+    x = np.arange(10, dtype=np.float32).reshape(10, 1)
+    it = mx.io.NDArrayIter(x, np.arange(10, dtype=np.float32),
+                           batch_size=4, last_batch_handle="pad")
+    batches = list(it)
+    assert len(batches) == 3
+    assert batches[2].pad == 2
+    it.reset()
+    assert len(list(it)) == 3
+
+
+def test_prefetching_iter():
+    x = np.random.randn(32, 4).astype(np.float32)
+    base = mx.io.NDArrayIter(x, np.zeros(32, dtype=np.float32),
+                             batch_size=8)
+    pre = mx.io.PrefetchingIter(base)
+    count = sum(1 for _ in pre)
+    assert count == 4
+    pre.reset()
+    assert sum(1 for _ in pre) == 4
