@@ -57,6 +57,8 @@ from . import model
 from .model import save_checkpoint, load_checkpoint
 from . import module
 from .module import Module
+from . import image
+from . import gluon
 
 from . import test_utils
 

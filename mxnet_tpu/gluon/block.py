@@ -1,0 +1,706 @@
+"""Gluon Block / HybridBlock / SymbolBlock (parity:
+python/mxnet/gluon/block.py).
+
+TPU-native hybridize: tracing ``hybrid_forward`` with Symbols builds a
+graph that becomes ONE CachedOp = one fused XLA executable
+(mxnet_tpu/cached_op.py), instead of the reference's CachedOp node-wise
+engine execution with static-alloc planning (block.py:748 →
+cached_op.cc). Deferred shape inference rides the Symbol layer's
+jax.eval_shape-based infer_shape.
+"""
+from __future__ import annotations
+
+import copy
+import re
+import threading
+import warnings
+from collections import OrderedDict
+
+import numpy as np
+
+from ..base import MXNetError
+from ..context import Context, cpu, current_context
+from .. import ndarray as nd
+from ..ndarray import NDArray
+from .. import symbol as sym_mod
+from ..symbol import Symbol
+from ..cached_op import CachedOp
+from .parameter import Parameter, ParameterDict, DeferredInitializationError
+
+__all__ = ["Block", "HybridBlock", "SymbolBlock"]
+
+
+class _BlockScope:
+    """Name manager for Blocks (reference: block.py:34)."""
+
+    _current = threading.local()
+
+    def __init__(self, block):
+        self._block = block
+        self._counter = {}
+        self._old_scope = None
+        self._name_scope = None
+
+    @staticmethod
+    def create(prefix, params, hint):
+        current = getattr(_BlockScope._current, "value", None)
+        if current is None:
+            if prefix is None:
+                from ..name import NameManager
+                prefix = NameManager.current().get(None, hint) + '_'
+            if params is None:
+                params = ParameterDict(prefix)
+            else:
+                params = ParameterDict(params.prefix, params)
+            return prefix, params
+        if prefix is None:
+            count = current._counter.get(hint, 0)
+            prefix = '%s%d_' % (hint, count)
+            current._counter[hint] = count + 1
+        if params is None:
+            parent = current._block.params
+            params = ParameterDict(parent.prefix + prefix, parent._shared)
+        else:
+            params = ParameterDict(params.prefix, params)
+        return current._block.prefix + prefix, params
+
+    def __enter__(self):
+        if self._block._empty_prefix:
+            return self
+        self._old_scope = getattr(_BlockScope._current, "value", None)
+        _BlockScope._current.value = self
+        from ..name import Prefix
+        self._name_scope = Prefix(self._block.prefix)
+        self._name_scope.__enter__()
+        return self
+
+    def __exit__(self, ptype, value, trace):
+        if self._block._empty_prefix:
+            return
+        self._name_scope.__exit__(ptype, value, trace)
+        self._name_scope = None
+        _BlockScope._current.value = self._old_scope
+
+
+def _flatten(args, inout_str):
+    if isinstance(args, NDArray):
+        return [args], int(0)
+    if isinstance(args, Symbol):
+        length = len(args.list_outputs())
+        length = length if length > 1 else 0
+        return [args], int(length)
+    assert isinstance(args, (list, tuple)), \
+        "HybridBlock %s must be (nested) list of Symbol or NDArray, " \
+        "but got %s of type %s" % (inout_str, str(args), str(type(args)))
+    flat = []
+    fmts = []
+    for i in args:
+        arg, fmt = _flatten(i, inout_str)
+        flat.extend(arg)
+        fmts.append(fmt)
+    return flat, fmts
+
+
+def _regroup(args, fmt):
+    if isinstance(fmt, int):
+        if fmt == 0:
+            return args[0], args[1:]
+        return args[:fmt], args[fmt:]
+    assert isinstance(args, (list, tuple)), \
+        "output must be (nested) list of Symbol or NDArray, but got %s of " \
+        "type %s" % (str(args), str(type(args)))
+    ret = []
+    for i in fmt:
+        res, args = _regroup(args, i)
+        ret.append(res)
+    return ret, args
+
+
+class Block:
+    """Base of all layers and models (reference: block.py:127)."""
+
+    def __init__(self, prefix=None, params=None):
+        self._empty_prefix = prefix == ''
+        self._prefix, self._params = _BlockScope.create(
+            prefix, params, self._alias())
+        self._name = self._prefix[:-1] if self._prefix.endswith('_') \
+            else self._prefix
+        self._scope = _BlockScope(self)
+        self._children = OrderedDict()
+        self._reg_params = {}
+        self._forward_hooks = OrderedDict()
+        self._forward_pre_hooks = OrderedDict()
+
+    def __repr__(self):
+        s = '{name}(\n{modstr}\n)'
+        modstr = '\n'.join(
+            ['  ({key}): {block}'.format(
+                key=key, block=_indent(block.__repr__(), 2))
+             for key, block in self.__dict__.items()
+             if isinstance(block, Block)])
+        return s.format(name=self.__class__.__name__, modstr=modstr)
+
+    def __setattr__(self, name, value):
+        if hasattr(self, name):
+            existing = getattr(self, name)
+            if isinstance(existing, (Parameter, Block)) and \
+                    not isinstance(value, type(existing)):
+                raise TypeError('Changing attribute type for {name} from '
+                                '{type1} to {type2} is not allowed.'.format(
+                                    name=name, type1=type(existing),
+                                    type2=type(value)))
+        if isinstance(value, Block):
+            self.register_child(value, name)
+        elif isinstance(value, Parameter):
+            assert name not in self._reg_params, \
+                "Overriding Parameter attribute %s is not allowed. " \
+                "If you want to share parameters between blocks, please " \
+                "set an attribute before initializing children blocks." % name
+            self._reg_params[name] = value
+        super().__setattr__(name, value)
+
+    def _check_container_with_block(self):
+        pass
+
+    def _alias(self):
+        return self.__class__.__name__.lower()
+
+    @property
+    def prefix(self):
+        return self._prefix
+
+    @property
+    def name(self):
+        return self._name
+
+    def name_scope(self):
+        return self._scope
+
+    @property
+    def params(self):
+        return self._params
+
+    def collect_params(self, select=None):
+        """All Parameters of this Block and children
+        (reference: block.py:278)."""
+        self._check_container_with_block()
+        ret = ParameterDict(self._params.prefix)
+        if not select:
+            ret.update(self.params)
+        else:
+            pattern = re.compile(select)
+            ret.update({name: value for name, value in self.params.items()
+                        if pattern.match(name)})
+        for cld in self._children.values():
+            ret.update(cld.collect_params(select=select))
+        return ret
+
+    def _collect_params_with_prefix(self, prefix=''):
+        if prefix:
+            prefix += '.'
+        ret = {prefix + key: val for key, val in self._reg_params.items()}
+        for name, child in self._children.items():
+            ret.update(child._collect_params_with_prefix(prefix + name))
+        return ret
+
+    def save_parameters(self, filename, deduplicate=False):
+        """Save by structure path (reference: block.py:315)."""
+        params = self._collect_params_with_prefix()
+        arg_dict = {key: val._check_and_get(val._data, None)
+                    for key, val in params.items()}
+        nd.save(filename, arg_dict)
+
+    def load_parameters(self, filename, ctx=None, allow_missing=False,
+                        ignore_extra=False, cast_dtype=False,
+                        dtype_source='current'):
+        """Load by structure path (reference: block.py:404)."""
+        loaded = nd.load(filename)
+        params = self._collect_params_with_prefix()
+        if not loaded and not params:
+            return
+        if not any('.' in i for i in loaded.keys()):
+            # legacy loading: by parameter full name
+            del loaded
+            self.collect_params().load(
+                filename, ctx, allow_missing, ignore_extra, self.prefix)
+            return
+        if not allow_missing:
+            for name in params.keys():
+                assert name in loaded, \
+                    "Parameter '%s' is missing in file '%s', which contains "\
+                    "parameters: %s." % (name, filename,
+                                         _brief_print_list(loaded.keys()))
+        for name in loaded:
+            if not ignore_extra and name not in params:
+                raise ValueError(
+                    "Parameter '%s' loaded from file '%s' is not present in "
+                    "ParameterDict, which contains parameters %s." % (
+                        name, filename, _brief_print_list(params.keys())))
+            if name in params:
+                params[name]._load_init(loaded[name], ctx)
+
+    def register_child(self, block, name=None):
+        if name is None:
+            name = str(len(self._children))
+        self._children[name] = block
+
+    def register_forward_pre_hook(self, hook):
+        handle = _HookHandle(self._forward_pre_hooks)
+        self._forward_pre_hooks[handle.id] = hook
+        return handle
+
+    def register_forward_hook(self, hook):
+        handle = _HookHandle(self._forward_hooks)
+        self._forward_hooks[handle.id] = hook
+        return handle
+
+    def apply(self, fn):
+        for cld in self._children.values():
+            cld.apply(fn)
+        fn(self)
+        return self
+
+    def initialize(self, init=None, ctx=None, verbose=False,
+                   force_reinit=False):
+        if init is None:
+            from .. import initializer
+            init = initializer.Uniform()
+        self.collect_params().initialize(init, ctx, verbose, force_reinit)
+
+    def hybridize(self, active=True, **kwargs):
+        for cld in self._children.values():
+            cld.hybridize(active, **kwargs)
+
+    def cast(self, dtype):
+        for child in self._children.values():
+            child.cast(dtype)
+        for _, param in self.params.items():
+            param.cast(dtype)
+
+    def __call__(self, *args):
+        for hook in self._forward_pre_hooks.values():
+            hook(self, args)
+        out = self.forward(*args)
+        for hook in self._forward_hooks.values():
+            hook(self, args, out)
+        return out
+
+    def forward(self, *args):
+        raise NotImplementedError()
+
+    def summary(self, *inputs):
+        summary = OrderedDict()
+        seen = set()
+        hooks = []
+
+        def _get_shape_str(args):
+            def flatten(args):
+                if not isinstance(args, (list, tuple)):
+                    return [args], int(0)
+                flat = []
+                fmts = []
+                for i in args:
+                    arg, fmt = flatten(i)
+                    flat.extend(arg)
+                    fmts.append(fmt)
+                return flat, fmts
+            flat_args, fmts = flatten(args)
+            flat_arg_shapes = [x.shape if isinstance(x, NDArray) else x
+                               for x in flat_args]
+            shapes = _regroup(flat_arg_shapes, fmts)[0] \
+                if not isinstance(fmts, int) else flat_arg_shapes[0]
+            shape_str = str(shapes).replace('L', '')
+            return shape_str
+
+        def _register_summary_hook(block):
+            def _summary_hook(block, _, outputs):
+                class_name = block.__class__.__name__
+                block_idx = len(summary) - 1
+                m_key = '%s-%i' % (class_name, block_idx + 1)
+                summary[m_key] = OrderedDict()
+                summary[m_key]['output_shape'] = _get_shape_str(outputs)
+                params = 0
+                summary[m_key]['trainable'] = 0
+                summary[m_key]['shared'] = 0
+                for p in block.params.values():
+                    params += int(np.prod(p.shape)) if p.shape else 0
+                    summary[m_key]['trainable'] += 0 if p.grad_req == 'null' \
+                        else int(np.prod(p.shape)) if p.shape else 0
+                    if p in seen:
+                        summary[m_key]['shared'] += \
+                            int(np.prod(p.shape)) if p.shape else 0
+                    else:
+                        seen.add(p)
+                summary[m_key]['n_params'] = params
+            if not isinstance(block, (Sequential_like())):
+                hooks.append(block.register_forward_hook(_summary_hook))
+
+        summary['Input'] = OrderedDict()
+        summary['Input']['output_shape'] = _get_shape_str(inputs)
+        summary['Input']['n_params'] = 0
+        summary['Input']['trainable'] = 0
+        summary['Input']['shared'] = 0
+        try:
+            self.apply(_register_summary_hook)
+            self(*inputs)
+            line_format = '{:>20}  {:>42} {:>15}'
+            print('-' * 80)
+            print(line_format.format('Layer (type)', 'Output Shape',
+                                     'Param #'))
+            print('=' * 80)
+            total_params = 0
+            trainable_params = 0
+            shared_params = 0
+            for layer in summary:
+                print(line_format.format(
+                    layer, str(summary[layer]['output_shape']),
+                    summary[layer]['n_params']))
+                total_params += summary[layer]['n_params']
+                trainable_params += summary[layer]['trainable']
+                shared_params += summary[layer]['shared']
+            print('=' * 80)
+            print('Parameters in forward computation graph, duplicate '
+                  'included')
+            print('   Total params: ' + str(total_params))
+            print('   Trainable params: ' + str(trainable_params))
+            print('   Non-trainable params: '
+                  + str(total_params - trainable_params))
+            print('Shared params in forward computation graph: '
+                  + str(shared_params))
+            print('Unique parameters in model: '
+                  + str(total_params - shared_params))
+            print('-' * 80)
+        finally:
+            for h in hooks:
+                h.detach()
+
+
+def Sequential_like():
+    from .nn.basic_layers import Sequential, HybridSequential
+    return (Sequential, HybridSequential)
+
+
+class _HookHandle:
+    _next_id = [0]
+
+    def __init__(self, hooks_dict):
+        self.id = _HookHandle._next_id[0]
+        _HookHandle._next_id[0] += 1
+        self._hooks_dict = hooks_dict
+
+    def detach(self):
+        self._hooks_dict.pop(self.id, None)
+
+
+def _indent(s_, num_spaces):
+    lines = s_.split('\n')
+    first = lines.pop(0)
+    lines = [(num_spaces * ' ') + line for line in lines]
+    return '\n'.join([first] + lines)
+
+
+def _brief_print_list(lst, limit=7):
+    lst = list(lst)
+    if len(lst) > limit:
+        return _brief_print_list(lst[:limit // 2], limit) + ', ..., ' + \
+            _brief_print_list(lst[-limit // 2:], limit)
+    return ', '.join(["'%s'" % str(i) for i in lst])
+
+
+class HybridBlock(Block):
+    """Block with hybridize support (reference: block.py:671)."""
+
+    def __init__(self, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._cached_graph = ()
+        self._cached_op = None
+        self._out_format = None
+        self._in_format = None
+        self._active = False
+        self._flags = []
+
+    def __setattr__(self, name, value):
+        super().__setattr__(name, value)
+        if isinstance(value, HybridBlock):
+            self._clear_cached_op()
+
+    def _get_graph(self, *args):
+        if not self._cached_graph:
+            flat_args, self._in_format = _flatten(args, "input")
+            inputs = [sym_mod.var('data%d' % i)
+                      for i in range(len(flat_args))]
+            grouped_inputs = _regroup(inputs, self._in_format)[0] \
+                if not isinstance(self._in_format, int) else inputs[0]
+            params = {i: j.var() for i, j in self._reg_params.items()}
+            with self.name_scope():
+                if isinstance(self._in_format, int):
+                    out = self.hybrid_forward(sym_mod, grouped_inputs,
+                                              **params)
+                else:
+                    out = self.hybrid_forward(sym_mod, *grouped_inputs,
+                                              **params)
+            flat_out, self._out_format = _flatten(out, "output")
+            self._cached_graph = (inputs, sym_mod.Group(flat_out)
+                                  if len(flat_out) > 1 else flat_out[0])
+        return self._cached_graph
+
+    def _build_cache(self, *args):
+        data, out = self._get_graph(*args)
+        data_names = {d.name: i for i, d in enumerate(data)}
+        params = self.collect_params()
+        input_names = out.list_inputs()
+
+        param_dict = {p.name: p for p in params.values()}
+        # build the ordered input source list: args + aux
+        arg_names = out.list_arguments()
+        aux_names = out.list_auxiliary_states()
+        self._cached_op_args = []
+        for name in arg_names + aux_names:
+            if name in data_names:
+                self._cached_op_args.append((True, data_names[name]))
+            else:
+                if name not in param_dict:
+                    raise MXNetError(
+                        "Unknown input to HybridBlock: %s" % name)
+                self._cached_op_args.append((False, param_dict[name]))
+        self._cached_op = CachedOp(out, self._flags)
+
+    def _deferred_infer_shape(self, *args):
+        try:
+            self.infer_shape(*args)
+        except Exception as e:
+            error_msg = "Deferred initialization failed because shape " \
+                "cannot be inferred. {}".format(e)
+            raise ValueError(error_msg)
+
+    def _call_cached_op(self, *args):
+        if self._cached_op is None:
+            self._build_cache(*args)
+        flat_args, fmt = _flatten(args, "input")
+        assert fmt == self._in_format, "Invalid input format"
+        cargs = []
+        for is_arg, ref in self._cached_op_args:
+            if is_arg:
+                cargs.append(flat_args[ref])
+            else:
+                cargs.append(ref.data())
+        out = self._cached_op(*cargs)
+        if isinstance(out, NDArray):
+            out = [out]
+        return _regroup(list(out), self._out_format)[0]
+
+    def _clear_cached_op(self):
+        self._cached_graph = ()
+        self._cached_op = None
+
+    def register_child(self, block, name=None):
+        if not isinstance(block, HybridBlock):
+            raise ValueError(
+                "Children of HybridBlock must also be HybridBlock, but %s "
+                "has type %s. If you are using Sequential, please try "
+                "HybridSequential instead." % (str(block),
+                                               str(type(block))))
+        super().register_child(block, name)
+        self._clear_cached_op()
+
+    def hybridize(self, active=True, **kwargs):
+        self._active = active
+        self._flags = list(kwargs.items())
+        self._clear_cached_op()
+        super().hybridize(active, **kwargs)
+
+    def cast(self, dtype):
+        self._clear_cached_op()
+        super().cast(dtype)
+
+    def _infer_attrs(self, infer_fn, attr, *args):
+        inputs, out = self._get_graph(*args)
+        flat_args, _ = _flatten(args, "input")
+        args_map = {}
+        for i, arg in enumerate(flat_args):
+            args_map['data%d' % i] = arg.shape if attr == 'shape' \
+                else arg.dtype
+        arg_attrs, _, aux_attrs = getattr(out, infer_fn)(**args_map)
+        if arg_attrs is None:
+            raise ValueError("Could not infer %s" % attr)
+        sdict = dict(zip(out.list_arguments(), arg_attrs))
+        sdict.update(dict(zip(out.list_auxiliary_states(), aux_attrs)))
+        for name, param in self.collect_params().items():
+            if name in sdict:
+                setattr(param, "_%s" % attr if attr == "shape" else attr,
+                        sdict[name])
+
+    def infer_shape(self, *args):
+        """Infer parameter shapes from inputs (reference: block.py:839)."""
+        self._infer_attrs('infer_shape', 'shape', *args)
+        for param in self.collect_params().values():
+            if param._deferred_init:
+                param._finish_deferred_init()
+
+    def infer_type(self, *args):
+        self._infer_attrs('infer_type', 'dtype', *args)
+
+    def export(self, path, epoch=0, remove_amp_cast=True):
+        """Emit symbol.json + params deploy artifact
+        (reference: block.py:868)."""
+        if not self._cached_graph:
+            raise RuntimeError(
+                "Please first call block.hybridize() and then run forward "
+                "with this block at least once before calling export.")
+        sym = self._cached_graph[1]
+        sym.save('%s-symbol.json' % path)
+        arg_names = set(sym.list_arguments())
+        aux_names = set(sym.list_auxiliary_states())
+        arg_dict = {}
+        for name, param in self.collect_params().items():
+            if name in arg_names:
+                arg_dict['arg:%s' % name] = param.data()
+            elif name in aux_names:
+                arg_dict['aux:%s' % name] = param.data()
+        nd.save('%s-%04d.params' % (path, epoch), arg_dict)
+        return '%s-symbol.json' % path, '%s-%04d.params' % (path, epoch)
+
+    def forward(self, x, *args):
+        """Dispatch hybridized vs imperative (reference: block.py:795)."""
+        if isinstance(x, NDArray):
+            if self._active:
+                try:
+                    return self._call_cached_op(x, *args)
+                except DeferredInitializationError:
+                    self._deferred_infer_shape(x, *args)
+                    return self._call_cached_op(x, *args)
+            try:
+                params = {i: j.data() for i, j in self._reg_params.items()}
+            except DeferredInitializationError:
+                self._deferred_infer_shape(x, *args)
+                params = {i: j.data() for i, j in self._reg_params.items()}
+            return self.hybrid_forward(nd, x, *args, **params)
+        assert isinstance(x, Symbol), \
+            "HybridBlock requires the first argument to forward be either " \
+            "Symbol or NDArray, but got %s" % type(x)
+        params = {i: j.var() for i, j in self._reg_params.items()}
+        with self.name_scope():
+            return self.hybrid_forward(sym_mod, x, *args, **params)
+
+    def hybrid_forward(self, F, x, *args, **kwargs):
+        raise NotImplementedError()
+
+
+class SymbolBlock(HybridBlock):
+    """Wrap a Symbol as a Block (reference: block.py:952)."""
+
+    @staticmethod
+    def imports(symbol_file, input_names, param_file=None, ctx=None):
+        sym = sym_mod.load(symbol_file)
+        if isinstance(input_names, str):
+            input_names = [input_names]
+        inputs = [sym_mod.var(i) for i in input_names]
+        ret = SymbolBlock(sym, inputs)
+        if param_file is not None:
+            params = nd.load(param_file)
+            remapped = {}
+            for name, value in params.items():
+                if name.startswith('arg:') or name.startswith('aux:'):
+                    name = name[4:]
+                remapped[name] = value
+            for name, param in ret.collect_params().items():
+                if name in remapped:
+                    param._load_init(remapped[name], ctx)
+        return ret
+
+    def __init__(self, outputs, inputs, params=None):
+        super().__init__(prefix=None, params=params)
+        self._prefix = ''
+        self._params = ParameterDict('', params)
+        if isinstance(inputs, (Symbol,)) and \
+                len(inputs.list_outputs()) == 1:
+            inputs = [inputs]
+        if isinstance(outputs, (list, tuple)) and len(outputs) == 1:
+            outputs = outputs[0]
+        if isinstance(outputs, (list, tuple)):
+            outputs = sym_mod.Group(outputs)
+
+        syms, self._in_format = _flatten(inputs, "input")
+        out, self._out_format = _flatten(outputs, "output")
+        out = sym_mod.Group(out) if len(out) > 1 else out[0]
+
+        input_names = set()
+        for i in syms:
+            assert len(i.list_outputs()) == 1, \
+                "Input symbols must be variable, but %s is an output of " \
+                "operators" % str(i)
+            input_names.add(i.name)
+
+        for name in out.list_arguments():
+            if name not in input_names:
+                self.params.get(name, allow_deferred_init=True)
+        for name in out.list_auxiliary_states():
+            if name not in input_names:
+                self.params.get(name, grad_req='null',
+                                allow_deferred_init=True)
+
+        self._cached_graph = (syms, out)
+        prefix = _common_prefix(list(self._params.keys()))
+        params = {k[len(prefix):]: v for k, v in self._params.items()}
+        self._reg_params = params
+
+    def forward(self, x, *args):
+        if isinstance(x, NDArray):
+            try:
+                return self._call_cached_op(x, *args)
+            except DeferredInitializationError:
+                # infer shapes from the cached graph directly
+                inputs, out = self._cached_graph
+                flat_args, _ = _flatten([x] + list(args), "input")
+                args_map = {i.name: a.shape
+                            for i, a in zip(inputs, flat_args)}
+                arg_shapes, _, aux_shapes = out.infer_shape(**args_map)
+                sdict = dict(zip(out.list_arguments(), arg_shapes))
+                sdict.update(zip(out.list_auxiliary_states(), aux_shapes))
+                for name, param in self.params.items():
+                    if param.shape is None or np.prod(param.shape) <= 0:
+                        param._shape = sdict[name]
+                    if param._deferred_init:
+                        param._finish_deferred_init()
+                return self._call_cached_op(x, *args)
+        assert isinstance(x, Symbol), \
+            "HybridBlock requires the first argument to forward be either " \
+            "Symbol or NDArray, but got %s" % type(x)
+        args, in_fmt = _flatten([x] + list(args), "input")
+        assert in_fmt == self._in_format, "Invalid input format"
+        ret = copy.copy(self._cached_graph[1])
+        return ret
+
+    def _build_cache(self, *args):
+        inputs, out = self._cached_graph
+        data_names = {d.name: i for i, d in enumerate(inputs)}
+        param_dict = {p.name: p for p in self.params.values()}
+        arg_names = out.list_arguments()
+        aux_names = out.list_auxiliary_states()
+        self._cached_op_args = []
+        for name in arg_names + aux_names:
+            if name in data_names:
+                self._cached_op_args.append((True, data_names[name]))
+            else:
+                self._cached_op_args.append((False, param_dict[name]))
+        self._cached_op = CachedOp(out, self._flags)
+
+    def _clear_cached_op(self):
+        tmp = self._cached_graph
+        super()._clear_cached_op()
+        self._cached_graph = tmp
+
+    def hybrid_forward(self, F, x, *args, **kwargs):
+        raise NotImplementedError()
+
+
+def _common_prefix(names):
+    if not names:
+        return ''
+    prefix = names[0]
+    for name in names:
+        i = 0
+        while i < len(prefix) and i < len(name) and prefix[i] == name[i]:
+            i += 1
+        prefix = prefix[:i]
+    return prefix

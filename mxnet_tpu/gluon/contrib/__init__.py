@@ -1,0 +1,3 @@
+"""Gluon contrib (parity: python/mxnet/gluon/contrib/)."""
+from . import nn
+from . import rnn

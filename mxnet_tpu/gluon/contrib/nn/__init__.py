@@ -1,0 +1,3 @@
+"""Contrib nn layers (parity: python/mxnet/gluon/contrib/nn/)."""
+from .basic_layers import (Concurrent, HybridConcurrent, Identity,
+                           SparseEmbedding, SyncBatchNorm, PixelShuffle2D)

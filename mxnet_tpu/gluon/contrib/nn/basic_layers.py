@@ -1,0 +1,113 @@
+"""Contrib layers (parity:
+python/mxnet/gluon/contrib/nn/basic_layers.py)."""
+from __future__ import annotations
+
+from ...block import Block, HybridBlock
+from ...nn.basic_layers import Sequential, HybridSequential, BatchNorm, \
+    Embedding
+
+__all__ = ["Concurrent", "HybridConcurrent", "Identity", "SparseEmbedding",
+           "SyncBatchNorm", "PixelShuffle2D"]
+
+
+class Concurrent(Sequential):
+    """Parallel branches concatenated (reference: basic_layers.py:38)."""
+
+    def __init__(self, axis=-1, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self.axis = axis
+
+    def forward(self, x):
+        from .... import ndarray as nd
+        out = [block(x) for block in self._children.values()]
+        return nd.Concat(*out, dim=self.axis)
+
+
+class HybridConcurrent(HybridSequential):
+    """Hybridizable parallel concat (reference: basic_layers.py:69)."""
+
+    def __init__(self, axis=-1, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self.axis = axis
+
+    def hybrid_forward(self, F, x):
+        out = [block(x) for block in self._children.values()]
+        return F.Concat(*out, dim=self.axis)
+
+
+class Identity(HybridBlock):
+    def __init__(self, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+
+    def hybrid_forward(self, F, x):
+        return x
+
+
+class SparseEmbedding(Block):
+    """Embedding with sparse gradients (reference: basic_layers.py:118).
+    On TPU gradients flow dense through XLA scatter-add; the sparse
+    row-update optimization lives in the row_sparse kvstore path."""
+
+    def __init__(self, input_dim, output_dim, dtype='float32',
+                 weight_initializer=None, **kwargs):
+        super().__init__(**kwargs)
+        self._kwargs = {'input_dim': input_dim, 'output_dim': output_dim,
+                        'dtype': dtype, 'sparse_grad': True}
+        self.weight = self.params.get('weight',
+                                      shape=(input_dim, output_dim),
+                                      init=weight_initializer,
+                                      dtype=dtype)
+
+    def forward(self, x):
+        from .... import ndarray as nd
+        return nd.Embedding(x, self.weight.data(), **self._kwargs)
+
+    def __repr__(self):
+        s = '{block_name}({input_dim} -> {output_dim}, {dtype})'
+        return s.format(block_name=self.__class__.__name__, **self._kwargs)
+
+
+class SyncBatchNorm(BatchNorm):
+    """Cross-device synchronized BatchNorm
+    (reference: src/operator/contrib/sync_batch_norm.cc).
+
+    TPU-native: when the batch is sharded over a mesh data axis, XLA's
+    batch-norm statistics inside a pjit program already reduce over the
+    global batch via psum — so this is the standard BatchNorm executed
+    under a mesh; ``num_devices`` is accepted for API parity.
+    """
+
+    def __init__(self, in_channels=0, num_devices=None, momentum=0.9,
+                 epsilon=1e-5, center=True, scale=True,
+                 use_global_stats=False, beta_initializer='zeros',
+                 gamma_initializer='ones',
+                 running_mean_initializer='zeros',
+                 running_variance_initializer='ones', **kwargs):
+        super().__init__(1, momentum, epsilon, center, scale,
+                         use_global_stats, beta_initializer,
+                         gamma_initializer, running_mean_initializer,
+                         running_variance_initializer, in_channels,
+                         **kwargs)
+        self._num_devices = num_devices
+
+
+class PixelShuffle2D(HybridBlock):
+    def __init__(self, factor):
+        super().__init__()
+        try:
+            self._factors = (int(factor),) * 2
+        except TypeError:
+            self._factors = tuple(int(fac) for fac in factor)
+            assert len(self._factors) == 2, \
+                "wrong length {}".format(len(self._factors))
+
+    def hybrid_forward(self, F, x):
+        f1, f2 = self._factors
+        x = F.Reshape(x, shape=(0, -4, -1, f1 * f2, 0, 0))
+        x = F.Reshape(x, shape=(0, 0, -4, f1, f2, 0, 0))
+        x = F.transpose(x, axes=(0, 1, 4, 2, 5, 3))
+        x = F.Reshape(x, shape=(0, 0, -3, -3))
+        return x
+
+    def __repr__(self):
+        return "{}({})".format(self.__class__.__name__, self._factors)

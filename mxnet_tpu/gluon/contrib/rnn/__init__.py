@@ -1,0 +1,2 @@
+"""Contrib RNN cells (parity: python/mxnet/gluon/contrib/rnn/)."""
+from .rnn_cell import VariationalDropoutCell, LSTMPCell

@@ -1,0 +1,7 @@
+"""Gluon data namespace (parity: python/mxnet/gluon/data/)."""
+from .dataset import (Dataset, SimpleDataset, ArrayDataset,
+                      RecordFileDataset)
+from .sampler import (Sampler, SequentialSampler, RandomSampler,
+                      BatchSampler)
+from .dataloader import DataLoader
+from . import vision

@@ -1,0 +1,96 @@
+"""DataLoader (parity: python/mxnet/gluon/data/dataloader.py).
+
+The reference forks multiprocessing workers and ships NDArrays back over
+POSIX shared memory (dataloader.py:53-98, CPUSharedStorage). TPU-native
+design: worker parallelism uses a thread pool — decode/augment release
+the GIL in numpy/PIL, the arrays land directly in host memory, and the
+device transfer is one batched device_put on the consumer side, so the
+shm round-trip is unnecessary.
+"""
+from __future__ import annotations
+
+import concurrent.futures as _futures
+
+import numpy as np
+
+from ... import ndarray as nd
+from .sampler import BatchSampler, RandomSampler, SequentialSampler
+
+__all__ = ["DataLoader", "default_batchify_fn"]
+
+
+def default_batchify_fn(data):
+    """Stack samples into a batch (reference: dataloader.py:127)."""
+    if isinstance(data[0], nd.NDArray):
+        return nd.stack(*data)
+    if isinstance(data[0], tuple):
+        data = zip(*data)
+        return [default_batchify_fn(i) for i in data]
+    data = np.asarray(data)
+    return nd.array(data, dtype=data.dtype)
+
+
+class DataLoader:
+    """Mini-batch loader over a Dataset (reference: dataloader.py:441)."""
+
+    def __init__(self, dataset, batch_size=None, shuffle=False,
+                 sampler=None, last_batch=None, batch_sampler=None,
+                 batchify_fn=None, num_workers=0, pin_memory=False,
+                 pin_device_id=0, prefetch=None, thread_pool=True):
+        self._dataset = dataset
+        self._pin_memory = pin_memory
+
+        if batch_sampler is None:
+            if batch_size is None:
+                raise ValueError("batch_size must be specified unless "
+                                 "batch_sampler is specified")
+            if sampler is None:
+                if shuffle:
+                    sampler = RandomSampler(len(dataset))
+                else:
+                    sampler = SequentialSampler(len(dataset))
+            elif shuffle:
+                raise ValueError("shuffle must not be specified if sampler "
+                                 "is specified")
+            batch_sampler = BatchSampler(
+                sampler, batch_size, last_batch if last_batch else 'keep')
+        elif batch_size is not None or shuffle or sampler is not None or \
+                last_batch is not None:
+            raise ValueError("batch_size, shuffle, sampler and last_batch "
+                             "must not be specified if batch_sampler is "
+                             "specified.")
+        self._batch_sampler = batch_sampler
+        self._num_workers = num_workers if num_workers >= 0 else 0
+        self._prefetch = max(0, int(prefetch)
+                             if prefetch is not None
+                             else 2 * self._num_workers)
+        if batchify_fn is None:
+            batchify_fn = default_batchify_fn
+        self._batchify_fn = batchify_fn
+
+    def _make_batch(self, batch_indices):
+        return self._batchify_fn([self._dataset[i] for i in batch_indices])
+
+    def __iter__(self):
+        if self._num_workers == 0:
+            for batch in self._batch_sampler:
+                yield self._make_batch(batch)
+            return
+        with _futures.ThreadPoolExecutor(self._num_workers) as pool:
+            pending = []
+            it = iter(self._batch_sampler)
+            try:
+                for _ in range(self._prefetch or self._num_workers):
+                    pending.append(pool.submit(self._make_batch, next(it)))
+            except StopIteration:
+                pass
+            while pending:
+                fut = pending.pop(0)
+                try:
+                    pending.append(pool.submit(self._make_batch, next(it)))
+                except StopIteration:
+                    pass
+                yield fut.result()
+
+    def __len__(self):
+        return len(self._batch_sampler)

@@ -1,0 +1,4 @@
+"""Vision data namespace (parity: python/mxnet/gluon/data/vision/)."""
+from .datasets import (MNIST, FashionMNIST, CIFAR10, CIFAR100,
+                       ImageFolderDataset, ImageRecordDataset)
+from . import transforms

@@ -1,0 +1,30 @@
+"""Pretrained model store (parity:
+python/mxnet/gluon/model_zoo/model_store.py).
+
+Zero-egress environment: serves only locally cached files under
+``root``; raises with a clear message otherwise.
+"""
+from __future__ import annotations
+
+import os
+
+__all__ = ["get_model_file", "purge"]
+
+
+def get_model_file(name, root=os.path.join('~', '.mxnet', 'models')):
+    root = os.path.expanduser(root)
+    for fname in sorted(os.listdir(root)) if os.path.isdir(root) else []:
+        if fname.startswith(name) and fname.endswith('.params'):
+            return os.path.join(root, fname)
+    raise RuntimeError(
+        "Pretrained model file for %r not found under %s and network "
+        "egress is unavailable; place the .params file there." % (name,
+                                                                  root))
+
+
+def purge(root=os.path.join('~', '.mxnet', 'models')):
+    root = os.path.expanduser(root)
+    if os.path.isdir(root):
+        for f in os.listdir(root):
+            if f.endswith(".params"):
+                os.remove(os.path.join(root, f))

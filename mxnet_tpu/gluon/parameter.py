@@ -1,0 +1,449 @@
+"""Gluon Parameter / ParameterDict (parity: python/mxnet/gluon/parameter.py).
+
+TPU note: a Parameter owns ONE NDArray handle (not per-device copies);
+data parallelism shards that array over the mesh instead of replicating
+python-side (SURVEY §2.2). Deferred initialization (shape inferred at
+first forward) is preserved.
+"""
+from __future__ import annotations
+
+from collections import OrderedDict
+
+import numpy as np
+
+from ..base import MXNetError
+from ..context import Context, cpu, current_context
+from .. import ndarray as nd
+from .. import initializer
+from .. import symbol as sym_mod
+
+__all__ = ["DeferredInitializationError", "Parameter", "Constant",
+           "ParameterDict", "tensor_types"]
+
+
+class DeferredInitializationError(MXNetError):
+    """Error for unfinished deferred initialization."""
+
+
+tensor_types = None  # set after import (NDArray, Symbol)
+
+
+class Parameter:
+    """A Block parameter (reference: parameter.py:43)."""
+
+    def __init__(self, name, grad_req='write', shape=None, dtype=np.float32,
+                 lr_mult=1.0, wd_mult=1.0, init=None, allow_deferred_init=False,
+                 differentiable=True, stype='default', grad_stype='default'):
+        self._var = None
+        self._data = None
+        self._grad = None
+        self._deferred_init = ()
+        self._differentiable = differentiable
+        self._allow_deferred_init = allow_deferred_init
+        self._grad_req = None
+        if isinstance(shape, int):
+            shape = (shape,)
+        self._shape = shape
+        self.name = name
+        self._dtype = dtype
+        self.lr_mult = lr_mult
+        self.wd_mult = wd_mult
+        self.init = init
+        self._stype = stype
+        self._grad_stype = grad_stype
+        self.grad_req = grad_req
+
+    def __repr__(self):
+        s = 'Parameter {name} (shape={shape}, dtype={dtype})'
+        return s.format(name=self.name, shape=self.shape, dtype=self.dtype)
+
+    @property
+    def grad_req(self):
+        return self._grad_req
+
+    @grad_req.setter
+    def grad_req(self, req):
+        assert req in ['write', 'add', 'null'], \
+            "grad_req must be one of 'write', 'add', or 'null', but got %s" \
+            % req
+        if not self._differentiable:
+            req = 'null'
+        if self._grad_req == req:
+            return
+        self._grad_req = req
+        if req == 'null':
+            self._grad = None
+        elif self._data is not None:
+            self._init_grad()
+
+    @property
+    def dtype(self):
+        return self._dtype
+
+    @dtype.setter
+    def dtype(self, dtype):
+        self.cast(dtype)
+
+    @property
+    def shape(self):
+        return self._shape
+
+    @shape.setter
+    def shape(self, new_shape):
+        if self._shape is None:
+            self._shape = new_shape
+            return
+        assert len(self._shape) == len(new_shape) and \
+            all(j in (0, i) for i, j in zip(new_shape, self._shape)), \
+            "Expected shape %s is incompatible with given shape %s." % (
+                str(new_shape), str(self._shape))
+        self._shape = new_shape
+
+    @property
+    def stype(self):
+        return self._stype
+
+    # -- init ------------------------------------------------------------
+    def initialize(self, init=None, ctx=None, default_init=None,
+                   force_reinit=False):
+        if default_init is None:
+            default_init = initializer.Uniform()
+        if self._data is not None and not force_reinit:
+            return
+        if ctx is None:
+            ctx = [current_context()]
+        if isinstance(ctx, Context):
+            ctx = [ctx]
+        if init is None:
+            init = default_init if self.init is None else self.init
+        if not self.shape or np.prod(self.shape) <= 0:
+            if self._allow_deferred_init:
+                self._deferred_init = (init, ctx, default_init, None)
+                return
+            raise ValueError("Cannot initialize Parameter '%s' because it "
+                             "has invalid shape: %s." % (self.name,
+                                                         str(self.shape)))
+        self._deferred_init = (init, ctx, default_init, None)
+        self._finish_deferred_init()
+
+    def _finish_deferred_init(self):
+        if not self._deferred_init:
+            return
+        init, ctx, default_init, data = self._deferred_init
+        self._deferred_init = ()
+        assert self.shape is not None and np.prod(self.shape) > 0, \
+            "Cannot initialize Parameter '%s' because it has invalid shape: "\
+            "%s. Please specify in_units, in_channels, etc for `Block`s." % (
+                self.name, str(self.shape))
+        from .. import autograd
+        with autograd.pause():
+            if data is None:
+                data = nd.zeros(self.shape, dtype=self.dtype, ctx=ctx[0])
+                actual_init = init if init is not None else default_init
+                if isinstance(actual_init, str):
+                    actual_init = initializer.create(actual_init)
+                actual_init(initializer.InitDesc(self.name, {}), data)
+            self._init_impl(data, ctx)
+
+    def _init_impl(self, data, ctx_list):
+        self._data = data
+        self._ctx_list = list(ctx_list)
+        if self._grad_req != 'null':
+            self._init_grad()
+
+    def _init_grad(self):
+        from .. import autograd
+        self._grad = nd.zeros(self._data.shape, dtype=self._data.dtype,
+                              ctx=self._data.context)
+        autograd.mark_variables([self._data], [self._grad],
+                                [self._grad_req])
+
+    def _check_and_get(self, arr, ctx):
+        if arr is not None:
+            return arr
+        if self._deferred_init:
+            raise DeferredInitializationError(
+                "Parameter '%s' has not been initialized yet because "
+                "initialization was deferred. Actual initialization happens "
+                "during the first forward pass. Please pass one batch of "
+                "data through the network before accessing Parameters." %
+                self.name)
+        raise RuntimeError(
+            "Parameter '%s' has not been initialized. Note that you should "
+            "initialize parameters and create Trainer with "
+            "Block.collect_params() instead of Block.params because the "
+            "later does not include Parameters of nested child Blocks" %
+            self.name)
+
+    # -- access ----------------------------------------------------------
+    def data(self, ctx=None):
+        return self._check_and_get(self._data, ctx)
+
+    def list_data(self):
+        return [self.data()]
+
+    def grad(self, ctx=None):
+        if self._data is not None and self._grad is None:
+            raise RuntimeError(
+                "Cannot get gradient array for Parameter '%s' because "
+                "grad_req='null'" % self.name)
+        return self._check_and_get(self._grad, ctx)
+
+    def list_grad(self):
+        return [self.grad()]
+
+    def list_ctx(self):
+        if self._data is None:
+            if self._deferred_init:
+                return self._deferred_init[1]
+            raise RuntimeError("Parameter '%s' has not been initialized"
+                               % self.name)
+        return self._ctx_list if hasattr(self, "_ctx_list") \
+            else [self._data.context]
+
+    def set_data(self, data):
+        self.shape = data.shape
+        if self._data is None:
+            assert self._deferred_init, \
+                "Parameter '%s' has not been initialized" % self.name
+            self._deferred_init = self._deferred_init[:3] + (data,)
+            return
+        if isinstance(data, nd.NDArray):
+            self._data._set_data(data.astype(self._data.dtype)._data)
+        else:
+            self._data._set_data(nd.array(
+                data, dtype=self._data.dtype)._data)
+
+    def zero_grad(self):
+        if self._grad is None:
+            return
+        self._grad[:] = 0
+
+    def reset_ctx(self, ctx):
+        pass  # single logical array on TPU; placement via sharding
+
+    def cast(self, dtype):
+        self._dtype = dtype
+        if self._data is None:
+            return
+        from .. import autograd
+        with autograd.pause():
+            self._data = self._data.astype(dtype)
+            if self._grad is not None:
+                self._grad = self._grad.astype(dtype)
+                autograd.mark_variables([self._data], [self._grad],
+                                        [self._grad_req])
+
+    def var(self):
+        if self._var is None:
+            self._var = sym_mod.var(self.name, shape=self.shape,
+                                    dtype=self.dtype, lr_mult=self.lr_mult,
+                                    wd_mult=self.wd_mult, init=self.init)
+        return self._var
+
+    def row_sparse_data(self, row_id):
+        return self.data().take(row_id)
+
+
+class Constant(Parameter):
+    """Non-trainable constant parameter (reference: parameter.py:612)."""
+
+    def __init__(self, name, value):
+        if not isinstance(value, nd.NDArray):
+            value = nd.array(value)
+        self.value = value
+
+        class Init(initializer.Initializer):
+            def _init_weight(self, _, arr):
+                value.copyto(arr)
+
+            _init_default = _init_weight
+        init_name = 'Constant_{}_{}'.format(name, id(self))
+        initializer._REG.register(init_name, allow_override=True)(Init)
+        super().__init__(name, grad_req='null', shape=value.shape,
+                         dtype=value.dtype, init=init_name,
+                         differentiable=False)
+
+
+class ParameterDict:
+    """Dict of Parameters with prefix (reference: parameter.py:632)."""
+
+    def __init__(self, prefix='', shared=None):
+        self._prefix = prefix
+        self._params = OrderedDict()
+        self._shared = shared
+
+    def __repr__(self):
+        s = '{name}(\n{content}\n)'
+        name = self._prefix + ' ' if self._prefix else ''
+        return s.format(name=name, content='\n'.join(
+            [' ' + v.__repr__() for v in self.values()]))
+
+    def __getitem__(self, key):
+        return self._params[key]
+
+    def __iter__(self):
+        return iter(self._params)
+
+    def items(self):
+        return self._params.items()
+
+    def keys(self):
+        return self._params.keys()
+
+    def values(self):
+        return self._params.values()
+
+    @property
+    def prefix(self):
+        return self._prefix
+
+    def _get_impl(self, name):
+        if name in self._params:
+            return self._params[name]
+        if self._shared is not None and name in self._shared._params:
+            self._params[name] = self._shared._params[name]
+            return self._shared._params[name]
+        return None
+
+    def get(self, name, **kwargs):
+        name = self._prefix + name
+        param = self._get_impl(name)
+        if param is None:
+            param = Parameter(name, **kwargs)
+            self._params[name] = param
+        else:
+            for k, v in kwargs.items():
+                if hasattr(param, k) and getattr(param, k) is not None:
+                    existing = getattr(param, k)
+                    if k == 'shape' and len(v) == len(existing):
+                        inferred_shape = []
+                        matched = True
+                        for dim1, dim2 in zip(v, existing):
+                            if dim1 != dim2 and dim1 * dim2 != 0:
+                                matched = False
+                                break
+                            elif dim1 == dim2:
+                                inferred_shape.append(dim1)
+                            elif dim1 == 0:
+                                inferred_shape.append(dim2)
+                            else:
+                                inferred_shape.append(dim1)
+                        if matched:
+                            param._shape = tuple(inferred_shape)
+                            continue
+                    elif k == 'dtype' and np.dtype(v) == np.dtype(existing):
+                        continue
+                    assert v is None or v == existing, \
+                        "Cannot retrieve Parameter '%s' because desired " \
+                        "attribute does not match with stored for " \
+                        "attribute '%s': desired '%s' vs stored '%s'." % (
+                            name, k, str(v), str(getattr(param, k)))
+                else:
+                    setattr(param, k, v)
+        return param
+
+    def get_constant(self, name, value=None):
+        name = self._prefix + name
+        param = self._get_impl(name)
+        if param is None:
+            if value is None:
+                raise KeyError("No constant named '{}'. Please specify value "
+                               "if you want to create a new constant.".format(
+                                   name))
+            param = Constant(name, value)
+            self._params[name] = param
+        elif value is not None:
+            assert isinstance(param, Constant), \
+                "Parameter '{}' already exists but it is not a constant." \
+                .format(name)
+        return param
+
+    def update(self, other):
+        for k, v in other.items():
+            if k in self._params:
+                assert self._params[k] is v, \
+                    "Cannot update self with other because they have " \
+                    "different Parameters with the same name '%s'" % k
+            else:
+                self._params[k] = v
+
+    def initialize(self, init=None, ctx=None, verbose=False,
+                   force_reinit=False):
+        if init is None:
+            init = initializer.Uniform()
+        if verbose and hasattr(init, "set_verbosity"):
+            init.set_verbosity(verbose=verbose)
+        for _, v in self.items():
+            v.initialize(None, ctx, init, force_reinit=force_reinit)
+
+    def zero_grad(self):
+        for i in self.values():
+            i.zero_grad()
+
+    def reset_ctx(self, ctx):
+        for i in self.values():
+            i.reset_ctx(ctx)
+
+    def setattr(self, name, value):
+        for i in self.values():
+            setattr(i, name, value)
+
+    def save(self, filename, strip_prefix=''):
+        arg_dict = {}
+        for param in self.values():
+            weight = param.data()
+            if not param.name.startswith(strip_prefix):
+                raise ValueError(
+                    "Prefix '%s' is to be striped before saving, but "
+                    "Parameter's name '%s' does not start with '%s'" % (
+                        strip_prefix, param.name, strip_prefix))
+            arg_dict[param.name[len(strip_prefix):]] = weight
+        nd.save(filename, arg_dict)
+
+    def load(self, filename, ctx=None, allow_missing=False,
+             ignore_extra=False, restore_prefix=''):
+        if restore_prefix:
+            for name in self.keys():
+                assert name.startswith(restore_prefix), \
+                    "restore_prefix is '%s' but Parameter name '%s' does " \
+                    "not start with it" % (restore_prefix, name)
+        lprefix = len(restore_prefix)
+        loaded = nd.load(filename)
+        arg_dict = {restore_prefix + k: v for k, v in loaded.items()}
+        if not allow_missing:
+            for name in self.keys():
+                assert name in arg_dict, \
+                    "Parameter '%s' is missing in file '%s'" % (
+                        name[lprefix:], filename)
+        for name in arg_dict:
+            if name not in self._params:
+                assert ignore_extra, \
+                    "Parameter '%s' loaded from file '%s' is not present " \
+                    "in ParameterDict" % (name[lprefix:], filename)
+                continue
+            self[name]._load_init(arg_dict[name], ctx)
+
+
+def _param_load_init(self, data, ctx):
+    if self.shape:
+        for self_dim, data_dim in zip(self.shape, data.shape):
+            assert self_dim in (0, data_dim), \
+                "Failed loading Parameter '%s' from saved params: shape " \
+                "incompatible expected %s vs saved %s" % (
+                    self.name, str(self.shape), str(data.shape))
+        self.shape = tuple(i if i != 0 else j
+                           for i, j in zip(self.shape, data.shape))
+    if self._data is None:
+        if self._deferred_init:
+            ctx_list = self._deferred_init[1]
+        else:
+            ctx_list = [ctx] if isinstance(ctx, Context) else \
+                (ctx or [current_context()])
+        self._init_impl(data.astype(self.dtype), ctx_list)
+    else:
+        self.set_data(data)
+    self._deferred_init = ()
+
+
+Parameter._load_init = _param_load_init

@@ -1,0 +1,6 @@
+"""Gluon RNN namespace (parity: python/mxnet/gluon/rnn/)."""
+from .rnn_layer import RNN, LSTM, GRU
+from .rnn_cell import (RecurrentCell, HybridRecurrentCell, RNNCell,
+                       LSTMCell, GRUCell, SequentialRNNCell,
+                       HybridSequentialRNNCell, DropoutCell, ModifierCell,
+                       ZoneoutCell, ResidualCell, BidirectionalCell)

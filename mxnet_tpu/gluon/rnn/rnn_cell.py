@@ -1,0 +1,692 @@
+"""Gluon RNN cells (parity: python/mxnet/gluon/rnn/rnn_cell.py)."""
+from __future__ import annotations
+
+from ... import ndarray as nd
+from ... import symbol as sym_mod
+from ...base import string_types
+from ..block import Block, HybridBlock
+from ..utils import _indent
+
+__all__ = ["RecurrentCell", "HybridRecurrentCell", "RNNCell", "LSTMCell",
+           "GRUCell", "SequentialRNNCell", "HybridSequentialRNNCell",
+           "DropoutCell", "ModifierCell", "ZoneoutCell", "ResidualCell",
+           "BidirectionalCell"]
+
+
+def _cells_state_info(cells, batch_size):
+    return sum([c.state_info(batch_size) for c in cells], [])
+
+
+def _cells_begin_state(cells, **kwargs):
+    return sum([c.begin_state(**kwargs) for c in cells], [])
+
+
+def _get_begin_state(cell, F, begin_state, inputs, batch_size):
+    if begin_state is None:
+        ctx = getattr(inputs[0] if isinstance(inputs, (list, tuple))
+                      else inputs, "context", None)
+        with cell.name_scope():
+            begin_state = cell.begin_state(func=nd.zeros,
+                                           batch_size=batch_size, ctx=ctx)
+    return begin_state
+
+
+def _format_sequence(length, inputs, layout, merge, in_layout=None):
+    assert inputs is not None, \
+        "unroll(inputs=None) only works for HybridBlock trace"
+    axis = layout.find('T')
+    batch_axis = layout.find('N')
+    batch_size = 0
+    in_axis = in_layout.find('T') if in_layout is not None else axis
+    F = nd
+    if isinstance(inputs, nd.NDArray):
+        batch_size = inputs.shape[batch_axis]
+        if merge is False:
+            assert length is None or length == inputs.shape[in_axis]
+            inputs = list(nd.split(inputs,
+                                   num_outputs=inputs.shape[in_axis],
+                                   axis=in_axis, squeeze_axis=True))
+            if not isinstance(inputs, list):
+                inputs = [inputs]
+    elif isinstance(inputs, sym_mod.Symbol):
+        F = sym_mod
+        if merge is False:
+            inputs = list(sym_mod.SliceChannel(
+                inputs, axis=in_axis, num_outputs=length,
+                squeeze_axis=1))
+    else:
+        assert length is None or len(inputs) == length
+        if isinstance(inputs[0], sym_mod.Symbol):
+            F = sym_mod
+        else:
+            batch_size = inputs[0].shape[batch_axis]
+        if merge is True:
+            inputs = _stack_seq(F, inputs, axis)
+    if isinstance(inputs, (nd.NDArray, sym_mod.Symbol)) and axis != in_axis:
+        inputs = F.swapaxes(inputs, dim1=axis, dim2=in_axis)
+    return inputs, axis, F, batch_size
+
+
+def _stack_seq(F, inputs, axis):
+    expanded = [F.expand_dims(i, axis=axis) for i in inputs]
+    return F.Concat(*expanded, dim=axis)
+
+
+def _mask_sequence_variable_length(F, data, length, valid_length, time_axis,
+                                   merge):
+    assert valid_length is not None
+    if not isinstance(data, (nd.NDArray, sym_mod.Symbol)):
+        data = _stack_seq(F, data, time_axis)
+    outputs = F.SequenceMask(data, sequence_length=valid_length,
+                             use_sequence_length=True, axis=time_axis)
+    if not merge:
+        outputs = list(F.split(outputs, num_outputs=data.shape[time_axis],
+                               axis=time_axis, squeeze_axis=True))
+    return outputs
+
+
+class RecurrentCell(Block):
+    """Abstract RNN cell (reference: rnn_cell.py:77)."""
+
+    def __init__(self, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._modified = False
+        self.reset()
+
+    def reset(self):
+        self._init_counter = -1
+        self._counter = -1
+        for cell in self._children.values():
+            cell.reset()
+
+    def state_info(self, batch_size=0):
+        raise NotImplementedError()
+
+    def begin_state(self, batch_size=0, func=nd.zeros, **kwargs):
+        assert not self._modified, \
+            "After applying modifier cells (e.g. ZoneoutCell) the base " \
+            "cell cannot be called directly. Call the modifier cell instead."
+        states = []
+        kwargs.pop('name', None)
+        for info in self.state_info(batch_size):
+            self._init_counter += 1
+            if info is None:
+                info = {}
+            shape = info.get('shape', ())
+            ctx = kwargs.get('ctx', None)
+            dtype = kwargs.get('dtype', 'float32')
+            state = func(shape, ctx=ctx, dtype=dtype)
+            states.append(state)
+        return states
+
+    def unroll(self, length, inputs, begin_state=None, layout='NTC',
+               merge_outputs=None, valid_length=None):
+        """Unroll over time (reference: rnn_cell.py:167)."""
+        self.reset()
+        inputs, axis, F, batch_size = _format_sequence(length, inputs,
+                                                       layout, False)
+        begin_state = _get_begin_state(self, F, begin_state, inputs,
+                                       batch_size)
+        states = begin_state
+        outputs = []
+        all_states = []
+        for i in range(length):
+            output, states = self(inputs[i], states)
+            outputs.append(output)
+            if valid_length is not None:
+                all_states.append(states)
+        if valid_length is not None:
+            states = [F.SequenceLast(_stack_seq(F, ele_list, 0),
+                                     sequence_length=valid_length,
+                                     use_sequence_length=True, axis=0)
+                      for ele_list in zip(*all_states)]
+            outputs = _mask_sequence_variable_length(
+                F, outputs, length, valid_length, axis, True)
+        if merge_outputs is None:
+            merge_outputs = isinstance(outputs, (nd.NDArray,
+                                                 sym_mod.Symbol))
+        if merge_outputs and not isinstance(outputs,
+                                            (nd.NDArray, sym_mod.Symbol)):
+            outputs = _stack_seq(F, outputs, axis)
+        elif not merge_outputs and isinstance(outputs,
+                                              (nd.NDArray,
+                                               sym_mod.Symbol)):
+            outputs = list(F.split(outputs,
+                                   num_outputs=length,
+                                   axis=axis, squeeze_axis=True))
+        return outputs, states
+
+    def _get_activation(self, F, inputs, activation, **kwargs):
+        func = {'tanh': F.tanh, 'relu': F.relu, 'sigmoid': F.sigmoid,
+                'softsign': F.softsign}.get(activation) \
+            if isinstance(activation, string_types) else None
+        if func:
+            return func(inputs, **kwargs)
+        if isinstance(activation, string_types):
+            return F.Activation(inputs, act_type=activation, **kwargs)
+        return activation(inputs, **kwargs)
+
+    def forward(self, inputs, states):
+        self._counter += 1
+        return super().forward(inputs, states)
+
+
+class HybridRecurrentCell(RecurrentCell, HybridBlock):
+    """Hybridizable recurrent cell (reference: rnn_cell.py:270)."""
+
+    def __init__(self, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+
+    def forward(self, inputs, states):
+        self._counter += 1
+        return HybridBlock.forward(self, inputs, states)
+
+    def hybrid_forward(self, F, x, *args, **kwargs):
+        raise NotImplementedError()
+
+
+class RNNCell(HybridRecurrentCell):
+    """Elman RNN cell (reference: rnn_cell.py:289)."""
+
+    def __init__(self, hidden_size, activation='tanh',
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 i2h_bias_initializer='zeros', h2h_bias_initializer='zeros',
+                 input_size=0, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._hidden_size = hidden_size
+        self._activation = activation
+        self._input_size = input_size
+        self.i2h_weight = self.params.get(
+            'i2h_weight', shape=(hidden_size, input_size),
+            init=i2h_weight_initializer, allow_deferred_init=True)
+        self.h2h_weight = self.params.get(
+            'h2h_weight', shape=(hidden_size, hidden_size),
+            init=h2h_weight_initializer, allow_deferred_init=True)
+        self.i2h_bias = self.params.get(
+            'i2h_bias', shape=(hidden_size,),
+            init=i2h_bias_initializer, allow_deferred_init=True)
+        self.h2h_bias = self.params.get(
+            'h2h_bias', shape=(hidden_size,),
+            init=h2h_bias_initializer, allow_deferred_init=True)
+
+    def state_info(self, batch_size=0):
+        return [{'shape': (batch_size, self._hidden_size),
+                 '__layout__': 'NC'}]
+
+    def _alias(self):
+        return 'rnn'
+
+    def __repr__(self):
+        s = '{name}({mapping}'
+        if hasattr(self, '_activation'):
+            s += ', {_activation}'
+        s += ')'
+        shape = self.i2h_weight.shape
+        mapping = '{0} -> {1}'.format(shape[1] if shape[1] else None,
+                                      shape[0])
+        return s.format(name=self.__class__.__name__, mapping=mapping,
+                        **self.__dict__)
+
+    def hybrid_forward(self, F, inputs, states, i2h_weight, h2h_weight,
+                       i2h_bias, h2h_bias):
+        prefix = 't%d_' % self._counter
+        i2h = F.FullyConnected(inputs, i2h_weight, i2h_bias,
+                               num_hidden=self._hidden_size,
+                               name=prefix + 'i2h')
+        h2h = F.FullyConnected(states[0], h2h_weight, h2h_bias,
+                               num_hidden=self._hidden_size,
+                               name=prefix + 'h2h')
+        i2h_plus_h2h = i2h + h2h
+        output = self._get_activation(F, i2h_plus_h2h, self._activation,
+                                      name=prefix + 'out')
+        return output, [output]
+
+
+class LSTMCell(HybridRecurrentCell):
+    """LSTM cell (reference: rnn_cell.py:389)."""
+
+    def __init__(self, hidden_size, i2h_weight_initializer=None,
+                 h2h_weight_initializer=None, i2h_bias_initializer='zeros',
+                 h2h_bias_initializer='zeros', input_size=0, prefix=None,
+                 params=None, activation='tanh',
+                 recurrent_activation='sigmoid'):
+        super().__init__(prefix=prefix, params=params)
+        self._hidden_size = hidden_size
+        self._input_size = input_size
+        self.i2h_weight = self.params.get(
+            'i2h_weight', shape=(4 * hidden_size, input_size),
+            init=i2h_weight_initializer, allow_deferred_init=True)
+        self.h2h_weight = self.params.get(
+            'h2h_weight', shape=(4 * hidden_size, hidden_size),
+            init=h2h_weight_initializer, allow_deferred_init=True)
+        self.i2h_bias = self.params.get(
+            'i2h_bias', shape=(4 * hidden_size,),
+            init=i2h_bias_initializer, allow_deferred_init=True)
+        self.h2h_bias = self.params.get(
+            'h2h_bias', shape=(4 * hidden_size,),
+            init=h2h_bias_initializer, allow_deferred_init=True)
+        self._activation = activation
+        self._recurrent_activation = recurrent_activation
+
+    def state_info(self, batch_size=0):
+        return [{'shape': (batch_size, self._hidden_size),
+                 '__layout__': 'NC'},
+                {'shape': (batch_size, self._hidden_size),
+                 '__layout__': 'NC'}]
+
+    def _alias(self):
+        return 'lstm'
+
+    def __repr__(self):
+        s = '{name}({mapping})'
+        shape = self.i2h_weight.shape
+        mapping = '{0} -> {1}'.format(shape[1] if shape[1] else None,
+                                      shape[0])
+        return s.format(name=self.__class__.__name__, mapping=mapping)
+
+    def hybrid_forward(self, F, inputs, states, i2h_weight, h2h_weight,
+                       i2h_bias, h2h_bias):
+        prefix = 't%d_' % self._counter
+        i2h = F.FullyConnected(inputs, i2h_weight, i2h_bias,
+                               num_hidden=self._hidden_size * 4,
+                               name=prefix + 'i2h')
+        h2h = F.FullyConnected(states[0], h2h_weight, h2h_bias,
+                               num_hidden=self._hidden_size * 4,
+                               name=prefix + 'h2h')
+        gates = i2h + h2h
+        slice_gates = F.SliceChannel(gates, num_outputs=4,
+                                     name=prefix + 'slice')
+        in_gate = self._get_activation(F, slice_gates[0],
+                                       self._recurrent_activation,
+                                       name=prefix + 'i')
+        forget_gate = self._get_activation(F, slice_gates[1],
+                                           self._recurrent_activation,
+                                           name=prefix + 'f')
+        in_transform = self._get_activation(F, slice_gates[2],
+                                            self._activation,
+                                            name=prefix + 'c')
+        out_gate = self._get_activation(F, slice_gates[3],
+                                        self._recurrent_activation,
+                                        name=prefix + 'o')
+        next_c = forget_gate * states[1] + in_gate * in_transform
+        next_h = out_gate * self._get_activation(F, next_c,
+                                                 self._activation,
+                                                 name=prefix + 'state')
+        return next_h, [next_h, next_c]
+
+
+class GRUCell(HybridRecurrentCell):
+    """GRU cell (reference: rnn_cell.py:519)."""
+
+    def __init__(self, hidden_size, i2h_weight_initializer=None,
+                 h2h_weight_initializer=None, i2h_bias_initializer='zeros',
+                 h2h_bias_initializer='zeros', input_size=0, prefix=None,
+                 params=None, activation='tanh',
+                 recurrent_activation='sigmoid'):
+        super().__init__(prefix=prefix, params=params)
+        self._hidden_size = hidden_size
+        self._activation = activation
+        self._recurrent_activation = recurrent_activation
+        self.i2h_weight = self.params.get(
+            'i2h_weight', shape=(3 * hidden_size, input_size),
+            init=i2h_weight_initializer, allow_deferred_init=True)
+        self.h2h_weight = self.params.get(
+            'h2h_weight', shape=(3 * hidden_size, hidden_size),
+            init=h2h_weight_initializer, allow_deferred_init=True)
+        self.i2h_bias = self.params.get(
+            'i2h_bias', shape=(3 * hidden_size,),
+            init=i2h_bias_initializer, allow_deferred_init=True)
+        self.h2h_bias = self.params.get(
+            'h2h_bias', shape=(3 * hidden_size,),
+            init=h2h_bias_initializer, allow_deferred_init=True)
+
+    def state_info(self, batch_size=0):
+        return [{'shape': (batch_size, self._hidden_size),
+                 '__layout__': 'NC'}]
+
+    def _alias(self):
+        return 'gru'
+
+    def __repr__(self):
+        s = '{name}({mapping})'
+        shape = self.i2h_weight.shape
+        mapping = '{0} -> {1}'.format(shape[1] if shape[1] else None,
+                                      shape[0])
+        return s.format(name=self.__class__.__name__, mapping=mapping)
+
+    def hybrid_forward(self, F, inputs, states, i2h_weight, h2h_weight,
+                       i2h_bias, h2h_bias):
+        prefix = 't%d_' % self._counter
+        prev_state_h = states[0]
+        i2h = F.FullyConnected(inputs, i2h_weight, i2h_bias,
+                               num_hidden=self._hidden_size * 3,
+                               name=prefix + 'i2h')
+        h2h = F.FullyConnected(prev_state_h, h2h_weight, h2h_bias,
+                               num_hidden=self._hidden_size * 3,
+                               name=prefix + 'h2h')
+        i2h_r, i2h_z, i2h = F.SliceChannel(i2h, num_outputs=3,
+                                           name=prefix + 'i2h_slice')
+        h2h_r, h2h_z, h2h = F.SliceChannel(h2h, num_outputs=3,
+                                           name=prefix + 'h2h_slice')
+        reset_gate = self._get_activation(F, i2h_r + h2h_r,
+                                          self._recurrent_activation,
+                                          name=prefix + 'r_act')
+        update_gate = self._get_activation(F, i2h_z + h2h_z,
+                                           self._recurrent_activation,
+                                           name=prefix + 'z_act')
+        next_h_tmp = self._get_activation(F, i2h + reset_gate * h2h,
+                                          self._activation,
+                                          name=prefix + 'h_act')
+        ones = F.ones_like(update_gate)
+        next_h = (ones - update_gate) * next_h_tmp + \
+            update_gate * prev_state_h
+        return next_h, [next_h]
+
+
+class SequentialRNNCell(RecurrentCell):
+    """Stack of cells (reference: rnn_cell.py:646)."""
+
+    def __init__(self, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+
+    def __repr__(self):
+        s = '{name}(\n{modstr}\n)'
+        return s.format(name=self.__class__.__name__,
+                        modstr='\n'.join(
+                            ['({i}): {m}'.format(i=i, m=_indent(m.__repr__(),
+                                                                2))
+                             for i, m in enumerate(self._children.values())]))
+
+    def add(self, cell):
+        self.register_child(cell)
+
+    def state_info(self, batch_size=0):
+        return _cells_state_info(self._children.values(), batch_size)
+
+    def begin_state(self, **kwargs):
+        assert not self._modified
+        return _cells_begin_state(self._children.values(), **kwargs)
+
+    def __call__(self, inputs, states):
+        self._counter += 1
+        next_states = []
+        p = 0
+        assert all(not isinstance(cell, BidirectionalCell)
+                   for cell in self._children.values())
+        for cell in self._children.values():
+            assert not isinstance(cell, BidirectionalCell)
+            n = len(cell.state_info())
+            state = states[p:p + n]
+            p += n
+            inputs, state = cell(inputs, state)
+            next_states.append(state)
+        return inputs, sum(next_states, [])
+
+    def unroll(self, length, inputs, begin_state=None, layout='NTC',
+               merge_outputs=None, valid_length=None):
+        self.reset()
+        num_cells = len(self._children)
+        inputs, axis, F, batch_size = _format_sequence(length, inputs,
+                                                       layout, None)
+        begin_state = _get_begin_state(self, F, begin_state, inputs,
+                                       batch_size)
+        p = 0
+        next_states = []
+        for i, cell in enumerate(self._children.values()):
+            n = len(cell.state_info())
+            states = begin_state[p:p + n]
+            p += n
+            inputs, states = cell.unroll(
+                length, inputs=inputs, begin_state=states, layout=layout,
+                merge_outputs=None if i < num_cells - 1 else merge_outputs,
+                valid_length=valid_length)
+            next_states.extend(states)
+        return inputs, next_states
+
+    def __getitem__(self, i):
+        return list(self._children.values())[i]
+
+    def __len__(self):
+        return len(self._children)
+
+    def forward(self, *args, **kwargs):
+        raise NotImplementedError
+
+
+class HybridSequentialRNNCell(HybridRecurrentCell):
+    """Hybrid stack of cells (reference: rnn_cell.py:746)."""
+
+    def __init__(self, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+
+    __repr__ = SequentialRNNCell.__repr__
+    add = SequentialRNNCell.add
+    state_info = SequentialRNNCell.state_info
+    begin_state = SequentialRNNCell.begin_state
+    __getitem__ = SequentialRNNCell.__getitem__
+    __len__ = SequentialRNNCell.__len__
+    unroll = SequentialRNNCell.unroll
+
+    def __call__(self, inputs, states):
+        self._counter += 1
+        next_states = []
+        p = 0
+        for cell in self._children.values():
+            assert not isinstance(cell, BidirectionalCell)
+            n = len(cell.state_info())
+            state = states[p:p + n]
+            p += n
+            inputs, state = cell(inputs, state)
+            next_states.append(state)
+        return inputs, sum(next_states, [])
+
+    def hybrid_forward(self, *args, **kwargs):
+        raise NotImplementedError
+
+
+class DropoutCell(HybridRecurrentCell):
+    """Dropout on time steps (reference: rnn_cell.py:795)."""
+
+    def __init__(self, rate, axes=(), prefix=None, params=None):
+        super().__init__(prefix, params)
+        assert isinstance(rate, float)
+        self._rate = rate
+        self._axes = axes
+
+    def __repr__(self):
+        s = '{name}(rate={_rate}, axes={_axes})'
+        return s.format(name=self.__class__.__name__, **self.__dict__)
+
+    def state_info(self, batch_size=0):
+        return []
+
+    def _alias(self):
+        return 'dropout'
+
+    def hybrid_forward(self, F, inputs, states):
+        if self._rate > 0:
+            inputs = F.Dropout(inputs, p=self._rate, axes=self._axes,
+                               name='t%d_fwd' % self._counter)
+        return inputs, states
+
+    def unroll(self, length, inputs, begin_state=None, layout='NTC',
+               merge_outputs=None, valid_length=None):
+        self.reset()
+        inputs, _, F, _ = _format_sequence(length, inputs, layout,
+                                           merge_outputs)
+        if isinstance(inputs, (nd.NDArray, sym_mod.Symbol)):
+            return self.hybrid_forward(F, inputs, [])
+        return super().unroll(length, inputs, begin_state=begin_state,
+                              layout=layout, merge_outputs=merge_outputs,
+                              valid_length=valid_length)
+
+
+class ModifierCell(HybridRecurrentCell):
+    """Base for cells wrapping another cell (reference: rnn_cell.py:862)."""
+
+    def __init__(self, base_cell):
+        assert not base_cell._modified, \
+            "Cell %s is already modified. One cell cannot be modified " \
+            "twice" % base_cell.name
+        base_cell._modified = True
+        super().__init__(prefix=base_cell.prefix + self._alias(),
+                         params=None)
+        self.base_cell = base_cell
+
+    @property
+    def params(self):
+        return self.base_cell.params
+
+    def state_info(self, batch_size=0):
+        return self.base_cell.state_info(batch_size)
+
+    def begin_state(self, func=nd.zeros, **kwargs):
+        assert not self._modified
+        self.base_cell._modified = False
+        begin = self.base_cell.begin_state(func=func, **kwargs)
+        self.base_cell._modified = True
+        return begin
+
+    def hybrid_forward(self, F, inputs, states):
+        raise NotImplementedError
+
+    def __repr__(self):
+        s = '{name}({base_cell})'
+        return s.format(name=self.__class__.__name__, **self.__dict__)
+
+
+class ZoneoutCell(ModifierCell):
+    """Zoneout regularization (reference: rnn_cell.py:922)."""
+
+    def __init__(self, base_cell, zoneout_outputs=0., zoneout_states=0.):
+        assert not isinstance(base_cell, BidirectionalCell), \
+            "BidirectionalCell doesn't support zoneout. Apply ZoneoutCell " \
+            "to the cells underneath instead."
+        self._zoneout_outputs = zoneout_outputs
+        self._zoneout_states = zoneout_states
+        super().__init__(base_cell)
+        self._prev_output = None
+
+    def __repr__(self):
+        s = '{name}(p_out={_zoneout_outputs}, p_state={_zoneout_states}, ' \
+            '{base_cell})'
+        return s.format(name=self.__class__.__name__, **self.__dict__)
+
+    def _alias(self):
+        return 'zoneout'
+
+    def reset(self):
+        super().reset()
+        self._prev_output = None
+
+    def hybrid_forward(self, F, inputs, states):
+        cell, p_outputs, p_states = self.base_cell, \
+            self._zoneout_outputs, self._zoneout_states
+        next_output, next_states = cell(inputs, states)
+        mask = (lambda p, like: F.Dropout(F.ones_like(like), p=p))
+        prev_output = self._prev_output
+        if prev_output is None:
+            prev_output = F.zeros_like(next_output)
+        output = (F.where(mask(p_outputs, next_output), next_output,
+                          prev_output)
+                  if p_outputs != 0. else next_output)
+        new_states = ([F.where(mask(p_states, new_s), new_s, old_s)
+                       for new_s, old_s in zip(next_states, states)]
+                      if p_states != 0. else next_states)
+        self._prev_output = output
+        return output, new_states
+
+
+class ResidualCell(ModifierCell):
+    """Residual connection around a cell (reference: rnn_cell.py:984)."""
+
+    def __init__(self, base_cell):
+        super().__init__(base_cell)
+
+    def hybrid_forward(self, F, inputs, states):
+        output, states = self.base_cell(inputs, states)
+        output = output + inputs
+        return output, states
+
+    def unroll(self, length, inputs, begin_state=None, layout='NTC',
+               merge_outputs=None, valid_length=None):
+        self.reset()
+        self.base_cell._modified = False
+        outputs, states = self.base_cell.unroll(
+            length, inputs=inputs, begin_state=begin_state, layout=layout,
+            merge_outputs=merge_outputs, valid_length=valid_length)
+        self.base_cell._modified = True
+        merge_outputs = isinstance(outputs, (nd.NDArray, sym_mod.Symbol)) \
+            if merge_outputs is None else merge_outputs
+        inputs, axis, F, _ = _format_sequence(length, inputs, layout,
+                                              merge_outputs)
+        if valid_length is not None:
+            inputs = _mask_sequence_variable_length(F, inputs, length,
+                                                    valid_length, axis,
+                                                    merge_outputs)
+        if merge_outputs:
+            outputs = outputs + inputs
+        else:
+            outputs = [i + j for i, j in zip(outputs, inputs)]
+        return outputs, states
+
+
+class BidirectionalCell(HybridRecurrentCell):
+    """Bidirectional wrapper (reference: rnn_cell.py:1034)."""
+
+    def __init__(self, l_cell, r_cell, output_prefix='bi_'):
+        super().__init__(prefix='', params=None)
+        self.register_child(l_cell, 'l_cell')
+        self.register_child(r_cell, 'r_cell')
+        self._output_prefix = output_prefix
+
+    def __call__(self, inputs, states):
+        raise NotImplementedError("Bidirectional cannot be stepped. "
+                                  "Please use unroll")
+
+    def __repr__(self):
+        s = '{name}(forward={l_cell}, backward={r_cell})'
+        return s.format(name=self.__class__.__name__,
+                        l_cell=self._children['l_cell'],
+                        r_cell=self._children['r_cell'])
+
+    def state_info(self, batch_size=0):
+        return _cells_state_info(self._children.values(), batch_size)
+
+    def begin_state(self, **kwargs):
+        assert not self._modified
+        return _cells_begin_state(self._children.values(), **kwargs)
+
+    def unroll(self, length, inputs, begin_state=None, layout='NTC',
+               merge_outputs=None, valid_length=None):
+        self.reset()
+        inputs, axis, F, batch_size = _format_sequence(length, inputs,
+                                                       layout, False)
+        reversed_inputs = list(reversed(inputs))
+        begin_state = _get_begin_state(self, F, begin_state, inputs,
+                                       batch_size)
+        states = begin_state
+        l_cell, r_cell = self._children.values()
+        l_outputs, l_states = l_cell.unroll(
+            length, inputs=inputs,
+            begin_state=states[:len(l_cell.state_info(batch_size))],
+            layout=layout, merge_outputs=False, valid_length=valid_length)
+        r_outputs, r_states = r_cell.unroll(
+            length, inputs=reversed_inputs,
+            begin_state=states[len(l_cell.state_info(batch_size)):],
+            layout=layout, merge_outputs=False, valid_length=valid_length)
+        if valid_length is None:
+            reversed_r_outputs = list(reversed(r_outputs))
+        else:
+            seq = _stack_seq(F, r_outputs, 0)
+            seq = F.SequenceReverse(seq, sequence_length=valid_length,
+                                    use_sequence_length=True, axis=0)
+            reversed_r_outputs = list(F.split(seq, num_outputs=length,
+                                              axis=0, squeeze_axis=True))
+        outputs = [F.Concat(l_o, r_o, dim=1)
+                   for i, (l_o, r_o) in enumerate(
+                       zip(l_outputs, reversed_r_outputs))]
+        if merge_outputs:
+            outputs = _stack_seq(F, outputs, axis)
+        states = l_states + r_states
+        return outputs, states

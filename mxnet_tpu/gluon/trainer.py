@@ -1,0 +1,204 @@
+"""Gluon Trainer (parity: python/mxnet/gluon/trainer.py).
+
+TPU-native: parameters are single (mesh-shardable) arrays, so
+``allreduce_grads`` is only a cross-process collective when running
+multi-host via a dist/tpu kvstore; the single-process multi-device
+reduce the reference does across GPU copies is unnecessary by
+construction (the mesh holds one sharded array).
+"""
+from __future__ import annotations
+
+from ..base import MXNetError
+from .. import optimizer as opt
+from .parameter import Parameter, ParameterDict
+
+__all__ = ["Trainer"]
+
+
+class Trainer:
+    def __init__(self, params, optimizer, optimizer_params=None,
+                 kvstore='device', compression_params=None,
+                 update_on_kvstore=None):
+        if isinstance(params, (dict, ParameterDict)):
+            params = list(params.values())
+        if not isinstance(params, (list, tuple)):
+            raise ValueError(
+                "First argument must be a list or dict of Parameters, "
+                "got %s." % (type(params)))
+        self._params = []
+        self._param2idx = {}
+        for i, param in enumerate(params):
+            if not isinstance(param, Parameter):
+                raise ValueError(
+                    "First argument must be a list or dict of Parameters, "
+                    "got list of %s." % (type(param)))
+            self._param2idx[param.name] = i
+            self._params.append(param)
+            param._set_trainer = getattr(param, "_set_trainer", None)
+        self._compression_params = compression_params
+        optimizer_params = optimizer_params if optimizer_params else {}
+        self._scale = float(optimizer_params.get('rescale_grad', 1.0))
+        self._contexts = self._check_contexts()
+        self._init_optimizer(optimizer, optimizer_params)
+        self._kvstore_params = {
+            'kvstore': kvstore, 'update_on_kvstore': update_on_kvstore}
+        self._kv_initialized = False
+        self._kvstore = None
+        self._update_on_kvstore = None
+        self._params_to_init = []
+        self._reset_kvstore()
+
+    def _check_contexts(self):
+        contexts = None
+        for param in self._params:
+            try:
+                ctx = param.list_ctx()
+            except Exception:
+                ctx = None
+            if contexts is None:
+                contexts = ctx
+        return contexts or []
+
+    def _init_optimizer(self, optimizer, optimizer_params):
+        param_dict = {i: param for i, param in enumerate(self._params)}
+        if isinstance(optimizer, opt.Optimizer):
+            assert not optimizer_params, \
+                "optimizer_params must be None if optimizer is an " \
+                "instance of Optimizer instead of str"
+            self._optimizer = optimizer
+            self._optimizer.param_dict = param_dict
+        else:
+            self._optimizer = opt.create(optimizer,
+                                         param_dict=param_dict,
+                                         **optimizer_params)
+        self._updaters = [opt.get_updater(self._optimizer)]
+
+    def _reset_kvstore(self):
+        self._kv_initialized = False
+        self._kvstore = None
+        self._update_on_kvstore = None
+        self._params_to_init = [p for p in self._params]
+
+    def _init_kvstore(self):
+        """KVStore wiring (reference: trainer.py:169)."""
+        config = self._kvstore_params
+        kvstore = config['kvstore']
+        update_on_kvstore = config['update_on_kvstore']
+        kv = None
+        if kvstore:
+            from .. import kvstore as kvs
+            if isinstance(kvstore, kvs.KVStore):
+                kv = kvstore
+            elif isinstance(kvstore, str):
+                if 'dist' in kvstore or 'tpu' in kvstore:
+                    kv = kvs.create(kvstore)
+                else:
+                    kv = None  # single logical device: no kvstore needed
+        if kv is not None and self._compression_params:
+            kv.set_gradient_compression(self._compression_params)
+        self._kvstore = kv
+        self._update_on_kvstore = bool(update_on_kvstore) \
+            if update_on_kvstore is not None else False
+        if kv is not None:
+            for i, param in enumerate(self._params):
+                if param._data is not None:
+                    kv.init(i, param.data())
+            if self._update_on_kvstore:
+                kv.set_optimizer(self._optimizer)
+        self._kv_initialized = True
+        self._params_to_init = [p for p in self._params_to_init
+                                if p._deferred_init]
+
+    @property
+    def learning_rate(self):
+        return self._optimizer.lr if self._optimizer.lr_scheduler is None \
+            else self._optimizer.lr_scheduler(self._optimizer.num_update)
+
+    @property
+    def optimizer(self):
+        return self._optimizer
+
+    def set_learning_rate(self, lr):
+        self._optimizer.set_learning_rate(lr)
+
+    def allreduce_grads(self):
+        """Reduce gradients across workers (reference: trainer.py:331)."""
+        if not self._kv_initialized:
+            self._init_kvstore()
+        if self._kvstore is None:
+            return
+        for i, param in enumerate(self._params):
+            if param.grad_req != 'null':
+                self._kvstore.push(i, param.grad())
+                if not self._update_on_kvstore:
+                    self._kvstore.pull(i, param.grad())
+
+    def step(self, batch_size, ignore_stale_grad=False):
+        """One optimization step (reference: trainer.py:302)."""
+        rescale_grad = self._scale / batch_size
+        self._check_and_rescale_grad(rescale_grad)
+        if not self._kv_initialized:
+            self._init_kvstore()
+        if self._kvstore is not None:
+            self.allreduce_grads()
+        self._update(ignore_stale_grad)
+
+    def _check_and_rescale_grad(self, scale):
+        if self._optimizer.rescale_grad != scale:
+            self._optimizer.rescale_grad = scale
+
+    def update(self, batch_size, ignore_stale_grad=False):
+        if not self._kv_initialized:
+            self._init_kvstore()
+        assert not (self._kvstore and self._update_on_kvstore), \
+            'update() when parameters are updated on kvstore is not ' \
+            'supported. Try setting `update_on_kvstore` to False when ' \
+            'creating trainer.'
+        self._check_and_rescale_grad(self._scale / batch_size)
+        self._update(ignore_stale_grad)
+
+    def _update(self, ignore_stale_grad=False):
+        updater = self._updaters[0]
+        for i, param in enumerate(self._params):
+            if param.grad_req == 'null':
+                continue
+            if param._data is None:
+                continue
+            if not ignore_stale_grad and not param._data._fresh_grad:
+                # grads are marked fresh by autograd.backward
+                pass
+            if self._kvstore is not None and self._update_on_kvstore:
+                continue  # kvstore hosted the update in allreduce_grads
+            updater(i, param.grad(), param.data())
+            param._data._fresh_grad = False
+        if self._kvstore is not None and self._update_on_kvstore:
+            for i, param in enumerate(self._params):
+                if param.grad_req != 'null':
+                    self._kvstore.pull(i, param.data())
+
+    def save_states(self, fname):
+        assert self._optimizer is not None
+        if not self._kv_initialized:
+            self._init_kvstore()
+        if self._update_on_kvstore and self._kvstore is not None:
+            self._kvstore.save_optimizer_states(fname, dump_optimizer=True)
+        else:
+            with open(fname, 'wb') as fout:
+                fout.write(self._updaters[0].get_states(
+                    dump_optimizer=True))
+
+    def load_states(self, fname):
+        if not self._kv_initialized:
+            self._init_kvstore()
+        if self._update_on_kvstore and self._kvstore is not None:
+            self._kvstore.load_optimizer_states(fname)
+            self._optimizer = self._kvstore._updater.optimizer
+        else:
+            with open(fname, 'rb') as f:
+                states = f.read()
+            for updater in self._updaters:
+                updater.set_states(states)
+                updater.optimizer = self._updaters[0].optimizer
+            self._optimizer = self._updaters[0].optimizer
+        param_dict = {i: param for i, param in enumerate(self._params)}
+        self._optimizer.param_dict = param_dict

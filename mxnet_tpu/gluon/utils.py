@@ -1,0 +1,125 @@
+"""Gluon utilities (parity: python/mxnet/gluon/utils.py)."""
+from __future__ import annotations
+
+import os
+import hashlib
+import warnings
+
+import numpy as np
+
+from .. import ndarray as nd
+from ..ndarray import NDArray
+
+__all__ = ["split_data", "split_and_load", "clip_global_norm", "check_sha1",
+           "download", "shape_is_known"]
+
+
+def split_data(data, num_slice, batch_axis=0, even_split=True):
+    """Split along batch axis (reference: utils.py:33)."""
+    size = data.shape[batch_axis]
+    if even_split and size % num_slice != 0:
+        raise ValueError(
+            "data with shape %s cannot be evenly split into %d slices "
+            "along axis %d. Use a batch size that's multiple of %d or set "
+            "even_split=False to allow uneven partitioning of data." % (
+                str(data.shape), num_slice, batch_axis, num_slice))
+    if num_slice == 1:
+        return [data]
+    step = size // num_slice
+    if not even_split:
+        slices = [
+            data.slice_axis(batch_axis, i * step,
+                            (i + 1) * step if i < num_slice - 1 else size)
+            for i in range(num_slice)]
+    else:
+        slices = [data.slice_axis(batch_axis, i * step, (i + 1) * step)
+                  for i in range(num_slice)]
+    return slices
+
+
+def split_and_load(data, ctx_list, batch_axis=0, even_split=True):
+    """Split and load to devices (reference: utils.py:88).
+
+    On a TPU mesh the efficient path is a single sharded array; this
+    keeps per-context slices for API parity with reference scripts.
+    """
+    if not isinstance(data, NDArray):
+        data = nd.array(data, ctx=ctx_list[0])
+    if len(ctx_list) == 1:
+        return [data.as_in_context(ctx_list[0])]
+    slices = split_data(data, len(ctx_list), batch_axis, even_split)
+    return [i.as_in_context(ctx) for i, ctx in zip(slices, ctx_list)]
+
+
+def clip_global_norm(arrays, max_norm, check_isfinite=True):
+    """Rescale arrays so total L2 norm <= max_norm
+    (reference: utils.py:117)."""
+    def _norm(array):
+        x = array.reshape((-1,))
+        return nd.dot(x, x)
+    assert len(arrays) > 0
+    ctx = arrays[0].context
+    total_norm = _norm(arrays[0]).as_in_context(ctx)
+    for arr in arrays[1:]:
+        total_norm = total_norm + _norm(arr).as_in_context(ctx)
+    total_norm = float(total_norm.sqrt().asscalar())
+    if check_isfinite and not np.isfinite(total_norm):
+        warnings.warn(UserWarning('nan or inf is detected. Clipping '
+                                  'results will be undefined.'),
+                      stacklevel=2)
+    scale = max_norm / (total_norm + 1e-8)
+    if scale < 1.0:
+        for arr in arrays:
+            arr *= scale
+    return total_norm
+
+
+def _nd_add(a, b):
+    return a + b
+
+
+def check_sha1(filename, sha1_hash):
+    sha1 = hashlib.sha1()
+    with open(filename, 'rb') as f:
+        while True:
+            data = f.read(1048576)
+            if not data:
+                break
+            sha1.update(data)
+    return sha1.hexdigest() == sha1_hash
+
+
+def download(url, path=None, overwrite=False, sha1_hash=None,
+             retries=5, verify_ssl=True):
+    """Download a file (reference: utils.py:187). This environment has no
+    egress; only serves already-cached files."""
+    if path is None:
+        fname = url.split('/')[-1]
+    elif os.path.isdir(path):
+        fname = os.path.join(path, url.split('/')[-1])
+    else:
+        fname = path
+    if os.path.exists(fname) and not overwrite and \
+            (not sha1_hash or check_sha1(fname, sha1_hash)):
+        return fname
+    raise RuntimeError(
+        "download(%s): network egress is unavailable in this environment "
+        "and the file is not cached at %s" % (url, fname))
+
+
+def shape_is_known(shape):
+    if shape is None:
+        return False
+    for dim_size in shape:
+        if dim_size == 0:
+            return False
+    return True
+
+
+def _indent(s_, numSpaces):
+    s = s_.split('\n')
+    if len(s) == 1:
+        return s_
+    first = s.pop(0)
+    s = [first] + [(numSpaces * ' ') + line for line in s]
+    return '\n'.join(s)

@@ -1,0 +1,7 @@
+"""Image utilities (parity: python/mxnet/image/)."""
+from .image import (imread, imdecode, imresize, fixed_crop, center_crop,
+                    random_crop, resize_short, color_normalize,
+                    CreateAugmenter, Augmenter, ResizeAug, ForceResizeAug,
+                    RandomCropAug, CenterCropAug, HorizontalFlipAug,
+                    CastAug, ColorNormalizeAug, BrightnessJitterAug,
+                    ContrastJitterAug, SaturationJitterAug, ImageIter)

@@ -268,6 +268,13 @@ class Mixed(Initializer):
                          % name)
 
 
+# registry aliases matching the reference (@init.register with alias)
+_REG.register("zeros", allow_override=True)(Zero)
+_REG.register("ones", allow_override=True)(One)
+_REG.register("gaussian", allow_override=True)(Normal)
+_REG.register("msra", allow_override=True)(MSRAPrelu)
+
+
 def create(name, **kwargs):
     if isinstance(name, Initializer):
         return name

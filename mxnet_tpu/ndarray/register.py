@@ -23,6 +23,8 @@ def make_stub(op):
         tensors = []
         pos_attrs = []
         for a in args:
+            if a is None:
+                continue
             if isinstance(a, NDArray):
                 tensors.append(a)
             elif isinstance(a, (list, tuple)) and a \

@@ -17,3 +17,4 @@ from . import random_ops    # noqa: F401
 from . import nn            # noqa: F401
 from . import optimizer_ops  # noqa: F401
 from . import linalg        # noqa: F401
+from . import rnn_op        # noqa: F401

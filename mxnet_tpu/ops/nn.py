@@ -670,6 +670,55 @@ register("SequenceReverse", _sequence_reverse,
 
 
 # ---------------------------------------------------------------------------
+# CTC loss (reference: src/operator/nn/ctc_loss.cc, warp-ctc semantics:
+# blank=0, labels 1..C-1, zero-padded labels). optax.ctc_loss on TPU.
+# ---------------------------------------------------------------------------
+
+def _ctc_args(attrs):
+    names = ["data", "label"]
+    if attrs.get("use_data_lengths", False):
+        names.append("data_lengths")
+    if attrs.get("use_label_lengths", False):
+        names.append("label_lengths")
+    return names
+
+
+def _ctc_loss(attrs, data, label, *rest):
+    import optax
+    use_dl = bool(attrs.get("use_data_lengths", False))
+    use_ll = bool(attrs.get("use_label_lengths", False))
+    rest = list(rest)
+    data_lengths = rest.pop(0) if use_dl else None
+    label_lengths = rest.pop(0) if use_ll else None
+
+    T, N, C = data.shape
+    logits = jnp.swapaxes(data, 0, 1)  # (N, T, C)
+    t_iota = jnp.arange(T)[None, :]
+    if data_lengths is not None:
+        logit_paddings = (t_iota >= data_lengths.astype(jnp.int32)[:, None]
+                          ).astype(jnp.float32)
+    else:
+        logit_paddings = jnp.zeros((N, T), dtype=jnp.float32)
+    labels = label.astype(jnp.int32)
+    s_iota = jnp.arange(labels.shape[1])[None, :]
+    if label_lengths is not None:
+        label_paddings = (s_iota >= label_lengths.astype(jnp.int32)[:, None]
+                          ).astype(jnp.float32)
+    else:
+        # zero labels are padding (warp-ctc convention, blank=0)
+        label_paddings = (labels == 0).astype(jnp.float32)
+    return optax.ctc_loss(logits, logit_paddings, labels, label_paddings,
+                          blank_id=0)
+
+
+register("_contrib_ctc_loss", _ctc_loss,
+         arg_names=("data", "label", "data_lengths", "label_lengths"),
+         defaults={"use_data_lengths": False, "use_label_lengths": False,
+                   "blank_label": "first"},
+         arg_names_fn=_ctc_args, aliases=("ctc_loss", "CTCLoss"))
+
+
+# ---------------------------------------------------------------------------
 # contrib transformer helper (reference: src/operator/contrib/transformer.cc)
 # ---------------------------------------------------------------------------
 

@@ -1,0 +1,170 @@
+"""Fused RNN operator (reference: src/operator/rnn.cc + rnn-inl.h:380,
+cudnn_rnn-inl.h).
+
+TPU-native design: one ``lax.scan`` per (layer, direction) — the scan
+body is a fused gate matmul that XLA tiles onto the MXU; this is the
+role the cuDNN fused RNN kernels play in the reference. Parameter
+layout, gate order (cuDNN: LSTM i,f,g,o; GRU r,z,n) and the flat
+parameter vector format match the reference so Gluon layer weights
+interoperate.
+
+Inputs: data (T,N,I), parameters (flat), state (L*D,N,H)[, state_cell].
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register
+
+_NGATES = {"rnn_relu": 1, "rnn_tanh": 1, "lstm": 4, "gru": 3}
+
+
+def _rnn_args(attrs):
+    names = ["data", "parameters", "state"]
+    if attrs.get("mode", "lstm") == "lstm":
+        names.append("state_cell")
+    return names
+
+
+def _rnn_outputs(attrs):
+    if not attrs.get("state_outputs", False):
+        return 1
+    return 3 if attrs.get("mode", "lstm") == "lstm" else 2
+
+
+def _unpack_params(params, mode, L, D, I, H):
+    """Slice the flat parameter vector into per-layer weights
+    (matches python/mxnet/gluon/rnn/rnn_layer.py weight layout)."""
+    G = _NGATES[mode]
+    ws, bs = [], []
+    off = 0
+    for l in range(L):
+        in_sz = I if l == 0 else H * D
+        layer_ws = []
+        for d in range(D):
+            w_i2h = lax.dynamic_slice(params, (off,), (G * H * in_sz,)) \
+                .reshape(G * H, in_sz)
+            off += G * H * in_sz
+            w_h2h = lax.dynamic_slice(params, (off,), (G * H * H,)) \
+                .reshape(G * H, H)
+            off += G * H * H
+            layer_ws.append((w_i2h, w_h2h))
+        ws.append(layer_ws)
+    for l in range(L):
+        layer_bs = []
+        for d in range(D):
+            b_i2h = lax.dynamic_slice(params, (off,), (G * H,))
+            off += G * H
+            b_h2h = lax.dynamic_slice(params, (off,), (G * H,))
+            off += G * H
+            layer_bs.append((b_i2h, b_h2h))
+        bs.append(layer_bs)
+    return ws, bs
+
+
+def _run_direction(mode, x, h0, c0, w_i2h, w_h2h, b_i2h, b_h2h, H,
+                   reverse=False):
+    """One lax.scan over time for one (layer, direction)."""
+    if reverse:
+        x = jnp.flip(x, axis=0)
+    # precompute input projections for ALL timesteps in one big matmul
+    # (MXU-friendly: (T*N, I) x (I, G*H))
+    T, N, _ = x.shape
+    xg = jnp.einsum("tni,gi->tng", x, w_i2h) + b_i2h
+
+    if mode == "lstm":
+        def scan_fn(carry, xg_t):
+            h, c = carry
+            gates = xg_t + jnp.dot(h, w_h2h.T) + b_h2h
+            i, f, g, o = jnp.split(gates, 4, axis=-1)
+            i = jax.nn.sigmoid(i)
+            f = jax.nn.sigmoid(f)
+            g = jnp.tanh(g)
+            o = jax.nn.sigmoid(o)
+            new_c = f * c + i * g
+            new_h = o * jnp.tanh(new_c)
+            return (new_h, new_c), new_h
+        (hT, cT), out = lax.scan(scan_fn, (h0, c0), xg)
+    elif mode == "gru":
+        def scan_fn(h, xg_t):
+            hg = jnp.dot(h, w_h2h.T) + b_h2h
+            xr, xz, xn = jnp.split(xg_t, 3, axis=-1)
+            hr, hz, hn = jnp.split(hg, 3, axis=-1)
+            r = jax.nn.sigmoid(xr + hr)
+            z = jax.nn.sigmoid(xz + hz)
+            n = jnp.tanh(xn + r * hn)
+            new_h = (1 - z) * n + z * h
+            return new_h, new_h
+        hT, out = lax.scan(scan_fn, h0, xg)
+        cT = None
+    else:
+        act = jnp.tanh if mode == "rnn_tanh" \
+            else (lambda v: jnp.maximum(v, 0))
+
+        def scan_fn(h, xg_t):
+            new_h = act(xg_t + jnp.dot(h, w_h2h.T) + b_h2h)
+            return new_h, new_h
+        hT, out = lax.scan(scan_fn, h0, xg)
+        cT = None
+    if reverse:
+        out = jnp.flip(out, axis=0)
+    return out, hT, cT
+
+
+def _rnn_forward(attrs, data, parameters, state, state_cell=None, rng=None):
+    mode = attrs.get("mode", "lstm")
+    H = int(attrs["state_size"])
+    L = int(attrs.get("num_layers", 1))
+    D = 2 if attrs.get("bidirectional", False) else 1
+    p = float(attrs.get("p", 0.0))
+    train = bool(attrs.get("__train__", False))
+    state_outputs = bool(attrs.get("state_outputs", False))
+
+    T, N, I = data.shape
+    ws, bs = _unpack_params(parameters, mode, L, D, I, H)
+
+    x = data
+    h_states = []
+    c_states = []
+    if rng is not None and p > 0:
+        drop_keys = jax.random.split(rng, max(L - 1, 1))
+    for l in range(L):
+        outs = []
+        for d in range(D):
+            idx = l * D + d
+            h0 = state[idx]
+            c0 = state_cell[idx] if state_cell is not None else None
+            (w_i2h, w_h2h) = ws[l][d]
+            (b_i2h, b_h2h) = bs[l][d]
+            out, hT, cT = _run_direction(mode, x, h0, c0, w_i2h, w_h2h,
+                                         b_i2h, b_h2h, H, reverse=(d == 1))
+            outs.append(out)
+            h_states.append(hT)
+            if cT is not None:
+                c_states.append(cT)
+        x = jnp.concatenate(outs, axis=-1) if D == 2 else outs[0]
+        if train and p > 0 and l < L - 1 and rng is not None:
+            keep = 1.0 - p
+            mask = jax.random.bernoulli(
+                drop_keys[l], keep, x.shape).astype(x.dtype)
+            x = x * mask / keep
+    h_out = jnp.stack(h_states, axis=0)
+    outputs = [x]
+    if state_outputs:
+        outputs.append(h_out)
+        if mode == "lstm":
+            outputs.append(jnp.stack(c_states, axis=0))
+    return tuple(outputs)
+
+
+register("RNN", _rnn_forward,
+         arg_names=("data", "parameters", "state", "state_cell"),
+         defaults={"state_size": 0, "num_layers": 1, "bidirectional": False,
+                   "mode": "lstm", "p": 0.0, "state_outputs": False,
+                   "projection_size": None, "lstm_state_clip_min": None,
+                   "lstm_state_clip_max": None, "lstm_state_clip_nan": False,
+                   "__train__": False},
+         num_outputs=_rnn_outputs, needs_rng=True,
+         arg_names_fn=_rnn_args)

@@ -14,6 +14,8 @@ def make_stub(op):
         attr = kwargs.pop("attr", None)
         symbols = []
         for a in args:
+            if a is None:
+                continue
             if isinstance(a, Symbol):
                 symbols.append(a)
             elif isinstance(a, (list, tuple)) and a \

@@ -247,8 +247,11 @@ class Symbol:
                 if shape is None:
                     sh_attr = n._extra_attrs.get("__shape__")
                     if sh_attr:
-                        shape = tuple(json.loads(sh_attr.replace("(", "[")
-                                                 .replace(")", "]")))
+                        import ast as _ast
+                        shape = tuple(_ast.literal_eval(sh_attr))
+                # dims of 0 mean "unknown" (gluon deferred init)
+                if shape is not None and any(s == 0 for s in shape):
+                    shape = None
                 dt = n._extra_attrs.get("__dtype__") or "float32"
                 var_shape_of[id(n)] = shape
                 shapes[(id(n), 0)] = shape

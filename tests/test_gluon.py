@@ -1,0 +1,353 @@
+"""Gluon tests (mirrors tests/python/unittest/test_gluon.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.test_utils import assert_almost_equal, default_context
+
+
+def test_parameter():
+    p = gluon.Parameter('weight', shape=(10, 10))
+    p.initialize(init='xavier', ctx=mx.cpu())
+    assert p.data().shape == (10, 10)
+    assert p.grad().shape == (10, 10)
+    assert p.name == 'weight'
+
+
+def test_parameter_dict_and_sharing():
+    params1 = gluon.ParameterDict('net1_')
+    params1.get('w', shape=(5, 5))
+    params2 = gluon.ParameterDict('net2_', shared=params1)
+    # not shared: creates its own
+    params2.get('x', shape=(3, 3))
+    assert 'net2_x' in params2
+    shared_dense = nn.Dense(4, in_units=4)
+    net = nn.Dense(4, in_units=4, params=shared_dense.collect_params())
+    shared_dense.initialize()
+    assert net.weight is shared_dense.collect_params()[
+        shared_dense.prefix + 'weight'] or \
+        net.collect_params().keys() == \
+        shared_dense.collect_params().keys()
+
+
+def test_dense_forward():
+    net = nn.Dense(4, in_units=3)
+    net.initialize()
+    x = mx.nd.ones((2, 3))
+    out = net(x)
+    assert out.shape == (2, 4)
+    w = net.weight.data().asnumpy()
+    b = net.bias.data().asnumpy()
+    assert_almost_equal(out.asnumpy(), x.asnumpy().dot(w.T) + b, rtol=1e-5,
+                        atol=1e-6)
+
+
+def test_deferred_init():
+    net = nn.Dense(4)
+    net.initialize()
+    out = net(mx.nd.ones((2, 7)))
+    assert net.weight.shape == (4, 7)
+    assert out.shape == (2, 4)
+
+
+def test_sequential_mlp_train():
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(32, activation='relu'))
+        net.add(nn.Dense(10))
+    net.initialize(mx.init.Xavier())
+    trainer = gluon.Trainer(net.collect_params(), 'sgd',
+                            {'learning_rate': 0.5})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+
+    rng = np.random.RandomState(0)
+    centers = rng.normal(0, 2, (10, 16))
+    y = rng.randint(0, 10, 128)
+    x = (centers[y] + rng.normal(0, 0.3, (128, 16))).astype(np.float32)
+    data = mx.nd.array(x)
+    label = mx.nd.array(y.astype(np.float32))
+
+    losses = []
+    for _ in range(30):
+        with mx.autograd.record():
+            out = net(data)
+            loss = loss_fn(out, label)
+        loss.backward()
+        trainer.step(128)
+        losses.append(float(loss.mean().asscalar()))
+    assert losses[-1] < losses[0] * 0.3, losses[::10]
+
+
+def test_hybridize_consistency():
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(16, activation='relu'))
+        net.add(nn.Dense(8))
+    net.initialize(mx.init.Xavier())
+    x = mx.nd.array(np.random.randn(4, 12))
+    out1 = net(x).asnumpy()
+    net.hybridize()
+    out2 = net(x).asnumpy()
+    assert_almost_equal(out1, out2, rtol=1e-5, atol=1e-6)
+
+
+def test_hybridize_training_with_batchnorm():
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(16))
+        net.add(nn.BatchNorm(axis=1))
+        net.add(nn.Activation('relu'))
+        net.add(nn.Dense(4))
+    net.initialize()
+    net.hybridize()
+    x = mx.nd.array(np.random.randn(8, 10))
+    net(x)  # first forward resolves deferred shapes
+    bn = net[1]
+    rm_before = bn.running_mean.data().asnumpy().copy()
+    with mx.autograd.record():
+        out = net(x)
+        loss = out.sum()
+    loss.backward()
+    # BatchNorm running stats updated through CachedOp aux writeback
+    rm_after = bn.running_mean.data().asnumpy()
+    assert not np.allclose(rm_before, rm_after)
+    # grads flow to first Dense
+    g = net[0].weight.grad().asnumpy()
+    assert np.abs(g).sum() > 0
+
+
+def test_conv_block():
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Conv2D(8, kernel_size=3, padding=1, activation='relu'))
+        net.add(nn.MaxPool2D(2))
+        net.add(nn.Conv2D(16, kernel_size=3, padding=1))
+        net.add(nn.GlobalAvgPool2D())
+        net.add(nn.Flatten())
+        net.add(nn.Dense(10))
+    net.initialize()
+    out = net(mx.nd.ones((2, 3, 8, 8)))
+    assert out.shape == (2, 10)
+    net.hybridize()
+    out2 = net(mx.nd.ones((2, 3, 8, 8)))
+    assert_almost_equal(out.asnumpy(), out2.asnumpy(), rtol=1e-5, atol=1e-5)
+
+
+def test_save_load_parameters(tmp_path):
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(8, in_units=4))
+        net.add(nn.Dense(4, in_units=8))
+    net.initialize(mx.init.Xavier())
+    f = str(tmp_path / "net.params")
+    net.save_parameters(f)
+
+    net2 = nn.HybridSequential()
+    with net2.name_scope():
+        net2.add(nn.Dense(8, in_units=4))
+        net2.add(nn.Dense(4, in_units=8))
+    net2.load_parameters(f)
+    x = mx.nd.ones((2, 4))
+    assert_almost_equal(net(x).asnumpy(), net2(x).asnumpy(), rtol=1e-6)
+
+
+def test_export_and_symbolblock(tmp_path):
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(8, in_units=4, activation='relu'))
+        net.add(nn.Dense(4, in_units=8))
+    net.initialize()
+    net.hybridize()
+    x = mx.nd.ones((2, 4))
+    ref = net(x)
+    prefix = str(tmp_path / "model")
+    sym_file, param_file = net.export(prefix)
+    net2 = gluon.SymbolBlock.imports(sym_file, ['data0'], param_file)
+    out = net2(x)
+    assert_almost_equal(ref.asnumpy(), out.asnumpy(), rtol=1e-5, atol=1e-6)
+
+
+def test_embedding_dropout():
+    net = nn.Embedding(20, 8)
+    net.initialize()
+    idx = mx.nd.array([1, 5, 19])
+    out = net(idx)
+    assert out.shape == (3, 8)
+    drop = nn.Dropout(0.5)
+    y = drop(out)
+    assert_almost_equal(y.asnumpy(), out.asnumpy())  # eval mode: identity
+
+
+def test_losses_basic():
+    pred = mx.nd.array(np.random.randn(4, 5))
+    label = mx.nd.array([0, 1, 2, 3])
+    l = gluon.loss.SoftmaxCrossEntropyLoss()(pred, label)
+    p = pred.asnumpy()
+    lp = p - p.max(-1, keepdims=True)
+    sm = np.exp(lp) / np.exp(lp).sum(-1, keepdims=True)
+    expected = -np.log(sm[np.arange(4), [0, 1, 2, 3]])
+    assert_almost_equal(l.asnumpy(), expected, rtol=1e-4, atol=1e-5)
+
+    l2 = gluon.loss.L2Loss()(pred, mx.nd.zeros((4, 5)))
+    assert_almost_equal(l2.asnumpy(), (p ** 2).mean(axis=1) / 2, rtol=1e-5,
+                        atol=1e-6)
+    l1 = gluon.loss.L1Loss()(pred, mx.nd.zeros((4, 5)))
+    assert_almost_equal(l1.asnumpy(), np.abs(p).mean(axis=1), rtol=1e-5,
+                        atol=1e-6)
+    h = gluon.loss.HuberLoss()(pred, mx.nd.zeros((4, 5)))
+    assert h.shape == (4,)
+
+
+def test_lstm_layer():
+    layer = gluon.rnn.LSTM(hidden_size=16, num_layers=2)
+    layer.initialize()
+    x = mx.nd.array(np.random.randn(5, 3, 8))  # (T, N, C)
+    out = layer(x)
+    assert out.shape == (5, 3, 16)
+    states = layer.begin_state(batch_size=3)
+    out, new_states = layer(x, states)
+    assert out.shape == (5, 3, 16)
+    assert new_states[0].shape == (2, 3, 16)
+    assert new_states[1].shape == (2, 3, 16)
+
+
+def test_gru_and_rnn_layers():
+    for layer, state_n in [(gluon.rnn.GRU(8), 1),
+                           (gluon.rnn.RNN(8, activation='tanh'), 1)]:
+        layer.initialize()
+        x = mx.nd.array(np.random.randn(4, 2, 6))
+        out = layer(x)
+        assert out.shape == (4, 2, 8)
+
+
+def test_bidirectional_lstm():
+    layer = gluon.rnn.LSTM(hidden_size=8, bidirectional=True)
+    layer.initialize()
+    x = mx.nd.array(np.random.randn(4, 2, 6))
+    out = layer(x)
+    assert out.shape == (4, 2, 16)
+
+
+def test_lstm_cell_unroll():
+    cell = gluon.rnn.LSTMCell(8, input_size=6)
+    cell.initialize()
+    x = mx.nd.array(np.random.randn(2, 5, 6))  # NTC
+    outputs, states = cell.unroll(5, x, layout='NTC', merge_outputs=True)
+    assert outputs.shape == (2, 5, 8)
+    assert states[0].shape == (2, 8)
+
+
+def test_sequential_rnn_cells():
+    stack = gluon.rnn.SequentialRNNCell()
+    stack.add(gluon.rnn.LSTMCell(8, input_size=4))
+    stack.add(gluon.rnn.LSTMCell(8, input_size=8))
+    stack.initialize()
+    x = mx.nd.array(np.random.randn(2, 3, 4))
+    outputs, states = stack.unroll(3, x, layout='NTC', merge_outputs=True)
+    assert outputs.shape == (2, 3, 8)
+    assert len(states) == 4
+
+
+def test_rnn_training():
+    """Gradient flows through the fused RNN op."""
+    layer = gluon.rnn.LSTM(hidden_size=8)
+    layer.initialize()
+    x = mx.nd.array(np.random.randn(4, 2, 6))
+    with mx.autograd.record():
+        out = layer(x)
+        loss = out.sum()
+    loss.backward()
+    g = layer.l0_i2h_weight.grad().asnumpy()
+    assert np.abs(g).sum() > 0
+
+
+def test_trainer_allreduce_and_lr():
+    net = nn.Dense(2, in_units=2)
+    net.initialize()
+    tr = gluon.Trainer(net.collect_params(), 'sgd',
+                       {'learning_rate': 0.1})
+    assert tr.learning_rate == 0.1
+    tr.set_learning_rate(0.01)
+    assert tr.learning_rate == 0.01
+    x = mx.nd.ones((2, 2))
+    with mx.autograd.record():
+        loss = net(x).sum()
+    loss.backward()
+    w_before = net.weight.data().asnumpy().copy()
+    tr.step(2)
+    assert not np.allclose(w_before, net.weight.data().asnumpy())
+
+
+def test_dataset_dataloader():
+    x = np.random.randn(20, 3).astype(np.float32)
+    y = np.arange(20, dtype=np.float32)
+    ds = gluon.data.ArrayDataset(x, y)
+    assert len(ds) == 20
+    loader = gluon.data.DataLoader(ds, batch_size=5, shuffle=True)
+    count = 0
+    for data, label in loader:
+        assert data.shape == (5, 3)
+        assert label.shape == (5,)
+        count += 1
+    assert count == 4
+    # threaded workers
+    loader2 = gluon.data.DataLoader(ds, batch_size=4, num_workers=2)
+    assert sum(1 for _ in loader2) == 5
+    # transform
+    ds2 = ds.transform_first(lambda a: a * 2)
+    d0, l0 = ds2[0]
+    assert_almost_equal(np.asarray(d0), x[0] * 2, rtol=1e-6)
+
+
+def test_model_zoo_smoke():
+    """Small-model forward for each family (ResNet-50 exercised in bench)."""
+    ctx = default_context()
+    x = mx.nd.ones((1, 3, 32, 32))
+    net = gluon.model_zoo.vision.get_model('resnet18_v1', classes=10)
+    net.initialize()
+    assert net(x).shape == (1, 10)
+    net = gluon.model_zoo.vision.get_model('resnet18_v2', classes=10)
+    net.initialize()
+    assert net(x).shape == (1, 10)
+    net = gluon.model_zoo.vision.get_model('mobilenet0.25', classes=10)
+    net.initialize()
+    assert net(x).shape == (1, 10)
+    net = gluon.model_zoo.vision.get_model('squeezenet1.1', classes=10)
+    net.initialize()
+    assert net(mx.nd.ones((1, 3, 64, 64))).shape == (1, 10)
+
+
+def test_resnet50_hybrid_forward_backward():
+    """The flagship config: ResNet-50 hybridized fwd+bwd (tiny input)."""
+    net = gluon.model_zoo.vision.resnet50_v1(classes=10)
+    net.initialize()
+    net.hybridize()
+    x = mx.nd.ones((1, 3, 64, 64))
+    with mx.autograd.record():
+        out = net(x)
+        loss = out.sum()
+    loss.backward()
+    assert out.shape == (1, 10)
+
+
+def test_contrib_layers():
+    from mxnet_tpu.gluon.contrib.nn import HybridConcurrent, Identity
+    net = HybridConcurrent(axis=1)
+    net.add(nn.Dense(4), nn.Dense(4))
+    net.initialize()
+    out = net(mx.nd.ones((2, 3)))
+    assert out.shape == (2, 8)
+    ident = Identity()
+    x = mx.nd.ones((2, 2))
+    assert_almost_equal(ident(x).asnumpy(), x.asnumpy())
+
+
+def test_block_summary_and_repr():
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(4, in_units=3))
+    net.initialize()
+    repr(net)
+    net.summary(mx.nd.ones((1, 3)))
