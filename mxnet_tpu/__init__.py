@@ -59,6 +59,7 @@ from . import module
 from .module import Module
 from . import image
 from . import gluon
+from . import parallel
 
 from . import test_utils
 

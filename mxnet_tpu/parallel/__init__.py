@@ -1,0 +1,14 @@
+"""Parallelism & distribution (TPU-native; SURVEY §2.2 / §5.7 / §5.8).
+
+Everything here is mesh-first: pick axes (dp/tp/sp/ep/pp), annotate
+shardings, let XLA insert collectives over ICI/DCN.
+"""
+from .mesh import (create_mesh, auto_mesh, mesh_axes, local_mesh,
+                   PartitionSpec, NamedSharding, replicated, shard_batch)
+from .collectives import (all_reduce, all_gather, reduce_scatter, broadcast,
+                          ppermute, barrier, psum_eager)
+from .ring_attention import ring_attention, ulysses_attention, \
+    local_attention
+from .data_parallel import (make_data_parallel_step, shard_params,
+                            DistributedTrainer)
+from . import distributed

@@ -1,0 +1,121 @@
+"""Collective primitives over the mesh.
+
+The TPU-native replacement for the reference's communication backends
+(SURVEY §5.8): CommCPU/CommDevice tree reduce, NCCL ring collectives and
+ps-lite push/pull all collapse into XLA collectives over ICI/DCN. These
+wrappers exist for the eager KVStore path and for shard_map kernels;
+inside pjit programs, sharding annotations let XLA insert them.
+"""
+from __future__ import annotations
+
+import functools
+
+__all__ = ["all_reduce", "all_gather", "reduce_scatter", "broadcast",
+           "ppermute", "barrier", "psum_eager"]
+
+
+def _shard_map():
+    import jax
+    import functools
+    if hasattr(jax, "shard_map"):
+        sm = jax.shard_map
+    else:
+        from jax.experimental import shard_map as _sm
+        sm = _sm.shard_map
+
+    def wrapped(f, **kwargs):
+        # psum outputs are replicated but the static checker can't always
+        # infer it; disable the check (arg name varies across versions)
+        for flag in ("check_vma", "check_rep"):
+            try:
+                return sm(f, **dict(kwargs, **{flag: False}))
+            except TypeError:
+                continue
+        return sm(f, **kwargs)
+    return wrapped
+
+
+def all_reduce(x, mesh, axis="dp", op="sum"):
+    """Sum the shards of ``x`` along a mesh axis; result is the reduced
+    (replicated) value — CommDevice::Reduce / ncclReduce role."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    def f(v):
+        if op == "sum":
+            return jax.lax.psum(v, axis)
+        if op == "max":
+            return jax.lax.pmax(v, axis)
+        if op == "mean":
+            return jax.lax.pmean(v, axis)
+        raise ValueError(op)
+
+    return _shard_map()(f, mesh=mesh, in_specs=(P(axis),),
+                        out_specs=P())(x)
+
+
+def all_gather(x, mesh, axis="dp", tiled=True):
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    def f(v):
+        return jax.lax.all_gather(v, axis, tiled=tiled)
+
+    return _shard_map()(f, mesh=mesh, in_specs=(P(axis),),
+                        out_specs=P())(x)
+
+
+def reduce_scatter(x, mesh, axis="dp"):
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    def f(v):
+        return jax.lax.psum_scatter(v, axis, tiled=True)
+
+    return _shard_map()(f, mesh=mesh, in_specs=(P(),),
+                        out_specs=P(axis))(x)
+
+
+def ppermute(x, mesh, axis, perm):
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    def f(v):
+        return jax.lax.ppermute(v, axis, perm)
+
+    return _shard_map()(f, mesh=mesh, in_specs=(P(axis),),
+                        out_specs=P(axis))(x)
+
+
+def broadcast(x, mesh, axis="dp", root=0):
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    def f(v):
+        idx = jax.lax.axis_index(axis)
+        v = jnp.where(idx == root, v, jnp.zeros_like(v))
+        return jax.lax.psum(v, axis)
+
+    return _shard_map()(f, mesh=mesh, in_specs=(P(axis),),
+                        out_specs=P(axis))(x)
+
+
+def psum_eager(arrays):
+    """Sum a python list of same-shape arrays in one fused XLA op (the
+    single-process CommDevice Reduce role)."""
+    import jax.numpy as jnp
+    out = arrays[0]
+    for a in arrays[1:]:
+        out = out + a
+    return out
+
+
+def barrier(name="barrier"):
+    import jax
+    try:
+        if jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+            multihost_utils.sync_global_devices(name)
+    except Exception:
+        pass

@@ -1,0 +1,70 @@
+"""Multi-host process-group management.
+
+Replaces the reference's ps-lite scheduler/DMLC_* env contract
+(docs/faq/distributed_training.md:254-267) with jax.distributed: rank and
+world size come from the JAX runtime; barriers are global device syncs.
+Launch contract: either set MXNET_TPU_COORDINATOR/MXNET_TPU_RANK/
+MXNET_TPU_WORLD (this module wires jax.distributed.initialize), or run
+under an environment that auto-initializes (Cloud TPU pods).
+"""
+from __future__ import annotations
+
+import os
+
+__all__ = ["init", "rank", "num_workers", "barrier", "is_initialized",
+           "finalize"]
+
+_initialized = [False]
+
+
+def init(coordinator=None, num_processes=None, process_id=None):
+    """Initialize the distributed runtime (the DMLC_PS_ROOT_URI role)."""
+    import jax
+    if _initialized[0]:
+        return
+    coordinator = coordinator or os.environ.get("MXNET_TPU_COORDINATOR")
+    num_processes = num_processes or os.environ.get("MXNET_TPU_WORLD")
+    process_id = process_id or os.environ.get("MXNET_TPU_RANK")
+    if coordinator:
+        jax.distributed.initialize(
+            coordinator_address=coordinator,
+            num_processes=int(num_processes),
+            process_id=int(process_id))
+    _initialized[0] = True
+
+
+def is_initialized():
+    return _initialized[0]
+
+
+def rank():
+    import jax
+    try:
+        return jax.process_index()
+    except Exception:
+        return 0
+
+
+def num_workers():
+    import jax
+    try:
+        return jax.process_count()
+    except Exception:
+        return 1
+
+
+def barrier(name="mxnet_tpu_barrier"):
+    import jax
+    if num_workers() > 1:
+        from jax.experimental import multihost_utils
+        multihost_utils.sync_global_devices(name)
+
+
+def finalize():
+    import jax
+    if _initialized[0]:
+        try:
+            jax.distributed.shutdown()
+        except Exception:
+            pass
+        _initialized[0] = False

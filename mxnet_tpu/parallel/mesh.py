@@ -1,0 +1,90 @@
+"""Device mesh management.
+
+The TPU-native replacement for the reference's device-group machinery
+(kvstore device lists, `group2ctx` placement, ps-lite rank/size). A
+:func:`create_mesh` builds a ``jax.sharding.Mesh`` whose axes name the
+parallelism dimensions:
+
+- ``dp`` — data parallel (batch sharding; allreduce ≙ psum over dp)
+- ``tp`` — tensor parallel (weight sharding inside layers)
+- ``sp`` — sequence/context parallel (ring attention / Ulysses)
+- ``ep`` — expert parallel (MoE expert sharding)
+- ``pp`` — pipeline stages
+
+Collectives ride ICI within a slice; across slices XLA routes over DCN
+automatically when the mesh spans hosts (jax.distributed).
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence
+
+import numpy as np
+
+__all__ = ["create_mesh", "auto_mesh", "mesh_axes", "local_mesh",
+           "PartitionSpec", "NamedSharding", "replicated", "shard_batch"]
+
+
+def PartitionSpec(*axes):
+    from jax.sharding import PartitionSpec as P
+    return P(*axes)
+
+
+def NamedSharding(mesh, spec):
+    from jax.sharding import NamedSharding as NS
+    return NS(mesh, spec)
+
+
+def create_mesh(axis_sizes: Dict[str, int], devices=None):
+    """Build a Mesh from {'dp': 2, 'tp': 4, ...}; axis order is the dict
+    order. Product must equal the device count used."""
+    import jax
+    from jax.sharding import Mesh
+    devices = devices if devices is not None else jax.devices()
+    names = list(axis_sizes.keys())
+    sizes = [int(axis_sizes[n]) for n in names]
+    total = int(np.prod(sizes))
+    if total != len(devices):
+        raise ValueError(
+            "mesh axes %s product %d != device count %d"
+            % (axis_sizes, total, len(devices)))
+    dev_array = np.asarray(devices).reshape(sizes)
+    return Mesh(dev_array, names)
+
+
+def auto_mesh(n_devices: Optional[int] = None,
+              prefer: Sequence[str] = ("dp", "tp", "sp")):
+    """Factor the device count into a sensible default mesh: largest
+    power-of-2 split across the preferred axes (dp gets the remainder)."""
+    import jax
+    n = n_devices if n_devices is not None else len(jax.devices())
+    sizes = {k: 1 for k in prefer}
+    axes = list(prefer)
+    i = len(axes) - 1
+    rem = n
+    # give trailing axes factors of 2 first, rest to dp
+    while i > 0 and rem % 2 == 0 and rem > 2:
+        sizes[axes[i]] *= 2
+        rem //= 2
+        i -= 1
+    sizes[axes[0]] = rem
+    return create_mesh(sizes, devices=jax.devices()[:n])
+
+
+def local_mesh(axis_name="dp"):
+    import jax
+    return create_mesh({axis_name: len(jax.devices())})
+
+
+def mesh_axes(mesh):
+    return dict(zip(mesh.axis_names, mesh.devices.shape))
+
+
+def replicated(mesh):
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    return NamedSharding(mesh, P())
+
+
+def shard_batch(mesh, batch_axes=("dp",)):
+    """Sharding for a batch tensor: dim 0 split over given mesh axes."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    return NamedSharding(mesh, P(tuple(batch_axes)))

@@ -13,6 +13,11 @@ if "xla_force_host_platform_device_count" not in prev:
     os.environ["XLA_FLAGS"] = \
         (prev + " --xla_force_host_platform_device_count=8").strip()
 
+import jax
+
+# The tunnel's TPU plugin overrides JAX_PLATFORMS; force cpu explicitly.
+jax.config.update("jax_platforms", "cpu")
+
 import numpy as np
 import pytest
 

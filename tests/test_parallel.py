@@ -1,0 +1,160 @@
+"""Parallelism tests on the 8-device virtual CPU mesh (SURVEY §4 pattern
+(d): distributed correctness without a real cluster)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import parallel as par
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def _require_8():
+    import jax
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 virtual devices")
+
+
+def test_mesh_creation():
+    _require_8()
+    mesh = par.create_mesh({"dp": 2, "tp": 2, "sp": 2})
+    assert par.mesh_axes(mesh) == {"dp": 2, "tp": 2, "sp": 2}
+    mesh2 = par.local_mesh("dp")
+    assert par.mesh_axes(mesh2)["dp"] == 8
+    mesh3 = par.auto_mesh(8)
+    assert np.prod(list(par.mesh_axes(mesh3).values())) == 8
+
+
+def test_collectives():
+    _require_8()
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    mesh = par.local_mesh("dp")
+    x = jnp.arange(16, dtype=jnp.float32)
+    xs = jax.device_put(x, NamedSharding(mesh, P("dp")))
+    out = par.all_reduce(xs, mesh, "dp")
+    # psum over shards: each shard of result = sum over devices of shards
+    expected = x.reshape(8, 2).sum(axis=0)
+    assert_almost_equal(np.asarray(out)[:2], np.asarray(expected))
+    g = par.all_gather(xs, mesh, "dp")
+    assert_almost_equal(np.asarray(g), np.arange(16, dtype=np.float32))
+
+
+def test_ring_attention_matches_local():
+    _require_8()
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    mesh = par.create_mesh({"sp": 8})
+    B, T, H, D = 2, 32, 4, 8
+    rng = np.random.RandomState(0)
+    q = jnp.asarray(rng.randn(B, T, H, D), dtype=jnp.float32)
+    k = jnp.asarray(rng.randn(B, T, H, D), dtype=jnp.float32)
+    v = jnp.asarray(rng.randn(B, T, H, D), dtype=jnp.float32)
+    ref = par.local_attention(q, k, v)
+    spec = NamedSharding(mesh, P(None, "sp", None, None))
+    qs, ks, vs = (jax.device_put(t, spec) for t in (q, k, v))
+    out = par.ring_attention(qs, ks, vs, mesh=mesh, axis="sp")
+    assert_almost_equal(np.asarray(out), np.asarray(ref), rtol=1e-4,
+                        atol=1e-4)
+
+
+def test_ring_attention_causal():
+    _require_8()
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    mesh = par.create_mesh({"sp": 4}, devices=None) \
+        if len(__import__("jax").devices()) == 4 else \
+        par.create_mesh({"sp": 8})
+    B, T, H, D = 1, 16, 2, 4
+    rng = np.random.RandomState(1)
+    q = jnp.asarray(rng.randn(B, T, H, D), dtype=jnp.float32)
+    k = jnp.asarray(rng.randn(B, T, H, D), dtype=jnp.float32)
+    v = jnp.asarray(rng.randn(B, T, H, D), dtype=jnp.float32)
+    ref = par.local_attention(q, k, v, causal=True)
+    spec = NamedSharding(mesh, P(None, "sp", None, None))
+    qs, ks, vs = (jax.device_put(t, spec) for t in (q, k, v))
+    out = par.ring_attention(qs, ks, vs, mesh=mesh, axis="sp", causal=True)
+    assert_almost_equal(np.asarray(out), np.asarray(ref), rtol=1e-4,
+                        atol=1e-4)
+
+
+def test_ulysses_matches_local():
+    _require_8()
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    mesh = par.create_mesh({"sp": 8})
+    B, T, H, D = 2, 16, 8, 4
+    rng = np.random.RandomState(2)
+    q = jnp.asarray(rng.randn(B, T, H, D), dtype=jnp.float32)
+    k = jnp.asarray(rng.randn(B, T, H, D), dtype=jnp.float32)
+    v = jnp.asarray(rng.randn(B, T, H, D), dtype=jnp.float32)
+    ref = par.local_attention(q, k, v)
+    spec = NamedSharding(mesh, P(None, "sp", None, None))
+    qs, ks, vs = (jax.device_put(t, spec) for t in (q, k, v))
+    out = par.ulysses_attention(qs, ks, vs, mesh=mesh, axis="sp")
+    assert_almost_equal(np.asarray(out), np.asarray(ref), rtol=1e-4,
+                        atol=1e-4)
+
+
+def test_data_parallel_step():
+    _require_8()
+    import jax
+    import jax.numpy as jnp
+    mesh = par.local_mesh("dp")
+
+    def loss_fn(params, batch):
+        pred = batch["x"] @ params["w"] + params["b"]
+        return jnp.mean((pred - batch["y"]) ** 2)
+
+    step, batch_sharding = par.make_data_parallel_step(
+        loss_fn, mesh, optimizer_update=lambda p, g: p - 0.2 * g)
+    rng = np.random.RandomState(0)
+    w_true = rng.randn(4, 1).astype(np.float32)
+    params = {"w": jnp.zeros((4, 1)), "b": jnp.zeros((1,))}
+    losses = []
+    for i in range(50):
+        x = rng.randn(32, 4).astype(np.float32)
+        y = x @ w_true
+        batch = {"x": jax.device_put(jnp.asarray(x), batch_sharding),
+                 "y": jax.device_put(jnp.asarray(y), batch_sharding)}
+        loss, params = step(params, batch)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0] * 0.1
+
+
+def test_distributed_trainer_gluon():
+    _require_8()
+    from mxnet_tpu.gluon import nn
+    from mxnet_tpu import gluon
+    mesh = par.local_mesh("dp")
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(16, activation='relu'))
+        net.add(nn.Dense(4))
+    net.initialize(mx.init.Xavier())
+    trainer = par.DistributedTrainer(
+        net, gluon.loss.SoftmaxCrossEntropyLoss(), mesh,
+        learning_rate=0.1)
+    rng = np.random.RandomState(0)
+    centers = rng.normal(0, 2, (4, 8))
+    y = rng.randint(0, 4, 64)
+    x = (centers[y] + rng.normal(0, 0.3, (64, 8))).astype(np.float32)
+    data = mx.nd.array(x)
+    label = mx.nd.array(y.astype(np.float32))
+    losses = [float(trainer.fit_batch(data, label).asscalar())
+              for _ in range(25)]
+    assert losses[-1] < losses[0] * 0.5, (losses[0], losses[-1])
+
+
+def test_kvstore_tpu_sync_single_host():
+    kv = mx.kvstore_create("tpu_sync")
+    assert kv.rank == 0
+    kv.init("w", mx.nd.ones((4,)))
+    kv.push("w", [mx.nd.ones((4,)), mx.nd.ones((4,)) * 2])
+    out = mx.nd.zeros((4,))
+    kv.pull("w", out)
+    assert_almost_equal(out.asnumpy(), 3 * np.ones(4))
+    kv.barrier()
