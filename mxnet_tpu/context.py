@@ -64,7 +64,7 @@ class Context:
 
     def __enter__(self):
         if not hasattr(Context._default_ctx, "value"):
-            Context._default_ctx.value = Context('cpu', 0)
+            Context._default_ctx.value = _initial_default_context()
         self._old_ctx = Context._default_ctx.value
         Context._default_ctx.value = self
         return self
@@ -101,8 +101,29 @@ class Context:
     @classproperty
     def default_ctx(cls):
         if not hasattr(Context._default_ctx, "value"):
-            Context._default_ctx.value = Context('cpu', 0)
+            Context._default_ctx.value = _initial_default_context()
         return Context._default_ctx.value
+
+
+def _initial_default_context() -> "Context":
+    """First-use default: the accelerator when one is present, else cpu.
+
+    This framework is TPU-native — a bare ``mx.nd.array(...)`` must land
+    on the TPU, exactly as the reference lands on the build's native
+    device. ``MXNET_DEFAULT_CONTEXT=cpu`` (or ``tpu``/``gpu``) overrides.
+    Unit tests pin ``JAX_PLATFORMS=cpu`` and therefore still get cpu.
+    """
+    import os
+    override = os.environ.get("MXNET_DEFAULT_CONTEXT", "").strip().lower()
+    if override:
+        return Context(override, 0)
+    try:
+        import jax
+        if jax.devices()[0].platform != 'cpu':
+            return Context('tpu', 0)
+    except Exception:  # backend init failure → host arrays still work
+        pass
+    return Context('cpu', 0)
 
 
 def cpu(device_id=0):

@@ -29,11 +29,33 @@ __all__ = ["Executor"]
 
 class Executor:
     def __init__(self, symbol, ctx, args, args_grad=None, grad_req="write",
-                 aux_states=None):
+                 aux_states=None, batch_args=None):
         from .ndarray import NDArray, zeros as nd_zeros
 
         self._symbol = symbol
-        self._ctx = ctx if isinstance(ctx, Context) else Context(ctx)
+        # Multi-context bind = in-program data parallelism: ONE compiled
+        # program over a 'dp' device mesh; batch args are sharded on dim
+        # 0, params/aux replicated, and XLA's SPMD partitioner inserts
+        # the gradient psum the reference routed through KVStore
+        # (executor_group.py:281 decide_slices + kvstore_dist.h:44).
+        self._ctx_arg = ctx
+        if isinstance(ctx, (list, tuple)) and len(ctx) > 1:
+            ctxs = [c if isinstance(c, Context) else Context(c)
+                    for c in ctx]
+            self._ctx = ctxs[0]
+            # The reference tolerates repeated contexts (one executor
+            # per list entry on the same GPU); a mesh needs distinct
+            # devices, and deduping is numerically equivalent since the
+            # program computes the global batch either way.
+            from .parallel.mesh import dp_mesh, distinct_devices
+            devices = distinct_devices(ctxs)
+            self._mesh = dp_mesh(devices) if len(devices) > 1 else None
+        else:
+            if isinstance(ctx, (list, tuple)):
+                ctx = ctx[0]
+            self._ctx = ctx if isinstance(ctx, Context) else Context(ctx)
+            self._mesh = None
+        self._batch_args = set(batch_args or ())
         self.arg_names = symbol.list_arguments()
         self.aux_names = symbol.list_auxiliary_states()
         self.output_names = symbol.list_outputs()
@@ -206,8 +228,17 @@ class Executor:
         if fn is not None:
             return fn
         run = self._make_graph_fn(is_train)
+        rep = None
+        if self._mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            rep = NamedSharding(self._mesh, P())
         if kind == "fwd":
-            fn = jax.jit(run)
+            if rep is not None:
+                # outputs auto-sharded; updated aux replicated so eager
+                # math on them never mixes device sets
+                fn = jax.jit(run, out_shardings=(None, rep))
+            else:
+                fn = jax.jit(run)
         else:
             gpos = self._grad_positions
 
@@ -223,11 +254,44 @@ class Executor:
                 grads, = vjp_fn(tuple(out_grads))
                 return outs, new_aux, grads
 
-            fn = jax.jit(fwdbwd)
+            if rep is not None:
+                # grads replicated = the in-program allreduce
+                fn = jax.jit(fwdbwd, out_shardings=(None, rep, rep))
+            else:
+                fn = jax.jit(fwdbwd)
         self._fns[key] = fn
         return fn
 
     # -- execution -------------------------------------------------------
+    def _dp_shardings(self):
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        return (NamedSharding(self._mesh, P()),
+                NamedSharding(self._mesh, P("dp")))
+
+    def _dp_place(self, args, aux):
+        """Commit persistent buffers to their mesh shardings: batch args
+        split on dim 0 over 'dp', everything else replicated. The NDArray
+        handles are updated in place so subsequent eager math (optimizer
+        updates on weights+grads) stays within one device set."""
+        import jax
+        rep, shard = self._dp_shardings()
+        n_dp = self._mesh.devices.size
+        placed = []
+        for name, arr, val in zip(self.arg_names, self.arg_arrays, args):
+            tgt = shard if (name in self._batch_args and val.ndim >= 1
+                            and val.shape[0] % n_dp == 0) else rep
+            if val.sharding != tgt:
+                val = jax.device_put(val, tgt)
+                arr._set_data(val)
+            placed.append(val)
+        placed_aux = []
+        for arr, val in zip(self.aux_arrays, aux):
+            if val.sharding != rep:
+                val = jax.device_put(val, rep)
+                arr._set_data(val)
+            placed_aux.append(val)
+        return tuple(placed), tuple(placed_aux)
+
     def _gather_inputs(self, kwargs):
         from .ndarray import NDArray
         if kwargs:
@@ -242,11 +306,18 @@ class Executor:
                         jnp.asarray(v, dtype=self.arg_dict[k].dtype))
         args = tuple(a._data for a in self.arg_arrays)
         aux = tuple(a._data for a in self.aux_arrays)
+        if self._mesh is not None:
+            args, aux = self._dp_place(args, aux)
         return args, aux
 
     def _rngs(self):
         from . import random as _random
-        return tuple(_random.new_key() for _ in range(self._rng_count))
+        keys = tuple(_random.new_key() for _ in range(self._rng_count))
+        if self._mesh is not None and keys:
+            import jax
+            rep, _ = self._dp_shardings()
+            keys = tuple(jax.device_put(k, rep) for k in keys)
+        return keys
 
     def _store_outputs(self, outs):
         from .ndarray import NDArray
@@ -307,7 +378,14 @@ class Executor:
             if tgt is None:
                 continue
             if self._grad_req[name] == "add":
-                tgt._set_data(tgt._data + g)
+                td = tgt._data
+                if self._mesh is not None and td.sharding != g.sharding:
+                    # first accumulation: the zeros buffer was created
+                    # pre-mesh on one device; move it to the grad's
+                    # (replicated) sharding before the eager add
+                    import jax
+                    td = jax.device_put(td, g.sharding)
+                tgt._set_data(td + g)
             else:
                 tgt._set_data(g)
         if self._monitor_callback is not None:
@@ -347,8 +425,9 @@ class Executor:
                 else:
                     grads[name] = nd_zeros(arg_shapes[idx], ctx=self._ctx,
                                            dtype=g.dtype)
-        return Executor(self._symbol, self._ctx, new_args, grads,
-                        self._grad_req, self.aux_arrays)
+        return Executor(self._symbol, self._ctx_arg, new_args, grads,
+                        self._grad_req, self.aux_arrays,
+                        batch_args=self._batch_args)
 
     def copy_params_from(self, arg_params, aux_params=None,
                          allow_extra_params=False):

@@ -25,6 +25,19 @@ class DeferredInitializationError(MXNetError):
     """Error for unfinished deferred initialization."""
 
 
+def _replicate_over_ctx(arr, ctx_list):
+    """Re-place ``arr`` as one array replicated over the dp mesh formed
+    by ``ctx_list``'s devices (in place, via handle swap)."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from ..parallel.mesh import dp_mesh, distinct_devices
+    devices = distinct_devices(ctx_list)
+    if len(devices) < 2:
+        return
+    mesh = dp_mesh(devices)
+    arr._set_data(jax.device_put(arr._data, NamedSharding(mesh, P())))
+
+
 tensor_types = None  # set after import (NDArray, Symbol)
 
 
@@ -146,8 +159,16 @@ class Parameter:
             self._init_impl(data, ctx)
 
     def _init_impl(self, data, ctx_list):
-        self._data = data
         self._ctx_list = list(ctx_list)
+        if len(self._ctx_list) > 1:
+            # Multi-context init = the Gluon data-parallel path. The
+            # reference keeps one copy per device (parameter.py:43 via
+            # _init_impl per-ctx copies); here the TPU-native form is a
+            # single array replicated over the contexts' dp mesh —
+            # eager ops between it and a batch-sharded input then run
+            # SPMD with the gradient psum inserted by XLA.
+            _replicate_over_ctx(data, self._ctx_list)
+        self._data = data
         if self._grad_req != 'null':
             self._init_grad()
 
@@ -155,6 +176,8 @@ class Parameter:
         from .. import autograd
         self._grad = nd.zeros(self._data.shape, dtype=self._data.dtype,
                               ctx=self._data.context)
+        if len(self._ctx_list) > 1:
+            _replicate_over_ctx(self._grad, self._ctx_list)
         autograd.mark_variables([self._data], [self._grad],
                                 [self._grad_req])
 

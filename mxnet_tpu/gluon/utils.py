@@ -38,17 +38,51 @@ def split_data(data, num_slice, batch_axis=0, even_split=True):
 
 
 def split_and_load(data, ctx_list, batch_axis=0, even_split=True):
-    """Split and load to devices (reference: utils.py:88).
+    """Load a batch onto devices (reference: utils.py:88).
 
-    On a TPU mesh the efficient path is a single sharded array; this
-    keeps per-context slices for API parity with reference scripts.
+    TPU-native divergence from the reference: with several contexts the
+    batch becomes ONE array sharded over the contexts' dp mesh (batch
+    axis split), returned as a single-element list — the reference's
+    ``[net(x) for x in split_and_load(...)]`` loop then runs the whole
+    global batch through one SPMD computation instead of launching one
+    python-side replica per device. Parameters initialized with the same
+    ctx list are replicated over the same mesh (parameter.py), so the
+    gradient allreduce happens in-program. ``even_split=False`` (uneven
+    slices) falls back to per-context slices, which cannot be combined
+    in one computation — only shape-level API parity.
     """
     if not isinstance(data, NDArray):
         data = nd.array(data, ctx=ctx_list[0])
     if len(ctx_list) == 1:
         return [data.as_in_context(ctx_list[0])]
-    slices = split_data(data, len(ctx_list), batch_axis, even_split)
-    return [i.as_in_context(ctx) for i, ctx in zip(slices, ctx_list)]
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from ..parallel.mesh import dp_mesh, distinct_devices
+    devices = distinct_devices(ctx_list)
+    if len(devices) < 2:
+        return [data.as_in_context(ctx_list[0])]
+    size = data.shape[batch_axis]
+    mesh = dp_mesh(devices)
+    if size % len(devices) == 0:
+        spec = [None] * data.ndim
+        spec[batch_axis] = "dp"
+        sharding = NamedSharding(mesh, P(*spec))
+    elif even_split:
+        raise ValueError(
+            "data with shape %s cannot be evenly split onto %d devices "
+            "along axis %d. Use a batch size that's a multiple of %d or "
+            "set even_split=False." % (str(data.shape), len(devices),
+                                       batch_axis, len(devices)))
+    else:
+        # Indivisible remainder batch (typical end of epoch): place it
+        # replicated on the mesh — every device computes the full small
+        # batch redundantly, but the math stays correct against the
+        # mesh-replicated parameters. (Per-device uneven slices, the
+        # reference behavior, cannot mix with mesh arrays in one
+        # computation.)
+        sharding = NamedSharding(mesh, P())
+    global_arr = jax.device_put(data._data, sharding)
+    return [NDArray(global_arr, ctx=ctx_list[0])]
 
 
 def clip_global_norm(arrays, max_norm, check_isfinite=True):

@@ -85,8 +85,12 @@ class KVStore:
         keys, vals = _ctype_key_value(key, value)
         for k, v in zip(keys, vals):
             if isinstance(v, (list, tuple)):
-                agg = v[0]
-                for other in v[1:]:
+                # CommDevice semantics (comm.h:451): gather the
+                # per-device copies onto the first device's placement,
+                # then tree-sum there (XLA fuses the adds).
+                vs = [v[0]] + [self._like(x, v[0]) for x in v[1:]]
+                agg = vs[0]
+                for other in vs[1:]:
                     agg = agg + other
             else:
                 agg = v
@@ -94,20 +98,81 @@ class KVStore:
             if self._optimizer is not None:
                 self._ensure_updater()
             if self._updater is not None:
+                self._align_placement(agg, self._data[k])
                 self._updater(self._key_index(k), agg, self._data[k])
             else:
                 # KVStoreLocal without updater: merged value replaces the
                 # stored one (kvstore_local.h PushImpl assign semantics)
                 self._data[k] = agg.copy()
 
+    @staticmethod
+    def _like(arr, ref):
+        """arr re-placed onto ref's sharding (no-op when it matches)."""
+        if getattr(arr._data, "sharding", None) == \
+                getattr(ref._data, "sharding", None):
+            return arr
+        import jax
+        return NDArray(jax.device_put(arr._data, ref._data.sharding),
+                       ctx=ref._ctx)
+
+    def _align_placement(self, pushed, stored):
+        """Move the stored value onto the pushed gradient's sharding when
+        they differ — a dp-mesh executor pushes replicated global arrays
+        while kvstore copies were made pre-mesh on one device, and jax
+        refuses eager math across device sets."""
+        p, s = pushed._data, stored._data
+        ps = getattr(p, "sharding", None)
+        ss = getattr(s, "sharding", None)
+        if ps is not None and ss is not None and ps != ss:
+            import jax
+            stored._set_data(jax.device_put(s, ps))
+
     def _global_reduce(self, arr):
+        """Cross-process allreduce for tpu_sync (SURVEY §5.8 north star).
+
+        The reduce runs IN-PROGRAM: each worker's value becomes one
+        shard of a global array over a 'worker' mesh axis and a single
+        jitted psum (XLA collective over ICI/DCN) produces the sum —
+        replacing the reference's ps-lite ZPush/ZPull round trip
+        (kvstore_dist.h:211). Falls back to a host allgather+sum if the
+        global-array path is unavailable on the running platform.
+        """
         if not self._is_dist or self.num_workers == 1:
             return arr
         import jax
         import jax.numpy as jnp
-        # cross-process allreduce over all participating hosts: use
-        # jax.make_array / process_allgather via multihost_utils
+        import numpy as _np
         from jax.experimental import multihost_utils
+        if getattr(self, "_inprogram_reduce", True):
+            try:
+                from jax.sharding import Mesh, PartitionSpec as P
+                from .parallel import collectives
+
+                # one device per process carries that worker's shard
+                per_proc = {}
+                for d in jax.devices():
+                    per_proc.setdefault(d.process_index, d)
+                workers = [per_proc[i] for i in sorted(per_proc)]
+                mesh = Mesh(_np.asarray(workers), ("worker",))
+                local = arr._data[None]  # (1, ...) local shard
+                glob = multihost_utils.host_local_array_to_global_array(
+                    local, mesh, P("worker"))
+                summed = collectives.all_reduce(glob, mesh, axis="worker")
+                # back to a process-local array before any eager math
+                local_sum = multihost_utils.global_array_to_host_local_array(
+                    summed, mesh, P())
+                return NDArray(local_sum[0], ctx=arr._ctx)
+            except Exception as exc:
+                # disable for the rest of the run so every push doesn't
+                # re-raise; the host roundtrip is correct but slow, and
+                # silence would hide that the fast path is dead
+                import warnings
+                warnings.warn(
+                    "kvstore %s: in-program collective reduce failed "
+                    "(%s: %s); falling back to host allgather for all "
+                    "subsequent pushes" % (self._type,
+                                           type(exc).__name__, exc))
+                self._inprogram_reduce = False
         summed = multihost_utils.process_allgather(arr._data)
         return NDArray(jnp.sum(summed, axis=0), ctx=arr._ctx)
 
@@ -118,10 +183,12 @@ class KVStore:
                 raise MXNetError("kvstore: key %s not initialized" % str(k))
             v = self._data[k]
             if isinstance(o, (list, tuple)):
+                # Broadcast: each destination keeps its own placement
+                # (comm.h Broadcast copies back out to every device).
                 for oo in o:
-                    oo._set_data(v._data)
+                    oo._set_data(self._like(v, oo)._data)
             else:
-                o._set_data(v._data)
+                o._set_data(self._like(v, o)._data)
 
     def pushpull(self, key, value, out=None, priority=0):
         self.push(key, value, priority)

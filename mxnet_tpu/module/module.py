@@ -211,6 +211,16 @@ class Module(BaseModule):
         arg_names = self._symbol.list_arguments()
         aux_names = self._aux_names
         ctx = self._context[0]
+        if len(self._context) > 1:
+            from ..parallel.mesh import distinct_devices
+            n_dev = len(distinct_devices(self._context))
+            batch = self._data_shapes[0].shape[0]
+            if n_dev > 1 and batch % n_dev != 0:
+                raise MXNetError(
+                    "batch size %d not divisible by %d devices (the dp "
+                    "mesh shards the batch evenly; the reference's uneven "
+                    "work_load_list split is not supported)"
+                    % (batch, n_dev))
 
         args = {}
         shared = shared_module._exec if shared_module is not None else None
@@ -250,7 +260,10 @@ class Module(BaseModule):
                 grads[name] = nd.zeros(shape, ctx=ctx)
 
         from ..executor import Executor
-        self._exec = Executor(self._symbol, ctx, args, grads, reqs, aux)
+        exec_ctx = self._context if len(self._context) > 1 else ctx
+        batch_args = set(self._data_names) | set(self._label_names)
+        self._exec = Executor(self._symbol, exec_ctx, args, grads, reqs,
+                              aux, batch_args=batch_args)
         self.binded = True
 
         if shared_module is not None and shared_module.params_initialized:
@@ -419,6 +432,14 @@ class Module(BaseModule):
         assert self.binded
         self._data_shapes, self._label_shapes = _parse_data_desc(
             self._data_names, self._label_names, data_shapes, label_shapes)
+        if len(self._context) > 1:
+            from ..parallel.mesh import distinct_devices
+            n_dev = len(distinct_devices(self._context))
+            batch = self._data_shapes[0].shape[0]
+            if n_dev > 1 and batch % n_dev != 0:
+                raise MXNetError(
+                    "reshape: batch size %d not divisible by %d devices"
+                    % (batch, n_dev))
         kwargs = {d.name: d.shape for d in self._data_shapes}
         if self._label_shapes:
             kwargs.update({l.name: l.shape for l in self._label_shapes})

@@ -195,11 +195,47 @@ def _get_jitted(op: OpDef, nattrs: Dict[str, Any], n_inputs: int):
     return fn
 
 
+def _align_device_sets(input_arrays):
+    """MXNet semantics let one op mix arrays the user placed on
+    different devices; jax refuses eager math across device sets. When
+    inputs disagree, re-place the minority onto the widest device set
+    (replicated if it is a mesh) — the analogue of the implicit copies
+    the reference's cross-device-copy op inserted."""
+    if len(input_arrays) < 2:
+        return input_arrays
+    shardings = [getattr(a, "sharding", None) for a in input_arrays]
+    first = next((s for s in shardings if s is not None), None)
+    if first is None or all(s is None or s == first for s in shardings):
+        return input_arrays  # common case: everything already agrees
+    import jax
+    sets = {}
+    for s in shardings:
+        if s is not None:
+            sets.setdefault(tuple(sorted(d.id for d in s.device_set)), s)
+    if len(sets) <= 1:
+        return input_arrays
+    widest = max(sets.values(), key=lambda s: len(s.device_set))
+    try:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        target = NamedSharding(widest.mesh, P()) \
+            if isinstance(widest, NamedSharding) else widest
+    except Exception:
+        target = widest
+    out = []
+    for a in input_arrays:
+        s = getattr(a, "sharding", None)
+        if s is not None and s.device_set != widest.device_set:
+            a = jax.device_put(a, target)
+        out.append(a)
+    return out
+
+
 def invoke(op: OpDef, input_arrays: Sequence[Any], attrs: Dict[str, Any],
            rng=None):
     """Eagerly execute ``op`` on raw jax arrays; returns tuple
     ``(outputs, aux_updates)`` where aux_updates is a list of (input_index,
     new_value) for mutable inputs."""
+    input_arrays = _align_device_sets(list(input_arrays))
     nattrs = normalize_attrs(op, attrs)
     fn = _get_jitted(op, nattrs, len(input_arrays))
     if op.needs_rng:

@@ -13,7 +13,7 @@ import warnings
 import numpy
 
 from ..base import Registry, MXNetError
-from ..ndarray import (NDArray, zeros, ones, array, invoke_nd)
+from ..ndarray import invoke_nd
 
 __all__ = ["Optimizer", "SGD", "Signum", "FTML", "DCASGD", "NAG", "SGLD",
            "Adam", "AdaGrad", "AdaDelta", "RMSProp", "Ftrl", "Adamax",
@@ -168,6 +168,13 @@ class Optimizer:
         self.__dict__.update(state)
 
 
+
+def _fp32_state(weight):
+    """fp32 accumulator zeros on the weight's own placement — these
+    optimizers keep fp32 state regardless of weight dtype (matching the
+    reference, whose ndarray.zeros defaults to float32)."""
+    return weight.zeros_like().astype(numpy.float32)
+
 def _common_kwargs(opt, lr, wd):
     kw = {"lr": lr, "wd": wd, "rescale_grad": opt.rescale_grad}
     if opt.clip_gradient is not None:
@@ -188,7 +195,7 @@ class SGD(Optimizer):
     def create_state(self, index, weight):
         if self.momentum == 0.0:
             return None
-        return zeros(weight.shape, ctx=weight.context, dtype=weight.dtype)
+        return weight.zeros_like()
 
     def create_state_multi_precision(self, index, weight):
         if self.multi_precision and weight.dtype == numpy.float16:
@@ -234,7 +241,7 @@ class Signum(Optimizer):
     def create_state(self, index, weight):
         if self.momentum == 0.0:
             return None
-        return zeros(weight.shape, ctx=weight.context, dtype=weight.dtype)
+        return weight.zeros_like()
 
     def update(self, index, weight, grad, state):
         self._update_count(index)
@@ -258,9 +265,9 @@ class FTML(Optimizer):
         self.epsilon = epsilon
 
     def create_state(self, index, weight):
-        return (zeros(weight.shape, ctx=weight.context, dtype=weight.dtype),
-                zeros(weight.shape, ctx=weight.context, dtype=weight.dtype),
-                zeros(weight.shape, ctx=weight.context, dtype=weight.dtype))
+        return (weight.zeros_like(),
+                weight.zeros_like(),
+                weight.zeros_like())
 
     def update(self, index, weight, grad, state):
         self._update_count(index)
@@ -285,7 +292,7 @@ class DCASGD(Optimizer):
     def create_state(self, index, weight):
         if self.momentum == 0.0:
             return (None, weight.copy())
-        return (zeros(weight.shape, ctx=weight.context, dtype=weight.dtype),
+        return (weight.zeros_like(),
                 weight.copy())
 
     def update(self, index, weight, grad, state):
@@ -316,7 +323,7 @@ class NAG(Optimizer):
     def create_state(self, index, weight):
         if self.momentum == 0.0:
             return None
-        return zeros(weight.shape, ctx=weight.context, dtype=weight.dtype)
+        return weight.zeros_like()
 
     def update(self, index, weight, grad, state):
         self._update_count(index)
@@ -358,8 +365,8 @@ class Adam(Optimizer):
         self.lazy_update = lazy_update
 
     def create_state(self, index, weight):
-        return (zeros(weight.shape, ctx=weight.context, dtype=weight.dtype),
-                zeros(weight.shape, ctx=weight.context, dtype=weight.dtype))
+        return (weight.zeros_like(),
+                weight.zeros_like())
 
     def update(self, index, weight, grad, state):
         self._update_count(index)
@@ -383,7 +390,7 @@ class AdaGrad(Optimizer):
         self.float_stable_eps = eps
 
     def create_state(self, index, weight):
-        return zeros(weight.shape, ctx=weight.context, dtype=weight.dtype)
+        return weight.zeros_like()
 
     def update(self, index, weight, grad, state):
         self._update_count(index)
@@ -402,8 +409,8 @@ class AdaDelta(Optimizer):
         self.epsilon = epsilon
 
     def create_state(self, index, weight):
-        return (zeros(weight.shape, ctx=weight.context),
-                zeros(weight.shape, ctx=weight.context))
+        return (_fp32_state(weight),
+                _fp32_state(weight))
 
     def update(self, index, weight, grad, state):
         from ..ndarray import sqrt as nd_sqrt
@@ -434,10 +441,10 @@ class RMSProp(Optimizer):
 
     def create_state(self, index, weight):
         if self.centered:
-            return (zeros(weight.shape, ctx=weight.context),
-                    zeros(weight.shape, ctx=weight.context),
-                    zeros(weight.shape, ctx=weight.context))
-        return zeros(weight.shape, ctx=weight.context)
+            return (_fp32_state(weight),
+                    _fp32_state(weight),
+                    _fp32_state(weight))
+        return _fp32_state(weight)
 
     def update(self, index, weight, grad, state):
         self._update_count(index)
@@ -465,8 +472,8 @@ class Ftrl(Optimizer):
         self.beta = beta
 
     def create_state(self, index, weight):
-        return (zeros(weight.shape, ctx=weight.context),
-                zeros(weight.shape, ctx=weight.context))
+        return (_fp32_state(weight),
+                _fp32_state(weight))
 
     def update(self, index, weight, grad, state):
         self._update_count(index)
@@ -486,8 +493,8 @@ class Adamax(Optimizer):
         self.beta2 = beta2
 
     def create_state(self, index, weight):
-        return (zeros(weight.shape, ctx=weight.context),
-                zeros(weight.shape, ctx=weight.context))
+        return (_fp32_state(weight),
+                _fp32_state(weight))
 
     def update(self, index, weight, grad, state):
         self._update_count(index)
@@ -517,8 +524,8 @@ class Nadam(Optimizer):
         self.m_schedule = 1.
 
     def create_state(self, index, weight):
-        return (zeros(weight.shape, ctx=weight.context),
-                zeros(weight.shape, ctx=weight.context))
+        return (_fp32_state(weight),
+                _fp32_state(weight))
 
     def update(self, index, weight, grad, state):
         self._update_count(index)
@@ -567,7 +574,7 @@ class Test(Optimizer):
     """Test optimizer: w -= lr*grad (reference keeps one too)."""
 
     def create_state(self, index, weight):
-        return zeros(weight.shape, ctx=weight.context)
+        return _fp32_state(weight)
 
     def update(self, index, weight, grad, state):
         weight[:] = weight - self.lr * (grad * self.rescale_grad)

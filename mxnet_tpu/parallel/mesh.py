@@ -21,7 +21,35 @@ from typing import Dict, Optional, Sequence
 import numpy as np
 
 __all__ = ["create_mesh", "auto_mesh", "mesh_axes", "local_mesh",
-           "PartitionSpec", "NamedSharding", "replicated", "shard_batch"]
+           "PartitionSpec", "NamedSharding", "replicated", "shard_batch",
+           "dp_mesh", "distinct_devices"]
+
+_DP_MESH_CACHE = {}
+
+
+def dp_mesh(devices):
+    """The shared 1-axis 'dp' mesh over an ordered device tuple. Cached
+    so Parameter replication, split_and_load batch sharding, and
+    executors binding the same context list all agree on one Mesh."""
+    key = tuple(devices)
+    mesh = _DP_MESH_CACHE.get(key)
+    if mesh is None:
+        mesh = create_mesh({"dp": len(devices)}, devices=list(devices))
+        _DP_MESH_CACHE[key] = mesh
+    return mesh
+
+
+def distinct_devices(ctx_list):
+    """Contexts resolved to unique jax devices, order kept. Reference
+    scripts pass repeated contexts (e.g. ``[gpu(0), gpu(0)]``) and
+    CPU-only hosts resolve every accelerator id to the same device —
+    both degrade to fewer distinct devices rather than erroring."""
+    devices = []
+    for c in ctx_list:
+        d = c.jax_device()
+        if d not in devices:
+            devices.append(d)
+    return devices
 
 
 def PartitionSpec(*axes):

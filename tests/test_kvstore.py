@@ -1,0 +1,91 @@
+"""KVStore numeric correctness.
+
+Ports the assertion pattern of the reference's
+tests/nightly/dist_sync_kvstore.py:28-60 and
+tests/nightly/test_kvstore.py (single-process multi-device numeric
+allreduce checks) onto the 8-device CPU mesh: values pushed from
+several devices must come back as their exact sum, repeated pushes
+accumulate through the updater, and pulls broadcast back to each
+destination's own placement.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+N_DEV = 8
+
+
+def _devices_available():
+    import jax
+    return len(jax.devices()) >= N_DEV
+
+
+pytestmark = pytest.mark.skipif(
+    not _devices_available(), reason="needs %d devices" % N_DEV)
+
+SHAPE = (4, 5)
+KEYS = [3, 5, 7]
+
+
+def test_push_pull_roundtrip():
+    kv = mx.kv.create("local")
+    kv.init(3, mx.nd.ones(SHAPE))
+    out = mx.nd.zeros(SHAPE)
+    kv.pull(3, out=out)
+    np.testing.assert_array_equal(out.asnumpy(), np.ones(SHAPE))
+
+
+def test_push_aggregation_across_devices():
+    """Sum of per-device pushed values (dist_sync_kvstore.py:38
+    expected = nworker * value)."""
+    kv = mx.kv.create("device")
+    kv.init(3, mx.nd.zeros(SHAPE))
+    vals = [mx.nd.ones(SHAPE, ctx=mx.cpu(i)) * (i + 1)
+            for i in range(N_DEV)]
+    kv.push(3, vals)
+    out = mx.nd.zeros(SHAPE)
+    kv.pull(3, out=out)
+    expected = np.ones(SHAPE) * sum(range(1, N_DEV + 1))
+    np.testing.assert_allclose(out.asnumpy(), expected, rtol=1e-6)
+
+
+def test_push_accumulates_with_updater():
+    kv = mx.kv.create("local")
+    kv.init(99, mx.nd.zeros(SHAPE))
+
+    def updater(key, pushed, stored):
+        stored += pushed
+
+    kv.set_updater(updater)
+    for _ in range(4):
+        kv.push(99, [mx.nd.ones(SHAPE, ctx=mx.cpu(i))
+                     for i in range(N_DEV)])
+    out = mx.nd.zeros(SHAPE)
+    kv.pull(99, out=out)
+    np.testing.assert_allclose(out.asnumpy(), np.ones(SHAPE) * 4 * N_DEV,
+                               rtol=1e-6)
+
+
+def test_pull_broadcast_preserves_placement():
+    kv = mx.kv.create("device")
+    kv.init(5, mx.nd.ones(SHAPE) * 2)
+    outs = [mx.nd.zeros(SHAPE, ctx=mx.cpu(i)) for i in range(N_DEV)]
+    kv.pull(5, out=outs)
+    for i, o in enumerate(outs):
+        np.testing.assert_array_equal(o.asnumpy(), np.ones(SHAPE) * 2)
+        devs = o._data.devices()
+        assert len(devs) == 1
+        assert next(iter(devs)).id == i
+
+
+def test_list_key_push_pull():
+    kv = mx.kv.create("local")
+    kv.init(KEYS, [mx.nd.ones(SHAPE)] * len(KEYS))
+    kv.push(KEYS, [[mx.nd.ones(SHAPE, ctx=mx.cpu(i)) * 2
+                    for i in range(N_DEV)] for _ in KEYS])
+    outs = [mx.nd.zeros(SHAPE) for _ in KEYS]
+    kv.pull(KEYS, out=outs)
+    for o in outs:
+        np.testing.assert_allclose(o.asnumpy(),
+                                   np.ones(SHAPE) * 2 * N_DEV, rtol=1e-6)
