@@ -334,7 +334,9 @@ class Executor:
     def forward(self, is_train=False, **kwargs):
         args, aux = self._gather_inputs(kwargs)
         fn = self._get_fn("fwd", bool(is_train))
-        outs, new_aux = fn(args, aux, self._rngs())
+        rngs = self._rngs()
+        self._last_rngs = rngs  # backward() must replay this draw
+        outs, new_aux = fn(args, aux, rngs)
         self._store_outputs(outs)
         if is_train:
             self._store_aux(new_aux)
@@ -344,13 +346,21 @@ class Executor:
 
     def backward(self, out_grads=None, is_train=True):
         self.forward_backward(out_grads=out_grads, is_train=is_train,
-                              _refresh_outputs=True)
+                              _refresh_outputs=True, _reuse_rngs=True)
 
     def forward_backward(self, out_grads=None, is_train=True,
-                         _refresh_outputs=True, **kwargs):
+                         _refresh_outputs=True, _reuse_rngs=False,
+                         **kwargs):
         """Fused forward+backward in ONE XLA computation (the TPU
         replacement for the reference's overlap of backprop with engine-
-        scheduled gradient reduction)."""
+        scheduled gradient reduction).
+
+        When invoked through ``backward()`` the RNG keys of the
+        caller's last ``forward()`` are replayed so stochastic ops
+        (Dropout, rrelu) are differentiated at the SAME random draw the
+        caller observed — the reference guarantees this by construction
+        since its backward consumes stored forward activations.
+        """
         import jax.numpy as jnp
         from .ndarray import NDArray
         if not self._grad_positions:
@@ -367,7 +377,12 @@ class Executor:
             if isinstance(out_grads, NDArray):
                 out_grads = [out_grads]
             ogs = tuple(g._data for g in out_grads)
-        outs, new_aux, grads = fn(args, aux, self._rngs(), ogs)
+        rngs = getattr(self, "_last_rngs", None) \
+            if _reuse_rngs else None
+        if rngs is None:
+            rngs = self._rngs()
+        self._last_rngs = None  # one replay per forward
+        outs, new_aux, grads = fn(args, aux, rngs, ogs)
         if _refresh_outputs:
             self._store_outputs(outs)
         if is_train:

@@ -158,18 +158,41 @@ class Trainer:
         self._update(ignore_stale_grad)
 
     def _update(self, ignore_stale_grad=False):
+        import warnings
         updater = self._updaters[0]
         for i, param in enumerate(self._params):
             if param.grad_req == 'null':
                 continue
             if param._data is None:
                 continue
-            if not ignore_stale_grad and not param._data._fresh_grad:
-                # grads are marked fresh by autograd.backward
-                pass
+            if not param._data._fresh_grad:
+                # grads are marked fresh by autograd.backward; a param
+                # untouched since its last update has a stale (or zero)
+                # gradient (reference: trainer.py:380-392)
+                if not ignore_stale_grad:
+                    raise UserWarning(
+                        "Gradient of Parameter `%s` on context %s has "
+                        "not been updated by backward since last "
+                        "`step`. This could mean a bug in your model "
+                        "that made it only use a subset of the "
+                        "Parameters (Blocks) for this iteration. If "
+                        "you are intentionally only using a subset, "
+                        "call step with ignore_stale_grad=True to "
+                        "suppress this warning and skip updating of "
+                        "Parameters with stale gradient"
+                        % (param.name, str(param.list_ctx()[0])))
+                continue  # skip stale params entirely
             if self._kvstore is not None and self._update_on_kvstore:
                 continue  # kvstore hosted the update in allreduce_grads
-            updater(i, param.grad(), param.data())
+            grad = param.grad()
+            if param._grad_stype == 'row_sparse':
+                # sparse_grad params (Embedding, SparseEmbedding): the
+                # backward produced a dense grad whose untouched rows
+                # are exactly zero; recast to row_sparse so the
+                # optimizer takes its lazy row path (reference gets the
+                # rsp grad directly from the sparse embedding kernel)
+                grad = grad.tostype('row_sparse')
+            updater(i, grad, param.data())
             param._data._fresh_grad = False
         if self._kvstore is not None and self._update_on_kvstore:
             for i, param in enumerate(self._params):

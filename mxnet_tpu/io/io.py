@@ -456,58 +456,86 @@ class CSVIter(DataIter):
 class LibSVMIter(DataIter):
     """LibSVM-format iterator (reference: src/io/iter_libsvm.cc).
 
-    Parses ``label idx:val ...`` lines into dense batches (sparse NDArray
-    output arrives with the sparse subsystem).
+    Parses ``label idx:val ...`` lines into ONE scipy CSR matrix and
+    yields CSRNDArray batches by slicing it — the sparse structure is
+    never densified (the reference's iterator likewise stays CSR
+    end-to-end). ``round_batch=True`` wraps the final short batch
+    around to the beginning, like the reference's round_batch.
     """
 
     def __init__(self, data_libsvm, data_shape, label_libsvm=None,
-                 label_shape=None, batch_size=1, round_batch=True, **kwargs):
+                 label_shape=None, batch_size=1, round_batch=True,
+                 data_name='data', label_name='softmax_label', **kwargs):
         super().__init__(batch_size)
+        import scipy.sparse as spsp
         feat_dim = int(np.prod(data_shape))
-        rows = []
-        labels = []
-        with open(data_libsvm) as f:
-            for line in f:
-                parts = line.strip().split()
-                if not parts:
-                    continue
-                labels.append(float(parts[0]))
-                row = np.zeros(feat_dim, dtype=np.float32)
-                for tok in parts[1:]:
-                    i, v = tok.split(":")
-                    row[int(i)] = float(v)
-                rows.append(row)
-        data = np.stack(rows).reshape((-1,) + tuple(data_shape))
-        label = np.asarray(labels, dtype=np.float32)
-        if label_libsvm is not None:
-            lrows = []
-            with open(label_libsvm) as f:
+
+        def parse(fname, dim):
+            vals, cols, indptr, heads = [], [], [0], []
+            with open(fname) as f:
                 for line in f:
                     parts = line.strip().split()
-                    lrow = np.zeros(int(np.prod(label_shape)),
-                                    dtype=np.float32)
+                    if not parts:
+                        continue
+                    heads.append(float(parts[0]))
                     for tok in parts[1:]:
                         i, v = tok.split(":")
-                        lrow[int(i)] = float(v)
-                    lrows.append(lrow)
-            label = np.stack(lrows)
-        self._inner = NDArrayIter(
-            data, label, batch_size,
-            last_batch_handle='roll_over' if round_batch else 'discard')
+                        cols.append(int(i))
+                        vals.append(float(v))
+                    indptr.append(len(cols))
+            m = spsp.csr_matrix(
+                (np.asarray(vals, np.float32),
+                 np.asarray(cols, np.int64), np.asarray(indptr, np.int64)),
+                shape=(len(indptr) - 1, dim))
+            return m, np.asarray(heads, np.float32)
+
+        self._csr, label = parse(data_libsvm, feat_dim)
+        if label_libsvm is not None:
+            lmat, _ = parse(label_libsvm, int(np.prod(label_shape)))
+            label = lmat.toarray()
+        self._label = label
+        self._num = self._csr.shape[0]
+        self._round = round_batch
+        self._cursor = 0
+        self._data_shape = tuple(data_shape)
+        self._data_name = data_name
+        self._label_name = label_name
 
     @property
     def provide_data(self):
-        return self._inner.provide_data
+        return [DataDesc(self._data_name,
+                         (self.batch_size,) + self._data_shape)]
 
     @property
     def provide_label(self):
-        return self._inner.provide_label
+        return [DataDesc(self._label_name,
+                         (self.batch_size,) + self._label.shape[1:])]
 
     def reset(self):
-        self._inner.reset()
-
-    def next(self):
-        return self._inner.next()
+        self._cursor = 0
 
     def iter_next(self):
-        return self._inner.iter_next()
+        return self._cursor < self._num
+
+    def next(self):
+        if not self.iter_next():
+            raise StopIteration
+        from ..ndarray import sparse as _sp
+        start = self._cursor
+        stop = start + self.batch_size
+        self._cursor = stop
+        if stop <= self._num:
+            idx = np.arange(start, stop)
+            pad = 0
+        elif self._round:
+            idx = np.arange(start, stop) % self._num
+            pad = 0
+        else:
+            idx = np.arange(start, self._num)
+            pad = stop - self._num
+            idx = np.concatenate([idx, np.zeros(pad, np.int64)])
+        data = _sp.csr_matrix(self._csr[idx])
+        label = nd_array(self._label[idx])
+        return DataBatch(data=[data], label=[label], pad=pad,
+                         provide_data=self.provide_data,
+                         provide_label=self.provide_label)

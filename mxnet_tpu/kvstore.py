@@ -28,6 +28,11 @@ from .ndarray import NDArray
 __all__ = ["KVStore", "create"]
 
 
+def _to_jnp(np_arr):
+    import jax.numpy as jnp
+    return jnp.asarray(np_arr)
+
+
 def _ctype_key_value(key, vals):
     if isinstance(key, (tuple, list)):
         return list(key), list(vals)
@@ -108,6 +113,10 @@ class KVStore:
     @staticmethod
     def _like(arr, ref):
         """arr re-placed onto ref's sharding (no-op when it matches)."""
+        from .ndarray.sparse import BaseSparseNDArray
+        if isinstance(arr, BaseSparseNDArray) \
+                or isinstance(ref, BaseSparseNDArray):
+            return arr  # sparse values carry their own placement
         if getattr(arr._data, "sharding", None) == \
                 getattr(ref._data, "sharding", None):
             return arr
@@ -120,6 +129,10 @@ class KVStore:
         they differ — a dp-mesh executor pushes replicated global arrays
         while kvstore copies were made pre-mesh on one device, and jax
         refuses eager math across device sets."""
+        from .ndarray.sparse import BaseSparseNDArray
+        if isinstance(pushed, BaseSparseNDArray) \
+                or isinstance(stored, BaseSparseNDArray):
+            return
         p, s = pushed._data, stored._data
         ps = getattr(p, "sharding", None)
         ss = getattr(s, "sharding", None)
@@ -139,6 +152,14 @@ class KVStore:
         """
         if not self._is_dist or self.num_workers == 1:
             return arr
+        from .ndarray.sparse import BaseSparseNDArray
+        if isinstance(arr, BaseSparseNDArray):
+            # cross-worker sparse reduce: densify → allreduce → recast
+            # (the reference server merges rsp via row union; the dense
+            # roundtrip is the documented TPU fallback)
+            stype = arr.stype
+            return self._global_reduce(arr.tostype("default")) \
+                .tostype(stype)
         import jax
         import jax.numpy as jnp
         import numpy as _np
@@ -177,11 +198,19 @@ class KVStore:
         return NDArray(jnp.sum(summed, axis=0), ctx=arr._ctx)
 
     def pull(self, key, out=None, priority=0, ignore_sparse=True):
+        from .ndarray.sparse import BaseSparseNDArray
         keys, outs = _ctype_key_value(key, out)
         for k, o in zip(keys, outs):
             if k not in self._data:
                 raise MXNetError("kvstore: key %s not initialized" % str(k))
             v = self._data[k]
+            if isinstance(v, BaseSparseNDArray):
+                if ignore_sparse:
+                    continue  # reference pull skips sparse values
+                tgts = o if isinstance(o, (list, tuple)) else [o]
+                for tgt in tgts:
+                    v.copyto(tgt)
+                continue
             if isinstance(o, (list, tuple)):
                 # Broadcast: each destination keeps its own placement
                 # (comm.h Broadcast copies back out to every device).
@@ -196,21 +225,39 @@ class KVStore:
             self.pull(key, out, priority)
 
     def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
-        """Pull selected rows (reference: kvstore.py row_sparse_pull →
-        kvstore_dist.h EncodeRowSparseKey). Dense-gather implementation."""
+        """Pull only the requested rows of a value (reference:
+        kvstore.py row_sparse_pull → kvstore_dist.h EncodeRowSparseKey).
+
+        The stored value's selected rows are gathered on-device; the
+        returned row set is deduplicated and sorted, as the reference
+        guarantees. Dense ``out`` receives the gathered row block;
+        RowSparseNDArray ``out`` receives (rows, indices).
+        """
+        import numpy as _host_np
+        from .ndarray.sparse import RowSparseNDArray, BaseSparseNDArray
         assert out is not None and row_ids is not None
         keys, outs = _ctype_key_value(key, out)
         if isinstance(row_ids, NDArray):
             row_ids = [row_ids] * len(keys)
         for k, o, rid in zip(keys, outs, row_ids):
             v = self._data[k]
-            rows = v.take(rid)
-            tgt = o if not isinstance(o, (list, tuple)) else o[0]
-            from .ndarray import sparse as _sp
-            if hasattr(tgt, "indices"):
-                tgt._set_rows(rid, rows)
-            else:
-                tgt._set_data(rows._data)
+            if isinstance(v, BaseSparseNDArray):
+                v = v.tostype("default")
+            rid_np = _host_np.unique(
+                rid.asnumpy().astype(_host_np.int64)
+                if isinstance(rid, NDArray)
+                else _host_np.asarray(rid, dtype=_host_np.int64))
+            rid_nd = NDArray(_to_jnp(rid_np), ctx=v._ctx)
+            rows = v.take(rid_nd)
+            tgts = o if isinstance(o, (list, tuple)) else [o]
+            for tgt in tgts:
+                if isinstance(tgt, RowSparseNDArray):
+                    tgt._sp_data = rows.copy()
+                    tgt._sp_indices = NDArray(_to_jnp(rid_np),
+                                              ctx=v._ctx)
+                    tgt._shape = v.shape
+                else:
+                    tgt._set_data(rows._data)
 
     # -- updater/optimizer ----------------------------------------------
     def set_updater(self, updater):

@@ -20,4 +20,28 @@ import types as _types
 op = _types.ModuleType(__name__ + ".op")
 _install_ops(op.__dict__)
 
-# sparse is populated by the sparse module when imported
+from . import sparse
+from .sparse import (BaseSparseNDArray, CSRNDArray, RowSparseNDArray,
+                     csr_matrix, row_sparse_array, cast_storage, retain)
+
+# sparse-aware dot: csr/row_sparse operands dispatch to the gather/
+# segment-sum lowering (the reference's FComputeEx storage dispatch,
+# src/operator/tensor/dot-inl.h)
+_dense_dot = globals().get("dot")
+
+
+def dot(lhs, rhs, transpose_a=False, transpose_b=False, out=None,
+        **kwargs):
+    if isinstance(lhs, BaseSparseNDArray) \
+            or isinstance(rhs, BaseSparseNDArray):
+        res = sparse.dot(lhs, rhs, transpose_a=transpose_a,
+                         transpose_b=transpose_b)
+        if out is not None:
+            out._set_data(res._data)
+            return out
+        return res
+    return _dense_dot(lhs, rhs, transpose_a=transpose_a,
+                      transpose_b=transpose_b, out=out, **kwargs)
+
+
+op.dot = dot

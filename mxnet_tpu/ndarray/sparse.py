@@ -1,0 +1,639 @@
+"""Sparse NDArrays: ``csr`` and ``row_sparse`` storage.
+
+Parity surface: python/mxnet/ndarray/sparse.py (CSRNDArray:287,
+RowSparseNDArray:561, csr_matrix:825, row_sparse_array:1020) and the
+C++ storage kinds in include/mxnet/ndarray.h:61-65 plus
+src/operator/tensor/cast_storage-inl.h.
+
+TPU-native design (SURVEY §7 hard part #4): a sparse array is a pair of
+dense device arrays (values + integer aux arrays) and a logical dense
+shape. Compute lowers to gather/scatter/segment-sum — the operations the
+TPU does well — instead of the reference's CPU/GPU sparse kernels:
+
+- ``dot(csr, dense)``            → take + segment_sum over row ids
+- ``dot(csr, dense, trans_a)``   → take + segment_sum over col ids
+- ``cast_storage``               → scatter (to dense) / host row-scan
+                                   (to sparse; nnz is data-dependent, so
+                                   the conversion syncs — documented)
+- ``retain``                     → gather of kept rows
+- optimizer lazy update          → gather rows, update, scatter (see
+                                   optimizer.py sparse paths)
+
+Aux index arrays use int64 like the reference's default aux dtype.
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from ..base import MXNetError
+from ..context import current_context
+from .ndarray import NDArray, array as _dense_array, zeros as _dense_zeros
+
+__all__ = ["BaseSparseNDArray", "CSRNDArray", "RowSparseNDArray",
+           "csr_matrix", "row_sparse_array", "cast_storage", "retain",
+           "dot", "zeros", "empty", "array", "add", "subtract", "multiply",
+           "divide"]
+
+
+def _jnp():
+    import jax.numpy as jnp
+    return jnp
+
+
+class BaseSparseNDArray(NDArray):
+    """Common behavior of csr/row_sparse arrays.
+
+    ``_data`` (the dense buffer) intentionally raises: any code path
+    that reaches for it must handle sparse explicitly (the reference
+    raises NotSupportedForSparseNDArray the same way).
+    """
+
+    def __init__(self, shape, ctx=None):
+        self._shape = tuple(int(s) for s in shape)
+        self._ctx = ctx if ctx is not None else current_context()
+        self.grad = None
+        self._grad_req = "null"
+        self._tape_node = None
+        self._tape_index = 0
+        self._fresh_grad = False
+
+    # _data is a plain attribute on NDArray; property here shadows it.
+    @property
+    def _data(self):
+        raise MXNetError(
+            "%s has no dense buffer; use .data/.indices (and .indptr) "
+            "or tostype('default')" % type(self).__name__)
+
+    @property
+    def shape(self):
+        return self._shape
+
+    @property
+    def dtype(self):
+        return self.data.dtype
+
+    @property
+    def context(self):
+        return self._ctx
+
+    ctx = context
+
+    def __repr__(self):
+        return "\n<%s %s @%s>" % (type(self).__name__,
+                                  "x".join(str(s) for s in self._shape),
+                                  self._ctx)
+
+    def __len__(self):
+        return self._shape[0]
+
+    # -- unsupported dense API (parity: sparse.py:147-160) --------------
+    def _not_supported(self, what):
+        raise MXNetError("%s is not supported for %s"
+                         % (what, type(self).__name__))
+
+    def reshape(self, *shape, **kwargs):
+        self._not_supported("reshape")
+
+    def _at(self, idx):
+        self._not_supported("_at")
+
+    def _slice(self, start, stop):
+        self._not_supported("_slice")
+
+    # -- host/introspection ---------------------------------------------
+    def asnumpy(self):
+        return self._dense_np()
+
+    def wait_to_read(self):
+        self.data.wait_to_read()
+
+    def copyto(self, other):
+        from ..context import Context
+        if isinstance(other, Context):
+            return self._clone(ctx=other)
+        if isinstance(other, BaseSparseNDArray):
+            if other.stype != self.stype:
+                raise MXNetError("copyto: storage type mismatch (%s vs %s)"
+                                 % (self.stype, other.stype))
+            other._assign_from(self)
+            return other
+        if isinstance(other, NDArray):
+            other._set_data(self.tostype("default")._data)
+            return other
+        raise TypeError("copyto does not support type %s" % type(other))
+
+    def copy(self):
+        return self._clone()
+
+    def astype(self, dtype, copy=True):
+        c = self._clone()
+        c._sp_data = c._sp_data.astype(dtype)
+        return c
+
+    def as_in_context(self, context):
+        if context == self._ctx:
+            return self
+        return self._clone(ctx=context)
+
+    def check_format(self, full_check=True):
+        self._check_format()
+
+    # -- arithmetic: scalar ops keep sparsity, the rest densify ----------
+    def _scalar_sparsity_op(self, other, fn):
+        if isinstance(other, (int, float)):
+            c = self._clone()
+            c._sp_data = fn(c._sp_data, other)
+            return c
+        return None
+
+    def __mul__(self, other):
+        r = self._scalar_sparsity_op(other, lambda d, s: d * s)
+        if r is not None:
+            return r
+        return _densify_binop(self, other, lambda a, b: a * b)
+
+    __rmul__ = __mul__
+
+    def __div__(self, other):
+        return self.__truediv__(other)
+
+    def __truediv__(self, other):
+        r = self._scalar_sparsity_op(other, lambda d, s: d / s)
+        if r is not None:
+            return r
+        return _densify_binop(self, other, lambda a, b: a / b)
+
+    def __add__(self, other):
+        same = self._same_structure_op(other, lambda a, b: a + b)
+        if same is not None:
+            return same
+        return _densify_binop(self, other, lambda a, b: a + b)
+
+    __radd__ = __add__
+
+    def __sub__(self, other):
+        same = self._same_structure_op(other, lambda a, b: a - b)
+        if same is not None:
+            return same
+        return _densify_binop(self, other, lambda a, b: a - b)
+
+    def __neg__(self):
+        c = self._clone()
+        c._sp_data = -c._sp_data
+        return c
+
+    def _same_structure_op(self, other, fn):
+        return None  # overridden by RowSparseNDArray
+
+
+def _densify_binop(lhs, rhs, fn):
+    a = lhs.tostype("default") if isinstance(lhs, BaseSparseNDArray) else lhs
+    b = rhs.tostype("default") if isinstance(rhs, BaseSparseNDArray) else rhs
+    return fn(a, b)
+
+
+class CSRNDArray(BaseSparseNDArray):
+    """Compressed sparse row matrix (reference: sparse.py:287)."""
+
+    def __init__(self, data, indices, indptr, shape, ctx=None):
+        super().__init__(shape, ctx)
+        if len(self._shape) != 2:
+            raise MXNetError("csr requires a 2-D shape, got %s"
+                             % (self._shape,))
+        self._sp_data = data
+        self._sp_indices = indices
+        self._sp_indptr = indptr
+
+    @property
+    def stype(self):
+        return "csr"
+
+    @property
+    def data(self):
+        return self._sp_data
+
+    @property
+    def indices(self):
+        return self._sp_indices
+
+    @property
+    def indptr(self):
+        return self._sp_indptr
+
+    @property
+    def _aux_types(self):
+        return [_np.dtype(_np.int64), _np.dtype(_np.int64)]
+
+    def _clone(self, ctx=None):
+        ctx = ctx or self._ctx
+        return CSRNDArray(self._sp_data.copy(), self._sp_indices.copy(),
+                          self._sp_indptr.copy(), self._shape, ctx=ctx)
+
+    def _assign_from(self, other):
+        self._sp_data = other._sp_data.copy()
+        self._sp_indices = other._sp_indices.copy()
+        self._sp_indptr = other._sp_indptr.copy()
+        self._shape = other._shape
+
+    def _check_format(self):
+        indptr = self._sp_indptr.asnumpy()
+        indices = self._sp_indices.asnumpy()
+        if indptr.shape != (self._shape[0] + 1,):
+            raise MXNetError("csr indptr length %s != rows+1" %
+                             (indptr.shape,))
+        if indptr[0] != 0 or indptr[-1] != indices.shape[0]:
+            raise MXNetError("csr indptr endpoints invalid")
+        if (_np.diff(indptr) < 0).any():
+            raise MXNetError("csr indptr must be non-decreasing")
+        if indices.size and (indices.min() < 0
+                             or indices.max() >= self._shape[1]):
+            raise MXNetError("csr indices out of bounds")
+
+    def _dense_np(self):
+        out = _np.zeros(self._shape, dtype=self._sp_data.dtype)
+        data = self._sp_data.asnumpy()
+        indices = self._sp_indices.asnumpy()
+        indptr = self._sp_indptr.asnumpy()
+        for i in range(self._shape[0]):
+            out[i, indices[indptr[i]:indptr[i + 1]]] = \
+                data[indptr[i]:indptr[i + 1]]
+        return out
+
+    def _row_ids(self):
+        """Per-nnz row id (host-computed from indptr; static per batch)."""
+        indptr = self._sp_indptr.asnumpy()
+        return _np.repeat(_np.arange(self._shape[0]), _np.diff(indptr))
+
+    def tostype(self, stype):
+        if stype == "csr":
+            return self
+        if stype == "default":
+            import jax.numpy as jnp
+            dense = jnp.zeros(self._shape, dtype=self._sp_data.dtype)
+            rows = jnp.asarray(self._row_ids())
+            cols = self._sp_indices._data
+            dense = dense.at[rows, cols].set(self._sp_data._data)
+            return NDArray(dense, ctx=self._ctx)
+        raise MXNetError("cast_storage from csr to %s is not supported"
+                         % stype)
+
+    def asscipy(self):
+        import scipy.sparse as spsp
+        return spsp.csr_matrix(
+            (self._sp_data.asnumpy(), self._sp_indices.asnumpy(),
+             self._sp_indptr.asnumpy()), shape=self._shape)
+
+    def __getitem__(self, key):
+        if isinstance(key, int):
+            n = self._shape[0]
+            if key < 0:
+                key += n
+            if not 0 <= key < n:
+                raise IndexError("index %d out of bounds for %d rows"
+                                 % (key, n))
+            return self[key:key + 1]
+        if isinstance(key, slice):
+            start, stop, step = key.indices(self._shape[0])
+            if step != 1:
+                raise MXNetError("csr slicing supports step=1 only")
+            stop = max(stop, start)
+            indptr = self._sp_indptr.asnumpy()
+            lo, hi = int(indptr[start]), int(indptr[stop])
+            import jax.numpy as jnp
+            return CSRNDArray(
+                NDArray(self._sp_data._data[lo:hi], ctx=self._ctx),
+                NDArray(self._sp_indices._data[lo:hi], ctx=self._ctx),
+                NDArray(jnp.asarray(indptr[start:stop + 1] - lo),
+                        ctx=self._ctx),
+                (stop - start, self._shape[1]), ctx=self._ctx)
+        raise MXNetError("csr indexing supports int/slice only")
+
+
+class RowSparseNDArray(BaseSparseNDArray):
+    """Row-sparse array: a subset of rows is stored (reference:
+    sparse.py:561). data shape = (nnz_rows,) + shape[1:]."""
+
+    def __init__(self, data, indices, shape, ctx=None):
+        super().__init__(shape, ctx)
+        self._sp_data = data
+        self._sp_indices = indices
+
+    @property
+    def stype(self):
+        return "row_sparse"
+
+    @property
+    def data(self):
+        return self._sp_data
+
+    @property
+    def indices(self):
+        return self._sp_indices
+
+    @property
+    def _aux_types(self):
+        return [_np.dtype(_np.int64)]
+
+    def _clone(self, ctx=None):
+        ctx = ctx or self._ctx
+        return RowSparseNDArray(self._sp_data.copy(),
+                                self._sp_indices.copy(),
+                                self._shape, ctx=ctx)
+
+    def _assign_from(self, other):
+        self._sp_data = other._sp_data.copy()
+        self._sp_indices = other._sp_indices.copy()
+        self._shape = other._shape
+
+    def _check_format(self):
+        idx = self._sp_indices.asnumpy()
+        if (_np.diff(idx) <= 0).any():
+            raise MXNetError("row_sparse indices must be strictly "
+                             "increasing")
+        if idx.size and (idx.min() < 0 or idx.max() >= self._shape[0]):
+            raise MXNetError("row_sparse indices out of bounds")
+        if tuple(self._sp_data.shape[1:]) != self._shape[1:]:
+            raise MXNetError("row_sparse data row shape mismatch")
+
+    def _dense_np(self):
+        out = _np.zeros(self._shape, dtype=self._sp_data.dtype)
+        out[self._sp_indices.asnumpy()] = self._sp_data.asnumpy()
+        return out
+
+    def tostype(self, stype):
+        if stype == "row_sparse":
+            return self
+        if stype == "default":
+            import jax.numpy as jnp
+            dense = jnp.zeros(self._shape, dtype=self._sp_data.dtype)
+            dense = dense.at[self._sp_indices._data].set(
+                self._sp_data._data)
+            return NDArray(dense, ctx=self._ctx)
+        raise MXNetError("cast_storage from row_sparse to %s is not "
+                         "supported" % stype)
+
+    def retain(self, indices):
+        return retain(self, indices)
+
+    def __getitem__(self, key):
+        if isinstance(key, slice):
+            if key.start or key.step or (key.stop is not None
+                                         and key.stop != self._shape[0]):
+                raise MXNetError("row_sparse supports [:] slicing only")
+            return self
+        raise MXNetError("row_sparse indexing supports [:] only")
+
+    def _same_structure_op(self, other, fn):
+        if isinstance(other, RowSparseNDArray) \
+                and other._shape == self._shape:
+            a_idx = self._sp_indices.asnumpy()
+            b_idx = other._sp_indices.asnumpy()
+            if a_idx.shape == b_idx.shape and (a_idx == b_idx).all():
+                c = self._clone()
+                c._sp_data = fn(self._sp_data, other._sp_data)
+                return c
+            import jax.numpy as jnp
+            union = _np.union1d(a_idx, b_idx)
+            a_pos = _np.searchsorted(union, a_idx)
+            b_pos = _np.searchsorted(union, b_idx)
+            zero = jnp.zeros((len(union),) + self._shape[1:],
+                             dtype=self._sp_data.dtype)
+            a_full = zero.at[jnp.asarray(a_pos)].set(self._sp_data._data)
+            b_full = zero.at[jnp.asarray(b_pos)].set(other._sp_data._data)
+            return RowSparseNDArray(
+                fn(NDArray(a_full, ctx=self._ctx),
+                   NDArray(b_full, ctx=self._ctx)),
+                NDArray(_jnp().asarray(union.astype(_np.int64)),
+                        ctx=self._ctx),
+                self._shape, ctx=self._ctx)
+        return None
+
+
+# -- constructors (parity: sparse.py:825, 1020) --------------------------
+
+def _as_nd(x, dtype, ctx):
+    if isinstance(x, NDArray):
+        return x.astype(dtype) if dtype is not None and x.dtype != dtype \
+            else x
+    return _dense_array(_np.asarray(x, dtype=dtype), ctx=ctx)
+
+
+def csr_matrix(arg1, shape=None, ctx=None, dtype=None):
+    """Create a CSRNDArray from (data, indices, indptr), a dense
+    array/NDArray, a scipy.sparse matrix, or another CSRNDArray."""
+    ctx = ctx or current_context()
+    try:
+        import scipy.sparse as spsp
+    except ImportError:
+        spsp = None
+    if isinstance(arg1, CSRNDArray):
+        return arg1._clone(ctx=ctx)
+    if spsp is not None and spsp.issparse(arg1):
+        m = arg1.tocsr()
+        return CSRNDArray(
+            _as_nd(m.data, dtype or m.dtype, ctx),
+            _as_nd(m.indices.astype(_np.int64), _np.int64, ctx),
+            _as_nd(m.indptr.astype(_np.int64), _np.int64, ctx),
+            m.shape, ctx=ctx)
+    if isinstance(arg1, tuple) and len(arg1) == 3:
+        data, indices, indptr = arg1
+        if shape is None:
+            ind = _np.asarray(indices)
+            ip = _np.asarray(indptr)
+            shape = (len(ip) - 1,
+                     int(ind.max()) + 1 if ind.size else 0)
+        return CSRNDArray(_as_nd(data, dtype, ctx),
+                          _as_nd(_np.asarray(indices, _np.int64),
+                                 _np.int64, ctx),
+                          _as_nd(_np.asarray(indptr, _np.int64),
+                                 _np.int64, ctx),
+                          shape, ctx=ctx)
+    if isinstance(arg1, tuple) and len(arg1) == 2:
+        if isinstance(arg1[0], int):
+            # (M, N) empty
+            return zeros("csr", arg1, ctx=ctx, dtype=dtype)
+        # (data, (row, col)) COO-style definition
+        import scipy.sparse as spsp2
+        data, (row, col) = arg1
+        m = spsp2.csr_matrix((_np.asarray(data),
+                              (_np.asarray(row), _np.asarray(col))),
+                             shape=shape)
+        return csr_matrix(m, ctx=ctx, dtype=dtype)
+    # dense source
+    src = arg1.asnumpy() if isinstance(arg1, NDArray) else \
+        _np.asarray(arg1, dtype=dtype)
+    return cast_storage(_dense_array(src, ctx=ctx), "csr")
+
+
+def row_sparse_array(arg1, shape=None, ctx=None, dtype=None):
+    """Create a RowSparseNDArray from (data, indices), a dense source,
+    or another RowSparseNDArray."""
+    ctx = ctx or current_context()
+    if isinstance(arg1, RowSparseNDArray):
+        return arg1._clone(ctx=ctx)
+    if isinstance(arg1, tuple) and len(arg1) == 2 and not _np.isscalar(
+            arg1[0]):
+        arr0 = _np.asarray(arg1[0]) if not isinstance(arg1[0], NDArray) \
+            else arg1[0]
+        if getattr(arr0, "ndim", 0) >= 1 and not isinstance(arg1[0], int):
+            data, indices = arg1
+            data_nd = _as_nd(data, dtype, ctx)
+            if shape is None:
+                ind = _np.asarray(indices)
+                shape = ((int(ind.max()) + 1 if ind.size else 0),) + \
+                    tuple(data_nd.shape[1:])
+            return RowSparseNDArray(
+                data_nd,
+                _as_nd(_np.asarray(indices, _np.int64), _np.int64, ctx),
+                shape, ctx=ctx)
+    if isinstance(arg1, tuple):
+        return zeros("row_sparse", arg1, ctx=ctx, dtype=dtype)
+    src = arg1.asnumpy() if isinstance(arg1, NDArray) else \
+        _np.asarray(arg1, dtype=dtype)
+    return cast_storage(_dense_array(src, ctx=ctx), "row_sparse")
+
+
+def zeros(stype, shape, ctx=None, dtype=None, **kwargs):
+    """All-zero sparse array (reference: sparse.py:1507)."""
+    ctx = ctx or current_context()
+    dtype = dtype or _np.float32
+    if stype == "default":
+        return _dense_zeros(shape, ctx=ctx, dtype=dtype)
+    if stype == "csr":
+        return CSRNDArray(
+            _dense_array(_np.zeros((0,), dtype), ctx=ctx),
+            _dense_array(_np.zeros((0,), _np.int64), ctx=ctx),
+            _dense_array(_np.zeros((shape[0] + 1,), _np.int64), ctx=ctx),
+            shape, ctx=ctx)
+    if stype == "row_sparse":
+        return RowSparseNDArray(
+            _dense_array(_np.zeros((0,) + tuple(shape[1:]), dtype),
+                         ctx=ctx),
+            _dense_array(_np.zeros((0,), _np.int64), ctx=ctx),
+            shape, ctx=ctx)
+    raise MXNetError("unknown storage type %s" % stype)
+
+
+def empty(stype, shape, ctx=None, dtype=None):
+    return zeros(stype, shape, ctx=ctx, dtype=dtype)
+
+
+def array(source_array, ctx=None, dtype=None):
+    """Sparse-aware array constructor (reference: sparse.py:1579)."""
+    import scipy.sparse as spsp
+    if isinstance(source_array, BaseSparseNDArray):
+        return source_array._clone(ctx=ctx or source_array.context)
+    if spsp.issparse(source_array):
+        return csr_matrix(source_array, ctx=ctx, dtype=dtype)
+    raise ValueError("Unexpected source_array type: use mx.nd.array for "
+                     "dense sources")
+
+
+# -- storage casts (parity: cast_storage-inl.h) ---------------------------
+
+def cast_storage(arr, stype):
+    """Convert between storage types. Dense→sparse scans for non-zeros
+    on the host (nnz is data-dependent; this syncs — same cost class as
+    the reference's CPU kernel which also walks the dense array)."""
+    if isinstance(arr, BaseSparseNDArray) or stype == "default":
+        return arr.tostype(stype)
+    if not isinstance(arr, NDArray):
+        raise TypeError("cast_storage expects an NDArray")
+    if stype == "row_sparse":
+        # the row mask is computed on device; only the 1-D bool mask is
+        # fetched, and the kept rows are gathered on device — no dense
+        # device→host transfer (this runs per-step in sparse_grad
+        # training loops)
+        import jax.numpy as jnp
+        g = arr._data
+        mask = jnp.any(g != 0, axis=tuple(range(1, g.ndim))) \
+            if g.ndim > 1 else (g != 0)
+        nz_rows = _np.where(_np.asarray(mask))[0].astype(_np.int64)
+        data = jnp.take(g, jnp.asarray(nz_rows), axis=0) if nz_rows.size \
+            else jnp.zeros((0,) + tuple(arr.shape[1:]), dtype=g.dtype)
+        return RowSparseNDArray(
+            NDArray(data, ctx=arr.context),
+            _dense_array(nz_rows, ctx=arr.context),
+            arr.shape, ctx=arr.context)
+    if stype == "csr":
+        import scipy.sparse as spsp
+        src = arr.asnumpy()
+        if src.ndim != 2:
+            raise MXNetError("csr requires 2-D input")
+        m = spsp.csr_matrix(src)
+        return CSRNDArray(
+            _dense_array(m.data.astype(src.dtype), ctx=arr.context),
+            _dense_array(m.indices.astype(_np.int64), ctx=arr.context),
+            _dense_array(m.indptr.astype(_np.int64), ctx=arr.context),
+            src.shape, ctx=arr.context)
+    raise MXNetError("unknown storage type %s" % stype)
+
+
+def retain(rsp, indices):
+    """Keep only the requested rows of a row_sparse array (reference:
+    _retain op) — a gather over the stored rows."""
+    if not isinstance(rsp, RowSparseNDArray):
+        raise MXNetError("retain expects a RowSparseNDArray")
+    want = indices.asnumpy().astype(_np.int64) \
+        if isinstance(indices, NDArray) else \
+        _np.asarray(indices, dtype=_np.int64)
+    have = rsp.indices.asnumpy()
+    mask = _np.isin(want, have)
+    kept = want[mask]
+    pos = _np.searchsorted(have, kept)
+    import jax.numpy as jnp
+    data = jnp.take(rsp.data._data, jnp.asarray(pos), axis=0) \
+        if kept.size else \
+        jnp.zeros((0,) + rsp.shape[1:], dtype=rsp.data.dtype)
+    return RowSparseNDArray(
+        NDArray(data, ctx=rsp.context),
+        _dense_array(kept, ctx=rsp.context),
+        rsp.shape, ctx=rsp.context)
+
+
+# -- sparse dot (parity: src/operator/tensor/dot-inl.h) -------------------
+
+def dot(lhs, rhs, transpose_a=False, transpose_b=False):
+    """Sparse-aware dot. csr×dense lowers to gather + segment_sum (the
+    MXU-friendly formulation); everything else falls back to dense."""
+    import jax
+    import jax.numpy as jnp
+    if isinstance(lhs, CSRNDArray) and isinstance(rhs, NDArray) \
+            and not isinstance(rhs, BaseSparseNDArray) and not transpose_b:
+        data = lhs.data._data
+        cols = lhs.indices._data
+        rows = jnp.asarray(lhs._row_ids())
+        if not transpose_a:
+            # (M,K)·(K,N): each nnz contributes data*rhs[col] to its row
+            contrib = data[:, None] * jnp.take(rhs._data, cols, axis=0)
+            out = jax.ops.segment_sum(contrib, rows,
+                                      num_segments=lhs.shape[0])
+        else:
+            # (M,K)ᵀ·(M,N) → (K,N): contributes data*rhs[row] to its col
+            contrib = data[:, None] * jnp.take(rhs._data, rows, axis=0)
+            out = jax.ops.segment_sum(contrib, cols,
+                                      num_segments=lhs.shape[1])
+        return NDArray(out, ctx=lhs.context)
+    a = lhs.tostype("default") if isinstance(lhs, BaseSparseNDArray) \
+        else lhs
+    b = rhs.tostype("default") if isinstance(rhs, BaseSparseNDArray) \
+        else rhs
+    return a.dot(b, transpose_a=transpose_a, transpose_b=transpose_b)
+
+
+# -- elemwise wrappers (parity: sparse.py:1193-1504) ----------------------
+
+def add(lhs, rhs):
+    return lhs + rhs
+
+
+def subtract(lhs, rhs):
+    return lhs - rhs
+
+
+def multiply(lhs, rhs):
+    return lhs * rhs
+
+
+def divide(lhs, rhs):
+    return lhs / rhs

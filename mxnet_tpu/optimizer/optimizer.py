@@ -108,8 +108,9 @@ class Optimizer:
     def set_wd_mult(self, args_wd_mult):
         self.wd_mult = {}
         for n in self.idx2name.values():
-            is_weight = n.endswith('_weight')
-            if not is_weight:
+            # biases/beta get no decay; weights AND norm-layer gammas
+            # keep it (reference: optimizer.py set_wd_mult)
+            if not (n.endswith('_weight') or n.endswith('_gamma')):
                 self.wd_mult[n] = 0.0
         if self.sym_info:
             attr, arg_names = self.sym_info
@@ -169,6 +170,39 @@ class Optimizer:
 
 
 
+def _lazy_row_update(op_name, weight, grad, states, attrs):
+    """Row-lazy sparse update (reference: the row_sparse kernels in
+    src/operator/optimizer_op.cc with ``lazy_update=True``): apply the
+    dense update rule to ONLY the rows named by the row_sparse gradient.
+    Untouched rows — and their optimizer states — receive no update at
+    all (no weight decay, no momentum decay), which is the semantic the
+    reference documents for lazy sparse training.
+
+    Lowering: gather the touched rows of weight and states, run the
+    same registered update op on the row block, scatter back — the
+    TPU-friendly form of the reference's per-row kernel loop.
+    """
+    import jax.numpy as jnp
+    from ..ops import registry as _R
+    op = _R.get_op(op_name)
+    nattrs = _R.normalize_attrs(op, attrs)
+    idx = grad.indices._data
+    w = weight._data
+    w_rows = jnp.take(w, idx, axis=0)
+    st_rows = [jnp.take(s._data, idx, axis=0) for s in states]
+    out = op.forward(nattrs, w_rows, grad.data._data, *st_rows)
+    if not isinstance(out, (tuple, list)):
+        out = (out,)
+    weight._set_data(w.at[idx].set(out[0]))
+    for s, ns in zip(states, out[1:]):
+        s._set_data(s._data.at[idx].set(ns))
+
+
+def _rsp_grad(grad):
+    from ..ndarray.sparse import RowSparseNDArray
+    return grad if isinstance(grad, RowSparseNDArray) else None
+
+
 def _fp32_state(weight):
     """fp32 accumulator zeros on the weight's own placement — these
     optimizers keep fp32 state regardless of weight dtype (matching the
@@ -208,6 +242,16 @@ class SGD(Optimizer):
         lr = self._get_lr(index)
         wd = self._get_wd(index)
         kw = _common_kwargs(self, lr, wd)
+        rsp = _rsp_grad(grad)
+        if rsp is not None:
+            if not self.lazy_update:
+                grad = rsp.tostype("default")
+            elif self.momentum != 0.0:
+                return _lazy_row_update("sgd_mom_update", weight, rsp,
+                                        [state],
+                                        dict(kw, momentum=self.momentum))
+            else:
+                return _lazy_row_update("sgd_update", weight, rsp, [], kw)
         if self.momentum != 0.0:
             invoke_nd("sgd_mom_update", [weight, grad, state],
                       dict(kw, momentum=self.momentum), out=weight)
@@ -378,9 +422,16 @@ class Adam(Optimizer):
         lr *= math.sqrt(coef2) / coef1
         kw = _common_kwargs(self, lr, wd)
         mean, var = state
-        invoke_nd("adam_update", [weight, grad, mean, var],
-                  dict(kw, beta1=self.beta1, beta2=self.beta2,
-                       epsilon=self.epsilon), out=weight)
+        kw_adam = dict(kw, beta1=self.beta1, beta2=self.beta2,
+                       epsilon=self.epsilon)
+        rsp = _rsp_grad(grad)
+        if rsp is not None:
+            if self.lazy_update:
+                return _lazy_row_update("adam_update", weight, rsp,
+                                        [mean, var], kw_adam)
+            grad = rsp.tostype("default")
+        invoke_nd("adam_update", [weight, grad, mean, var], kw_adam,
+                  out=weight)
 
 
 @register
@@ -396,9 +447,14 @@ class AdaGrad(Optimizer):
         self._update_count(index)
         lr = self._get_lr(index)
         wd = self._get_wd(index)
-        kw = _common_kwargs(self, lr, wd)
-        invoke_nd("adagrad_update", [weight, grad, state],
-                  dict(kw, epsilon=self.float_stable_eps), out=weight)
+        kw = dict(_common_kwargs(self, lr, wd),
+                  epsilon=self.float_stable_eps)
+        rsp = _rsp_grad(grad)
+        if rsp is not None:
+            # reference sparse adagrad is always row-lazy
+            return _lazy_row_update("adagrad_update", weight, rsp,
+                                    [state], kw)
+        invoke_nd("adagrad_update", [weight, grad, state], kw, out=weight)
 
 
 @register
@@ -479,10 +535,14 @@ class Ftrl(Optimizer):
         self._update_count(index)
         lr = self._get_lr(index)
         wd = self._get_wd(index)
-        kw = _common_kwargs(self, lr, wd)
+        kw = dict(_common_kwargs(self, lr, wd),
+                  lamda1=self.lamda1, beta=self.beta)
         z, n = state
-        invoke_nd("ftrl_update", [weight, grad, z, n],
-                  dict(kw, lamda1=self.lamda1, beta=self.beta), out=weight)
+        rsp = _rsp_grad(grad)
+        if rsp is not None:
+            # reference sparse ftrl is row-lazy
+            return _lazy_row_update("ftrl_update", weight, rsp, [z, n], kw)
+        invoke_nd("ftrl_update", [weight, grad, z, n], kw, out=weight)
 
 
 @register

@@ -6,6 +6,11 @@ chain seeds every random op. ``seed(n)`` resets the chain (parity with
 ``mx.random.seed``); each random-op invocation consumes a fresh subkey.
 Recorded autograd tapes stash the subkey used so backward replays are
 bit-exact (the role the reference's saved RNG resource states play).
+
+The chain is process-global behind a lock (not thread-local): worker
+threads (PrefetchingIter, DataLoader pools) draw distinct subkeys from
+the one chain, and ``seed()`` reseeds every thread at once — matching
+the reference, whose random resource is per-device, not per-thread.
 """
 from __future__ import annotations
 
@@ -13,16 +18,10 @@ import threading
 
 __all__ = ["seed", "new_key", "current_seed"]
 
-_state = threading.local()
+_lock = threading.Lock()
 _DEFAULT_SEED = 0
-
-
-def _get():
-    if not hasattr(_state, "key"):
-        import jax
-        _state.key = jax.random.PRNGKey(_DEFAULT_SEED)
-        _state.seed_val = _DEFAULT_SEED
-    return _state
+_key = None
+_seed_val = _DEFAULT_SEED
 
 
 def seed(seed_state, ctx="all"):
@@ -31,18 +30,22 @@ def seed(seed_state, ctx="all"):
     ``ctx`` accepted for API parity; on TPU the key chain is global.
     """
     import jax
-    st = _get()
-    st.key = jax.random.PRNGKey(int(seed_state))
-    st.seed_val = int(seed_state)
+    global _key, _seed_val
+    with _lock:
+        _key = jax.random.PRNGKey(int(seed_state))
+        _seed_val = int(seed_state)
 
 
 def current_seed():
-    return _get().seed_val
+    return _seed_val
 
 
 def new_key():
-    """Split and return a fresh PRNG subkey."""
+    """Split and return a fresh PRNG subkey (thread-safe)."""
     import jax
-    st = _get()
-    st.key, sub = jax.random.split(st.key)
-    return sub
+    global _key
+    with _lock:
+        if _key is None:
+            _key = jax.random.PRNGKey(_DEFAULT_SEED)
+        _key, sub = jax.random.split(_key)
+        return sub

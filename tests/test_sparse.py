@@ -1,0 +1,290 @@
+"""Sparse subsystem tests.
+
+Mirrors the reference's tests/python/unittest/test_sparse_ndarray.py /
+test_sparse_operator.py assertion patterns plus the
+tests/python/train/test_sparse_fm.py convergence gate (BASELINE
+config 4).
+"""
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.ndarray import sparse as sp
+
+
+def _rand_dense(shape, density=0.3, seed=0):
+    rng = np.random.RandomState(seed)
+    d = rng.uniform(-1, 1, shape) * (rng.uniform(size=shape) < density)
+    return d.astype(np.float32)
+
+
+# -- CSRNDArray -----------------------------------------------------------
+
+def test_csr_roundtrip_forms():
+    dense = _rand_dense((5, 7))
+    c1 = sp.csr_matrix(dense)
+    np.testing.assert_allclose(c1.asnumpy(), dense, rtol=1e-6)
+    assert c1.stype == "csr"
+    # scipy
+    import scipy.sparse as spsp
+    c2 = sp.csr_matrix(spsp.csr_matrix(dense))
+    np.testing.assert_allclose(c2.asnumpy(), dense, rtol=1e-6)
+    # (data, indices, indptr)
+    c3 = sp.csr_matrix((c1.data.asnumpy(), c1.indices.asnumpy(),
+                        c1.indptr.asnumpy()), shape=(5, 7))
+    np.testing.assert_allclose(c3.asnumpy(), dense, rtol=1e-6)
+    # (data, (row, col))
+    coo = spsp.coo_matrix(dense)
+    c4 = sp.csr_matrix((coo.data, (coo.row, coo.col)), shape=(5, 7))
+    np.testing.assert_allclose(c4.asnumpy(), dense, rtol=1e-6)
+    # asscipy roundtrip
+    np.testing.assert_allclose(c1.asscipy().toarray(), dense, rtol=1e-6)
+    c1.check_format()
+
+
+def test_csr_slice():
+    dense = _rand_dense((6, 4))
+    c = sp.csr_matrix(dense)
+    s = c[2:5]
+    assert s.stype == "csr" and s.shape == (3, 4)
+    np.testing.assert_allclose(s.asnumpy(), dense[2:5], rtol=1e-6)
+    np.testing.assert_allclose(c[1].asnumpy(), dense[1:2], rtol=1e-6)
+
+
+def test_csr_dot():
+    dense = _rand_dense((6, 8), seed=1)
+    c = sp.csr_matrix(dense)
+    rhs = np.random.RandomState(2).uniform(size=(8, 3)).astype(np.float32)
+    out = mx.nd.dot(c, mx.nd.array(rhs))
+    np.testing.assert_allclose(out.asnumpy(), dense @ rhs, rtol=1e-5)
+    rhs2 = np.random.RandomState(3).uniform(size=(6, 3)).astype(np.float32)
+    outT = mx.nd.dot(c, mx.nd.array(rhs2), transpose_a=True)
+    np.testing.assert_allclose(outT.asnumpy(), dense.T @ rhs2, rtol=1e-5)
+
+
+# -- RowSparseNDArray -----------------------------------------------------
+
+def test_rsp_roundtrip_and_retain():
+    dense = np.zeros((10, 3), np.float32)
+    dense[[1, 4, 8]] = np.random.RandomState(0).uniform(size=(3, 3))
+    r = sp.row_sparse_array(dense)
+    assert r.stype == "row_sparse"
+    np.testing.assert_allclose(r.asnumpy(), dense, rtol=1e-6)
+    assert list(r.indices.asnumpy()) == [1, 4, 8]
+    # definition form
+    r2 = sp.row_sparse_array((r.data.asnumpy(), [1, 4, 8]),
+                             shape=(10, 3))
+    np.testing.assert_allclose(r2.asnumpy(), dense, rtol=1e-6)
+    # retain
+    kept = sp.retain(r, mx.nd.array([4, 8, 9]))
+    assert list(kept.indices.asnumpy()) == [4, 8]
+    np.testing.assert_allclose(kept.asnumpy()[4], dense[4], rtol=1e-6)
+    assert kept.asnumpy()[1].sum() == 0
+    r.check_format()
+
+
+def test_rsp_arithmetic():
+    dense = np.zeros((8, 2), np.float32)
+    dense[[0, 3]] = 1.5
+    r = sp.row_sparse_array(dense)
+    np.testing.assert_allclose((r * 2).asnumpy(), dense * 2)
+    np.testing.assert_allclose((-r).asnumpy(), -dense)
+    np.testing.assert_allclose((r / 4).asnumpy(), dense / 4)
+    # same indices: stays sparse
+    s = r + r
+    assert s.stype == "row_sparse"
+    np.testing.assert_allclose(s.asnumpy(), dense * 2)
+    # different indices: union merge, stays sparse
+    dense2 = np.zeros((8, 2), np.float32)
+    dense2[[3, 6]] = 2.0
+    s2 = r + sp.row_sparse_array(dense2)
+    assert s2.stype == "row_sparse"
+    assert list(s2.indices.asnumpy()) == [0, 3, 6]
+    np.testing.assert_allclose(s2.asnumpy(), dense + dense2)
+    # sparse + dense densifies
+    s3 = r + mx.nd.ones((8, 2))
+    assert s3.stype == "default"
+    np.testing.assert_allclose(s3.asnumpy(), dense + 1)
+
+
+def test_cast_storage_and_zeros():
+    dense = _rand_dense((4, 5), seed=4)
+    nd = mx.nd.array(dense)
+    assert nd.tostype("csr").stype == "csr"
+    assert nd.tostype("row_sparse").stype == "row_sparse"
+    np.testing.assert_allclose(
+        nd.tostype("csr").tostype("default").asnumpy(), dense, rtol=1e-6)
+    z = sp.zeros("row_sparse", (3, 2))
+    assert z.asnumpy().sum() == 0 and z.stype == "row_sparse"
+    z2 = sp.zeros("csr", (3, 2))
+    assert z2.asnumpy().sum() == 0
+
+
+# -- optimizer lazy updates ----------------------------------------------
+
+def _lazy_case(opt_name, **opt_kwargs):
+    F, K = 10, 4
+    rng = np.random.RandomState(5)
+    w0 = rng.uniform(size=(F, K)).astype(np.float32)
+    touched = [2, 7]
+    g_rows = rng.uniform(size=(len(touched), K)).astype(np.float32)
+
+    # sparse lazy path
+    opt_sparse = mx.optimizer.create(opt_name, learning_rate=0.1,
+                                     wd=0.01, **opt_kwargs)
+    w_sp = mx.nd.array(w0)
+    state_sp = opt_sparse.create_state(0, w_sp)
+    grad_rsp = sp.row_sparse_array((g_rows, touched), shape=(F, K))
+    opt_sparse.update(0, w_sp, grad_rsp, state_sp)
+
+    # dense oracle on the touched block only
+    opt_dense = mx.optimizer.create(opt_name, learning_rate=0.1,
+                                    wd=0.01, **opt_kwargs)
+    w_block = mx.nd.array(w0[touched])
+    state_block = opt_dense.create_state(0, w_block)
+    opt_dense.update(0, w_block, mx.nd.array(g_rows), state_block)
+
+    out = w_sp.asnumpy()
+    untouched = [i for i in range(F) if i not in touched]
+    np.testing.assert_allclose(out[untouched], w0[untouched])  # frozen
+    np.testing.assert_allclose(out[touched], w_block.asnumpy(),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_sgd_lazy_update():
+    _lazy_case("sgd", momentum=0.9)
+    _lazy_case("sgd")
+
+
+def test_adam_lazy_update():
+    _lazy_case("adam")
+
+
+def test_adagrad_lazy_update():
+    _lazy_case("adagrad")
+
+
+def test_ftrl_lazy_update():
+    _lazy_case("ftrl")
+
+
+# -- kvstore sparse -------------------------------------------------------
+
+def test_kvstore_row_sparse_pull():
+    kv = mx.kv.create("local")
+    w = np.random.RandomState(6).uniform(size=(9, 3)).astype(np.float32)
+    kv.init(0, mx.nd.array(w))
+    # rsp out, dedup + sort guaranteed
+    out = sp.zeros("row_sparse", (9, 3))
+    kv.row_sparse_pull(0, out=out, row_ids=mx.nd.array([7, 2, 7, 0]))
+    assert list(out.indices.asnumpy()) == [0, 2, 7]
+    np.testing.assert_allclose(out.asnumpy()[[0, 2, 7]], w[[0, 2, 7]],
+                               rtol=1e-6)
+    # dense out receives the gathered block
+    dense_out = mx.nd.zeros((3, 3))
+    kv.row_sparse_pull(0, out=dense_out, row_ids=mx.nd.array([0, 2, 7]))
+    np.testing.assert_allclose(dense_out.asnumpy(), w[[0, 2, 7]],
+                               rtol=1e-6)
+
+
+def test_kvstore_push_rsp():
+    kv = mx.kv.create("local")
+    kv.init(1, mx.nd.zeros((6, 2)))
+    updates = []
+    kv.set_updater(lambda k, g, s: updates.append(g))
+    d = np.zeros((6, 2), np.float32)
+    d[[1, 3]] = 2.0
+    kv.push(1, sp.row_sparse_array(d))
+    assert updates and updates[0].stype == "row_sparse"
+
+
+# -- Gluon sparse_grad + FM end-to-end ------------------------------------
+
+def test_embedding_sparse_grad_lazy_rows():
+    from mxnet_tpu import gluon, autograd
+    from mxnet_tpu.gluon import nn
+    net = nn.Embedding(20, 4, sparse_grad=True)
+    net.initialize(mx.init.Xavier())
+    w_before = None
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 1.0, "momentum": 0.9})
+    x = mx.nd.array([[1, 5], [5, 9]])
+    with autograd.record():
+        out = net(x)
+        loss = (out * out).sum()
+    w_before = net.weight.data().asnumpy().copy()
+    loss.backward()
+    trainer.step(1)
+    w_after = net.weight.data().asnumpy()
+    touched = [1, 5, 9]
+    untouched = [i for i in range(20) if i not in touched]
+    # lazy semantics: untouched rows bit-identical (no wd/momentum decay)
+    np.testing.assert_array_equal(w_after[untouched], w_before[untouched])
+    assert np.abs(w_after[touched] - w_before[touched]).sum() > 0
+
+
+def test_libsvm_iter_yields_csr(tmp_path):
+    f = tmp_path / "data.libsvm"
+    f.write_text("1 0:1.5 3:2.0\n0 1:1.0\n1 2:0.5 3:1.0\n0 0:2.0\n")
+    it = mx.io.LibSVMIter(data_libsvm=str(f), data_shape=(4,),
+                          batch_size=2)
+    batch = next(iter([it.next()]))
+    assert batch.data[0].stype == "csr"
+    np.testing.assert_allclose(batch.data[0].asnumpy(),
+                               [[1.5, 0, 0, 2.0], [0, 1.0, 0, 0]],
+                               rtol=1e-6)
+
+
+def test_factorization_machine_trains():
+    """BASELINE config 4 gate: FM with sparse-grad embeddings converges
+    (port of tests/python/train/test_sparse_fm.py)."""
+    from mxnet_tpu import gluon, autograd
+    from mxnet_tpu.gluon import nn
+
+    F, K, NNZ, N, B = 50, 4, 5, 256, 32
+    rng = np.random.RandomState(7)
+    idx = rng.randint(0, F, (N, NNZ))
+    vals = rng.uniform(0.5, 1.5, (N, NNZ)).astype(np.float32)
+    true_w = rng.normal(0, 1, F).astype(np.float32)
+    logits = (true_w[idx] * vals).sum(1)
+    y = (logits > 0).astype(np.float32)
+
+    class FM(gluon.Block):
+        def __init__(self, **kw):
+            super().__init__(**kw)
+            with self.name_scope():
+                self.w = nn.Embedding(F, 1, sparse_grad=True)
+                self.v = nn.Embedding(F, K, sparse_grad=True)
+
+        def forward(self, idx, vals):
+            linear = (self.w(idx)[:, :, 0] * vals).sum(1)
+            vx = self.v(idx) * vals.expand_dims(2)     # (B, NNZ, K)
+            s1 = vx.sum(1) ** 2                        # (B, K)
+            s2 = (vx ** 2).sum(1)
+            return linear + 0.5 * (s1 - s2).sum(1)
+
+    mx.random.seed(8)
+    net = FM()
+    net.initialize(mx.init.Normal(0.05))
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 0.02})
+    loss_fn = gluon.loss.SigmoidBinaryCrossEntropyLoss()
+    losses = []
+    for epoch in range(8):
+        ep = []
+        for b in range(N // B):
+            sl = slice(b * B, (b + 1) * B)
+            xi = mx.nd.array(idx[sl])
+            vi = mx.nd.array(vals[sl])
+            yi = mx.nd.array(y[sl])
+            with autograd.record():
+                out = net(xi, vi)
+                loss = loss_fn(out, yi)
+            loss.backward()
+            trainer.step(B)
+            ep.append(float(loss.asnumpy().mean()))
+        losses.append(np.mean(ep))
+    assert losses[-1] < losses[0] * 0.6, losses
