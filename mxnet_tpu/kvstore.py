@@ -257,7 +257,14 @@ class KVStore:
                                               ctx=v._ctx)
                     tgt._shape = v.shape
                 else:
-                    tgt._set_data(rows._data)
+                    # reference asserts the out stype is row_sparse
+                    # (kvstore.py row_sparse_pull); a dense out would
+                    # silently get a (len(row_ids), D) buffer in place
+                    # of its declared full shape.
+                    raise MXNetError(
+                        "row_sparse_pull requires 'out' arrays with "
+                        "stype='row_sparse', got dense NDArray for key "
+                        "%s" % (k,))
 
     # -- updater/optimizer ----------------------------------------------
     def set_updater(self, updater):

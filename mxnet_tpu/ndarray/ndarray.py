@@ -188,9 +188,13 @@ class NDArray:
             self._set_data(self._data.at[key].set(v))
 
     def __getitem__(self, key):
-        key = _clean_index(key)
-        out_data = self._data[key]
-        return NDArray(out_data, ctx=self._ctx)
+        # Routed through the registered `_getitem` op so the lookup is
+        # recorded on the autograd tape (gradients flow through any
+        # slice/int/fancy index, as in the reference which lowers
+        # indexing to op.slice/op.take/op.gather_nd).
+        spec, arrays = _index_spec(key, self._ctx)
+        return invoke_nd("_getitem", [self] + arrays,
+                         {"spec": spec, "num_arrays": len(arrays)})
 
     # -- autograd --------------------------------------------------------
     def attach_grad(self, grad_req="write", stype=None):
@@ -522,6 +526,49 @@ def _clean_index(key):
     if isinstance(key, tuple):
         return tuple(_clean_index(k) for k in key)
     return key
+
+
+def _index_spec(key, ctx):
+    """Normalize an indexing key into (hashable spec, array inputs).
+
+    Spec item kinds: ("s", start, stop, step) slice, ("i", n) integer,
+    ("n",) newaxis, ("e",) ellipsis, ("a",) array placeholder consumed
+    in order from the extra op inputs. Boolean masks are converted to
+    integer coordinate arrays host-side (they are concrete values in the
+    eager path, so this costs one sync at most).
+    """
+    items = key if isinstance(key, tuple) else (key,)
+    spec = []
+    arrays = []
+
+    def push_array(a):
+        np_a = a.asnumpy() if isinstance(a, NDArray) else _np.asarray(a)
+        if np_a.dtype == _np.bool_:
+            for coord in _np.nonzero(np_a):
+                spec.append(("a",))
+                arrays.append(array(coord.astype(_np.int32), ctx=ctx))
+        else:
+            spec.append(("a",))
+            if isinstance(a, NDArray) and np_a.dtype != _np.bool_:
+                arrays.append(a)
+            else:
+                arrays.append(array(np_a.astype(_np.int32), ctx=ctx))
+
+    for it in items:
+        if isinstance(it, slice):
+            spec.append(("s", it.start, it.stop, it.step))
+        elif it is None:
+            spec.append(("n",))
+        elif it is Ellipsis:
+            spec.append(("e",))
+        elif isinstance(it, integer_types) or isinstance(it, _np.integer):
+            spec.append(("i", int(it)))
+        elif isinstance(it, (NDArray, _np.ndarray, list)):
+            push_array(it)
+        else:
+            raise MXNetError("NDArray indexing does not support key "
+                             "component of type %s" % type(it))
+    return tuple(spec), arrays
 
 
 def _as_nd(x, ctx=None):

@@ -283,6 +283,37 @@ class CSRNDArray(BaseSparseNDArray):
             (self._sp_data.asnumpy(), self._sp_indices.asnumpy(),
              self._sp_indptr.asnumpy()), shape=self._shape)
 
+    def _same_structure_op(self, other, fn):
+        # csr ⊕ csr keeps csr storage (reference elemwise_add(csr, csr)
+        # returns csr). Pattern union is computed host-side from the
+        # concrete index arrays; values merge on device.
+        if not (isinstance(other, CSRNDArray)
+                and other._shape == self._shape):
+            return None
+        import jax.numpy as jnp
+        ncols = self._shape[1]
+        a_keys = self._row_ids().astype(_np.int64) * ncols \
+            + self._sp_indices.asnumpy().astype(_np.int64)
+        b_keys = other._row_ids().astype(_np.int64) * ncols \
+            + other._sp_indices.asnumpy().astype(_np.int64)
+        union = _np.union1d(a_keys, b_keys)
+        zero = jnp.zeros((len(union),), dtype=self._sp_data.dtype)
+        a_full = zero.at[jnp.asarray(_np.searchsorted(union, a_keys))] \
+            .set(self._sp_data._data)
+        b_full = zero.at[jnp.asarray(_np.searchsorted(union, b_keys))] \
+            .set(other._sp_data._data)
+        out_data = fn(NDArray(a_full, ctx=self._ctx),
+                      NDArray(b_full, ctx=self._ctx))
+        u_rows = (union // ncols).astype(_np.int64)
+        counts = _np.bincount(u_rows, minlength=self._shape[0])
+        indptr = _np.concatenate([[0], _np.cumsum(counts)]) \
+            .astype(_np.int64)
+        return CSRNDArray(
+            out_data,
+            _dense_array((union % ncols), ctx=self._ctx, dtype=_np.int64),
+            _dense_array(indptr, ctx=self._ctx, dtype=_np.int64),
+            self._shape, ctx=self._ctx)
+
     def __getitem__(self, key):
         if isinstance(key, int):
             n = self._shape[0]
@@ -603,14 +634,17 @@ def dot(lhs, rhs, transpose_a=False, transpose_b=False):
         data = lhs.data._data
         cols = lhs.indices._data
         rows = jnp.asarray(lhs._row_ids())
+        vec = rhs.ndim == 1  # matrix-vector (reference DotCsrDnsDns)
         if not transpose_a:
             # (M,K)·(K,N): each nnz contributes data*rhs[col] to its row
-            contrib = data[:, None] * jnp.take(rhs._data, cols, axis=0)
+            taken = jnp.take(rhs._data, cols, axis=0)
+            contrib = data * taken if vec else data[:, None] * taken
             out = jax.ops.segment_sum(contrib, rows,
                                       num_segments=lhs.shape[0])
         else:
             # (M,K)ᵀ·(M,N) → (K,N): contributes data*rhs[row] to its col
-            contrib = data[:, None] * jnp.take(rhs._data, rows, axis=0)
+            taken = jnp.take(rhs._data, rows, axis=0)
+            contrib = data * taken if vec else data[:, None] * taken
             out = jax.ops.segment_sum(contrib, cols,
                                       num_segments=lhs.shape[1])
         return NDArray(out, ctx=lhs.context)

@@ -205,3 +205,37 @@ def _index_copy(attrs, old, idx, new):
 
 register("_contrib_index_copy", _index_copy,
          arg_names=("old_tensor", "index_vector", "new_tensor"))
+
+
+# ---------------------------------------------------------------------------
+# __getitem__ as a first-class recorded op
+# ---------------------------------------------------------------------------
+# The reference routes NDArray indexing through op.slice / op.take /
+# op.gather_nd so gradients flow (ref: python/mxnet/ndarray/ndarray.py:507-796
+# _get_nd_basic_indexing / _get_nd_advanced_indexing). We do the same with a
+# single generic op: the structural part of the index key (slices, ints,
+# None, Ellipsis) is a hashable attr `spec`, and any array indices become
+# tensor *inputs* — so the whole lookup is one XLA gather on the tape, with
+# its scatter-add VJP supplied by jax.
+
+def _getitem_impl(attrs, data, *index_arrays):
+    it = iter(index_arrays)
+    idx = []
+    for item in attrs["spec"]:
+        kind = item[0]
+        if kind == "s":           # slice
+            idx.append(slice(item[1], item[2], item[3]))
+        elif kind == "i":         # integer
+            idx.append(item[1])
+        elif kind == "n":         # newaxis
+            idx.append(None)
+        elif kind == "e":         # ellipsis
+            idx.append(Ellipsis)
+        else:                     # "a": array index (advanced indexing)
+            idx.append(next(it).astype(jnp.int32))
+    return data[tuple(idx)]
+
+
+register("_getitem", _getitem_impl, arg_names=("data",),
+         defaults={"spec": (), "num_arrays": 0},
+         key_var_num_args="num_arrays")

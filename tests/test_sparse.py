@@ -183,11 +183,11 @@ def test_kvstore_row_sparse_pull():
     assert list(out.indices.asnumpy()) == [0, 2, 7]
     np.testing.assert_allclose(out.asnumpy()[[0, 2, 7]], w[[0, 2, 7]],
                                rtol=1e-6)
-    # dense out receives the gathered block
+    # dense out is rejected (reference asserts out stype is row_sparse)
+    from mxnet_tpu.base import MXNetError
     dense_out = mx.nd.zeros((3, 3))
-    kv.row_sparse_pull(0, out=dense_out, row_ids=mx.nd.array([0, 2, 7]))
-    np.testing.assert_allclose(dense_out.asnumpy(), w[[0, 2, 7]],
-                               rtol=1e-6)
+    with pytest.raises(MXNetError):
+        kv.row_sparse_pull(0, out=dense_out, row_ids=mx.nd.array([0, 2, 7]))
 
 
 def test_kvstore_push_rsp():
@@ -288,3 +288,42 @@ def test_factorization_machine_trains():
             ep.append(float(loss.asnumpy().mean()))
         losses.append(np.mean(ep))
     assert losses[-1] < losses[0] * 0.6, losses
+
+
+def test_csr_dot_vector_rhs():
+    """ADVICE r2: dot(csr, 1-D dense) must be matrix-vector (M,)."""
+    from mxnet_tpu.ndarray import sparse as sp
+    dense = np.array([[1.0, 0, 2], [0, 3, 0]], np.float32)
+    csr = sp.csr_matrix(dense)
+    v = mx.nd.array(np.array([1.0, 2.0, 3.0]))
+    out = sp.dot(csr, v)
+    assert out.shape == (2,)
+    assert np.allclose(out.asnumpy(), dense @ np.array([1.0, 2, 3]))
+    outT = sp.dot(csr, mx.nd.array(np.array([1.0, 2.0])), transpose_a=True)
+    assert outT.shape == (3,)
+    assert np.allclose(outT.asnumpy(), dense.T @ np.array([1.0, 2]))
+
+
+def test_csr_add_keeps_csr_stype():
+    """ADVICE r2: elemwise csr+csr returns csr, not dense."""
+    from mxnet_tpu.ndarray import sparse as sp
+    a_d = np.array([[1.0, 0, 2], [0, 0, 3]], np.float32)
+    b_d = np.array([[0.0, 5, 2], [1, 0, 0]], np.float32)
+    a, b = sp.csr_matrix(a_d), sp.csr_matrix(b_d)
+    s = a + b
+    assert s.stype == "csr"
+    assert np.allclose(s.tostype("default").asnumpy(), a_d + b_d)
+    d = a - b
+    assert d.stype == "csr"
+    assert np.allclose(d.tostype("default").asnumpy(), a_d - b_d)
+
+
+def test_row_sparse_pull_dense_out_raises():
+    """ADVICE r2: row_sparse_pull with a dense out must raise."""
+    from mxnet_tpu.base import MXNetError
+    kv = mx.kv.create("local")
+    kv.init("w", mx.nd.ones((4, 2)))
+    out = mx.nd.zeros((4, 2))
+    with pytest.raises(MXNetError):
+        kv.row_sparse_pull("w", out=out,
+                           row_ids=mx.nd.array(np.array([0, 2])))
