@@ -230,8 +230,8 @@ class KVStore:
 
         The stored value's selected rows are gathered on-device; the
         returned row set is deduplicated and sorted, as the reference
-        guarantees. Dense ``out`` receives the gathered row block;
-        RowSparseNDArray ``out`` receives (rows, indices).
+        guarantees. ``out`` must be row_sparse (the reference asserts
+        the same); a dense ``out`` raises MXNetError.
         """
         import numpy as _host_np
         from .ndarray.sparse import RowSparseNDArray, BaseSparseNDArray

@@ -531,11 +531,13 @@ def _clean_index(key):
 def _index_spec(key, ctx):
     """Normalize an indexing key into (hashable spec, array inputs).
 
-    Spec item kinds: ("s", start, stop, step) slice, ("i", n) integer,
-    ("n",) newaxis, ("e",) ellipsis, ("a",) array placeholder consumed
-    in order from the extra op inputs. Boolean masks are converted to
-    integer coordinate arrays host-side (they are concrete values in the
-    eager path, so this costs one sync at most).
+    Spec item kinds: ("s", start, stop, step) slice, ("b", v) bool
+    scalar, ("n",) newaxis, ("e",) ellipsis, ("a",) array placeholder
+    consumed in order from the extra op inputs (integers become 0-d
+    array inputs so distinct values share one compiled program).
+    Boolean masks are converted to integer coordinate arrays host-side
+    (they are concrete values in the eager path, so this costs one sync
+    at most).
     """
     items = key if isinstance(key, tuple) else (key,)
     spec = []
@@ -561,8 +563,18 @@ def _index_spec(key, ctx):
             spec.append(("n",))
         elif it is Ellipsis:
             spec.append(("e",))
+        elif isinstance(it, (bool, _np.bool_)):
+            # bool scalars are 0-d masks (numpy semantics: insert an
+            # axis of size int(v)), NOT integers — and bool is an int
+            # subclass, so this must be checked first.
+            spec.append(("b", bool(it)))
         elif isinstance(it, integer_types) or isinstance(it, _np.integer):
-            spec.append(("i", int(it)))
+            # pass the value as a 0-d array input, not a baked attr, so
+            # x[0], x[1], ... share ONE compiled program (ints among
+            # advanced indices are 0-d advanced indices in numpy, so
+            # semantics are unchanged; jnp wraps negative values).
+            spec.append(("a",))
+            arrays.append(array(_np.int32(int(it)), ctx=ctx))
         elif isinstance(it, (NDArray, _np.ndarray, list)):
             push_array(it)
         else:

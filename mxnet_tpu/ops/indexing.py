@@ -225,7 +225,9 @@ def _getitem_impl(attrs, data, *index_arrays):
         kind = item[0]
         if kind == "s":           # slice
             idx.append(slice(item[1], item[2], item[3]))
-        elif kind == "i":         # integer
+        elif kind == "i":         # integer (legacy saved graphs)
+            idx.append(item[1])
+        elif kind == "b":         # bool scalar: 0-d mask, static shape
             idx.append(item[1])
         elif kind == "n":         # newaxis
             idx.append(None)

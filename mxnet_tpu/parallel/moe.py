@@ -1,0 +1,103 @@
+"""Mixture-of-Experts with top-k routing over the ``ep`` mesh axis.
+
+Switch/GShard-style static-capacity dispatch, built for the MXU: the
+token→expert routing is expressed as two dense einsums (dispatch and
+combine) over a one-hot (token, expert, slot) tensor, so the whole MoE
+layer is batched matmuls with static shapes — no scatter, no dynamic
+shapes, nothing XLA can't tile. Experts live on the ``ep`` axis via the
+``(E, D, F)`` leading-dim sharding of the expert weights; XLA inserts
+the all-to-all implied by tokens-sharded-by-dp meeting
+experts-sharded-by-ep.
+
+The reference framework has no MoE (SURVEY §5.7: capability extension);
+routing semantics follow the public Switch Transformer recipe: top-k
+gating with probability renormalisation, capacity factor, load-balance
+auxiliary loss.
+"""
+from __future__ import annotations
+
+import math
+
+__all__ = ["topk_route", "moe_ffn", "load_balance_loss"]
+
+
+def topk_route(gate_logits, k, capacity):
+    """Route each token to its top-k experts under a per-expert capacity.
+
+    gate_logits: (S, E) router scores for S tokens.
+    Returns (dispatch, combine, aux):
+      dispatch: (S, E, C) one-hot — token s occupies slot c of expert e
+      combine:  (S, E, C) — dispatch weighted by renormalised gate prob
+      aux: load-balance auxiliary loss (scalar)
+    Tokens that overflow an expert's capacity are dropped for that
+    expert (their combine weight is 0 — the residual connection carries
+    them), exactly the Switch capacity semantics.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    S, E = gate_logits.shape
+    probs = jax.nn.softmax(gate_logits, axis=-1)            # (S, E)
+    topv, topi = jax.lax.top_k(probs, k)                    # (S, k)
+    topv = topv / jnp.clip(topv.sum(-1, keepdims=True), 1e-9)
+
+    # one-hot expert choice per (token, rank): (S, k, E)
+    choice = jax.nn.one_hot(topi, E, dtype=gate_logits.dtype)
+    # position of each (token, rank) within its expert's queue: number
+    # of earlier claims on the same expert. Flatten ranks in priority
+    # order (all rank-0 claims before rank-1) so top-1 picks never lose
+    # their slot to another token's top-2 pick.
+    flat = choice.transpose(1, 0, 2).reshape(k * S, E)      # (k*S, E)
+    pos_flat = jnp.cumsum(flat, axis=0) - flat              # claims before
+    pos = pos_flat.reshape(k, S, E).transpose(1, 0, 2)      # (S, k, E)
+    within = pos * choice                                    # claimed slot
+    keep = (pos < capacity) * choice                         # (S, k, E)
+    slot = jax.nn.one_hot(jnp.sum(within, -1).astype(jnp.int32),
+                          capacity, dtype=gate_logits.dtype)  # (S, k, C)
+    # (S, k, E) x (S, k, C) -> (S, E, C)
+    dispatch = jnp.einsum("ske,skc->sec", keep, slot)
+    combine = jnp.einsum("ske,skc->sec", keep * topv[..., None], slot)
+
+    aux = load_balance_loss(probs, choice[:, 0, :])
+    return dispatch, combine, aux
+
+
+def load_balance_loss(probs, top1_choice):
+    """Switch aux loss: E * dot(mean gate prob, mean top-1 assignment)."""
+    import jax.numpy as jnp
+    E = probs.shape[-1]
+    density = top1_choice.mean(0)          # fraction routed per expert
+    density_proxy = probs.mean(0)          # mean router prob per expert
+    return E * jnp.sum(density * density_proxy)
+
+
+def moe_ffn(x, gate_w, w1, w2, *, k=2, capacity_factor=1.25, mesh=None,
+            ep_axis="ep"):
+    """Top-k routed expert FFN.
+
+    x: (B, T, D) tokens; gate_w: (D, E); w1: (E, D, F); w2: (E, F, D)
+    with w1/w2 sharded P(ep_axis, ...). Returns (out (B,T,D), aux_loss).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    B, T, D = x.shape
+    E = gate_w.shape[-1]
+    S = B * T
+    capacity = max(1, int(math.ceil(k * S / E * capacity_factor)))
+
+    tokens = x.reshape(S, D)
+    dispatch, combine, aux = topk_route(tokens @ gate_w, k, capacity)
+
+    # gather tokens into per-expert buffers: (E, C, D) — a dense einsum,
+    # and the point where XLA inserts the dp<->ep all-to-all.
+    expert_in = jnp.einsum("sec,sd->ecd", dispatch, tokens)
+    if mesh is not None and ep_axis in mesh.axis_names:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        expert_in = jax.lax.with_sharding_constraint(
+            expert_in, NamedSharding(mesh, P(ep_axis, None, None)))
+    h = jnp.einsum("ecd,edf->ecf", expert_in, w1)
+    h = jax.nn.gelu(h)
+    expert_out = jnp.einsum("ecf,efd->ecd", h, w2)
+    out = jnp.einsum("sec,ecd->sd", combine, expert_out)
+    return out.reshape(B, T, D), aux

@@ -82,6 +82,34 @@ def test_indexing():
                         np.arange(24).reshape(2, 3, 4)[:, :, 1:3])
 
 
+def test_indexing_bool_scalar():
+    """x[True]/x[False] follow numpy 0-d-mask semantics (bool is an int
+    subclass — a bare bool must NOT be treated as a row index)."""
+    n = np.arange(6).reshape(2, 3)
+    a = mx.nd.array(n)
+    assert a[True].shape == n[True].shape == (1, 2, 3)
+    assert_almost_equal(a[True].asnumpy(), n[True])
+    assert a[False].shape == n[False].shape == (0, 2, 3)
+    assert a[np.bool_(True)].shape == (1, 2, 3)
+
+
+def test_indexing_int_shares_compiled_program():
+    """x[0], x[1], ... must share ONE compiled program: the integer is
+    an array input, not a baked attribute."""
+    from mxnet_tpu.ops import registry
+    a = mx.nd.array(np.arange(32).reshape(8, 4))
+    _ = a[0]
+    before = len(registry._jit_cache) if hasattr(registry, "_jit_cache") \
+        else None
+    for i in range(1, 8):
+        assert_almost_equal(a[i].asnumpy(), np.arange(32).reshape(8, 4)[i])
+        assert_almost_equal(a[-i].asnumpy(),
+                            np.arange(32).reshape(8, 4)[-i])
+    if before is not None:
+        assert len(registry._jit_cache) == before, \
+            "integer indexing recompiles per index value"
+
+
 def test_setitem():
     a = mx.nd.zeros((3, 3))
     a[1] = 1.0

@@ -1,0 +1,144 @@
+"""Pipeline parallelism + routed MoE + the driver's multichip dryrun.
+
+Pins the round-3 additions of SURVEY §5.7: a GPipe schedule over the
+``pp`` mesh axis and top-k routed MoE over ``ep``, plus the
+``dryrun_multichip`` driver artifact itself so it can never silently
+rot again (it shipped broken in rounds 1 and 2).
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from mxnet_tpu import parallel as par
+
+
+def _mesh(sizes):
+    return par.create_mesh(sizes, devices=jax.devices("cpu")[
+        :int(np.prod(list(sizes.values())))])
+
+
+class TestPipeline:
+    def test_matches_sequential(self):
+        mesh = _mesh({"pp": 4, "dp": 2})
+        n_stages, n_micro, mb, D = 4, 8, 2, 6
+        rng = np.random.RandomState(0)
+        w = jnp.asarray(rng.normal(0, 0.5, (n_stages, D, D)), jnp.float32)
+        b = jnp.asarray(rng.normal(0, 0.5, (n_stages, D)), jnp.float32)
+        x = jnp.asarray(rng.normal(0, 1, (n_micro, mb, D)), jnp.float32)
+
+        def stage(pz, h):
+            wz, bz = pz
+            return jnp.tanh(h @ wz + bz)
+
+        got = par.pipeline_apply(stage, (w, b), x, mesh=mesh, axis="pp",
+                                 mb_spec=P(None, "dp", None))
+        want = x
+        for s in range(n_stages):
+            want = jnp.tanh(want @ w[s] + b[s])
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_differentiable(self):
+        mesh = _mesh({"pp": 2})
+        n_stages, n_micro, mb, D = 2, 4, 2, 4
+        rng = np.random.RandomState(1)
+        w = jnp.asarray(rng.normal(0, 0.5, (n_stages, D, D)), jnp.float32)
+        x = jnp.asarray(rng.normal(0, 1, (n_micro, mb, D)), jnp.float32)
+
+        def stage(wz, h):
+            return jnp.tanh(h @ wz)
+
+        def loss(w):
+            return jnp.sum(par.pipeline_apply(stage, w, x, mesh=mesh,
+                                              axis="pp") ** 2)
+
+        def loss_seq(w):
+            h = x
+            for s in range(n_stages):
+                h = jnp.tanh(h @ w[s])
+            return jnp.sum(h ** 2)
+
+        g = jax.grad(loss)(w)
+        g_ref = jax.grad(loss_seq)(w)
+        np.testing.assert_allclose(np.asarray(g), np.asarray(g_ref),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_too_few_microbatches_raises(self):
+        mesh = _mesh({"pp": 4})
+        x = jnp.zeros((2, 2, 4))
+        w = jnp.zeros((4, 4, 4))
+        with pytest.raises(ValueError, match="n_micro"):
+            par.pipeline_apply(lambda w, h: h @ w, w, x, mesh=mesh,
+                               axis="pp")
+
+
+class TestMoE:
+    def test_topk_route_respects_capacity_and_renorm(self):
+        rng = np.random.RandomState(2)
+        S, E, k, C = 16, 4, 2, 3
+        logits = jnp.asarray(rng.normal(0, 1, (S, E)), jnp.float32)
+        dispatch, combine, aux = par.topk_route(logits, k, C)
+        assert dispatch.shape == (S, E, C)
+        d = np.asarray(dispatch)
+        # each (expert, slot) holds at most one token
+        assert d.sum(axis=0).max() <= 1.0 + 1e-6
+        # each token occupies at most k slots total
+        assert d.sum(axis=(1, 2)).max() <= k + 1e-6
+        # combine weights of an undropped token sum to ~1 (renormalised)
+        c = np.asarray(combine).sum(axis=(1, 2))
+        full = d.sum(axis=(1, 2)) == k
+        if full.any():
+            np.testing.assert_allclose(c[full], 1.0, atol=1e-5)
+        assert np.isfinite(float(aux))
+
+    def test_moe_ffn_matches_dense_gather(self):
+        """With capacity high enough to drop nothing, routed MoE equals
+        the explicit per-token top-k mixture computed in numpy."""
+        rng = np.random.RandomState(3)
+        B, T, D, F, E, k = 2, 4, 6, 8, 4, 2
+        x = jnp.asarray(rng.normal(0, 1, (B, T, D)), jnp.float32)
+        gw = jnp.asarray(rng.normal(0, 1, (D, E)), jnp.float32)
+        w1 = jnp.asarray(rng.normal(0, 0.5, (E, D, F)), jnp.float32)
+        w2 = jnp.asarray(rng.normal(0, 0.5, (E, F, D)), jnp.float32)
+        out, aux = par.moe_ffn(x, gw, w1, w2, k=k, capacity_factor=float(E))
+
+        toks = np.asarray(x).reshape(-1, D)
+        probs = np.asarray(jax.nn.softmax(toks @ np.asarray(gw), axis=-1))
+        want = np.zeros_like(toks)
+        for s in range(toks.shape[0]):
+            top = np.argsort(-probs[s])[:k]
+            wts = probs[s][top] / probs[s][top].sum()
+            for e, wt in zip(top, wts):
+                h = np.asarray(jax.nn.gelu(toks[s] @ np.asarray(w1[e])))
+                want[s] += wt * (h @ np.asarray(w2[e]))
+        np.testing.assert_allclose(np.asarray(out).reshape(-1, D), want,
+                                   rtol=1e-4, atol=1e-4)
+
+    def test_moe_grads_flow(self):
+        rng = np.random.RandomState(4)
+        B, T, D, F, E = 2, 2, 4, 4, 2
+        x = jnp.asarray(rng.normal(0, 1, (B, T, D)), jnp.float32)
+        params = dict(
+            gw=jnp.asarray(rng.normal(0, 1, (D, E)), jnp.float32),
+            w1=jnp.asarray(rng.normal(0, 0.5, (E, D, F)), jnp.float32),
+            w2=jnp.asarray(rng.normal(0, 0.5, (E, F, D)), jnp.float32))
+
+        def loss(p):
+            out, aux = par.moe_ffn(x, p["gw"], p["w1"], p["w2"], k=1)
+            return jnp.sum(out ** 2) + 0.01 * aux
+
+        g = jax.grad(loss)(params)
+        for name in ("gw", "w1", "w2"):
+            assert float(jnp.abs(g[name]).sum()) > 0, name
+
+
+class TestDryrunMultichip:
+    def test_dryrun_8(self, capsys):
+        import sys
+        sys.path.insert(0, ".")
+        from __graft_entry__ import dryrun_multichip
+        dryrun_multichip(8)
+        assert "dryrun_multichip OK" in capsys.readouterr().out
