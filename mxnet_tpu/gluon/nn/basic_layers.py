@@ -167,6 +167,17 @@ class Embedding(HybridBlock):
             grad_stype='row_sparse' if sparse_grad else 'default')
 
     def hybrid_forward(self, F, x, weight):
+        if self._kwargs['sparse_grad']:
+            # stash the looked-up rows so Trainer can build the
+            # row_sparse gradient from the true touched-row ids instead
+            # of scanning the dense grad for non-zero rows (which both
+            # syncs the host every step and drops touched rows whose
+            # gradient is exactly zero) — the reference gets these ids
+            # from its sparse embedding kernel's rsp grad output
+            from ...ndarray import NDArray
+            from ... import autograd
+            if isinstance(x, NDArray) and autograd.is_recording():
+                self.weight._sparse_row_ids = x
         return F.Embedding(x, weight, name='fwd', **self._kwargs)
 
     def __repr__(self):

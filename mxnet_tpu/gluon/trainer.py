@@ -157,6 +157,20 @@ class Trainer:
         self._check_and_rescale_grad(self._scale / batch_size)
         self._update(ignore_stale_grad)
 
+    @staticmethod
+    def _to_row_sparse(param, grad):
+        ids = getattr(param, '_sparse_row_ids', None)
+        if ids is None:
+            return grad.tostype('row_sparse')
+        import numpy as _np
+        from ..ndarray.sparse import RowSparseNDArray
+        param._sparse_row_ids = None
+        rows = _np.unique(ids.asnumpy().astype(_np.int64).ravel())
+        from ..ndarray import array as _nd_array
+        rows_nd = _nd_array(rows, ctx=grad.context, dtype='int64')
+        return RowSparseNDArray(grad.take(rows_nd), rows_nd, grad.shape,
+                                ctx=grad.context)
+
     def _update(self, ignore_stale_grad=False):
         import warnings
         updater = self._updaters[0]
@@ -187,11 +201,12 @@ class Trainer:
             grad = param.grad()
             if param._grad_stype == 'row_sparse':
                 # sparse_grad params (Embedding, SparseEmbedding): the
-                # backward produced a dense grad whose untouched rows
-                # are exactly zero; recast to row_sparse so the
-                # optimizer takes its lazy row path (reference gets the
-                # rsp grad directly from the sparse embedding kernel)
-                grad = grad.tostype('row_sparse')
+                # backward produced a dense grad; build the row_sparse
+                # view from the row ids the forward recorded (true
+                # touched rows — keeps rows whose grad is exactly zero
+                # and avoids scanning the dense grad), falling back to
+                # a non-zero-row scan when no ids were stashed
+                grad = self._to_row_sparse(param, grad)
             updater(i, grad, param.data())
             param._data._fresh_grad = False
         if self._kvstore is not None and self._update_on_kvstore:

@@ -226,6 +226,34 @@ def test_embedding_sparse_grad_lazy_rows():
     assert np.abs(w_after[touched] - w_before[touched]).sum() > 0
 
 
+def test_embedding_touched_zero_grad_row_still_updates():
+    """A touched row whose gradient is exactly zero must still take its
+    lazy momentum step: the trainer derives row ids from the recorded
+    embedding indices, not from a non-zero scan of the dense grad."""
+    from mxnet_tpu import gluon, autograd
+    from mxnet_tpu.gluon import nn
+    net = nn.Embedding(10, 3, sparse_grad=True)
+    net.initialize(mx.init.Xavier())
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.5, "momentum": 0.9})
+    # step 1: touch rows 2 and 4 with a real gradient to build momentum
+    with autograd.record():
+        loss = net(mx.nd.array([2, 4])).sum()
+    loss.backward()
+    trainer.step(1)
+    w1 = net.weight.data().asnumpy().copy()
+    # step 2: touch row 2 only, with zero upstream gradient — momentum
+    # must still move row 2 (lazy update applies to touched rows)
+    with autograd.record():
+        loss = (net(mx.nd.array([2])) * 0.0).sum()
+    loss.backward()
+    trainer.step(1)
+    w2 = net.weight.data().asnumpy()
+    assert np.abs(w2[2] - w1[2]).sum() > 0, \
+        "touched row with zero grad missed its momentum update"
+    np.testing.assert_array_equal(w2[4], w1[4])  # untouched: frozen
+
+
 def test_libsvm_iter_yields_csr(tmp_path):
     f = tmp_path / "data.libsvm"
     f.write_text("1 0:1.5 3:2.0\n0 1:1.0\n1 2:0.5 3:1.0\n0 0:2.0\n")
