@@ -54,8 +54,10 @@ from . import visualization
 from . import visualization as viz
 from . import profiler
 from . import model
+from . import rnn
 from .model import save_checkpoint, load_checkpoint
 from . import module
+from . import module as mod
 from .module import Module
 from . import image
 from . import gluon

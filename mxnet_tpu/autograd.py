@@ -248,22 +248,32 @@ def _get_backward_fn(struct, instrs, head_refs):
     return fn
 
 
-def _do_backward(heads, head_grads):
-    import jax.numpy as jnp
-    heads = list(heads)
+def _prepare_program(heads):
+    """Collect + linearize the tape under ``heads`` (shared by the
+    first-order and create_graph backward paths)."""
     nodes = _collect_graph(heads)
     if not nodes and all(h._tape_node is None for h in heads):
         raise MXNetError("cannot call backward: no ops were recorded "
                          "(use autograd.record())")
+    return _build_program(heads, nodes)
+
+
+def _cotangents(heads, head_grads):
+    """Raw jax cotangent buffers, defaulting to ones per head."""
+    import jax.numpy as jnp
+    if head_grads is None:
+        return [jnp.ones(h.shape, h._data.dtype) for h in heads]
+    return [jnp.ones(h.shape, h._data.dtype) if g is None else g._data
+            for h, g in zip(heads, head_grads)]
+
+
+def _do_backward(heads, head_grads):
+    heads = list(heads)
     instrs, struct, head_refs, leaves, consts, rngs = \
-        _build_program(heads, nodes)
+        _prepare_program(heads)
     if not leaves:
         return [], []
-    if head_grads is None:
-        cots = [jnp.ones(h.shape, h._data.dtype) for h in heads]
-    else:
-        cots = [jnp.ones(h.shape, h._data.dtype) if g is None else g._data
-                for h, g in zip(heads, head_grads)]
+    cots = _cotangents(heads, head_grads)
     fn = _get_backward_fn(struct, instrs, head_refs)
     _, grads = fn(tuple(l._data for l in leaves), tuple(consts),
                   tuple(rngs), tuple(cots))
@@ -284,14 +294,67 @@ def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
         leaf._fresh_grad = True
 
 
+_hgrad_cache: Dict[Tuple, Any] = {}
+_hgrad_counter = [0]
+
+
+def _backward_as_op(heads, head_grads):
+    """Differentiate ``heads`` w.r.t. the tape leaves by invoking the
+    whole vjp program as ONE recorded op — so the returned gradients
+    are themselves on the tape and a second ``backward``/``grad`` runs
+    ``jax.vjp`` over this op's forward, i.e. true higher-order autograd
+    (reference: create_graph=True, python/mxnet/autograd.py:270).
+    Returns (leaves, grad_NDArrays)."""
+    import jax
+    from .ndarray.ndarray import NDArray, invoke_nd
+    from .ops.registry import OpDef
+
+    heads = list(heads)
+    instrs, struct, head_refs, leaves, consts, rngs = \
+        _prepare_program(heads)
+    if not leaves:
+        return [], []
+    n_l, n_c, n_r = len(leaves), len(consts), len(rngs)
+    key = (struct, head_refs)
+    opdef = _hgrad_cache.get(key)
+    if opdef is None:
+        def grad_fwd(attrs, *vals):
+            lv = vals[:n_l]
+            cv = list(vals[n_l:n_l + n_c])
+            rv = list(vals[n_l + n_c:n_l + n_c + n_r])
+            cots = vals[n_l + n_c + n_r:]
+
+            def f(lv_):
+                return _run_program(instrs, head_refs, list(lv_), cv, rv)
+
+            _, vjp_fn = jax.vjp(f, tuple(lv))
+            grads, = vjp_fn(tuple(cots))
+            return tuple(grads)
+
+        _hgrad_counter[0] += 1
+        opdef = OpDef("_backward_program%d" % _hgrad_counter[0], grad_fwd,
+                      arg_names=tuple("in%d" % i
+                                      for i in range(n_l + n_c + n_r
+                                                     + len(heads))),
+                      num_outputs=n_l)
+        with _bwd_cache_lock:
+            _hgrad_cache[key] = opdef
+
+    cots = [NDArray(c, ctx=heads[0]._ctx)
+            for c in _cotangents(heads, head_grads)]
+    const_nds = [NDArray(c, ctx=heads[0]._ctx) for c in consts]
+    rng_nds = [NDArray(r, ctx=heads[0]._ctx) for r in rngs]
+    out = invoke_nd(opdef, list(leaves) + const_nds + rng_nds + cots, {})
+    grads = out if isinstance(out, (list, tuple)) else [out]
+    return leaves, list(grads)
+
+
 def grad(heads, variables, head_grads=None, retain_graph=None,
          create_graph=False, train_mode=True):
-    """Return gradients of heads w.r.t. variables
-    (reference: autograd.py:270)."""
+    """Return gradients of heads w.r.t. variables; with
+    ``create_graph=True`` the results stay on the tape for higher-order
+    differentiation (reference: autograd.py:270)."""
     from .ndarray.ndarray import NDArray
-    if create_graph:
-        raise MXNetError("create_graph=True (higher-order imperative grad) "
-                         "is not supported yet; use symbolic grad instead")
     if isinstance(heads, NDArray):
         heads = [heads]
     if isinstance(variables, NDArray):
@@ -304,19 +367,20 @@ def grad(heads, variables, head_grads=None, retain_graph=None,
     for v in variables:
         if v._grad_req == "null":
             v._grad_req = "write"
-    try:
-        leaves, grads = _do_backward(
-            heads, [head_grads] if isinstance(head_grads, NDArray)
-            else head_grads)
-    finally:
-        pass
-    gmap = {id(l): g for l, g in zip(leaves, grads)}
+    hg = [head_grads] if isinstance(head_grads, NDArray) else head_grads
+    if create_graph:
+        leaves, grad_nds = _backward_as_op(heads, hg)
+        gmap = {id(l): g for l, g in zip(leaves, grad_nds)}
+    else:
+        leaves, grads = _do_backward(heads, hg)
+        gmap = {id(l): NDArray(g, ctx=l._ctx)
+                for l, g in zip(leaves, grads)}
     out = []
     for v, pr in zip(variables, prev):
         if id(v) not in gmap:
             raise MXNetError("one of the variables does not participate in "
                              "the computation of heads")
-        out.append(NDArray(gmap[id(v)], ctx=v._ctx))
+        out.append(gmap[id(v)])
         v._grad_req = pr[0]
     return out[0] if single else out
 

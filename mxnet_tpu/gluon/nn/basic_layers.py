@@ -177,7 +177,11 @@ class Embedding(HybridBlock):
             from ...ndarray import NDArray
             from ... import autograd
             if isinstance(x, NDArray) and autograd.is_recording():
-                self.weight._sparse_row_ids = x
+                # accumulate (don't overwrite): several forwards of a
+                # shared weight before one step must union their rows
+                ids = getattr(self.weight, '_sparse_row_ids', None) or []
+                ids.append(x)
+                self.weight._sparse_row_ids = ids
         return F.Embedding(x, weight, name='fwd', **self._kwargs)
 
     def __repr__(self):

@@ -165,7 +165,8 @@ class Trainer:
         import numpy as _np
         from ..ndarray.sparse import RowSparseNDArray
         param._sparse_row_ids = None
-        rows = _np.unique(ids.asnumpy().astype(_np.int64).ravel())
+        rows = _np.unique(_np.concatenate(
+            [i.asnumpy().astype(_np.int64).ravel() for i in ids]))
         from ..ndarray import array as _nd_array
         rows_nd = _nd_array(rows, ctx=grad.context, dtype='int64')
         return RowSparseNDArray(grad.take(rows_nd), rows_nd, grad.shape,

@@ -45,3 +45,5 @@ def dot(lhs, rhs, transpose_a=False, transpose_b=False, out=None,
 
 
 op.dot = dot
+
+from . import contrib  # noqa: F401  (foreach/while_loop/cond)

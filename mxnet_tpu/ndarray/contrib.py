@@ -1,0 +1,111 @@
+"""Imperative control flow (reference: python/mxnet/ndarray/contrib.py
+foreach/while_loop/cond).
+
+Eager semantics: the loop runs on the host, each iteration's ops are
+recorded on the autograd tape, so gradients flow with no extra
+machinery (the reference builds a subgraph op even eagerly; we match
+its *semantics* — for the compiled/XLA-native path use the symbolic
+`sym.contrib.foreach` & co., or hybridize, which lower to one
+``lax.scan``).
+
+Divergence (documented): ``while_loop`` zero-fills the rows of the
+stacked outputs beyond the executed step count; the reference leaves
+them undefined.
+"""
+from __future__ import annotations
+
+from ..base import MXNetError
+
+__all__ = ["foreach", "while_loop", "cond"]
+
+
+def _as_list(x):
+    if x is None:
+        return [], True
+    if isinstance(x, (list, tuple)):
+        return list(x), False
+    return [x], True
+
+
+def foreach(body, data, init_states):
+    """Run ``body`` over dim 0 of ``data``; body(data_item, states) ->
+    (outputs, new_states). Returns (stacked_outputs, final_states)."""
+    from . import stack as _stack
+    data_list, data_single = _as_list(data)
+    states, states_single = _as_list(init_states)
+    if not data_list:
+        raise MXNetError("foreach needs at least one data input")
+    length = data_list[0].shape[0]
+    for d in data_list[1:]:
+        if d.shape[0] != length:
+            raise MXNetError("foreach data inputs disagree on dim 0")
+
+    collected = None
+    outs_single = True
+    for i in range(length):
+        eles = [d[i] for d in data_list]
+        outs, states = body(eles[0] if data_single else eles,
+                            states[0] if states_single else list(states))
+        outs, outs_single = _as_list(outs)
+        states, _ = _as_list(states)
+        if collected is None:
+            collected = [[] for _ in outs]
+        for slot, o in zip(collected, outs):
+            slot.append(o)
+    stacked = [_stack(*slot, axis=0) for slot in (collected or [])]
+    return (stacked[0] if outs_single and stacked else stacked,
+            states[0] if states_single else states)
+
+
+def while_loop(cond, func, loop_vars, max_iterations=None):
+    """Run ``func`` while ``cond`` holds, at most ``max_iterations``
+    times; cond(*loop_vars) -> scalar, func(*loop_vars) -> (outputs,
+    new_loop_vars). Stacked outputs have max_iterations rows (tail
+    zero-filled); also returns the final loop_vars."""
+    from . import stack as _stack, zeros_like as _zeros_like
+    loop_vars, single_var = _as_list(loop_vars)
+    if max_iterations is None:
+        raise MXNetError("while_loop requires max_iterations")
+    if not loop_vars:
+        raise MXNetError("while_loop requires at least one loop var")
+
+    collected = None
+    outs_single = True
+    steps = 0
+    while steps < int(max_iterations) and \
+            bool(cond(*loop_vars).asnumpy().reshape(())):
+        step = func(*loop_vars)
+        if not (isinstance(step, tuple) and len(step) == 2):
+            raise MXNetError(
+                "while_loop func must return (outputs, new_loop_vars)")
+        outs, new_vars = step
+        outs, outs_single = _as_list(outs)
+        new_vars, _ = _as_list(new_vars)
+        if len(new_vars) != len(loop_vars):
+            raise MXNetError(
+                "while_loop func returned %d loop_vars, expected %d"
+                % (len(new_vars), len(loop_vars)))
+        loop_vars = new_vars
+        if collected is None:
+            collected = [[] for _ in outs]
+        for slot, o in zip(collected, outs):
+            slot.append(o)
+        steps += 1
+
+    if collected is None:
+        raise MXNetError(
+            "while_loop executed zero steps; cannot infer output shapes "
+            "(the reference raises here too)")
+    stacked = []
+    for slot in collected:
+        pad = [_zeros_like(slot[0])] * (int(max_iterations) - len(slot))
+        stacked.append(_stack(*(slot + pad), axis=0))
+    return (stacked[0] if outs_single else stacked,
+            loop_vars[0] if single_var else loop_vars)
+
+
+def cond(pred, then_func, else_func):
+    """Run one branch based on scalar ``pred`` (an NDArray); the branch
+    functions take no arguments (they close over outer NDArrays)."""
+    taken = bool(pred.asnumpy().reshape(()))
+    return then_func() if taken else else_func()

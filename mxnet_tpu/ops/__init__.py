@@ -18,3 +18,4 @@ from . import nn            # noqa: F401
 from . import optimizer_ops  # noqa: F401
 from . import linalg        # noqa: F401
 from . import rnn_op        # noqa: F401
+from . import control_flow  # noqa: F401

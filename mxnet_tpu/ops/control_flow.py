@@ -1,0 +1,223 @@
+"""Control-flow operators (reference: src/operator/control_flow.cc
+`_foreach` :1255, `_while_loop` :1316, `_cond` :1378).
+
+TPU-native design: the reference implements these as stateful subgraph
+ops interpreted node-by-node by the executor. Here the subgraph is a
+pure JAX function (built once via ``build_graph_callable``) carried in
+the op attrs, and the op forward lowers straight to
+``lax.scan`` / masked scan / ``lax.cond`` — so a loop inside a
+hybridized block or bound executor is ONE fused XLA while/scan, not an
+unrolled graph or a host loop.
+
+Divergence (documented): ``_while_loop`` lowers to a *masked* scan of
+``max_iterations`` steps rather than ``lax.while_loop``, because the
+masked form is reverse-differentiable and maps to a static MXU-friendly
+schedule; iterations after the predicate fails are computed and masked
+out. Results match the reference (undefined tail rows are zero here).
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from ..base import MXNetError
+from .registry import register
+
+__all__ = ["Subgraph"]
+
+
+class Subgraph:
+    """A traced Symbol subgraph as a pure function, usable as a hashable
+    op attribute.
+
+    ``layout`` maps each subgraph argument (in ``list_arguments`` order)
+    to where its value comes from at each invocation:
+    ``("data", i)`` — i-th scanned input slice, ``("state", i)`` — i-th
+    loop state, ``("free", i)`` — i-th closed-over (free) input.
+    """
+
+    def __init__(self, sym, layout: Sequence[Tuple[str, int]]):
+        from ..cached_op import build_graph_callable
+        fn, arg_names, aux_names, n_rng, n_out = build_graph_callable(sym)
+        if aux_names:
+            raise MXNetError(
+                "control-flow subgraphs cannot carry mutable auxiliary "
+                "states (got %s); hoist the stateful op out of the loop"
+                % (aux_names,))
+        self.sym = sym
+        self.fn = fn
+        self.arg_names = arg_names
+        self.layout = list(layout)
+        self.n_rng = n_rng
+        self.n_out = n_out
+        if len(self.layout) != len(arg_names):
+            raise MXNetError(
+                "subgraph layout covers %d args but the traced graph has "
+                "%d (%s)" % (len(self.layout), len(arg_names), arg_names))
+
+    def bind_vals(self, data, states, free):
+        pools = {"data": data, "state": states, "free": free}
+        return [pools[kind][i] for kind, i in self.layout]
+
+    def __call__(self, data, states, free, rng=None):
+        outs = self.fn({}, *self.bind_vals(data, states, free), rng=rng)
+        return outs[:self.n_out]
+
+    # identity hashing: the eager jit cache and the tape key on this
+    def __hash__(self):
+        return id(self)
+
+    def __eq__(self, other):
+        return self is other
+
+    # -- JSON round-trip (Symbol.save/load of control-flow graphs) ------
+    def to_json_attr(self) -> str:
+        import json
+        return "__subgraph__:" + json.dumps(
+            {"symbol": json.loads(self.sym.tojson()),
+             "layout": self.layout})
+
+    @staticmethod
+    def from_json_attr(s: str) -> "Subgraph":
+        import json
+        from ..symbol import symbol as _sym
+        payload = json.loads(s[len("__subgraph__:"):])
+        sym = _sym.load_json(json.dumps(payload["symbol"]))
+        layout = [(k, i) for k, i in payload["layout"]]
+        return Subgraph(sym, layout)
+
+
+def _split_rng(rng, n):
+    import jax
+    if rng is None:
+        return None
+    return jax.random.split(rng, n)
+
+
+def _sub_rng(keys, idx):
+    return None if keys is None else keys[idx]
+
+
+# ---------------------------------------------------------------------------
+# _foreach  ≙  lax.scan
+# ---------------------------------------------------------------------------
+
+def _foreach_impl(attrs, *inputs, rng=None):
+    import jax
+    sub: Subgraph = attrs["subgraph"]
+    n_data = attrs["num_data"]
+    n_state = attrs["num_states"]
+    n_out_data = attrs["num_out_data"]
+    data = inputs[:n_data]
+    init = inputs[n_data:n_data + n_state]
+    free = inputs[n_data + n_state:]
+    length = data[0].shape[0] if n_data else 0
+
+    keys = _split_rng(rng, max(length, 1)) if sub.n_rng else None
+
+    def step(carry, xs):
+        states, i = carry
+        k = None if keys is None else keys[i]
+        outs = sub(list(xs), list(states), list(free), rng=k)
+        return (tuple(outs[n_out_data:]), i + 1), tuple(outs[:n_out_data])
+
+    (final, _), ys = jax.lax.scan(step, (tuple(init), 0), tuple(data))
+    return tuple(ys) + tuple(final)
+
+
+register("_foreach", _foreach_impl, arg_names=("data",),
+         defaults={"subgraph": None, "num_data": 1, "num_states": 0,
+                   "num_out_data": 1, "num_free": 0},
+         num_outputs=lambda a: a["num_out_data"] + a["num_states"],
+         key_var_num_args="__num_args__", needs_rng=True)
+
+
+# ---------------------------------------------------------------------------
+# _while_loop  ≙  masked scan of max_iterations steps (differentiable)
+# ---------------------------------------------------------------------------
+
+def _while_loop_impl(attrs, *inputs, rng=None):
+    import jax
+    import jax.numpy as jnp
+    cond_sub: Subgraph = attrs["cond_subgraph"]
+    body_sub: Subgraph = attrs["body_subgraph"]
+    n_state = attrs["num_states"]
+    n_out_data = attrs["num_out_data"]
+    max_iter = attrs["max_iterations"]
+    if max_iter is None or int(max_iter) <= 0:
+        raise MXNetError("_while_loop requires a positive max_iterations")
+    max_iter = int(max_iter)
+    n_cf = attrs["num_free_cond"]
+    states = inputs[:n_state]
+    cond_free = inputs[n_state:n_state + n_cf]
+    body_free = inputs[n_state + n_cf:]
+
+    keys = _split_rng(rng, max_iter) if body_sub.n_rng else None
+
+    def step(carry, i):
+        states, active = carry
+        c = cond_sub([], list(states), list(cond_free))[0]
+        active = jnp.logical_and(active, jnp.reshape(c, ()).astype(bool))
+        k = _sub_rng(keys, i)
+        outs = body_sub([], list(states), list(body_free), rng=k)
+        step_outs = [jnp.where(active, o, jnp.zeros_like(o))
+                     for o in outs[:n_out_data]]
+        new_states = tuple(
+            jnp.where(active, n, s)
+            for n, s in zip(outs[n_out_data:], states))
+        return (new_states, active), tuple(step_outs)
+
+    init = (tuple(states), jnp.asarray(True))
+    (final, _), ys = jax.lax.scan(step, init, jnp.arange(max_iter))
+    return tuple(ys) + tuple(final)
+
+
+register("_while_loop", _while_loop_impl, arg_names=("data",),
+         defaults={"cond_subgraph": None, "body_subgraph": None,
+                   "num_states": 1, "num_out_data": 0,
+                   "max_iterations": None, "num_free_cond": 0,
+                   "num_free_body": 0},
+         num_outputs=lambda a: a["num_out_data"] + a["num_states"],
+         key_var_num_args="__num_args__", needs_rng=True)
+
+
+# ---------------------------------------------------------------------------
+# _cond  ≙  lax.cond
+# ---------------------------------------------------------------------------
+
+def _cond_impl(attrs, *inputs, rng=None):
+    import jax
+    import jax.numpy as jnp
+    pred_sub: Subgraph = attrs["cond_subgraph"]
+    then_sub: Subgraph = attrs["then_subgraph"]
+    else_sub: Subgraph = attrs["else_subgraph"]
+    n_state = attrs["num_states"]       # shared branch inputs
+    n_pf = attrs["num_free_cond"]
+    n_tf = attrs["num_free_then"]
+    states = inputs[:n_state]
+    pred_free = inputs[n_state:n_state + n_pf]
+    then_free = inputs[n_state + n_pf:n_state + n_pf + n_tf]
+    else_free = inputs[n_state + n_pf + n_tf:]
+
+    keys = _split_rng(rng, 2) if (then_sub.n_rng or else_sub.n_rng) \
+        else None
+    pred = pred_sub([], list(states), list(pred_free))[0]
+    pred = jnp.reshape(pred, ()).astype(bool)
+
+    def then_fn(_):
+        return tuple(then_sub([], list(states), list(then_free),
+                              rng=_sub_rng(keys, 0)))
+
+    def else_fn(_):
+        return tuple(else_sub([], list(states), list(else_free),
+                              rng=_sub_rng(keys, 1)))
+
+    return jax.lax.cond(pred, then_fn, else_fn, operand=None)
+
+
+register("_cond", _cond_impl, arg_names=("data",),
+         defaults={"cond_subgraph": None, "then_subgraph": None,
+                   "else_subgraph": None, "num_states": 1,
+                   "num_free_cond": 0, "num_free_then": 0,
+                   "num_free_else": 0, "num_outputs_": 1},
+         num_outputs=lambda a: a["num_outputs_"],
+         key_var_num_args="__num_args__", needs_rng=True)

@@ -141,6 +141,9 @@ def _parse_attr_value(v):
         return _BOOL_STR[v]
     if v == "None":
         return None
+    if v.startswith("__subgraph__:"):
+        from .control_flow import Subgraph
+        return Subgraph.from_json_attr(v)
     try:
         return ast.literal_eval(v)
     except (ValueError, SyntaxError):

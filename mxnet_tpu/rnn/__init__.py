@@ -1,0 +1,13 @@
+"""Symbolic RNN package (reference: python/mxnet/rnn/).
+
+Cells compose Symbols for use with the Module API — most importantly
+``BucketingModule`` for variable-length sequence training (BASELINE
+config 3: LSTM on PTB). The Gluon-side cells live in
+``mxnet_tpu.gluon.rnn``; this package is their symbolic twin with the
+reference's parameter naming so checkpoints interoperate.
+"""
+from .rnn_cell import (RNNParams, BaseRNNCell, RNNCell, LSTMCell, GRUCell,
+                       FusedRNNCell, SequentialRNNCell, BidirectionalCell,
+                       DropoutCell, ModifierCell, ResidualCell,
+                       ZoneoutCell)
+from .io import BucketSentenceIter, encode_sentences

@@ -1,0 +1,138 @@
+"""Bucketed sequence iterators (reference: python/mxnet/rnn/io.py).
+
+``BucketSentenceIter`` feeds ``BucketingModule``: sentences are grouped
+into the smallest bucket that fits, padded to the bucket length, and
+each batch carries its ``bucket_key`` so the module switches to (or
+compiles once) the executor for that length — the strategy that bounds
+XLA recompiles for variable-length data (SURVEY §2.2 bucketing row).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..base import MXNetError
+from ..io.io import DataBatch, DataDesc, DataIter
+from ..ndarray import array as _nd_array
+
+__all__ = ["BucketSentenceIter", "encode_sentences"]
+
+
+def encode_sentences(sentences, vocab=None, invalid_label=-1,
+                     invalid_key="\n", start_label=0):
+    """Map tokenised sentences to integer ids, building the vocab as
+    needed (reference: rnn/io.py encode_sentences)."""
+    idx = start_label
+    if vocab is None:
+        vocab = {invalid_key: invalid_label}
+        new_vocab = True
+    else:
+        new_vocab = False
+    res = []
+    for sent in sentences:
+        coded = []
+        for word in sent:
+            if word not in vocab:
+                if not new_vocab:
+                    raise MXNetError("word %s not in provided vocab" % word)
+                if idx == invalid_label:
+                    idx += 1
+                vocab[word] = idx
+                idx += 1
+            coded.append(vocab[word])
+        res.append(coded)
+    return res, vocab
+
+
+class BucketSentenceIter(DataIter):
+    """Iterate encoded sentences in length buckets.
+
+    Labels are the data shifted one step left (next-token prediction),
+    padded with ``invalid_label`` — the PTB language-model contract.
+    """
+
+    def __init__(self, sentences, batch_size, buckets=None,
+                 invalid_label=-1, data_name="data",
+                 label_name="softmax_label", dtype="float32", layout="NT"):
+        super().__init__(batch_size=batch_size)
+        if not buckets:
+            lens = np.bincount([len(s) for s in sentences])
+            buckets = [i for i, n in enumerate(lens)
+                       if n >= batch_size and i > 0]
+        buckets = sorted(buckets)
+        if not buckets:
+            raise MXNetError("no usable buckets for the given sentences")
+
+        self.data_name = data_name
+        self.label_name = label_name
+        self.dtype = dtype
+        self.layout = layout
+        self.invalid_label = invalid_label
+        self.buckets = buckets
+        self.default_bucket_key = max(buckets)
+
+        # place each sentence in the smallest bucket that fits
+        self.data = [[] for _ in buckets]
+        ndiscard = 0
+        for sent in sentences:
+            pos = np.searchsorted(buckets, len(sent))
+            if pos >= len(buckets):
+                ndiscard += 1
+                continue
+            pad = np.full((buckets[pos],), invalid_label, dtype=dtype)
+            pad[:len(sent)] = sent
+            self.data[pos].append(pad)
+        self.data = [np.asarray(x, dtype=dtype) for x in self.data]
+        if ndiscard:
+            import logging
+            logging.warning("BucketSentenceIter discarded %d sentences "
+                            "longer than the largest bucket", ndiscard)
+
+        self.batch_axis = layout.find("N")
+        shape = (batch_size, self.default_bucket_key) \
+            if self.batch_axis == 0 else (self.default_bucket_key,
+                                          batch_size)
+        self.provide_data = [DataDesc(data_name, shape, layout=layout)]
+        self.provide_label = [DataDesc(label_name, shape, layout=layout)]
+        self.idx = []
+        for i, buck in enumerate(self.data):
+            self.idx.extend((i, j) for j in
+                            range(0, len(buck) - batch_size + 1,
+                                  batch_size))
+        self.curr_idx = 0
+        self.reset()
+
+    def reset(self):
+        self.curr_idx = 0
+        np.random.shuffle(self.idx)
+        for buck in self.data:
+            np.random.shuffle(buck)
+        # labels: next token; last position gets invalid_label
+        self.nddata = []
+        self.ndlabel = []
+        for buck in self.data:
+            label = np.full_like(buck, self.invalid_label)
+            if buck.shape[1] > 1:
+                label[:, :-1] = buck[:, 1:]
+            self.nddata.append(_nd_array(buck, dtype=self.dtype))
+            self.ndlabel.append(_nd_array(label, dtype=self.dtype))
+
+    def next(self):
+        if self.curr_idx == len(self.idx):
+            raise StopIteration
+        i, j = self.idx[self.curr_idx]
+        self.curr_idx += 1
+        bs = self.batch_size
+        if self.batch_axis == 0:
+            data = self.nddata[i][j:j + bs]
+            label = self.ndlabel[i][j:j + bs]
+        else:
+            data = self.nddata[i][j:j + bs].T
+            label = self.ndlabel[i][j:j + bs].T
+        L = self.buckets[i]
+        shape = (bs, L) if self.batch_axis == 0 else (L, bs)
+        return DataBatch(
+            [data], [label], pad=0, bucket_key=L,
+            provide_data=[DataDesc(self.data_name, shape,
+                                   layout=self.layout)],
+            provide_label=[DataDesc(self.label_name, shape,
+                                    layout=self.layout)])

@@ -10,3 +10,5 @@ import types as _types
 
 op = _types.ModuleType(__name__ + ".op")
 _install_ops(op.__dict__)
+
+from . import contrib  # noqa: F401  (foreach/while_loop/cond)

@@ -382,7 +382,8 @@ class Symbol:
                 "name": n.name,
                 "inputs": [[nid[id(s)], idx, 0] for (s, idx) in n.inputs],
             }
-            attrs = {k: str(v) for k, v in n.attrs.items()}
+            attrs = {k: (v.to_json_attr() if hasattr(v, "to_json_attr")
+                         else str(v)) for k, v in n.attrs.items()}
             attrs.update(n._extra_attrs)
             if attrs:
                 jn["attrs"] = attrs

@@ -209,3 +209,53 @@ def test_grad_through_chained_index():
     g = emb.grad.asnumpy()
     assert abs(g.sum() - 4.0) < 1e-5
     assert g[:, 1:].sum() == 0.0
+
+
+# -- higher-order gradients (create_graph=True; reference autograd.py:270,
+#    tests/python/unittest/test_autograd.py test_grad_with_stype etc.) ----
+
+def test_second_order_grad():
+    x = mx.nd.array([1.0, 2.0, 3.0])
+    x.attach_grad()
+    with ag.record():
+        y = x * x * x                              # x^3
+        dy = ag.grad(y, x, create_graph=True)      # 3x^2, on the tape
+        z = (dy * dy).sum()                        # 9x^4
+    z.backward()                                   # 36x^3
+    want = 36.0 * np.array([1.0, 2.0, 3.0]) ** 3
+    assert np.allclose(x.grad.asnumpy(), want)
+
+
+def test_third_order_grad():
+    x = mx.nd.array([2.0])
+    x.attach_grad()
+    with ag.record():
+        y = x ** 4
+        g1 = ag.grad(y, x, create_graph=True)      # 4x^3
+        g2 = ag.grad(g1, x, create_graph=True)     # 12x^2
+    g2.backward()                                  # 24x
+    assert np.allclose(x.grad.asnumpy(), [48.0])
+
+
+def test_second_order_through_exp():
+    x = mx.nd.array([0.5, 1.5])
+    x.attach_grad()
+    with ag.record():
+        y = mx.nd.exp(2.0 * x).sum()
+        g = ag.grad(y, x, create_graph=True)       # 2 e^{2x}
+        z = g.sum()
+    z.backward()                                   # 4 e^{2x}
+    want = 4.0 * np.exp(2.0 * np.array([0.5, 1.5]))
+    assert np.allclose(x.grad.asnumpy(), want, rtol=1e-5)
+
+
+def test_first_order_create_graph_matches_plain():
+    x = mx.nd.array(np.random.RandomState(3).randn(4).astype(np.float32))
+    x.attach_grad()
+    with ag.record():
+        y = (x * x).sum()
+        g_graph = ag.grad(y, x, create_graph=True)
+    with ag.record():
+        y2 = (x * x).sum()
+    g_plain = ag.grad(y2, x)
+    assert np.allclose(g_graph.asnumpy(), g_plain.asnumpy())

@@ -1,0 +1,211 @@
+"""Control flow ops: foreach / while_loop / cond.
+
+Reference parity: src/operator/control_flow.cc (:1255,:1316,:1378) and
+python/mxnet/{ndarray,symbol}/contrib.py. The symbolic path must lower
+to lax.scan/masked-scan/lax.cond inside ONE compiled program; the
+imperative path records on the tape so gradients flow.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd
+from mxnet_tpu.test_utils import default_context
+
+
+def assert_close(a, b, rtol=1e-5, atol=1e-6):
+    np.testing.assert_allclose(a, b, rtol=rtol, atol=atol)
+
+
+# ---------------------------------------------------------------------------
+# imperative (nd.contrib)
+# ---------------------------------------------------------------------------
+
+class TestImperativeForeach:
+    def test_cumsum_states(self):
+        data = mx.nd.array(np.arange(12).reshape(4, 3))
+        init = mx.nd.zeros((3,))
+
+        def body(x, s):
+            out = x + s
+            return out, out
+
+        outs, final = mx.nd.contrib.foreach(body, data, init)
+        want = np.cumsum(np.arange(12).reshape(4, 3), axis=0)
+        assert_close(outs.asnumpy(), want)
+        assert_close(final.asnumpy(), want[-1])
+
+    def test_multi_data_multi_state(self):
+        a = mx.nd.array(np.arange(6).reshape(3, 2))
+        b = mx.nd.array(np.ones((3, 2)))
+        s0 = mx.nd.zeros((2,))
+        s1 = mx.nd.ones((2,))
+
+        def body(xs, states):
+            x, y = xs
+            u, v = states
+            return [x + u, y * v], [x + u, y * v]
+
+        outs, finals = mx.nd.contrib.foreach(body, [a, b], [s0, s1])
+        assert len(outs) == 2 and len(finals) == 2
+        want0 = np.cumsum(np.arange(6).reshape(3, 2), axis=0)
+        assert_close(outs[0].asnumpy(), want0)
+        assert_close(outs[1].asnumpy(), np.ones((3, 2)))
+
+    def test_grad_flows(self):
+        data = mx.nd.array(np.arange(1, 7, dtype=np.float32).reshape(3, 2))
+        w = mx.nd.array(np.array([2.0, 3.0], np.float32))
+        w.attach_grad()
+        init = mx.nd.zeros((2,))
+        with autograd.record():
+            outs, final = mx.nd.contrib.foreach(
+                lambda x, s: (x * w + s, x * w + s), data, init)
+            loss = final.sum()
+        loss.backward()
+        # d(sum_i sum_t x_t*w)/dw = sum_t x_t
+        want = np.arange(1, 7, dtype=np.float32).reshape(3, 2).sum(0)
+        assert_close(w.grad.asnumpy(), want)
+
+
+class TestImperativeWhileLoop:
+    def test_accumulate_until(self):
+        def cond(i, s):
+            return i < 5
+
+        def func(i, s):
+            return s + i, [i + 1, s + i]
+
+        outs, (i_f, s_f) = mx.nd.contrib.while_loop(
+            cond, func, [mx.nd.array([0.0]), mx.nd.array([0.0])],
+            max_iterations=8)
+        assert outs.shape == (8, 1)
+        assert float(i_f.asnumpy()[0]) == 5.0
+        assert float(s_f.asnumpy()[0]) == 10.0        # 0+1+2+3+4
+        assert_close(outs.asnumpy().ravel(),
+                     [0, 1, 3, 6, 10, 0, 0, 0])    # tail zero-filled
+
+    def test_zero_steps_raises(self):
+        with pytest.raises(mx.base.MXNetError, match="zero steps"):
+            mx.nd.contrib.while_loop(
+                lambda i: i < 0, lambda i: (i, [i + 1]),
+                [mx.nd.array([5.0])], max_iterations=3)
+
+
+class TestImperativeCond:
+    def test_branches(self):
+        x = mx.nd.array([3.0])
+        y = mx.nd.array([4.0])
+        out = mx.nd.contrib.cond(x < y, lambda: x * 2, lambda: y * 2)
+        assert float(out.asnumpy()[0]) == 6.0
+        out = mx.nd.contrib.cond(x > y, lambda: x * 2, lambda: y * 2)
+        assert float(out.asnumpy()[0]) == 8.0
+
+
+# ---------------------------------------------------------------------------
+# symbolic (sym.contrib) — lowered to lax control flow in one program
+# ---------------------------------------------------------------------------
+
+class TestSymbolicForeach:
+    def test_cumsum_matches_imperative(self):
+        data = mx.sym.var("data")
+        init = mx.sym.var("init")
+        outs, final = mx.sym.contrib.foreach(
+            lambda x, s: (x + s, x + s), data, init)
+        g = mx.sym.Group([outs, final])
+        x = mx.nd.array(np.arange(12).reshape(4, 3))
+        ex = g.bind(default_context(),
+                    {"data": x, "init": mx.nd.zeros((3,))})
+        o, f = ex.forward()
+        want = np.cumsum(np.arange(12).reshape(4, 3), axis=0)
+        assert_close(o.asnumpy(), want)
+        assert_close(f.asnumpy(), want[-1])
+
+    def test_free_variable_and_grad(self):
+        data = mx.sym.var("data")
+        init = mx.sym.var("init")
+        w = mx.sym.var("w")         # free in the body
+        outs, final = mx.sym.contrib.foreach(
+            lambda x, s: (x * w + s, x * w + s), data, init)
+        loss = mx.sym.sum(final)
+        xv = np.arange(1, 7, dtype=np.float32).reshape(3, 2)
+        ex = loss.bind(default_context(),
+                       {"data": mx.nd.array(xv),
+                        "init": mx.nd.zeros((2,)),
+                        "w": mx.nd.array([2.0, 3.0])},
+                       args_grad={"w": mx.nd.zeros((2,))})
+        ex.forward(is_train=True)
+        ex.backward()
+        assert_close(ex.grad_dict["w"].asnumpy(), xv.sum(0))
+
+    def test_rnn_style_scan(self):
+        """An RNN unrolled by foreach == the same RNN unrolled by hand."""
+        T, B, I, H = 5, 2, 3, 4
+        data = mx.sym.var("data")           # (T, B, I)
+        h0 = mx.sym.var("h0")
+        wx = mx.sym.var("wx")
+        wh = mx.sym.var("wh")
+
+        def step(x, h):
+            h2 = mx.sym.tanh(mx.sym.dot(x, wx) + mx.sym.dot(h, wh))
+            return h2, h2
+
+        outs, _ = mx.sym.contrib.foreach(step, data, h0)
+        rng = np.random.RandomState(0)
+        vals = {"data": rng.randn(T, B, I).astype(np.float32),
+                "h0": np.zeros((B, H), np.float32),
+                "wx": rng.randn(I, H).astype(np.float32) * 0.5,
+                "wh": rng.randn(H, H).astype(np.float32) * 0.5}
+        ex = outs.bind(default_context(),
+                       {k: mx.nd.array(v) for k, v in vals.items()})
+        got = ex.forward()[0].asnumpy()
+        h = vals["h0"]
+        for t in range(T):
+            h = np.tanh(vals["data"][t] @ vals["wx"] + h @ vals["wh"])
+            assert_close(got[t], h, rtol=1e-4, atol=1e-5)
+
+
+class TestSymbolicWhileLoop:
+    def test_matches_imperative(self):
+        i0 = mx.sym.var("i0")
+        s0 = mx.sym.var("s0")
+        outs, finals = mx.sym.contrib.while_loop(
+            lambda i, s: i < 5,
+            lambda i, s: (s + i, [i + 1, s + i]),
+            [i0, s0], max_iterations=8)
+        g = mx.sym.Group([outs] + finals)
+        ex = g.bind(default_context(), {"i0": mx.nd.array([0.0]),
+                                        "s0": mx.nd.array([0.0])})
+        o, i_f, s_f = ex.forward()
+        assert_close(o.asnumpy().ravel(), [0, 1, 3, 6, 10, 0, 0, 0])
+        assert float(i_f.asnumpy()[0]) == 5.0
+        assert float(s_f.asnumpy()[0]) == 10.0
+
+
+class TestSymbolicCond:
+    def test_both_branches_compile_one_runs(self):
+        a = mx.sym.var("a")
+        b = mx.sym.var("b")
+        out = mx.sym.contrib.cond(
+            mx.sym.sum(a) < mx.sym.sum(b), lambda: a * 2, lambda: b * 3)
+        ex = out.bind(default_context(), {"a": mx.nd.array([1.0, 2.0]),
+                                          "b": mx.nd.array([5.0, 5.0])})
+        assert_close(ex.forward()[0].asnumpy(), [2.0, 4.0])
+        ex2 = out.bind(default_context(), {"a": mx.nd.array([9.0, 9.0]),
+                                           "b": mx.nd.array([1.0, 1.0])})
+        assert_close(ex2.forward()[0].asnumpy(), [3.0, 3.0])
+
+
+class TestSerialization:
+    def test_foreach_json_roundtrip(self):
+        data = mx.sym.var("data")
+        init = mx.sym.var("init")
+        outs, final = mx.sym.contrib.foreach(
+            lambda x, s: (x + s, x + s), data, init)
+        g = mx.sym.Group([outs, final])
+        g2 = mx.sym.load_json(g.tojson())
+        x = mx.nd.array(np.arange(6, dtype=np.float32).reshape(3, 2))
+        ex = g2.bind(default_context(),
+                     {"data": x, "init": mx.nd.zeros((2,))})
+        o, f = ex.forward()
+        want = np.cumsum(np.arange(6).reshape(3, 2), axis=0)
+        assert_close(o.asnumpy(), want)

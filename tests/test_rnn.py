@@ -1,0 +1,270 @@
+"""Symbolic rnn package + BucketSentenceIter + PTB-style convergence
+(reference: python/mxnet/rnn/, tests/python/train/test_bucketing.py —
+BASELINE config 3)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.test_utils import default_context
+
+
+def _unroll_forward(cell, T, B, I, seed=0):
+    rng = np.random.RandomState(seed)
+    data = mx.sym.var("data")
+    outs, states = cell.unroll(T, data, layout="NTC", merge_outputs=True)
+    x = rng.randn(B, T, I).astype(np.float32)
+    group = mx.sym.Group([outs] + list(states))
+    args = {n: mx.nd.array(rng.randn(*_shape_for(n, cell, I))
+                           .astype(np.float32) * 0.1)
+            for n in group.list_arguments() if n != "data"}
+    args["data"] = mx.nd.array(x)
+    ex = group.bind(default_context(), args)
+    return [o.asnumpy() for o in ex.forward()], x, \
+        {k: v.asnumpy() for k, v in args.items()}
+
+
+def _shape_for(name, cell, I):
+    H = cell._num_hidden
+    mult = {"lstm_": 4, "gru_": 3}.get(cell._prefix, 1)
+    if name.endswith("i2h_weight"):
+        return (mult * H, I)
+    if name.endswith("h2h_weight"):
+        return (mult * H, H)
+    return (mult * H,)
+
+
+class TestCells:
+    def test_rnn_cell_matches_numpy(self):
+        T, B, I, H = 3, 2, 4, 5
+        cell = mx.rnn.RNNCell(H)
+        (outs, h_f), x, args = _unroll_forward(cell, T, B, I)
+        wi, bi = args["rnn_i2h_weight"], args["rnn_i2h_bias"]
+        wh, bh = args["rnn_h2h_weight"], args["rnn_h2h_bias"]
+        h = np.zeros((B, H), np.float32)
+        for t in range(T):
+            h = np.tanh(x[:, t] @ wi.T + bi + h @ wh.T + bh)
+            np.testing.assert_allclose(outs[:, t], h, rtol=1e-4,
+                                       atol=1e-5)
+        np.testing.assert_allclose(h_f, h, rtol=1e-4, atol=1e-5)
+
+    def test_lstm_cell_matches_numpy(self):
+        T, B, I, H = 3, 2, 4, 5
+        cell = mx.rnn.LSTMCell(H, forget_bias=1.0)
+        (outs, h_f, c_f), x, args = _unroll_forward(cell, T, B, I)
+        wi, bi = args["lstm_i2h_weight"], args["lstm_i2h_bias"]
+        wh, bh = args["lstm_h2h_weight"], args["lstm_h2h_bias"]
+
+        def sig(v):
+            return 1.0 / (1.0 + np.exp(-v))
+
+        h = np.zeros((B, H), np.float32)
+        c = np.zeros((B, H), np.float32)
+        for t in range(T):
+            g = x[:, t] @ wi.T + bi + h @ wh.T + bh
+            i_g, f_g, c_g, o_g = np.split(g, 4, axis=1)
+            c = sig(f_g + 1.0) * c + sig(i_g) * np.tanh(c_g)
+            h = sig(o_g) * np.tanh(c)
+            np.testing.assert_allclose(outs[:, t], h, rtol=1e-4,
+                                       atol=1e-5)
+        np.testing.assert_allclose(c_f, c, rtol=1e-4, atol=1e-5)
+
+    def test_gru_cell_matches_numpy(self):
+        T, B, I, H = 3, 2, 4, 5
+        cell = mx.rnn.GRUCell(H)
+        (outs, h_f), x, args = _unroll_forward(cell, T, B, I)
+        wi, bi = args["gru_i2h_weight"], args["gru_i2h_bias"]
+        wh, bh = args["gru_h2h_weight"], args["gru_h2h_bias"]
+
+        def sig(v):
+            return 1.0 / (1.0 + np.exp(-v))
+
+        h = np.zeros((B, H), np.float32)
+        for t in range(T):
+            gi = x[:, t] @ wi.T + bi
+            gh = h @ wh.T + bh
+            ir, iz, io = np.split(gi, 3, axis=1)
+            hr, hz, ho = np.split(gh, 3, axis=1)
+            r, z = sig(ir + hr), sig(iz + hz)
+            new = np.tanh(io + r * ho)
+            h = z * h + (1 - z) * new
+            np.testing.assert_allclose(outs[:, t], h, rtol=1e-4,
+                                       atol=1e-5)
+
+    def test_sequential_stack_shapes(self):
+        T, B, I, H1, H2 = 4, 3, 6, 5, 2
+        stack = mx.rnn.SequentialRNNCell()
+        stack.add(mx.rnn.LSTMCell(H1, prefix="l0_"))
+        stack.add(mx.rnn.LSTMCell(H2, prefix="l1_"))
+        data = mx.sym.var("data")
+        outs, states = stack.unroll(T, data, merge_outputs=True)
+        rng = np.random.RandomState(1)
+        args = {"data": mx.nd.array(rng.randn(B, T, I).astype(np.float32))}
+        for n in mx.sym.Group([outs]).list_arguments():
+            if n == "data":
+                continue
+            H, mult = (H1, 4) if n.startswith("l0") else (H2, 4)
+            inp = I if n == "l0_i2h_weight" else \
+                (H1 if n in ("l1_i2h_weight",) else H)
+            shape = (mult * H, inp) if n.endswith("weight") else (mult * H,)
+            args[n] = mx.nd.array(rng.randn(*shape).astype(np.float32) * .1)
+        ex = outs.bind(default_context(), args)
+        assert ex.forward()[0].shape == (B, T, H2)
+
+    def test_bidirectional_concat(self):
+        T, B, I, H = 3, 2, 4, 5
+        cell = mx.rnn.BidirectionalCell(
+            mx.rnn.RNNCell(H, prefix="f_"), mx.rnn.RNNCell(H, prefix="b_"))
+        data = mx.sym.var("data")
+        outs, states = cell.unroll(T, data, merge_outputs=True)
+        rng = np.random.RandomState(2)
+        args = {"data": mx.nd.array(rng.randn(B, T, I).astype(np.float32))}
+        for n in mx.sym.Group([outs]).list_arguments():
+            if n == "data":
+                continue
+            inp = I if "i2h_weight" in n else H
+            shape = (H, inp) if n.endswith("weight") else (H,)
+            args[n] = mx.nd.array(rng.randn(*shape).astype(np.float32) * .1)
+        ex = outs.bind(default_context(), args)
+        assert ex.forward()[0].shape == (B, T, 2 * H)
+
+    def test_fused_matches_unfused_lstm(self):
+        """FusedRNNCell (RNN op / lax.scan) == explicit LSTMCell unroll."""
+        T, B, I, H = 4, 2, 3, 5
+        rng = np.random.RandomState(3)
+        x = rng.randn(B, T, I).astype(np.float32)
+        wi = rng.randn(4 * H, I).astype(np.float32) * 0.3
+        wh = rng.randn(4 * H, H).astype(np.float32) * 0.3
+        bi = rng.randn(4 * H).astype(np.float32) * 0.1
+        bh = rng.randn(4 * H).astype(np.float32) * 0.1
+
+        data = mx.sym.var("data")
+        fused = mx.rnn.FusedRNNCell(H, mode="lstm", prefix="fused_")
+        f_out, _ = fused.unroll(T, data, layout="NTC", merge_outputs=True)
+        # fused parameter vector layout: i2h_w, h2h_w, i2h_b, h2h_b
+        pvec = np.concatenate([wi.ravel(), wh.ravel(), bi, bh])
+        exf = f_out.bind(default_context(),
+                         {"data": mx.nd.array(x),
+                          "fused_parameters": mx.nd.array(pvec)})
+        got = exf.forward()[0].asnumpy()
+
+        cell = mx.rnn.LSTMCell(H, forget_bias=0.0, prefix="ref_")
+        r_out, _ = cell.unroll(T, data, layout="NTC", merge_outputs=True)
+        exr = r_out.bind(default_context(),
+                         {"data": mx.nd.array(x),
+                          "ref_i2h_weight": mx.nd.array(wi),
+                          "ref_h2h_weight": mx.nd.array(wh),
+                          "ref_i2h_bias": mx.nd.array(bi),
+                          "ref_h2h_bias": mx.nd.array(bh)})
+        want = exr.forward()[0].asnumpy()
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+class TestBeginState:
+    def test_fused_begin_state_batch_axis(self):
+        """batch_size substitution must land on the N axis of the
+        declared layout (LNC for fused cells), not blindly on axis 0."""
+        cell = mx.rnn.FusedRNNCell(8, mode="lstm", prefix="f_")
+        states = cell.begin_state(func=mx.sym.zeros, batch_size=4)
+        shapes = mx.sym.Group(states).infer_shape()[1]
+        assert all(s == (1, 4, 8) for s in shapes), shapes
+
+    def test_step_cell_begin_state_batch(self):
+        cell = mx.rnn.LSTMCell(6, prefix="s_")
+        states = cell.begin_state(func=mx.sym.zeros, batch_size=3)
+        shapes = mx.sym.Group(states).infer_shape()[1]
+        assert all(s == (3, 6) for s in shapes), shapes
+
+
+class TestBucketSentenceIter:
+    def _sentences(self, n=64, seed=0):
+        rng = np.random.RandomState(seed)
+        return [list(rng.randint(1, 20, size=rng.randint(3, 15)))
+                for _ in range(n)]
+
+    def test_bucketing_and_labels(self):
+        sents = self._sentences()
+        it = mx.rnn.BucketSentenceIter(sents, batch_size=4,
+                                       buckets=[5, 10, 15],
+                                       invalid_label=0)
+        assert it.default_bucket_key == 15
+        n = 0
+        for batch in it:
+            L = batch.bucket_key
+            assert L in (5, 10, 15)
+            d = batch.data[0].asnumpy()
+            l = batch.label[0].asnumpy()
+            assert d.shape == (4, L)
+            # label is data shifted one left
+            np.testing.assert_array_equal(l[:, :-1], d[:, 1:])
+            n += 1
+        assert n > 0
+        it.reset()
+        assert sum(1 for _ in it) == n
+
+    def test_encode_sentences(self):
+        sents = [["a", "b", "a"], ["c", "b"]]
+        coded, vocab = mx.rnn.encode_sentences(sents, invalid_label=0,
+                                               start_label=1)
+        assert coded[0][0] == coded[0][2]
+        assert len(vocab) == 4  # a, b, c + invalid
+
+
+class TestPTBStyleConvergence:
+    def test_lstm_lm_learns_synthetic_corpus(self):
+        """BASELINE config 3 smoke: LSTM LM through BucketingModule on a
+        deterministic synthetic corpus — perplexity must drop sharply."""
+        V, E, H, B = 16, 12, 24, 8
+        rng = np.random.RandomState(7)
+        # deterministic cyclic language: next token = (tok + 1) % V
+        sents = []
+        for _ in range(96):
+            start = rng.randint(1, V)
+            length = rng.randint(4, 12)
+            sents.append([(start + k) % (V - 1) + 1
+                          for k in range(length)])
+        it = mx.rnn.BucketSentenceIter(sents, batch_size=B,
+                                       buckets=[4, 8, 12],
+                                       invalid_label=0)
+
+        def sym_gen(seq_len):
+            data = mx.sym.var("data")
+            label = mx.sym.var("softmax_label")
+            embed = mx.sym.Embedding(data, input_dim=V, output_dim=E,
+                                     name="embed")
+            stack = mx.rnn.SequentialRNNCell()
+            stack.add(mx.rnn.LSTMCell(H, prefix="lstm_l0_"))
+            outputs, _ = stack.unroll(seq_len, embed, layout="NTC",
+                                      merge_outputs=True)
+            pred = mx.sym.Reshape(outputs, shape=(-1, H))
+            pred = mx.sym.FullyConnected(pred, num_hidden=V, name="pred")
+            label_f = mx.sym.Reshape(label, shape=(-1,))
+            pred = mx.sym.SoftmaxOutput(pred, label_f, name="softmax",
+                                        use_ignore=True, ignore_label=0)
+            return pred, ("data",), ("softmax_label",)
+
+        mod = mx.mod.BucketingModule(
+            sym_gen, default_bucket_key=it.default_bucket_key,
+            context=default_context())
+        mod.bind(data_shapes=it.provide_data,
+                 label_shapes=it.provide_label)
+        mod.init_params(mx.init.Xavier())
+        mod.init_optimizer(optimizer="adam",
+                           optimizer_params={"learning_rate": 0.01})
+        ppl = mx.metric.Perplexity(ignore_label=0)
+
+        first = last = None
+        for epoch in range(8):
+            it.reset()
+            ppl.reset()
+            for batch in it:
+                mod.forward(batch, is_train=True)
+                mod.update_metric(ppl, batch.label)
+                mod.backward()
+                mod.update()
+            val = ppl.get()[1]
+            if first is None:
+                first = val
+            last = val
+        assert last < first * 0.5, \
+            "perplexity did not drop: first=%.2f last=%.2f" % (first, last)
+        assert last < 4.0, "final perplexity too high: %.2f" % last

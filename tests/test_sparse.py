@@ -254,6 +254,25 @@ def test_embedding_touched_zero_grad_row_still_updates():
     np.testing.assert_array_equal(w2[4], w1[4])  # untouched: frozen
 
 
+def test_embedding_rows_union_across_forwards():
+    """Two forwards of one sparse_grad weight before a single step must
+    union their touched rows (the stash accumulates, not overwrites)."""
+    from mxnet_tpu import gluon, autograd
+    from mxnet_tpu.gluon import nn
+    net = nn.Embedding(10, 3, sparse_grad=True)
+    net.initialize(mx.init.Xavier())
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.5})
+    w0 = net.weight.data().asnumpy().copy()
+    with autograd.record():
+        loss = net(mx.nd.array([2])).sum() + net(mx.nd.array([7])).sum()
+    loss.backward()
+    trainer.step(1)
+    w1 = net.weight.data().asnumpy()
+    assert np.abs(w1[2] - w0[2]).sum() > 0, "row 2 update dropped"
+    assert np.abs(w1[7] - w0[7]).sum() > 0, "row 7 update dropped"
+
+
 def test_libsvm_iter_yields_csr(tmp_path):
     f = tmp_path / "data.libsvm"
     f.write_text("1 0:1.5 3:2.0\n0 1:1.0\n1 2:0.5 3:1.0\n0 0:2.0\n")
