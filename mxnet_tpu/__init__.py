@@ -22,6 +22,7 @@ from .name import NameManager
 from .attribute import AttrScope
 from . import base
 from . import ops
+from . import operator      # registers the `Custom` op before stub codegen
 from . import ndarray
 from . import ndarray as nd
 from . import random

@@ -316,7 +316,8 @@ def _backward_as_op(heads, head_grads):
         return [], []
     n_l, n_c, n_r = len(leaves), len(consts), len(rngs)
     key = (struct, head_refs)
-    opdef = _hgrad_cache.get(key)
+    with _bwd_cache_lock:
+        opdef = _hgrad_cache.get(key)
     if opdef is None:
         def grad_fwd(attrs, *vals):
             lv = vals[:n_l]
@@ -331,14 +332,17 @@ def _backward_as_op(heads, head_grads):
             grads, = vjp_fn(tuple(cots))
             return tuple(grads)
 
-        _hgrad_counter[0] += 1
-        opdef = OpDef("_backward_program%d" % _hgrad_counter[0], grad_fwd,
-                      arg_names=tuple("in%d" % i
-                                      for i in range(n_l + n_c + n_r
-                                                     + len(heads))),
-                      num_outputs=n_l)
         with _bwd_cache_lock:
-            _hgrad_cache[key] = opdef
+            opdef = _hgrad_cache.get(key)       # double-checked: the
+            if opdef is None:                   # name must stay unique
+                _hgrad_counter[0] += 1
+                opdef = OpDef(
+                    "_backward_program%d" % _hgrad_counter[0], grad_fwd,
+                    arg_names=tuple("in%d" % i
+                                    for i in range(n_l + n_c + n_r
+                                                   + len(heads))),
+                    num_outputs=n_l)
+                _hgrad_cache[key] = opdef
 
     cots = [NDArray(c, ctx=heads[0]._ctx)
             for c in _cotangents(heads, head_grads)]

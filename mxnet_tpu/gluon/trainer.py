@@ -210,6 +210,11 @@ class Trainer:
                 grad = self._to_row_sparse(param, grad)
             updater(i, grad, param.data())
             param._data._fresh_grad = False
+        # drop row-id stashes on EVERY param (also frozen/stale-skipped
+        # ones) so forwards from this step never leak into the next
+        for param in self._params:
+            if getattr(param, '_sparse_row_ids', None) is not None:
+                param._sparse_row_ids = None
         if self._kvstore is not None and self._update_on_kvstore:
             for i, param in enumerate(self._params):
                 if param.grad_req != 'null':

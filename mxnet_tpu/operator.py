@@ -1,0 +1,220 @@
+"""Custom operators in Python (reference: python/mxnet/operator.py,
+src/operator/custom/custom-inl.h:50).
+
+TPU-native design: the user's ``CustomOp.forward``/``backward`` run on
+the host through ``jax.pure_callback``, so a Custom node embeds in a
+compiled program (hybridized block, bound executor, even inside
+``lax.scan``) and XLA treats it as an opaque host call. Gradients wire
+through ``jax.custom_vjp`` into the user's ``backward``. Like the
+reference's ``CustomOperator`` singleton — which runs all frontend
+callbacks on its own thread pool so engine threads never execute user
+Python — every callback here is funneled through ONE dedicated worker
+thread: user code sees serialized, ordered invocations and can't
+deadlock an XLA dispatch thread on the GIL.
+"""
+from __future__ import annotations
+
+import concurrent.futures
+import threading
+
+import numpy as _np
+
+from .base import MXNetError
+
+__all__ = ["CustomOp", "CustomOpProp", "register", "get_all_registered"]
+
+_PROP_REGISTRY = {}
+
+# the dedicated callback thread (CustomOperator's thread-pool analogue)
+_worker = None
+_worker_lock = threading.Lock()
+
+
+def _on_worker(fn, *args):
+    global _worker
+    if _worker is None:
+        with _worker_lock:
+            if _worker is None:
+                _worker = concurrent.futures.ThreadPoolExecutor(
+                    max_workers=1, thread_name_prefix="mxnet_custom_op")
+    return _worker.submit(fn, *args).result()
+
+
+class CustomOp:
+    """Base class for user operators (reference: operator.py CustomOp)."""
+
+    def forward(self, is_train, req, in_data, out_data, aux):
+        raise NotImplementedError()
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        raise NotImplementedError()
+
+    def assign(self, dst, req, src):
+        """Write ``src`` into ``dst`` honouring the write request."""
+        if req in ("null", None):
+            return
+        if req == "add":
+            dst[:] = dst + src
+        else:               # write / inplace
+            dst[:] = src
+
+
+class CustomOpProp:
+    """Describes a custom op's signature (reference: CustomOpProp)."""
+
+    def __init__(self, need_top_grad=True):
+        self.need_top_grad_ = need_top_grad
+
+    def list_arguments(self):
+        return ["data"]
+
+    def list_outputs(self):
+        return ["output"]
+
+    def list_auxiliary_states(self):
+        return []
+
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0]] * len(self.list_outputs()), []
+
+    def infer_type(self, in_type):
+        return in_type, [in_type[0]] * len(self.list_outputs()), []
+
+    def declare_backward_dependency(self, out_grad, in_data, out_data):
+        deps = []
+        if self.need_top_grad_:
+            deps.extend(out_grad)
+        deps.extend(in_data)
+        deps.extend(out_data)
+        return deps
+
+    def create_operator(self, ctx, in_shapes, in_dtypes):
+        return CustomOp()
+
+
+def register(reg_name):
+    """Decorator registering a CustomOpProp subclass under ``op_type``
+    (reference: operator.py register → CustomOpPropCreator)."""
+    def do_register(prop_cls):
+        if not issubclass(prop_cls, CustomOpProp):
+            raise MXNetError(
+                "register('%s') expects a CustomOpProp subclass"
+                % reg_name)
+        _PROP_REGISTRY[reg_name] = prop_cls
+        return prop_cls
+    return do_register
+
+
+def get_all_registered():
+    return dict(_PROP_REGISTRY)
+
+
+# ---------------------------------------------------------------------------
+# The `Custom` operator: bridges the registry into the op library
+# ---------------------------------------------------------------------------
+
+def _make_prop(attrs):
+    op_type = attrs.get("op_type")
+    if not op_type:
+        raise MXNetError("Custom requires an op_type= keyword")
+    cls = _PROP_REGISTRY.get(op_type)
+    if cls is None:
+        raise MXNetError(
+            "Custom op_type '%s' is not registered (use "
+            "@mx.operator.register)" % op_type)
+    kwargs = {k: str(v) for k, v in attrs.items()
+              if k not in ("op_type", "__train__") and
+              not (k.startswith("__") and k.endswith("__"))}
+    return cls(**kwargs)
+
+
+def _custom_arg_names(attrs):
+    return list(_make_prop(attrs).list_arguments())
+
+
+def _custom_num_outputs(attrs):
+    return len(_make_prop(attrs).list_outputs())
+
+
+def _wrap_nd(buffers):
+    from .ndarray.ndarray import NDArray
+    from .context import cpu
+    import jax.numpy as jnp
+    return [NDArray(jnp.asarray(b), ctx=cpu()) for b in buffers]
+
+
+def _custom_impl(attrs, *inputs):
+    import jax
+
+    prop = _make_prop(attrs)
+    if prop.list_auxiliary_states():
+        raise MXNetError(
+            "Custom ops with auxiliary states are not supported on the "
+            "TPU backend; carry state through explicit outputs instead")
+    is_train = bool(attrs.get("__train__", False))
+    n_out = len(prop.list_outputs())
+
+    in_shapes = [list(x.shape) for x in inputs]
+    shapes = prop.infer_shape(in_shapes)
+    out_shapes = [tuple(s) for s in shapes[1]]
+    in_types = [x.dtype for x in inputs]
+    types = prop.infer_type(in_types)
+    out_types = types[1]
+    out_struct = tuple(jax.ShapeDtypeStruct(s, t)
+                       for s, t in zip(out_shapes, out_types))
+    op = prop.create_operator(None, in_shapes, in_types)
+
+    def host_forward(*arrs):
+        def run():
+            in_data = _wrap_nd(arrs)
+            out_data = _wrap_nd(_np.zeros(s, t)
+                                for s, t in zip(out_shapes, out_types))
+            op.forward(is_train, ["write"] * n_out, in_data, out_data, [])
+            return tuple(o.asnumpy().astype(t)
+                         for o, t in zip(out_data, out_types))
+        return _on_worker(run)
+
+    def host_backward(*arrs):
+        def run():
+            k = len(inputs)
+            xs = _wrap_nd(arrs[:k])
+            outs = _wrap_nd(arrs[k:k + n_out])
+            cots = _wrap_nd(arrs[k + n_out:])
+            in_grad = _wrap_nd(_np.zeros(tuple(s), t)
+                               for s, t in zip(in_shapes, in_types))
+            op.backward(["write"] * k, cots, xs, outs, in_grad, [])
+            return tuple(g.asnumpy().astype(t)
+                         for g, t in zip(in_grad, in_types))
+        return _on_worker(run)
+
+    in_struct = tuple(jax.ShapeDtypeStruct(tuple(s), t)
+                      for s, t in zip(in_shapes, in_types))
+
+    @jax.custom_vjp
+    def f(*xs):
+        return jax.pure_callback(host_forward, out_struct, *xs)
+
+    def f_fwd(*xs):
+        outs = jax.pure_callback(host_forward, out_struct, *xs)
+        return outs, (xs, outs)
+
+    def f_bwd(res, cots):
+        xs, outs = res
+        return jax.pure_callback(host_backward, in_struct,
+                                 *(tuple(xs) + tuple(outs) + tuple(cots)))
+
+    f.defvjp(f_fwd, f_bwd)
+    outs = f(*inputs)
+    return outs if n_out > 1 else outs[0]
+
+
+def _register_custom_opdef():
+    from .ops.registry import register as _register_op
+    _register_op("Custom", _custom_impl,
+                 arg_names=("data",),
+                 defaults={"op_type": None, "__train__": False},
+                 num_outputs=_custom_num_outputs,
+                 arg_names_fn=_custom_arg_names)
+
+
+_register_custom_opdef()

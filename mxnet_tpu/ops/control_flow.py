@@ -151,11 +151,16 @@ def _while_loop_impl(attrs, *inputs, rng=None):
     cond_free = inputs[n_state:n_state + n_cf]
     body_free = inputs[n_state + n_cf:]
 
-    keys = _split_rng(rng, max_iter) if body_sub.n_rng else None
+    rng_c = rng_b = None
+    if rng is not None and (cond_sub.n_rng or body_sub.n_rng):
+        rng_c, rng_b = _split_rng(rng, 2)
+    ckeys = _split_rng(rng_c, max_iter) if cond_sub.n_rng else None
+    keys = _split_rng(rng_b, max_iter) if body_sub.n_rng else None
 
     def step(carry, i):
         states, active = carry
-        c = cond_sub([], list(states), list(cond_free))[0]
+        c = cond_sub([], list(states), list(cond_free),
+                     rng=_sub_rng(ckeys, i))[0]
         active = jnp.logical_and(active, jnp.reshape(c, ()).astype(bool))
         k = _sub_rng(keys, i)
         outs = body_sub([], list(states), list(body_free), rng=k)
@@ -198,9 +203,12 @@ def _cond_impl(attrs, *inputs, rng=None):
     then_free = inputs[n_state + n_pf:n_state + n_pf + n_tf]
     else_free = inputs[n_state + n_pf + n_tf:]
 
-    keys = _split_rng(rng, 2) if (then_sub.n_rng or else_sub.n_rng) \
-        else None
-    pred = pred_sub([], list(states), list(pred_free))[0]
+    keys = None
+    if rng is not None and (pred_sub.n_rng or then_sub.n_rng
+                            or else_sub.n_rng):
+        keys = _split_rng(rng, 3)
+    pred = pred_sub([], list(states), list(pred_free),
+                    rng=_sub_rng(keys, 2))[0]
     pred = jnp.reshape(pred, ()).astype(bool)
 
     def then_fn(_):

@@ -81,7 +81,10 @@ class BucketSentenceIter(DataIter):
             pad = np.full((buckets[pos],), invalid_label, dtype=dtype)
             pad[:len(sent)] = sent
             self.data[pos].append(pad)
-        self.data = [np.asarray(x, dtype=dtype) for x in self.data]
+        # keep 2-D shape even for buckets no sentence landed in
+        self.data = [np.asarray(x, dtype=dtype) if x else
+                     np.zeros((0, buckets[i]), dtype=dtype)
+                     for i, x in enumerate(self.data)]
         if ndiscard:
             import logging
             logging.warning("BucketSentenceIter discarded %d sentences "

@@ -164,12 +164,14 @@ def _to_steps(inputs, length, layout):
     t_axis = layout.find("T")
     if t_axis not in (0, 1):
         raise MXNetError("unsupported RNN layout %s" % layout)
-    steps = sym.split(inputs, num_outputs=length, axis=t_axis,
-                      squeeze_axis=True) if length > 1 else \
-        [sym.Reshape(sym.slice_axis(inputs, axis=t_axis, begin=0, end=1),
-                     shape=(0, -1))]
     if length == 1:
-        return steps, True
+        one = sym.slice_axis(inputs, axis=t_axis, begin=0, end=1)
+        # drop the singleton time axis: merge it into the batch dim for
+        # TNC (axis 0), keep the batch dim for NTC (axis 1)
+        shape = (-3, -1) if t_axis == 0 else (0, -1)
+        return [sym.Reshape(one, shape=shape)], True
+    steps = sym.split(inputs, num_outputs=length, axis=t_axis,
+                      squeeze_axis=True)
     return [steps[i] for i in range(length)], True
 
 
@@ -536,14 +538,15 @@ class ZoneoutCell(ModifierCell):
         out, next_states = self.base_cell(inputs, states)
 
         def mix(p, new, old):
-            if p == 0.0 or old is None:
+            if p == 0.0:
                 return new
+            if old is None:     # first step zones out against zeros
+                old = sym.zeros_like(new)
             mask = sym.Dropout(sym.ones_like(new), p=p)
             return sym.where(mask, new, old)
 
-        prev = self._prev_output
-        out_mixed = mix(self._zo, out, prev)
-        self._prev_output = out
+        out_mixed = mix(self._zo, out, self._prev_output)
+        self._prev_output = out_mixed       # carry the mixed output
         next_states = [mix(self._zs, n, o)
                        for n, o in zip(next_states, states)]
         return out_mixed, next_states

@@ -219,28 +219,53 @@ def check_symbolic_backward(sym, location, out_grads, expected, rtol=1e-5,
 def check_consistency(sym, ctx_list=None, scale=1.0, dtype=None,
                       arg_params=None, aux_params=None, tol=None,
                       raise_on_err=True, **kwargs):
-    """Cross-backend oracle: run the SAME graph eagerly (interpreted,
-    per-op jit) and symbolically (one compiled XLA program) and compare —
-    the TPU analogue of the reference's cpu-vs-gpu check_consistency
-    (test_utils.py:1224)."""
+    """Cross-backend oracle (the reference's cpu-vs-gpu
+    check_consistency, test_utils.py:1224): run the SAME graph
+    symbolically (one compiled XLA program) on every context in
+    ``ctx_list`` — e.g. ``[mx.cpu(), mx.tpu()]`` for the TPU test lane —
+    plus eagerly (interpreted, per-op jit) on the first context, and
+    compare all outputs against the first context's."""
     from .ndarray import array
     from . import autograd as ag
-    ctx = default_context()
+    ctx = ctx_list[0] if ctx_list else default_context()
     arg_names = sym.list_arguments()
+    aux_names = sym.list_auxiliary_states()
     shapes = kwargs.get("shapes")
     if arg_params is None:
         arg_params = {n: np.random.normal(0, scale, size=s).astype(
             dtype or np.float32) for n, s in shapes.items()}
-    # symbolic path
-    exe = sym.bind(ctx, {k: array(v, ctx=ctx) for k, v in arg_params.items()})
+    else:
+        arg_params = dict(arg_params)   # never mutate the caller's dict
+    if aux_params is None:
+        aux_params = {n: arg_params.pop(n) for n in aux_names
+                      if n in arg_params}
+
+    def _bind(c):
+        return sym.bind(c,
+                        {k: array(v, ctx=c) for k, v in arg_params.items()},
+                        aux_states={k: array(v, ctx=c)
+                                    for k, v in aux_params.items()}
+                        if aux_params else None)
+
+    # symbolic path, per context
+    exe = _bind(ctx)
     exe.forward(is_train=False)
     sym_outs = [o.asnumpy() for o in exe.outputs]
+    for other in (ctx_list or [])[1:]:
+        exe_o = _bind(other)
+        exe_o.forward(is_train=False)
+        for ref_o, got_o in zip(sym_outs,
+                                [o.asnumpy() for o in exe_o.outputs]):
+            assert_almost_equal(ref_o, got_o, rtol=tol or 1e-4,
+                                atol=tol or 1e-4,
+                                names=(str(ctx), str(other)))
     # eager path: interpret graph node by node via NDArray ops
     from .symbol.symbol import _topo
     env = {}
+    all_params = dict(arg_params, **aux_params)
     for node in sym._topo_nodes():
         if node.is_variable():
-            env[(id(node), 0)] = array(arg_params[node.name], ctx=ctx)
+            env[(id(node), 0)] = array(all_params[node.name], ctx=ctx)
         else:
             from .ndarray.ndarray import invoke_nd
             ins = [env[(id(s), i)] for (s, i) in node.inputs]

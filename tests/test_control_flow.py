@@ -209,3 +209,27 @@ class TestSerialization:
         o, f = ex.forward()
         want = np.cumsum(np.arange(6).reshape(3, 2), axis=0)
         assert_close(o.asnumpy(), want)
+
+
+class TestRngInSubgraphs:
+    def test_dropout_in_while_cond_and_body(self):
+        """rng-consuming ops inside cond/pred subgraphs get keys."""
+        i0 = mx.sym.var("i0")
+        outs, finals = mx.sym.contrib.while_loop(
+            # Dropout in the CONDITION graph (p=0 → identity, but the
+            # op still requests a key)
+            lambda i: mx.sym.sum(mx.sym.Dropout(i, p=0.0)) < 3,
+            lambda i: (mx.sym.Dropout(i, p=0.0), [i + 1]),
+            [i0], max_iterations=5)
+        g = mx.sym.Group([outs] + finals)
+        ex = g.bind(default_context(), {"i0": mx.nd.array([0.0])})
+        o, i_f = ex.forward()
+        assert float(i_f.asnumpy()[0]) == 3.0
+
+    def test_dropout_in_cond_pred(self):
+        a = mx.sym.var("a")
+        out = mx.sym.contrib.cond(
+            mx.sym.sum(mx.sym.Dropout(a, p=0.0)) > 0,
+            lambda: a * 2, lambda: a * 3)
+        ex = out.bind(default_context(), {"a": mx.nd.array([1.0])})
+        assert float(ex.forward()[0].asnumpy()[0]) == 2.0

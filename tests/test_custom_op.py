@@ -1,0 +1,135 @@
+"""Custom op framework (reference: python/mxnet/operator.py,
+src/operator/custom/; test shape follows
+tests/python/unittest/test_operator.py test_custom_op)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd
+from mxnet_tpu.test_utils import default_context
+
+
+@mx.operator.register("sqr")
+class SqrProp(mx.operator.CustomOpProp):
+    def __init__(self):
+        super().__init__(need_top_grad=True)
+
+    def list_arguments(self):
+        return ["data"]
+
+    def list_outputs(self):
+        return ["output"]
+
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0]], []
+
+    def create_operator(self, ctx, shapes, dtypes):
+        return Sqr()
+
+
+class Sqr(mx.operator.CustomOp):
+    def forward(self, is_train, req, in_data, out_data, aux):
+        self.assign(out_data[0], req[0], in_data[0] * in_data[0])
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        self.assign(in_grad[0], req[0],
+                    2.0 * in_data[0] * out_grad[0])
+
+
+@mx.operator.register("twosum")
+class TwoSumProp(mx.operator.CustomOpProp):
+    """Two inputs, two outputs — exercises multi-arity plumbing."""
+
+    def list_arguments(self):
+        return ["a", "b"]
+
+    def list_outputs(self):
+        return ["sum", "diff"]
+
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0], in_shape[0]], []
+
+    def create_operator(self, ctx, shapes, dtypes):
+        return TwoSum()
+
+
+class TwoSum(mx.operator.CustomOp):
+    def forward(self, is_train, req, in_data, out_data, aux):
+        a, b = in_data
+        self.assign(out_data[0], req[0], a + b)
+        self.assign(out_data[1], req[1], a - b)
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        gs, gd = out_grad
+        self.assign(in_grad[0], req[0], gs + gd)
+        self.assign(in_grad[1], req[1], gs - gd)
+
+
+class TestEager:
+    def test_forward(self):
+        x = mx.nd.array([1.0, 2.0, 3.0])
+        y = mx.nd.Custom(x, op_type="sqr")
+        np.testing.assert_allclose(y.asnumpy(), [1.0, 4.0, 9.0])
+
+    def test_backward(self):
+        x = mx.nd.array([1.0, 2.0, 3.0])
+        x.attach_grad()
+        with autograd.record():
+            y = mx.nd.Custom(x, op_type="sqr")
+            loss = y.sum()
+        loss.backward()
+        np.testing.assert_allclose(x.grad.asnumpy(), [2.0, 4.0, 6.0])
+
+    def test_multi_io(self):
+        a = mx.nd.array([3.0, 5.0])
+        b = mx.nd.array([1.0, 2.0])
+        a.attach_grad()
+        b.attach_grad()
+        with autograd.record():
+            s, d = mx.nd.Custom(a, b, op_type="twosum")
+            loss = (s * 2.0 + d).sum()
+        np.testing.assert_allclose(s.asnumpy(), [4.0, 7.0])
+        np.testing.assert_allclose(d.asnumpy(), [2.0, 3.0])
+        loss.backward()
+        np.testing.assert_allclose(a.grad.asnumpy(), [3.0, 3.0])  # 2+1
+        np.testing.assert_allclose(b.grad.asnumpy(), [1.0, 1.0])  # 2-1
+
+    def test_unregistered_raises(self):
+        with pytest.raises(mx.base.MXNetError, match="not registered"):
+            mx.nd.Custom(mx.nd.ones((2,)), op_type="no_such_op")
+
+
+class TestSymbolic:
+    def test_bind_forward_backward(self):
+        data = mx.sym.var("data")
+        y = mx.sym.Custom(data, op_type="sqr", name="sqr0")
+        loss = mx.sym.sum(y)
+        x = mx.nd.array([2.0, -3.0])
+        ex = loss.bind(default_context(), {"data": x},
+                       args_grad={"data": mx.nd.zeros((2,))})
+        out = ex.forward(is_train=True)[0]
+        np.testing.assert_allclose(float(out.asnumpy()), 13.0)
+        ex.backward()
+        np.testing.assert_allclose(ex.grad_dict["data"].asnumpy(),
+                                   [4.0, -6.0])
+
+    def test_hybridized_gluon_block(self):
+        from mxnet_tpu.gluon import nn, HybridBlock
+
+        class Net(HybridBlock):
+            def __init__(self):
+                super().__init__()
+                self.dense = nn.Dense(4)
+
+            def hybrid_forward(self, F, x):
+                return F.Custom(self.dense(x), op_type="sqr")
+
+        net = Net()
+        net.initialize(mx.init.Xavier())
+        x = mx.nd.array(np.random.RandomState(0)
+                        .randn(2, 3).astype(np.float32))
+        eager = net(x).asnumpy()
+        net.hybridize()
+        hybrid = net(x).asnumpy()
+        np.testing.assert_allclose(eager, hybrid, rtol=1e-5, atol=1e-6)
+        assert (hybrid >= 0).all()      # squared output

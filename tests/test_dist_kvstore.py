@@ -1,0 +1,109 @@
+"""Multi-process tpu_sync kvstore (SURVEY §4(d); asserts ported from
+ref tests/nightly/dist_sync_kvstore.py:28-60).
+
+Each worker is a real OS process with its own jax runtime, joined via
+``jax.distributed.initialize`` over a local coordinator — the TPU-build
+analogue of the reference's `tools/launch.py -n 4 dist_sync_kvstore.py`
+single-host multi-process harness. Skipped when the platform cannot
+spawn the process group (sandboxed CI without localhost sockets).
+"""
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+_NPROC = 2
+
+_WORKER = r'''
+import sys
+import numpy as np
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.distributed.initialize(coordinator_address=sys.argv[1],
+                           num_processes=int(sys.argv[2]),
+                           process_id=int(sys.argv[3]))
+import mxnet_tpu as mx
+
+# the in-program psum path must run — its host-allgather fallback warns,
+# and this test exists to prove the collective path, so warning = failure
+import warnings
+warnings.filterwarnings("error", message=".*in-program collective.*")
+
+kv = mx.kv.create("tpu_sync")
+rank, nw = kv.rank, kv.num_workers
+assert nw == int(sys.argv[2]), (nw, sys.argv[2])
+shape = (3, 3)
+big_shape = (50, 4)
+
+# init is a broadcast: every worker starts from the same value
+kv.init(3, mx.nd.ones(shape))
+kv.init(99, mx.nd.ones(big_shape))
+
+# one push per worker of (rank+1)*ones → pull must see the global sum
+kv.push(3, mx.nd.ones(shape) * (rank + 1))
+out = mx.nd.zeros(shape)
+kv.pull(3, out=out)
+want = sum(r + 1 for r in range(nw))
+assert np.allclose(out.asnumpy(), want), (out.asnumpy(), want)
+
+# repeated pushes keep reducing fresh values (ref :43-52 loop)
+for it in range(3):
+    kv.push(99, mx.nd.ones(big_shape) * (it + rank))
+    out = mx.nd.zeros(big_shape)
+    kv.pull(99, out=out)
+    want = sum(it + r for r in range(nw))
+    assert np.allclose(out.asnumpy(), want), (it, out.asnumpy(), want)
+
+# rank-dependent values: every worker must agree on the reduced result
+kv.init(7, mx.nd.zeros(shape))
+val = np.arange(9, dtype=np.float32).reshape(shape) * (rank + 1)
+kv.push(7, mx.nd.array(val))
+out = mx.nd.zeros(shape)
+kv.pull(7, out=out)
+want = np.arange(9, dtype=np.float32).reshape(shape) * \
+    sum(r + 1 for r in range(nw))
+assert np.allclose(out.asnumpy(), want)
+
+print("WORKER_OK", rank, flush=True)
+'''
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def test_tpu_sync_two_process_allreduce(tmp_path):
+    port = _free_port()
+    coord = "127.0.0.1:%d" % port
+    script = tmp_path / "worker.py"
+    script.write_text(_WORKER)
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)          # one CPU device per process
+    env["JAX_NUM_CPU_DEVICES"] = "1"
+    env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+    procs = [subprocess.Popen(
+        [sys.executable, str(script), coord, str(_NPROC), str(i)],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, env=env,
+        cwd=repo_root) for i in range(_NPROC)]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=300)
+            outs.append(out.decode())
+    except subprocess.TimeoutExpired:
+        for p in procs:
+            p.kill()
+        pytest.skip("jax.distributed process group did not come up "
+                    "(no localhost sockets?)")
+    for i, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, \
+            "worker %d failed:\n%s" % (i, out[-3000:])
+        assert "WORKER_OK %d" % i in out

@@ -268,3 +268,30 @@ class TestPTBStyleConvergence:
         assert last < first * 0.5, \
             "perplexity did not drop: first=%.2f last=%.2f" % (first, last)
         assert last < 4.0, "final perplexity too high: %.2f" % last
+
+
+class TestEdgeCases:
+    def test_unroll_length_one_tnc(self):
+        """length==1 TNC unroll must keep (B, I) step shape."""
+        cell = mx.rnn.RNNCell(4, prefix="u1_")
+        data = mx.sym.var("data")       # (1, B, I)
+        outs, states = cell.unroll(1, data, layout="TNC",
+                                   merge_outputs=True)
+        rng = np.random.RandomState(0)
+        args = {"data": mx.nd.array(rng.randn(1, 3, 2).astype(np.float32)),
+                "u1_i2h_weight": mx.nd.array(
+                    rng.randn(4, 2).astype(np.float32)),
+                "u1_i2h_bias": mx.nd.zeros((4,)),
+                "u1_h2h_weight": mx.nd.array(
+                    rng.randn(4, 4).astype(np.float32)),
+                "u1_h2h_bias": mx.nd.zeros((4,))}
+        ex = outs.bind(default_context(), args)
+        assert ex.forward()[0].shape == (1, 3, 4)
+
+    def test_bucket_iter_empty_bucket_ok(self):
+        """An explicit bucket no sentence fits must not crash."""
+        sents = [[1, 2, 3, 4, 5, 6, 7]] * 8     # all length 7
+        it = mx.rnn.BucketSentenceIter(sents, batch_size=4,
+                                       buckets=[3, 10], invalid_label=0)
+        keys = {b.bucket_key for b in it}
+        assert keys == {10}

@@ -1,0 +1,104 @@
+"""TPU test lane (round-1 task 5 / VERDICT r2 task 6).
+
+The main suite pins jax to the virtual CPU mesh (conftest.py), so this
+lane spawns a subprocess on the DEFAULT platform — the real TPU when
+one is attached — and runs the cross-backend oracle: the same graphs
+bound on ``mx.cpu()`` and ``mx.tpu()``, outputs compared via
+``check_consistency`` (the reference's cpu-vs-gpu oracle,
+ref: python/mxnet/test_utils.py:1224). This is the lane that catches
+placement and compiled-backend regressions (both round-1/2 bug classes).
+Degrades to cpu-vs-cpu when no accelerator is attached (still exercises
+the lane end to end).
+"""
+import os
+import subprocess
+import sys
+
+_LANE = r'''
+import numpy as np
+import jax
+jax.config.update("jax_default_matmul_precision", "highest")
+import mxnet_tpu as mx
+from mxnet_tpu.test_utils import check_consistency
+
+has_tpu = any(d.platform != "cpu" for d in jax.devices())
+ctxs = [mx.cpu(), mx.tpu(0)] if has_tpu else [mx.cpu(), mx.cpu()]
+print("LANE ctxs:", ctxs, flush=True)
+rng = np.random.RandomState(0)
+
+def P(**shapes):
+    return {n: rng.normal(0, 1, s).astype(np.float32)
+            for n, s in shapes.items()}
+
+# core ops (FC, conv+BN+relu block, softmax, pooling, reduce, elemwise)
+x = mx.sym.var("x")
+w = mx.sym.var("w")
+b = mx.sym.var("b")
+check_consistency(mx.sym.FullyConnected(x, w, b, num_hidden=8),
+                  ctx_list=ctxs,
+                  arg_params=P(x=(4, 16), w=(8, 16), b=(8,)))
+
+conv = mx.sym.Convolution(x, w, b, kernel=(3, 3), num_filter=6,
+                          pad=(1, 1))
+gamma = mx.sym.var("gamma"); beta = mx.sym.var("beta")
+mmean = mx.sym.var("mmean"); mvar = mx.sym.var("mvar")
+bn = mx.sym.BatchNorm(conv, gamma, beta, mmean, mvar, fix_gamma=False)
+blk = mx.sym.Activation(bn, act_type="relu")
+params = P(x=(2, 3, 8, 8), w=(6, 3, 3, 3), b=(6,),
+           gamma=(6,), beta=(6,))
+params["mmean"] = np.zeros(6, np.float32)
+params["mvar"] = np.ones(6, np.float32)
+check_consistency(blk, ctx_list=ctxs, arg_params=params, tol=2e-3)
+
+check_consistency(mx.sym.softmax(x), ctx_list=ctxs,
+                  arg_params=P(x=(4, 10)))
+check_consistency(mx.sym.Pooling(x, kernel=(2, 2), stride=(2, 2),
+                                 pool_type="max"),
+                  ctx_list=ctxs, arg_params=P(x=(2, 3, 8, 8)))
+check_consistency(mx.sym.sum(mx.sym.exp(x), axis=1), ctx_list=ctxs,
+                  arg_params=P(x=(5, 7)))
+check_consistency(mx.sym.dot(x, w), ctx_list=ctxs,
+                  arg_params=P(x=(4, 8), w=(8, 3)), tol=1e-3)
+
+# a ResNet block: conv-bn-relu-conv-bn + identity, relu
+c1 = mx.sym.Convolution(x, mx.sym.var("w1"), no_bias=True,
+                        kernel=(3, 3), num_filter=4, pad=(1, 1))
+b1 = mx.sym.BatchNorm(c1, mx.sym.var("g1"), mx.sym.var("be1"),
+                      mx.sym.var("m1"), mx.sym.var("v1"),
+                      fix_gamma=False)
+r1 = mx.sym.Activation(b1, act_type="relu")
+c2 = mx.sym.Convolution(r1, mx.sym.var("w2"), no_bias=True,
+                        kernel=(3, 3), num_filter=4, pad=(1, 1))
+b2 = mx.sym.BatchNorm(c2, mx.sym.var("g2"), mx.sym.var("be2"),
+                      mx.sym.var("m2"), mx.sym.var("v2"),
+                      fix_gamma=False)
+res = mx.sym.Activation(b2 + x, act_type="relu")
+p = P(x=(2, 4, 8, 8), w1=(4, 4, 3, 3), w2=(4, 4, 3, 3),
+      g1=(4,), be1=(4,), g2=(4,), be2=(4,))
+for n in ("m1", "m2"):
+    p[n] = np.zeros(4, np.float32)
+for n in ("v1", "v2"):
+    p[n] = np.ones(4, np.float32)
+check_consistency(res, ctx_list=ctxs, arg_params=p, tol=2e-3)
+
+print("LANE_OK", flush=True)
+'''
+
+
+def test_tpu_cpu_consistency_lane(tmp_path):
+    script = tmp_path / "lane.py"
+    script.write_text(_LANE)
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    # default platform: the real TPU when attached (conftest pins THIS
+    # process to cpu; the lane subprocess must not inherit that)
+    env.pop("JAX_PLATFORMS", None)
+    env.pop("XLA_FLAGS", None)
+    env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+    out = subprocess.run([sys.executable, str(script)], env=env,
+                         cwd=repo_root, capture_output=True, text=True,
+                         timeout=1200)
+    assert out.returncode == 0, \
+        "TPU lane failed:\n%s\n%s" % (out.stdout[-3000:],
+                                      out.stderr[-3000:])
+    assert "LANE_OK" in out.stdout
