@@ -1,7 +1,15 @@
-"""Gluon losses (parity: python/mxnet/gluon/loss.py, 837 LoC)."""
+"""Gluon losses (API parity: python/mxnet/gluon/loss.py, 837 LoC).
+
+Own structure: the shared pipeline — reshape label like pred, compute a
+pointwise penalty, apply weighting, reduce over non-batch axes — lives
+once in :class:`_PointwiseLoss`; each standard loss only supplies its
+penalty in ``_penalty``. Losses with non-standard arity (CTC, Triplet,
+CosineEmbedding, SigmoidBCE with pos_weight) override
+``hybrid_forward`` directly.
+"""
 from __future__ import annotations
 
-import numpy as np
+import math
 
 from ..base import numeric_types
 from .block import HybridBlock
@@ -9,22 +17,28 @@ from .block import HybridBlock
 __all__ = ["Loss", "L2Loss", "L1Loss", "SigmoidBinaryCrossEntropyLoss",
            "SigmoidBCELoss", "SoftmaxCrossEntropyLoss", "SoftmaxCELoss",
            "KLDivLoss", "CTCLoss", "HuberLoss", "HingeLoss",
-           "SquaredHingeLoss", "LogisticLoss", "TripletLoss", "PoissonNLLLoss",
-           "CosineEmbeddingLoss"]
+           "SquaredHingeLoss", "LogisticLoss", "TripletLoss",
+           "PoissonNLLLoss", "CosineEmbeddingLoss"]
 
 
 def _apply_weighting(F, loss, weight=None, sample_weight=None):
-    """(reference: loss.py:39)"""
+    """Per-sample then global weighting (reference: loss.py:39)."""
     if sample_weight is not None:
         loss = F.broadcast_mul(loss, sample_weight)
     if weight is not None:
-        assert isinstance(weight, numeric_types), "weight must be a number"
+        if not isinstance(weight, numeric_types):
+            raise AssertionError("weight must be a number")
         loss = loss * weight
     return loss
 
 
 def _reshape_like(F, x, y):
     return x.reshape(y.shape)
+
+
+def _softplus(F, x):
+    """log(1+e^x) — the stable building block of the sigmoid-CE family."""
+    return F.Activation(x, act_type="softrelu")
 
 
 class Loss(HybridBlock):
@@ -36,207 +50,218 @@ class Loss(HybridBlock):
         self._batch_axis = batch_axis
 
     def __repr__(self):
-        s = '{name}(batch_axis={_batch_axis}, w={_weight})'
-        return s.format(name=self.__class__.__name__, **self.__dict__)
+        return "{}(batch_axis={}, w={})".format(
+            type(self).__name__, self._batch_axis, self._weight)
+
+    def _finish(self, F, loss, sample_weight):
+        """Weighting + mean over non-batch axes — the common tail."""
+        loss = _apply_weighting(F, loss, self._weight, sample_weight)
+        return F.mean(loss, axis=self._batch_axis, exclude=True)
 
     def hybrid_forward(self, F, x, *args, **kwargs):
         raise NotImplementedError
 
 
-class L2Loss(Loss):
-    def __init__(self, weight=1., batch_axis=0, **kwargs):
-        super().__init__(weight, batch_axis, **kwargs)
+class _PointwiseLoss(Loss):
+    """Template for losses of the form mean(penalty(pred, label))."""
 
-    def hybrid_forward(self, F, pred, label, sample_weight=None):
-        label = _reshape_like(F, label, pred)
-        loss = F.square(label - pred)
-        loss = _apply_weighting(F, loss, self._weight / 2, sample_weight)
-        return F.mean(loss, axis=self._batch_axis, exclude=True)
-
-
-class L1Loss(Loss):
     def __init__(self, weight=None, batch_axis=0, **kwargs):
         super().__init__(weight, batch_axis, **kwargs)
 
+    def _penalty(self, F, pred, label):
+        raise NotImplementedError
+
     def hybrid_forward(self, F, pred, label, sample_weight=None):
         label = _reshape_like(F, label, pred)
-        loss = F.abs(label - pred)
-        loss = _apply_weighting(F, loss, self._weight, sample_weight)
-        return F.mean(loss, axis=self._batch_axis, exclude=True)
+        return self._finish(F, self._penalty(F, pred, label),
+                            sample_weight)
+
+
+class L2Loss(_PointwiseLoss):
+    """Halved squared error (reference: loss.py:114)."""
+
+    def __init__(self, weight=1., batch_axis=0, **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+
+    def _penalty(self, F, pred, label):
+        # the reference's weight/2 convention lives in this 0.5 factor
+        return F.square(label - pred) * 0.5
+
+
+class L1Loss(_PointwiseLoss):
+    """Absolute error (reference: loss.py:149)."""
+
+    def _penalty(self, F, pred, label):
+        return F.abs(label - pred)
 
 
 class SigmoidBinaryCrossEntropyLoss(Loss):
-    """(reference: loss.py:184)"""
+    """BCE on logits (stable form) or probabilities
+    (reference: loss.py:184)."""
 
     def __init__(self, from_sigmoid=False, weight=None, batch_axis=0,
                  **kwargs):
         super().__init__(weight, batch_axis, **kwargs)
         self._from_sigmoid = from_sigmoid
 
+    @staticmethod
+    def _logit_bce(F, z, y, pos_weight):
+        if pos_weight is None:
+            # max(z,0) - z*y + log(1+e^-|z|)
+            return F.relu(z) - z * y + _softplus(F, -F.abs(z))
+        lw = 1 + F.broadcast_mul(pos_weight - 1, y)
+        return z - z * y + lw * (_softplus(F, -F.abs(z)) + F.relu(-z))
+
+    @staticmethod
+    def _prob_bce(F, p, y, pos_weight):
+        eps = 1e-12
+        pos_term = F.log(p + eps) * y
+        if pos_weight is not None:
+            pos_term = F.broadcast_mul(pos_term, pos_weight)
+        return -(pos_term + F.log(1. - p + eps) * (1. - y))
+
     def hybrid_forward(self, F, pred, label, sample_weight=None,
                        pos_weight=None):
         label = _reshape_like(F, label, pred)
-        if not self._from_sigmoid:
-            if pos_weight is None:
-                loss = F.relu(pred) - pred * label + \
-                    F.Activation(-F.abs(pred), act_type='softrelu')
-            else:
-                log_weight = 1 + F.broadcast_mul(pos_weight - 1, label)
-                loss = pred - pred * label + log_weight * \
-                    (F.Activation(-F.abs(pred), act_type='softrelu')
-                     + F.relu(-pred))
-        else:
-            eps = 1e-12
-            if pos_weight is None:
-                loss = -(F.log(pred + eps) * label
-                         + F.log(1. - pred + eps) * (1. - label))
-            else:
-                loss = -(F.broadcast_mul(F.log(pred + eps) * label,
-                                         pos_weight)
-                         + F.log(1. - pred + eps) * (1. - label))
-        loss = _apply_weighting(F, loss, self._weight, sample_weight)
-        return F.mean(loss, axis=self._batch_axis, exclude=True)
+        core = self._prob_bce if self._from_sigmoid else self._logit_bce
+        return self._finish(F, core(F, pred, label, pos_weight),
+                            sample_weight)
 
 
 SigmoidBCELoss = SigmoidBinaryCrossEntropyLoss
 
 
 class SoftmaxCrossEntropyLoss(Loss):
-    """(reference: loss.py:268)"""
+    """CE over log-softmax, sparse or dense labels
+    (reference: loss.py:268)."""
 
     def __init__(self, axis=-1, sparse_label=True, from_logits=False,
                  weight=None, batch_axis=0, **kwargs):
         super().__init__(weight, batch_axis, **kwargs)
-        self._axis = axis
-        self._sparse_label = sparse_label
+        self._axis, self._sparse_label = axis, sparse_label
         self._from_logits = from_logits
 
     def hybrid_forward(self, F, pred, label, sample_weight=None):
-        if not self._from_logits:
-            pred = F.log_softmax(pred, axis=self._axis)
+        logp = pred if self._from_logits else \
+            F.log_softmax(pred, axis=self._axis)
         if self._sparse_label:
-            loss = -F.pick(pred, label, axis=self._axis, keepdims=True)
+            loss = -F.pick(logp, label, axis=self._axis, keepdims=True)
         else:
-            label = _reshape_like(F, label, pred)
-            loss = -F.sum(pred * label, axis=self._axis, keepdims=True)
-        loss = _apply_weighting(F, loss, self._weight, sample_weight)
-        return F.mean(loss, axis=self._batch_axis, exclude=True)
+            dense = _reshape_like(F, label, logp)
+            loss = -F.sum(logp * dense, axis=self._axis, keepdims=True)
+        return self._finish(F, loss, sample_weight)
 
 
 SoftmaxCELoss = SoftmaxCrossEntropyLoss
 
 
-class KLDivLoss(Loss):
-    def __init__(self, from_logits=True, axis=-1, weight=None, batch_axis=0,
-                 **kwargs):
-        super().__init__(weight, batch_axis, **kwargs)
-        self._from_logits = from_logits
-        self._axis = axis
+class KLDivLoss(_PointwiseLoss):
+    """KL(label || softmax(pred)) (reference: loss.py:344)."""
 
-    def hybrid_forward(self, F, pred, label, sample_weight=None):
-        if not self._from_logits:
-            pred = F.log_softmax(pred, axis=self._axis)
-        loss = label * (F.log(label + 1e-12) - pred)
-        loss = _apply_weighting(F, loss, self._weight, sample_weight)
-        return F.mean(loss, axis=self._batch_axis, exclude=True)
+    def __init__(self, from_logits=True, axis=-1, weight=None,
+                 batch_axis=0, **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+        self._from_logits, self._axis = from_logits, axis
+
+    def _penalty(self, F, pred, label):
+        logp = pred if self._from_logits else \
+            F.log_softmax(pred, axis=self._axis)
+        return label * (F.log(label + 1e-12) - logp)
 
 
 class CTCLoss(Loss):
-    """Connectionist Temporal Classification loss
+    """Connectionist Temporal Classification
     (reference: loss.py:403, src/operator/contrib/ctc_loss.cc).
-    TPU-native: optax.ctc_loss under the hood."""
+    TPU-native: lowers to the _contrib_ctc_loss op (optax.ctc_loss)."""
+
+    _PRED_LAYOUTS = ("NTC", "TNC")
+    _LABEL_LAYOUTS = ("NT", "TN")
 
     def __init__(self, layout='NTC', label_layout='NT', weight=None,
                  **kwargs):
-        assert layout in ['NTC', 'TNC'], \
-            "Only 'NTC' and 'TNC' layouts for pred are supported, " \
-            "got: %s" % layout
-        assert label_layout in ['NT', 'TN'], \
-            "Only 'NT' and 'TN' layouts for label are supported, " \
-            "got: %s" % label_layout
-        self._layout = layout
-        self._label_layout = label_layout
-        batch_axis = label_layout.find('N')
-        super().__init__(weight, batch_axis, **kwargs)
+        if layout not in self._PRED_LAYOUTS:
+            raise AssertionError(
+                "Only 'NTC' and 'TNC' layouts for pred are supported, "
+                "got: %s" % layout)
+        if label_layout not in self._LABEL_LAYOUTS:
+            raise AssertionError(
+                "Only 'NT' and 'TN' layouts for label are supported, "
+                "got: %s" % label_layout)
+        self._layout, self._label_layout = layout, label_layout
+        super().__init__(weight, label_layout.find('N'), **kwargs)
 
     def hybrid_forward(self, F, pred, label, pred_lengths=None,
                        label_lengths=None, sample_weight=None):
-        if self._layout == 'NTC':
-            pred = F.SwapAxis(pred, dim1=0, dim2=1)  # → TNC (op layout)
-        if self._label_layout == 'TN':
+        # the op wants TNC preds / NT labels
+        if self._layout != 'TNC':
+            pred = F.SwapAxis(pred, dim1=0, dim2=1)
+        if self._label_layout != 'NT':
             label = F.SwapAxis(label, dim1=0, dim2=1)
-        tensors = [pred, label]
-        if pred_lengths is not None:
-            tensors.append(pred_lengths)
-        if label_lengths is not None:
-            tensors.append(label_lengths)
+        operands = [pred, label]
+        for opt in (pred_lengths, label_lengths):
+            if opt is not None:
+                operands.append(opt)
         loss = F._contrib_ctc_loss(
-            *tensors,
+            *operands,
             use_data_lengths=pred_lengths is not None,
             use_label_lengths=label_lengths is not None)
-        loss = _apply_weighting(F, loss, self._weight, sample_weight)
-        return loss
+        return _apply_weighting(F, loss, self._weight, sample_weight)
 
 
-class HuberLoss(Loss):
+class HuberLoss(_PointwiseLoss):
+    """Quadratic near zero, linear past rho (reference: loss.py:469)."""
+
     def __init__(self, rho=1, weight=None, batch_axis=0, **kwargs):
-        super().__init__(weight, batch_axis, **kwargs)
+        super().__init__(weight=weight, batch_axis=batch_axis, **kwargs)
         self._rho = rho
 
-    def hybrid_forward(self, F, pred, label, sample_weight=None):
-        label = _reshape_like(F, label, pred)
-        loss = F.abs(label - pred)
-        loss = F.where(loss > self._rho,
-                       loss - 0.5 * self._rho,
-                       (0.5 / self._rho) * F.square(loss))
-        loss = _apply_weighting(F, loss, self._weight, sample_weight)
-        return F.mean(loss, axis=self._batch_axis, exclude=True)
+    def _penalty(self, F, pred, label):
+        err = F.abs(label - pred)
+        quad = (0.5 / self._rho) * F.square(err)
+        return F.where(err > self._rho, err - 0.5 * self._rho, quad)
 
 
-class HingeLoss(Loss):
+class HingeLoss(_PointwiseLoss):
+    """max(0, margin - pred*label) (reference: loss.py:514)."""
+
     def __init__(self, margin=1, weight=None, batch_axis=0, **kwargs):
-        super().__init__(weight, batch_axis, **kwargs)
+        super().__init__(weight=weight, batch_axis=batch_axis, **kwargs)
         self._margin = margin
 
-    def hybrid_forward(self, F, pred, label, sample_weight=None):
-        label = _reshape_like(F, label, pred)
-        loss = F.relu(self._margin - pred * label)
-        loss = _apply_weighting(F, loss, self._weight, sample_weight)
-        return F.mean(loss, axis=self._batch_axis, exclude=True)
+    def _penalty(self, F, pred, label):
+        return F.relu(self._margin - pred * label)
 
 
-class SquaredHingeLoss(Loss):
-    def __init__(self, margin=1, weight=None, batch_axis=0, **kwargs):
-        super().__init__(weight, batch_axis, **kwargs)
-        self._margin = margin
+class SquaredHingeLoss(HingeLoss):
+    """Squared hinge (reference: loss.py:557)."""
 
-    def hybrid_forward(self, F, pred, label, sample_weight=None):
-        label = _reshape_like(F, label, pred)
-        loss = F.square(F.relu(self._margin - pred * label))
-        loss = _apply_weighting(F, loss, self._weight, sample_weight)
-        return F.mean(loss, axis=self._batch_axis, exclude=True)
+    def _penalty(self, F, pred, label):
+        return F.square(super()._penalty(F, pred, label))
 
 
-class LogisticLoss(Loss):
-    def __init__(self, weight=None, batch_axis=0, label_format='signed',
-                 **kwargs):
-        super().__init__(weight, batch_axis, **kwargs)
+class LogisticLoss(_PointwiseLoss):
+    """Stable log(1+e^{-pred*label}) via the BCE form
+    (reference: loss.py:600)."""
+
+    def __init__(self, weight=None, batch_axis=0,
+                 label_format='signed', **kwargs):
+        super().__init__(weight=weight, batch_axis=batch_axis, **kwargs)
+        if label_format not in ("signed", "binary"):
+            raise ValueError(
+                "label_format can only be signed or binary, recieved %s."
+                % label_format)
         self._label_format = label_format
-        if self._label_format not in ["signed", "binary"]:
-            raise ValueError("label_format can only be signed or binary, "
-                             "recieved %s." % label_format)
 
-    def hybrid_forward(self, F, pred, label, sample_weight=None):
-        label = _reshape_like(F, label, pred)
+    def _penalty(self, F, pred, label):
         if self._label_format == 'signed':
-            label = (label + 1.0) / 2.0
-        loss = F.relu(pred) - pred * label + \
-            F.Activation(-F.abs(pred), act_type='softrelu')
-        loss = _apply_weighting(F, loss, self._weight, sample_weight)
-        return F.mean(loss, axis=self._batch_axis, exclude=True)
+            label = (label + 1.0) * 0.5        # {-1,1} → {0,1}
+        return F.relu(pred) - pred * label + _softplus(F, -F.abs(pred))
 
 
 class TripletLoss(Loss):
+    """max(0, margin + |pos-pred|² - |neg-pred|²)
+    (reference: loss.py:650)."""
+
     def __init__(self, margin=1, weight=None, batch_axis=0, **kwargs):
         super().__init__(weight, batch_axis, **kwargs)
         self._margin = margin
@@ -244,52 +269,55 @@ class TripletLoss(Loss):
     def hybrid_forward(self, F, pred, positive, negative):
         positive = _reshape_like(F, positive, pred)
         negative = _reshape_like(F, negative, pred)
-        loss = F.sum(F.square(positive - pred) - F.square(negative - pred),
-                     axis=self._batch_axis, exclude=True)
-        loss = F.relu(loss + self._margin)
-        return _apply_weighting(F, loss, self._weight, None)
+        gap = F.square(positive - pred) - F.square(negative - pred)
+        per_sample = F.sum(gap, axis=self._batch_axis, exclude=True)
+        return _apply_weighting(F, F.relu(per_sample + self._margin),
+                                self._weight, None)
 
 
 class PoissonNLLLoss(Loss):
+    """Poisson negative log likelihood (reference: loss.py:699)."""
+
     def __init__(self, weight=None, from_logits=True, batch_axis=0,
                  compute_full=False, **kwargs):
         super().__init__(weight, batch_axis, **kwargs)
-        self._from_logits = from_logits
-        self._compute_full = compute_full
+        self._from_logits, self._compute_full = from_logits, compute_full
 
     def hybrid_forward(self, F, pred, target, sample_weight=None,
                        epsilon=1e-08):
         target = _reshape_like(F, target, pred)
         if self._from_logits:
-            loss = F.exp(pred) - target * pred
+            nll = F.exp(pred) - target * pred
         else:
-            loss = pred - target * F.log(pred + epsilon)
+            nll = pred - target * F.log(pred + epsilon)
         if self._compute_full:
-            stirling_factor = target * F.log(target) - target + \
-                0.5 * F.log(2 * target * np.pi)
-            stirling_factor = stirling_factor * (target > 1)
-            loss = loss + stirling_factor
-        loss = _apply_weighting(F, loss, self._weight, sample_weight)
-        return F.mean(loss)
+            # Stirling correction for target > 1
+            stirling = target * F.log(target) - target + \
+                0.5 * F.log(2 * math.pi * target)
+            nll = nll + stirling * (target > 1)
+        nll = _apply_weighting(F, nll, self._weight, sample_weight)
+        return F.mean(nll)
 
 
 class CosineEmbeddingLoss(Loss):
+    """1-cos for positive pairs, relu(cos-margin) for negative
+    (reference: loss.py:756)."""
+
     def __init__(self, weight=None, batch_axis=0, margin=0, **kwargs):
         super().__init__(weight, batch_axis, **kwargs)
         self._margin = margin
 
+    @staticmethod
+    def _cosine(F, x, y, axis=-1):
+        dot = F.sum(x * y, axis=axis).reshape((-1, 1))
+        nx = F.norm(x, axis=axis).reshape((-1, 1))
+        ny = F.norm(y, axis=axis).reshape((-1, 1))
+        floor = dot * 0 + 1e-12
+        return dot / F.broadcast_maximum(nx * ny, floor)
+
     def hybrid_forward(self, F, input1, input2, label, sample_weight=None):
         input1 = _reshape_like(F, input1, input2)
-        cos_sim = self._cosine_similarity(F, input1, input2)
+        cos = self._cosine(F, input1, input2)
         label = label.reshape((-1, 1))
-        loss = F.where(label == 1, 1 - cos_sim,
-                       F.relu(cos_sim - self._margin))
-        loss = _apply_weighting(F, loss, self._weight, sample_weight)
-        return F.mean(loss, axis=self._batch_axis, exclude=True)
-
-    def _cosine_similarity(self, F, x, y, axis=-1):
-        x_norm = F.norm(x, axis=axis).reshape((-1, 1))
-        y_norm = F.norm(y, axis=axis).reshape((-1, 1))
-        x_dot_y = F.sum(x * y, axis=axis).reshape((-1, 1))
-        eps_arr = x_dot_y * 0 + 1e-12
-        return x_dot_y / F.broadcast_maximum(x_norm * y_norm, eps_arr)
+        loss = F.where(label == 1, 1 - cos, F.relu(cos - self._margin))
+        return self._finish(F, loss, sample_weight)

@@ -1,4 +1,13 @@
-"""Weight initializers (parity: python/mxnet/initializer.py)."""
+"""Weight initializers (API parity: python/mxnet/initializer.py).
+
+Own structure: name-suffix routing is a declarative table
+(`_SUFFIX_ROUTES`) rather than an if/elif chain, and every built-in
+initializer is a tiny `_generate(name, shape) -> ndarray` under a
+shared write path. Subclasses may still override ``_init_weight(name,
+arr)`` — the documented extension point the reference established —
+and everything funnels through one `_set` so dtype/placement handling
+lives in a single place.
+"""
 from __future__ import annotations
 
 import json
@@ -16,14 +25,14 @@ _REG: Registry = Registry("initializer", case_sensitive=False)
 
 
 class InitDesc(str):
-    """Name + attrs descriptor passed to initializers
+    """Parameter name enriched with attrs + the global initializer
     (reference: initializer.py:37)."""
 
     def __new__(cls, name, attrs=None, global_init=None):
-        ret = super().__new__(cls, name)
-        ret.attrs = attrs or {}
-        ret.global_init = global_init
-        return ret
+        self = str.__new__(cls, name)
+        self.attrs = attrs or {}
+        self.global_init = global_init
+        return self
 
 
 def register(klass):
@@ -31,108 +40,111 @@ def register(klass):
     return klass
 
 
+# suffix → handler method, first match wins (order matters: the
+# reference's chain is reproduced as data)
+_SUFFIX_ROUTES = (
+    (("weight",), "_init_weight"),
+    (("bias",), "_init_bias"),
+    (("gamma",), "_init_gamma"),
+    (("beta",), "_init_beta"),
+    (("moving_mean", "running_mean", "moving_inv_var", "moving_avg",
+      "min", "max"), "_init_zero"),
+    (("moving_var", "running_var"), "_init_one"),
+)
+
+
 class Initializer:
-    """Base initializer (reference: initializer.py:95)."""
+    """Base initializer: routes a parameter by name suffix, fills the
+    array in place (reference: initializer.py:95)."""
 
     def __init__(self, **kwargs):
         self._kwargs = kwargs
-        self._verbose = False
-        self._print_func = None
+        self._verbose, self._print_func = False, None
 
     def set_verbosity(self, verbose=False, print_func=None):
-        self._verbose = verbose
-        self._print_func = print_func
+        self._verbose, self._print_func = verbose, print_func
         return self
 
     def dumps(self):
-        return json.dumps([self.__class__.__name__.lower(), self._kwargs])
+        """Serialized [name, kwargs] form consumed by ``create``."""
+        return json.dumps([type(self).__name__.lower(), self._kwargs])
 
     def __call__(self, desc, arr):
         if not isinstance(desc, str):
-            raise TypeError("desc must be a string or InitDesc")
-        if getattr(desc, "global_init", None) is None and \
-                isinstance(desc, InitDesc):
+            raise TypeError(
+                "initializer expects a parameter name (str/InitDesc), "
+                "got %s" % type(desc))
+        if isinstance(desc, InitDesc) and desc.global_init is None:
             desc.global_init = self
-        init = getattr(desc, "attrs", {}).get("__init__", "")
-        if init:
-            klass, kwargs = json.loads(init)
-            create(klass, **kwargs)._init_weight(desc, arr)
+        override = getattr(desc, "attrs", {}).get("__init__")
+        if override:
+            kind, kwargs = json.loads(override)
+            create(kind, **kwargs)._init_weight(desc, arr)
             return
-        name = str(desc)
-        if name.endswith("weight"):
-            self._init_weight(name, arr)
-        elif name.endswith("bias"):
-            self._init_bias(name, arr)
-        elif name.endswith("gamma"):
-            self._init_gamma(name, arr)
-        elif name.endswith("beta"):
-            self._init_beta(name, arr)
-        elif name.endswith("moving_mean") or name.endswith("running_mean"):
-            self._init_zero(name, arr)
-        elif name.endswith("moving_var") or name.endswith("running_var"):
-            self._init_one(name, arr)
-        elif name.endswith("moving_inv_var") or name.endswith("moving_avg"):
-            self._init_zero(name, arr)
-        elif name.endswith("min") or name.endswith("max"):
-            self._init_zero(name, arr)
-        else:
-            self._init_default(name, arr)
+        for suffixes, method in _SUFFIX_ROUTES:
+            if str(desc).endswith(suffixes):
+                getattr(self, method)(desc, arr)
+                return
+        self._init_default(desc, arr)
 
-    def _set(self, arr, np_value):
+    # -- write path -------------------------------------------------------
+    def _set(self, arr, value):
         from .ndarray import array as nd_array
-        arr[:] = nd_array(np.asarray(np_value, dtype=arr.dtype))
+        arr[:] = nd_array(np.asarray(value, dtype=arr.dtype))
 
+    # -- per-kind handlers (subclass extension points) --------------------
     def _init_zero(self, name, arr):
         self._set(arr, np.zeros(arr.shape))
 
     def _init_one(self, name, arr):
         self._set(arr, np.ones(arr.shape))
 
-    def _init_bias(self, name, arr):
-        self._init_zero(name, arr)
-
-    def _init_gamma(self, name, arr):
-        self._init_one(name, arr)
-
-    def _init_beta(self, name, arr):
-        self._init_zero(name, arr)
+    _init_bias = _init_zero
+    _init_beta = _init_zero
+    _init_gamma = _init_one
 
     def _init_weight(self, name, arr):
-        raise NotImplementedError("Must override it")
+        self._set(arr, self._generate(name, arr.shape))
+
+    def _generate(self, name, shape):
+        raise NotImplementedError(
+            "%s must implement _generate or override _init_weight"
+            % type(self).__name__)
 
     def _init_default(self, name, arr):
         raise ValueError(
-            'Unknown initialization pattern for %s. Default initialization '
-            'is now limited to "weight", "bias", "gamma" and "beta". Pass an '
-            'explicit Initializer to init these arrays.' % name)
+            "no initialization rule for %r: only *weight/*bias/*gamma/"
+            "*beta (and BatchNorm stats) route automatically — pass an "
+            "explicit Initializer for this array" % str(name))
+
+
+class _EverywhereMixin:
+    """Initializers that apply to any parameter kind, not just weights."""
+
+    def _init_default(self, name, arr):
+        self._init_weight(name, arr)
 
 
 @register
-class Zero(Initializer):
-    def _init_weight(self, _, arr):
-        self._init_zero(_, arr)
-
-    _init_default = _init_weight
+class Zero(_EverywhereMixin, Initializer):
+    def _generate(self, name, shape):
+        return np.zeros(shape)
 
 
 @register
-class One(Initializer):
-    def _init_weight(self, _, arr):
-        self._init_one(_, arr)
-
-    _init_default = _init_weight
+class One(_EverywhereMixin, Initializer):
+    def _generate(self, name, shape):
+        return np.ones(shape)
 
 
 @register
-class Constant(Initializer):
+class Constant(_EverywhereMixin, Initializer):
     def __init__(self, value=0.0):
         super().__init__(value=value)
         self.value = value
 
-    def _init_weight(self, _, arr):
-        self._set(arr, np.full(arr.shape, self.value))
-
-    _init_default = _init_weight
+    def _generate(self, name, shape):
+        return np.full(shape, self.value)
 
 
 @register
@@ -141,8 +153,8 @@ class Uniform(Initializer):
         super().__init__(scale=scale)
         self.scale = scale
 
-    def _init_weight(self, _, arr):
-        self._set(arr, np.random.uniform(-self.scale, self.scale, arr.shape))
+    def _generate(self, name, shape):
+        return np.random.uniform(-self.scale, self.scale, shape)
 
 
 @register
@@ -151,134 +163,150 @@ class Normal(Initializer):
         super().__init__(sigma=sigma)
         self.sigma = sigma
 
-    def _init_weight(self, _, arr):
-        self._set(arr, np.random.normal(0, self.sigma, arr.shape))
+    def _generate(self, name, shape):
+        return np.random.normal(0.0, self.sigma, shape)
 
 
 @register
 class Orthogonal(Initializer):
+    """SVD-orthogonalized random matrix (reference: initializer.py:482)."""
+
     def __init__(self, scale=1.414, rand_type="uniform"):
         super().__init__(scale=scale, rand_type=rand_type)
-        self.scale = scale
-        self.rand_type = rand_type
+        self.scale, self.rand_type = scale, rand_type
 
-    def _init_weight(self, _, arr):
-        nout = arr.shape[0]
-        nin = int(np.prod(arr.shape[1:]))
-        if self.rand_type == "uniform":
-            tmp = np.random.uniform(-1, 1, (nout, nin))
-        else:
-            tmp = np.random.normal(0, 1, (nout, nin))
-        u, _, v = np.linalg.svd(tmp, full_matrices=False)
-        q = u if u.shape == tmp.shape else v
-        self._set(arr, (self.scale * q).reshape(arr.shape))
+    def _generate(self, name, shape):
+        rows, cols = shape[0], int(np.prod(shape[1:]))
+        seed = np.random.uniform(-1, 1, (rows, cols)) \
+            if self.rand_type == "uniform" \
+            else np.random.normal(0, 1, (rows, cols))
+        u, _, vt = np.linalg.svd(seed, full_matrices=False)
+        basis = u if u.shape == seed.shape else vt
+        return (self.scale * basis).reshape(shape)
+
+
+def _fans(name, shape):
+    """(fan_in, fan_out) with conv receptive-field scaling."""
+    if len(shape) < 2:
+        raise ValueError(
+            "Xavier-family initializers need >= 2 dims; %r has shape %s"
+            % (str(name), (shape,)))
+    field = np.prod(shape[2:]) if len(shape) > 2 else 1.0
+    return shape[1] * field, shape[0] * field
 
 
 @register
 class Xavier(Initializer):
-    """Xavier/Glorot (reference: initializer.py:540)."""
+    """Glorot scaling (reference: initializer.py:540)."""
+
+    _FACTORS = {
+        "avg": lambda fi, fo: (fi + fo) / 2.0,
+        "in": lambda fi, fo: fi,
+        "out": lambda fi, fo: fo,
+    }
 
     def __init__(self, rnd_type="uniform", factor_type="avg", magnitude=3):
         super().__init__(rnd_type=rnd_type, factor_type=factor_type,
                          magnitude=magnitude)
-        self.rnd_type = rnd_type
-        self.factor_type = factor_type
+        self.rnd_type, self.factor_type = rnd_type, factor_type
         self.magnitude = float(magnitude)
 
-    def _init_weight(self, name, arr):
-        shape = arr.shape
-        hw_scale = 1.
-        if len(shape) < 2:
+    def _generate(self, name, shape):
+        try:
+            factor = self._FACTORS[self.factor_type](*_fans(name, shape))
+        except KeyError:
             raise ValueError(
-                'Xavier initializer cannot be applied to vector {0}. It '
-                'requires at least 2D.'.format(name))
-        if len(shape) > 2:
-            hw_scale = np.prod(shape[2:])
-        fan_in, fan_out = shape[1] * hw_scale, shape[0] * hw_scale
-        factor = 1.
-        if self.factor_type == "avg":
-            factor = (fan_in + fan_out) / 2.0
-        elif self.factor_type == "in":
-            factor = fan_in
-        elif self.factor_type == "out":
-            factor = fan_out
-        else:
-            raise ValueError("Incorrect factor type")
-        scale = np.sqrt(self.magnitude / factor)
+                "factor_type must be avg/in/out, got %r"
+                % (self.factor_type,))
+        bound = np.sqrt(self.magnitude / factor)
         if self.rnd_type == "uniform":
-            self._set(arr, np.random.uniform(-scale, scale, shape))
-        elif self.rnd_type == "gaussian":
-            self._set(arr, np.random.normal(0, scale, shape))
-        else:
-            raise ValueError("Unknown random type")
+            return np.random.uniform(-bound, bound, shape)
+        if self.rnd_type == "gaussian":
+            return np.random.normal(0.0, bound, shape)
+        raise ValueError("rnd_type must be uniform/gaussian, got %r"
+                         % (self.rnd_type,))
 
 
 @register
 class MSRAPrelu(Xavier):
+    """He/MSRA init specialised for PReLU slopes
+    (reference: initializer.py:626)."""
+
     def __init__(self, factor_type="avg", slope=0.25):
-        magnitude = 2. / (1 + slope ** 2)
-        super().__init__("gaussian", factor_type, magnitude)
+        super().__init__("gaussian", factor_type, 2.0 / (1 + slope ** 2))
         self._kwargs = {"factor_type": factor_type, "slope": slope}
 
 
 @register
 class Bilinear(Initializer):
-    def _init_weight(self, _, arr):
-        weight = np.zeros(arr.shape, dtype="float32")
-        shape = arr.shape
-        f = np.ceil(shape[3] / 2.)
-        c = (2 * f - 1 - f % 2) / (2. * f)
-        for i in range(int(np.prod(shape))):
-            x = i % shape[3]
-            y = (i // shape[3]) % shape[2]
-            weight.flat[i] = (1 - abs(x / f - c)) * (1 - abs(y / f - c))
-        self._set(arr, weight)
+    """Bilinear upsampling kernel for deconvolution
+    (reference: initializer.py:657)."""
+
+    def _generate(self, name, shape):
+        kw = shape[3]
+        kh = shape[2]
+        f = np.ceil(kw / 2.0)
+        c = (2 * f - 1 - f % 2) / (2.0 * f)
+        xs = np.arange(kw)
+        ys = np.arange(kh)
+        kernel = np.outer(1 - np.abs(ys / f - c), 1 - np.abs(xs / f - c))
+        return np.broadcast_to(kernel, shape)
 
 
 @register
 class LSTMBias(Initializer):
-    """Forget-gate bias 1.0, rest 0 (reference: initializer.py:685)."""
+    """1.0 on the forget-gate quarter, zero elsewhere
+    (reference: initializer.py:685)."""
 
     def __init__(self, forget_bias=1.0):
         super().__init__(forget_bias=forget_bias)
         self.forget_bias = forget_bias
 
-    def _init_weight(self, name, arr):
-        b = np.zeros(arr.shape, dtype="float32")
-        num_hidden = int(b.shape[0] / 4)
-        b[num_hidden:2 * num_hidden] = self.forget_bias
-        self._set(arr, b)
+    def _generate(self, name, shape):
+        vec = np.zeros(shape, dtype="float32")
+        h = shape[0] // 4
+        vec[h:2 * h] = self.forget_bias
+        return vec
 
-    _init_default = _init_weight
-    _init_bias = _init_weight
+    def _init_default(self, name, arr):
+        self._init_weight(name, arr)
+
+    _init_bias = Initializer._init_weight
 
 
 @register
 class Mixed(Initializer):
+    """First regex pattern that matches a name picks its initializer
+    (reference: initializer.py:286)."""
+
     def __init__(self, patterns, initializers):
         super().__init__()
-        self.map = list(zip([re.compile(p) for p in patterns], initializers))
+        if len(patterns) != len(initializers):
+            raise ValueError("patterns and initializers must pair up")
+        self.map = [(re.compile(p), ini)
+                    for p, ini in zip(patterns, initializers)]
 
     def __call__(self, name, arr):
-        for prog, init in self.map:
-            if prog.match(str(name)):
-                init(name, arr)
+        for pattern, ini in self.map:
+            if pattern.match(str(name)):
+                ini(name, arr)
                 return
-        raise ValueError('Parameter name %s did not match any pattern.'
-                         % name)
+        raise ValueError(
+            "parameter %r matched none of the Mixed patterns; add a "
+            "'.*' catch-all if that is intended" % str(name))
 
 
-# registry aliases matching the reference (@init.register with alias)
-_REG.register("zeros", allow_override=True)(Zero)
-_REG.register("ones", allow_override=True)(One)
-_REG.register("gaussian", allow_override=True)(Normal)
-_REG.register("msra", allow_override=True)(MSRAPrelu)
+# reference alias names (@mx.init.register alias strings)
+for _alias, _cls in (("zeros", Zero), ("ones", One), ("gaussian", Normal),
+                     ("msra", MSRAPrelu)):
+    _REG.register(_alias, allow_override=True)(_cls)
 
 
 def create(name, **kwargs):
+    """Resolve an initializer from an instance, name, or alias."""
     if isinstance(name, Initializer):
         return name
     cls = _REG.find(str(name))
     if cls is None:
-        raise MXNetError("Unknown initializer %s" % name)
+        raise MXNetError("unknown initializer %r" % (name,))
     return cls(**kwargs)

@@ -1,7 +1,13 @@
-"""Evaluation metrics (parity: python/mxnet/metric.py, 1,649 LoC).
+"""Evaluation metrics (API parity: python/mxnet/metric.py, 1,649 LoC).
 
-Metrics run on host numpy — they sit outside the compiled step, like the
-reference's CPU-side metric updates (SURVEY §3.1 call stack).
+Own architecture: every built-in metric is a *batch statistic* — a
+method returning ``(stat_sum, count)`` for one (label, pred) pair — and
+the shared base accumulates those into the running ``sum_metric /
+num_inst`` average. Regression metrics share one elementwise-error
+class, the F1/MCC pair share one confusion-matrix accumulator built on
+``numpy.bincount``, and likelihood metrics share one gather-probs core.
+Metrics run on host numpy, outside the compiled step, exactly where the
+reference runs them (SURVEY §3.1 call stack).
 """
 from __future__ import annotations
 
@@ -25,37 +31,56 @@ def register(klass):
     return klass
 
 
-def _as_numpy(x):
+def _host(x):
+    """Fetch to host numpy (NDArray or array-like)."""
+    asnumpy = getattr(x, "asnumpy", None)
+    return asnumpy() if asnumpy is not None else numpy.asarray(x)
+
+
+def _listify(x):
     from .ndarray import NDArray
-    if isinstance(x, NDArray):
-        return x.asnumpy()
-    return numpy.asarray(x)
+    return [x] if isinstance(x, NDArray) else x
 
 
 def check_label_shapes(labels, preds, wrap=False, shape=False):
-    if not shape:
-        label_shape, pred_shape = len(labels), len(preds)
-    else:
-        label_shape, pred_shape = labels.shape, preds.shape
-    if label_shape != pred_shape:
-        raise ValueError("Shape of labels {} does not match shape of "
-                         "predictions {}".format(label_shape, pred_shape))
+    """Reference-compatible shape guard (metric.py:32)."""
+    got = (labels.shape, preds.shape) if shape else \
+        (len(labels), len(preds))
+    if got[0] != got[1]:
+        raise ValueError(
+            "Shape of labels {} does not match shape of predictions {}"
+            .format(*got))
     if wrap:
-        from .ndarray import NDArray
-        if isinstance(labels, NDArray):
-            labels = [labels]
-        if isinstance(preds, NDArray):
-            preds = [preds]
+        labels, preds = _listify(labels), _listify(preds)
     return labels, preds
 
 
+def _as_2d(a):
+    return a.reshape(a.shape[0], 1) if a.ndim == 1 else a
+
+
+def _gathered_probs(label, pred):
+    """Probability assigned to each sample's true class: pred rows
+    indexed by the integer labels."""
+    flat = label.ravel().astype(numpy.int64)
+    rows = pred.reshape(-1, pred.shape[-1])
+    if flat.shape[0] != rows.shape[0]:
+        raise ValueError(
+            "label count %d does not match prediction rows %d"
+            % (flat.shape[0], rows.shape[0]))
+    return flat, rows[numpy.arange(flat.shape[0]), flat]
+
+
 class EvalMetric:
-    """Base metric (reference: metric.py:56)."""
+    """Running-average metric base (reference: metric.py:56).
+
+    Built-ins implement :meth:`_batch_stat`; overriding :meth:`update`
+    wholesale (the reference's protocol) also works.
+    """
 
     def __init__(self, name, output_names=None, label_names=None, **kwargs):
         self.name = str(name)
-        self.output_names = output_names
-        self.label_names = label_names
+        self.output_names, self.label_names = output_names, label_names
         self._kwargs = kwargs
         self.reset()
 
@@ -63,49 +88,54 @@ class EvalMetric:
         return "EvalMetric: {}".format(dict(self.get_name_value()))
 
     def get_config(self):
-        config = self._kwargs.copy()
-        config.update({
-            'metric': self.__class__.__name__,
-            'name': self.name,
-            'output_names': self.output_names,
-            'label_names': self.label_names})
-        return config
+        cfg = dict(self._kwargs,
+                   metric=type(self).__name__, name=self.name,
+                   output_names=self.output_names,
+                   label_names=self.label_names)
+        return cfg
 
-    def update_dict(self, label, pred):
-        if self.output_names is not None:
-            pred = [pred[name] for name in self.output_names]
-        else:
-            pred = list(pred.values())
-        if self.label_names is not None:
-            label = [label[name] for name in self.label_names]
-        else:
-            label = list(label.values())
-        self.update(label, pred)
+    # -- accumulation -----------------------------------------------------
+    def _batch_stat(self, label, pred):
+        raise NotImplementedError(
+            "%s defines neither _batch_stat nor update" % type(self))
 
     def update(self, labels, preds):
-        raise NotImplementedError()
+        labels, preds = check_label_shapes(labels, preds, True)
+        for label, pred in zip(labels, preds):
+            s, n = self._batch_stat(_host(label), _host(pred))
+            self.sum_metric += s
+            self.num_inst += n
+
+    def update_dict(self, label, pred):
+        pick = lambda d, names: [d[k] for k in names] if names is not None \
+            else list(d.values())
+        self.update(pick(label, self.label_names),
+                    pick(pred, self.output_names))
 
     def reset(self):
-        self.num_inst = 0
-        self.sum_metric = 0.0
+        self.sum_metric, self.num_inst = 0.0, 0
+
+    # -- readout ----------------------------------------------------------
+    def _value(self):
+        return self.sum_metric / self.num_inst
 
     def get(self):
-        if self.num_inst == 0:
-            return (self.name, float('nan'))
-        return (self.name, self.sum_metric / self.num_inst)
+        if not self.num_inst:
+            return (self.name, float("nan"))
+        return (self.name, self._value())
 
     def get_name_value(self):
         name, value = self.get()
-        if not isinstance(name, list):
-            name = [name]
-        if not isinstance(value, list):
-            value = [value]
-        return list(zip(name, value))
+        names = name if isinstance(name, list) else [name]
+        values = value if isinstance(value, list) else [value]
+        return list(zip(names, values))
 
 
 @register
 class CompositeEvalMetric(EvalMetric):
-    def __init__(self, metrics=None, name='composite', output_names=None,
+    """Fan-out wrapper over child metrics (reference: metric.py:212)."""
+
+    def __init__(self, metrics=None, name="composite", output_names=None,
                  label_names=None):
         super().__init__(name, output_names, label_names)
         self.metrics = [create(m) for m in (metrics or [])]
@@ -117,405 +147,338 @@ class CompositeEvalMetric(EvalMetric):
         try:
             return self.metrics[index]
         except IndexError:
-            return ValueError("Metric index {} is out of range 0 and {}"
-                              .format(index, len(self.metrics)))
+            return ValueError(
+                "Metric index {} is out of range 0 and {}"
+                .format(index, len(self.metrics)))
 
     def update_dict(self, labels, preds):
-        for metric in self.metrics:
-            metric.update_dict(labels, preds)
+        for child in self.metrics:
+            child.update_dict(labels, preds)
 
     def update(self, labels, preds):
-        for metric in self.metrics:
-            metric.update(labels, preds)
+        for child in self.metrics:
+            child.update(labels, preds)
 
     def reset(self):
-        try:
-            for metric in self.metrics:
-                metric.reset()
-        except AttributeError:
-            pass
+        for child in getattr(self, "metrics", ()):
+            child.reset()
 
     def get(self):
-        names = []
-        values = []
-        for metric in self.metrics:
-            name, value = metric.get()
-            if not isinstance(name, list):
-                name = [name]
-            if not isinstance(value, list):
-                value = [value]
-            names.extend(name)
-            values.extend(value)
+        names, values = [], []
+        for child in self.metrics:
+            for n, v in child.get_name_value():
+                names.append(n)
+                values.append(v)
         return (names, values)
 
 
+# ---------------------------------------------------------------------------
+# classification
+# ---------------------------------------------------------------------------
+
 @register
 class Accuracy(EvalMetric):
-    """Classification accuracy (reference: metric.py:365)."""
+    """Fraction of argmax predictions equal to the label
+    (reference: metric.py:365)."""
 
-    def __init__(self, axis=1, name='accuracy', output_names=None,
+    def __init__(self, axis=1, name="accuracy", output_names=None,
                  label_names=None):
         super().__init__(name, output_names, label_names, axis=axis)
         self.axis = axis
 
-    def update(self, labels, preds):
-        labels, preds = check_label_shapes(labels, preds, True)
-        for label, pred_label in zip(labels, preds):
-            label = _as_numpy(label)
-            pred_label = _as_numpy(pred_label)
-            if pred_label.shape != label.shape:
-                pred_label = pred_label.argmax(axis=self.axis)
-            pred_label = pred_label.astype('int32').reshape(-1)
-            label = label.astype('int32').reshape(-1)
-            check_label_shapes(label, pred_label)
-            self.sum_metric += (pred_label == label).sum()
-            self.num_inst += len(pred_label)
+    def _batch_stat(self, label, pred):
+        if pred.shape != label.shape:
+            pred = pred.argmax(axis=self.axis)
+        pred = pred.ravel().astype(numpy.int32)
+        label = label.ravel().astype(numpy.int32)
+        check_label_shapes(label, pred)     # no silent broadcasting
+        hits = numpy.equal(pred, label)
+        return hits.sum(), hits.size
 
 
 @register
 class TopKAccuracy(EvalMetric):
-    def __init__(self, top_k=1, name='top_k_accuracy', output_names=None,
+    """Label within the k highest-scored classes
+    (reference: metric.py:439)."""
+
+    def __init__(self, top_k=1, name="top_k_accuracy", output_names=None,
                  label_names=None):
-        super().__init__(name, output_names, label_names, top_k=top_k)
+        if top_k <= 1:
+            raise ValueError("use Accuracy for top_k <= 1")
+        super().__init__("%s_%d" % (name, top_k), output_names,
+                         label_names, top_k=top_k)
         self.top_k = top_k
-        assert self.top_k > 1, 'Please use Accuracy if top_k is no more than 1'
-        self.name += '_%d' % self.top_k
 
-    def update(self, labels, preds):
-        labels, preds = check_label_shapes(labels, preds, True)
-        for label, pred_label in zip(labels, preds):
-            assert len(pred_label.shape) <= 2, \
-                'Predictions should be no more than 2 dims'
-            pred = _as_numpy(pred_label).astype('float32')
-            pred_label = numpy.argpartition(pred, -self.top_k)
-            label = _as_numpy(label).astype('int32')
-            check_label_shapes(label, pred_label)
-            num_samples = pred_label.shape[0]
-            num_dims = len(pred_label.shape)
-            if num_dims == 1:
-                self.sum_metric += (pred_label.flat == label.flat).sum()
-            elif num_dims == 2:
-                num_classes = pred_label.shape[1]
-                top_k = min(num_classes, self.top_k)
-                for j in range(top_k):
-                    self.sum_metric += (
-                        pred_label[:, num_classes - 1 - j].flat
-                        == label.flat).sum()
-            self.num_inst += num_samples
+    def _batch_stat(self, label, pred):
+        if pred.ndim > 2:
+            raise ValueError("TopKAccuracy expects <= 2-d predictions")
+        label = label.astype(numpy.int64).ravel()
+        if pred.ndim == 1:
+            return numpy.equal(pred.astype(numpy.int64),
+                               label).sum(), label.shape[0]
+        k = min(self.top_k, pred.shape[1])
+        top = numpy.argpartition(pred.astype(numpy.float32), -k)[:, -k:]
+        hits = (top == label[:, None]).any(axis=1)
+        return hits.sum(), label.shape[0]
 
 
-class _BinaryClassificationMetrics:
+class _Confusion:
+    """2x2 confusion counts via one bincount per batch."""
+
+    __slots__ = ("counts",)
+
     def __init__(self):
-        self.reset_stats()
+        self.clear()
 
-    def update_binary_stats(self, label, pred):
-        pred = _as_numpy(pred)
-        label = _as_numpy(label).astype('int32')
-        pred_label = numpy.argmax(pred, axis=1)
-        check_label_shapes(label, pred)
-        if len(numpy.unique(label)) > 2:
-            raise ValueError("%s currently only supports binary "
-                             "classification." % self.__class__.__name__)
-        pred_true = (pred_label == 1)
-        pred_false = 1 - pred_true
-        label_true = (label == 1)
-        label_false = 1 - label_true
-        self.true_positives += (pred_true * label_true).sum()
-        self.false_positives += (pred_true * label_false).sum()
-        self.false_negatives += (pred_false * label_true).sum()
-        self.true_negatives += (pred_false * label_false).sum()
+    def clear(self):
+        self.counts = numpy.zeros(4, dtype=numpy.int64)
 
-    @property
-    def precision(self):
-        if self.true_positives + self.false_positives > 0:
-            return float(self.true_positives) / (
-                self.true_positives + self.false_positives)
-        return 0.
+    def absorb(self, label, pred_scores):
+        label = label.astype(numpy.int64).ravel()
+        if numpy.unique(label).size > 2:
+            raise ValueError(
+                "confusion-matrix metrics support binary labels only")
+        check_label_shapes(label, pred_scores)
+        # anything other than class 1 counts as negative — matches the
+        # reference's (pred_label == 1)/(label == 1) convention, and
+        # keeps bincount indices in [0, 4) for signed labels or extra
+        # prediction columns
+        truth = (label == 1).astype(numpy.int64)
+        decided = (pred_scores.argmax(axis=1) == 1).astype(numpy.int64)
+        self.counts += numpy.bincount(2 * truth + decided, minlength=4)
 
-    @property
-    def recall(self):
-        if self.true_positives + self.false_negatives > 0:
-            return float(self.true_positives) / (
-                self.true_positives + self.false_negatives)
-        return 0.
+    # counts layout: [TN, FP, FN, TP]
+    tn = property(lambda self: float(self.counts[0]))
+    fp = property(lambda self: float(self.counts[1]))
+    fn = property(lambda self: float(self.counts[2]))
+    tp = property(lambda self: float(self.counts[3]))
 
     @property
-    def fscore(self):
-        if self.precision + self.recall > 0:
-            return 2 * self.precision * self.recall / (
-                self.precision + self.recall)
-        return 0.
+    def total(self):
+        return int(self.counts.sum())
 
     @property
-    def matthewscc(self):
-        if not self.total_examples:
-            return 0.
-        true_pos = float(self.true_positives)
-        false_pos = float(self.false_positives)
-        false_neg = float(self.false_negatives)
-        true_neg = float(self.true_negatives)
-        terms = [(true_pos + false_pos), (true_pos + false_neg),
-                 (true_neg + false_pos), (true_neg + false_neg)]
-        denom = 1.
-        for t in filter(lambda t: t != 0., terms):
-            denom *= t
-        return ((true_pos * true_neg) - (false_pos * false_neg)) \
-            / math.sqrt(denom)
+    def f1(self):
+        denom = 2 * self.tp + self.fp + self.fn
+        return 2 * self.tp / denom if denom else 0.0
 
     @property
-    def total_examples(self):
-        return self.false_negatives + self.false_positives + \
-            self.true_negatives + self.true_positives
+    def mcc(self):
+        num = self.tp * self.tn - self.fp * self.fn
+        factors = [self.tp + self.fp, self.tp + self.fn,
+                   self.tn + self.fp, self.tn + self.fn]
+        denom = 1.0
+        for f in factors:
+            if f:
+                denom *= f
+        return num / math.sqrt(denom) if self.total else 0.0
 
-    def reset_stats(self):
-        self.false_positives = 0
-        self.false_negatives = 0
-        self.true_positives = 0
-        self.true_negatives = 0
 
+class _ConfusionMetric(EvalMetric):
+    """Shared macro/micro averaging over a _Confusion score."""
 
-@register
-class F1(EvalMetric):
-    def __init__(self, name='f1', output_names=None, label_names=None,
+    _score_of = None        # property name on _Confusion
+
+    def __init__(self, name, output_names=None, label_names=None,
                  average="macro"):
         self.average = average
-        self.metrics = _BinaryClassificationMetrics()
-        EvalMetric.__init__(self, name=name, output_names=output_names,
-                            label_names=label_names)
+        self._conf = _Confusion()
+        super().__init__(name, output_names=output_names,
+                         label_names=label_names)
 
     def update(self, labels, preds):
         labels, preds = check_label_shapes(labels, preds, True)
         for label, pred in zip(labels, preds):
-            self.metrics.update_binary_stats(label, pred)
+            self._conf.absorb(_host(label), _host(pred))
+        score = getattr(self._conf, self._score_of)
         if self.average == "macro":
-            self.sum_metric += self.metrics.fscore
+            self.sum_metric += score
             self.num_inst += 1
-            self.metrics.reset_stats()
+            self._conf.clear()
         else:
-            self.sum_metric = self.metrics.fscore * \
-                self.metrics.total_examples
-            self.num_inst = self.metrics.total_examples
+            self.sum_metric = score * self._conf.total
+            self.num_inst = self._conf.total
 
     def reset(self):
-        self.sum_metric = 0.
-        self.num_inst = 0.
-        if hasattr(self, 'metrics'):
-            self.metrics.reset_stats()
+        self.sum_metric, self.num_inst = 0.0, 0
+        if hasattr(self, "_conf"):
+            self._conf.clear()
 
 
 @register
-class MCC(EvalMetric):
-    def __init__(self, name='mcc', output_names=None, label_names=None,
+class F1(_ConfusionMetric):
+    """Binary F1 (reference: metric.py:565)."""
+    _score_of = "f1"
+
+    def __init__(self, name="f1", output_names=None, label_names=None,
                  average="macro"):
-        self._average = average
-        self._metrics = _BinaryClassificationMetrics()
-        EvalMetric.__init__(self, name=name, output_names=output_names,
-                            label_names=label_names)
+        super().__init__(name, output_names, label_names, average)
 
-    def update(self, labels, preds):
-        labels, preds = check_label_shapes(labels, preds, True)
-        for label, pred in zip(labels, preds):
-            self._metrics.update_binary_stats(label, pred)
-        if self._average == "macro":
-            self.sum_metric += self._metrics.matthewscc
-            self.num_inst += 1
-            self._metrics.reset_stats()
-        else:
-            self.sum_metric = self._metrics.matthewscc * \
-                self._metrics.total_examples
-            self.num_inst = self._metrics.total_examples
 
-    def reset(self):
-        self.sum_metric = 0.
-        self.num_inst = 0.
-        if hasattr(self, '_metrics'):
-            self._metrics.reset_stats()
+@register
+class MCC(_ConfusionMetric):
+    """Matthews correlation coefficient (reference: metric.py:665)."""
+    _score_of = "mcc"
 
+    def __init__(self, name="mcc", output_names=None, label_names=None,
+                 average="macro"):
+        super().__init__(name, output_names, label_names, average)
+
+
+# ---------------------------------------------------------------------------
+# likelihood family
+# ---------------------------------------------------------------------------
 
 @register
 class Perplexity(EvalMetric):
-    def __init__(self, ignore_label=None, axis=-1, name='perplexity',
+    """exp of the mean negative log prob of the true class, with
+    optional ignored label id (reference: metric.py:761)."""
+
+    def __init__(self, ignore_label=None, axis=-1, name="perplexity",
                  output_names=None, label_names=None):
         super().__init__(name, output_names, label_names,
                          ignore_label=ignore_label, axis=axis)
-        self.ignore_label = ignore_label
-        self.axis = axis
+        self.ignore_label, self.axis = ignore_label, axis
 
-    def update(self, labels, preds):
-        assert len(labels) == len(preds)
-        loss = 0.
-        num = 0
-        for label, pred in zip(labels, preds):
-            label = _as_numpy(label)
-            pred = _as_numpy(pred)
-            assert label.size == pred.size / pred.shape[-1], \
-                "shape mismatch"
-            label = label.reshape((label.size,)).astype('int32')
-            probs = pred.reshape(-1, pred.shape[-1])[
-                numpy.arange(label.size), label]
-            if self.ignore_label is not None:
-                ignore = (label == self.ignore_label).astype(probs.dtype)
-                num -= numpy.sum(ignore)
-                probs = probs * (1 - ignore) + ignore
-            loss -= numpy.sum(numpy.log(numpy.maximum(1e-10, probs)))
-            num += label.size
-        self.sum_metric += loss
-        self.num_inst += num
+    def _batch_stat(self, label, pred):
+        flat, probs = _gathered_probs(label, pred)
+        count = flat.shape[0]
+        if self.ignore_label is not None:
+            keep = flat != self.ignore_label
+            probs = numpy.where(keep, probs, 1.0)
+            count = int(keep.sum())
+        nll = -numpy.log(numpy.maximum(probs, 1e-10)).sum()
+        return nll, count
 
-    def get(self):
-        if self.num_inst == 0:
-            return (self.name, float('nan'))
-        return (self.name, math.exp(self.sum_metric / self.num_inst))
+    def _value(self):
+        return math.exp(self.sum_metric / self.num_inst)
 
 
-@register
-class MAE(EvalMetric):
-    def __init__(self, name='mae', output_names=None, label_names=None):
-        super().__init__(name, output_names, label_names)
+class _GatheredNLL(EvalMetric):
+    """Mean -log(p_true + eps); CrossEntropy and NLL differ only in
+    their default name (reference: metric.py:846, :917)."""
 
-    def update(self, labels, preds):
-        labels, preds = check_label_shapes(labels, preds, True)
-        for label, pred in zip(labels, preds):
-            label = _as_numpy(label)
-            pred = _as_numpy(pred)
-            if len(label.shape) == 1:
-                label = label.reshape(label.shape[0], 1)
-            if len(pred.shape) == 1:
-                pred = pred.reshape(pred.shape[0], 1)
-            self.sum_metric += numpy.abs(label - pred).mean()
-            self.num_inst += 1
-
-
-@register
-class MSE(EvalMetric):
-    def __init__(self, name='mse', output_names=None, label_names=None):
-        super().__init__(name, output_names, label_names)
-
-    def update(self, labels, preds):
-        labels, preds = check_label_shapes(labels, preds, True)
-        for label, pred in zip(labels, preds):
-            label = _as_numpy(label)
-            pred = _as_numpy(pred)
-            if len(label.shape) == 1:
-                label = label.reshape(label.shape[0], 1)
-            if len(pred.shape) == 1:
-                pred = pred.reshape(pred.shape[0], 1)
-            self.sum_metric += ((label - pred) ** 2.0).mean()
-            self.num_inst += 1
-
-
-@register
-class RMSE(EvalMetric):
-    def __init__(self, name='rmse', output_names=None, label_names=None):
-        super().__init__(name, output_names, label_names)
-
-    def update(self, labels, preds):
-        labels, preds = check_label_shapes(labels, preds, True)
-        for label, pred in zip(labels, preds):
-            label = _as_numpy(label)
-            pred = _as_numpy(pred)
-            if len(label.shape) == 1:
-                label = label.reshape(label.shape[0], 1)
-            if len(pred.shape) == 1:
-                pred = pred.reshape(pred.shape[0], 1)
-            self.sum_metric += numpy.sqrt(((label - pred) ** 2.0).mean())
-            self.num_inst += 1
-
-
-@register
-class CrossEntropy(EvalMetric):
-    def __init__(self, eps=1e-12, name='cross-entropy', output_names=None,
-                 label_names=None):
+    def __init__(self, eps, name, output_names, label_names):
         super().__init__(name, output_names, label_names, eps=eps)
         self.eps = eps
 
-    def update(self, labels, preds):
-        labels, preds = check_label_shapes(labels, preds, True)
-        for label, pred in zip(labels, preds):
-            label = _as_numpy(label)
-            pred = _as_numpy(pred)
-            label = label.ravel()
-            assert label.shape[0] == pred.shape[0]
-            prob = pred[numpy.arange(label.shape[0]), numpy.int64(label)]
-            self.sum_metric += (-numpy.log(prob + self.eps)).sum()
-            self.num_inst += label.shape[0]
+    def _batch_stat(self, label, pred):
+        flat, probs = _gathered_probs(label, pred)
+        return -numpy.log(probs + self.eps).sum(), flat.shape[0]
 
 
 @register
-class NegativeLogLikelihood(EvalMetric):
-    def __init__(self, eps=1e-12, name='nll-loss', output_names=None,
+class CrossEntropy(_GatheredNLL):
+    def __init__(self, eps=1e-12, name="cross-entropy", output_names=None,
                  label_names=None):
-        super().__init__(name, output_names, label_names, eps=eps)
-        self.eps = eps
+        super().__init__(eps, name, output_names, label_names)
 
-    def update(self, labels, preds):
-        labels, preds = check_label_shapes(labels, preds, True)
-        for label, pred in zip(labels, preds):
-            label = _as_numpy(label)
-            pred = _as_numpy(pred)
-            label = label.ravel()
-            num_examples = pred.shape[0]
-            assert label.shape[0] == num_examples, \
-                (label.shape[0], num_examples)
-            prob = pred[numpy.arange(num_examples, dtype=numpy.int64),
-                        numpy.int64(label)]
-            self.sum_metric += (-numpy.log(prob + self.eps)).sum()
-            self.num_inst += num_examples
+
+@register
+class NegativeLogLikelihood(_GatheredNLL):
+    def __init__(self, eps=1e-12, name="nll-loss", output_names=None,
+                 label_names=None):
+        super().__init__(eps, name, output_names, label_names)
+
+
+# ---------------------------------------------------------------------------
+# regression
+# ---------------------------------------------------------------------------
+
+class _ElementwiseError(EvalMetric):
+    """Batch-mean of an elementwise error, averaged over batches."""
+
+    @staticmethod
+    def _error(diff):
+        raise NotImplementedError
+
+    def _batch_stat(self, label, pred):
+        diff = _as_2d(label) - _as_2d(pred)
+        return self._error(diff), 1
+
+
+@register
+class MAE(_ElementwiseError):
+    def __init__(self, name="mae", output_names=None, label_names=None):
+        super().__init__(name, output_names, label_names)
+
+    @staticmethod
+    def _error(diff):
+        return numpy.abs(diff).mean()
+
+
+@register
+class MSE(_ElementwiseError):
+    def __init__(self, name="mse", output_names=None, label_names=None):
+        super().__init__(name, output_names, label_names)
+
+    @staticmethod
+    def _error(diff):
+        return numpy.square(diff).mean()
+
+
+@register
+class RMSE(_ElementwiseError):
+    def __init__(self, name="rmse", output_names=None, label_names=None):
+        super().__init__(name, output_names, label_names)
+
+    @staticmethod
+    def _error(diff):
+        return math.sqrt(numpy.square(diff).mean())
 
 
 @register
 class PearsonCorrelation(EvalMetric):
-    def __init__(self, name='pearsonr', output_names=None, label_names=None):
+    def __init__(self, name="pearsonr", output_names=None,
+                 label_names=None):
         super().__init__(name, output_names, label_names)
 
-    def update(self, labels, preds):
-        labels, preds = check_label_shapes(labels, preds, True)
-        for label, pred in zip(labels, preds):
-            check_label_shapes(label, pred, False, True)
-            label = _as_numpy(label)
-            pred = _as_numpy(pred)
-            self.sum_metric += numpy.corrcoef(pred.ravel(),
-                                              label.ravel())[0, 1]
-            self.num_inst += 1
+    def _batch_stat(self, label, pred):
+        check_label_shapes(label, pred, False, True)
+        return numpy.corrcoef(pred.ravel(), label.ravel())[0, 1], 1
 
+
+# ---------------------------------------------------------------------------
+# loss passthrough + custom
+# ---------------------------------------------------------------------------
 
 @register
 class Loss(EvalMetric):
-    """Mean of raw loss outputs (reference: metric.py Loss)."""
+    """Mean of raw loss outputs; ignores labels
+    (reference: metric.py:1421)."""
 
-    def __init__(self, name='loss', output_names=None, label_names=None):
+    def __init__(self, name="loss", output_names=None, label_names=None):
         super().__init__(name, output_names, label_names)
 
     def update(self, _, preds):
-        from .ndarray import NDArray
-        if isinstance(preds, NDArray):
-            preds = [preds]
-        for pred in preds:
-            loss = _as_numpy(pred).sum()
-            self.sum_metric += loss
+        for pred in _listify(preds):
+            self.sum_metric += float(_host(pred).sum())
             self.num_inst += pred.size
 
 
 @register
 class Torch(Loss):
-    def __init__(self, name='torch', output_names=None, label_names=None):
+    def __init__(self, name="torch", output_names=None, label_names=None):
         super().__init__(name, output_names, label_names)
 
 
 @register
 class Caffe(Loss):
-    def __init__(self, name='caffe', output_names=None, label_names=None):
+    def __init__(self, name="caffe", output_names=None, label_names=None):
         super().__init__(name, output_names, label_names)
 
 
 @register
 class CustomMetric(EvalMetric):
+    """Wraps feval(label, pred) -> value or (sum, count)
+    (reference: metric.py:1480)."""
+
     def __init__(self, feval, name=None, allow_extra_outputs=False,
                  output_names=None, label_names=None):
         if name is None:
             name = feval.__name__
-            if name.find('<') != -1:
-                name = 'custom(%s)' % name
+            if "<" in name:
+                name = "custom(%s)" % name
         super().__init__(name, output_names, label_names, feval=feval,
                          allow_extra_outputs=allow_extra_outputs)
         self._feval = feval
@@ -525,47 +488,46 @@ class CustomMetric(EvalMetric):
         if not self._allow_extra_outputs:
             labels, preds = check_label_shapes(labels, preds, True)
         for pred, label in zip(preds, labels):
-            label = _as_numpy(label)
-            pred = _as_numpy(pred)
-            reval = self._feval(label, pred)
-            if isinstance(reval, tuple):
-                (sum_metric, num_inst) = reval
-                self.sum_metric += sum_metric
-                self.num_inst += num_inst
+            result = self._feval(_host(label), _host(pred))
+            if isinstance(result, tuple):
+                s, n = result
             else:
-                self.sum_metric += reval
-                self.num_inst += 1
+                s, n = result, 1
+            self.sum_metric += s
+            self.num_inst += n
 
 
 def np(numpy_feval, name=None, allow_extra_outputs=False):
+    """Lift a numpy feval into a CustomMetric (reference: metric.py:1566)."""
+
     def feval(label, pred):
         return numpy_feval(label, pred)
+
     feval.__name__ = numpy_feval.__name__
     return CustomMetric(feval, name, allow_extra_outputs)
 
 
+_SHORTHAND = {"acc": "Accuracy", "ce": "CrossEntropy",
+              "nll_loss": "NegativeLogLikelihood",
+              "top_k_acc": "TopKAccuracy"}
+
+
 def create(metric, *args, **kwargs):
-    if callable(metric):
-        return CustomMetric(metric, *args, **kwargs)
-    if isinstance(metric, CompositeEvalMetric):
-        return metric
+    """Resolve str / callable / list / instance into an EvalMetric."""
     if isinstance(metric, EvalMetric):
         return metric
+    if callable(metric):
+        return CustomMetric(metric, *args, **kwargs)
     if isinstance(metric, list):
-        composite_metric = CompositeEvalMetric()
-        for child_metric in metric:
-            composite_metric.add(create(child_metric, *args, **kwargs))
-        return composite_metric
+        bundle = CompositeEvalMetric()
+        for item in metric:
+            bundle.add(create(item, *args, **kwargs))
+        return bundle
     if isinstance(metric, str):
-        cls = _REG.find(metric)
-        if cls is None:
-            # convenience aliases
-            aliases = {"acc": Accuracy, "ce": CrossEntropy,
-                       "nll_loss": NegativeLogLikelihood,
-                       "top_k_acc": TopKAccuracy}
-            cls = aliases.get(metric.lower())
-        if cls is None:
-            raise MXNetError("Metric must be either callable or str; "
-                             "unknown: %s" % metric)
-        return cls(*args, **kwargs)
+        key = _SHORTHAND.get(metric.lower(), metric)
+        cls = _REG.find(key)
+        if cls is not None:
+            return cls(*args, **kwargs)
+        raise MXNetError(
+            "Metric must be either callable or str; unknown: %s" % metric)
     raise TypeError("metric should be either str, callable or EvalMetric")
