@@ -1,248 +1,325 @@
-"""Gluon Block / HybridBlock / SymbolBlock (parity:
+"""Gluon Block / HybridBlock / SymbolBlock (API parity:
 python/mxnet/gluon/block.py).
+
+Own architecture:
+- nested inputs/outputs ride a tiny pytree codec (``_tree_flatten`` /
+  ``_tree_unflatten`` with explicit spec objects) instead of the
+  reference's interleaved flatten/regroup lists;
+- naming is one ``_Naming`` scope object owning both the child-prefix
+  counter and the NameManager prefix push;
+- the hybridize cache stores tagged input sources (``("data", i)`` /
+  ``("param", p)``) resolved at call time.
 
 TPU-native hybridize: tracing ``hybrid_forward`` with Symbols builds a
 graph that becomes ONE CachedOp = one fused XLA executable
-(mxnet_tpu/cached_op.py), instead of the reference's CachedOp node-wise
-engine execution with static-alloc planning (block.py:748 →
-cached_op.cc). Deferred shape inference rides the Symbol layer's
-jax.eval_shape-based infer_shape.
+(mxnet_tpu/cached_op.py), instead of the reference's node-wise engine
+execution with static-alloc planning (block.py:748 → cached_op.cc).
+Deferred shape inference rides the Symbol layer's jax.eval_shape-based
+infer_shape.
 """
 from __future__ import annotations
 
 import copy
 import re
 import threading
-import warnings
 from collections import OrderedDict
 
 import numpy as np
 
 from ..base import MXNetError
-from ..context import Context, cpu, current_context
 from .. import ndarray as nd
 from ..ndarray import NDArray
 from .. import symbol as sym_mod
 from ..symbol import Symbol
 from ..cached_op import CachedOp
 from .parameter import Parameter, ParameterDict, DeferredInitializationError
+from .utils import _indent
 
 __all__ = ["Block", "HybridBlock", "SymbolBlock"]
 
 
-class _BlockScope:
-    """Name manager for Blocks (reference: block.py:34)."""
+# ---------------------------------------------------------------------------
+# pytree codec for nested Symbol/NDArray structures
+# ---------------------------------------------------------------------------
 
-    _current = threading.local()
+class _Leaf:
+    """Spec of one leaf; ``width`` > 0 marks a multi-output Symbol that
+    regroups as a slice of that many outputs."""
 
-    def __init__(self, block):
-        self._block = block
-        self._counter = {}
-        self._old_scope = None
-        self._name_scope = None
+    __slots__ = ("width",)
 
-    @staticmethod
-    def create(prefix, params, hint):
-        current = getattr(_BlockScope._current, "value", None)
-        if current is None:
-            if prefix is None:
-                from ..name import NameManager
-                prefix = NameManager.current().get(None, hint) + '_'
-            if params is None:
-                params = ParameterDict(prefix)
-            else:
-                params = ParameterDict(params.prefix, params)
-            return prefix, params
-        if prefix is None:
-            count = current._counter.get(hint, 0)
-            prefix = '%s%d_' % (hint, count)
-            current._counter[hint] = count + 1
-        if params is None:
-            parent = current._block.params
-            params = ParameterDict(parent.prefix + prefix, parent._shared)
-        else:
-            params = ParameterDict(params.prefix, params)
-        return current._block.prefix + prefix, params
+    def __init__(self, width=0):
+        self.width = width
 
-    def __enter__(self):
-        if self._block._empty_prefix:
-            return self
-        self._old_scope = getattr(_BlockScope._current, "value", None)
-        _BlockScope._current.value = self
-        from ..name import Prefix
-        self._name_scope = Prefix(self._block.prefix)
-        self._name_scope.__enter__()
-        return self
-
-    def __exit__(self, ptype, value, trace):
-        if self._block._empty_prefix:
-            return
-        self._name_scope.__exit__(ptype, value, trace)
-        self._name_scope = None
-        _BlockScope._current.value = self._old_scope
+    def __eq__(self, other):
+        return isinstance(other, _Leaf) and self.width == other.width
 
 
+def _tree_flatten(tree, role):
+    """→ (leaves, spec). spec is a _Leaf or a list of nested specs."""
+    if isinstance(tree, NDArray):
+        return [tree], _Leaf()
+    if isinstance(tree, Symbol):
+        n = len(tree.list_outputs())
+        return [tree], _Leaf(n if n > 1 else 0)
+    if not isinstance(tree, (list, tuple)):
+        raise AssertionError(
+            "HybridBlock %s must be (nested) list of Symbol or NDArray, "
+            "but got %s of type %s" % (role, str(tree), str(type(tree))))
+    leaves, specs = [], []
+    for item in tree:
+        sub_leaves, sub_spec = _tree_flatten(item, role)
+        leaves.extend(sub_leaves)
+        specs.append(sub_spec)
+    return leaves, specs
+
+
+def _tree_unflatten(leaves, spec):
+    """Inverse of _tree_flatten; consumes from ``leaves`` (a list used
+    as a queue) and returns the structured value."""
+    if isinstance(spec, _Leaf):
+        if spec.width == 0:
+            return leaves.pop(0)
+        picked = leaves[:spec.width]
+        del leaves[:spec.width]
+        return picked
+    return [_tree_unflatten(leaves, s) for s in spec]
+
+
+# legacy names (reference-compatible signatures) used by older callers
 def _flatten(args, inout_str):
-    if isinstance(args, NDArray):
-        return [args], int(0)
-    if isinstance(args, Symbol):
-        length = len(args.list_outputs())
-        length = length if length > 1 else 0
-        return [args], int(length)
-    assert isinstance(args, (list, tuple)), \
-        "HybridBlock %s must be (nested) list of Symbol or NDArray, " \
-        "but got %s of type %s" % (inout_str, str(args), str(type(args)))
-    flat = []
-    fmts = []
-    for i in args:
-        arg, fmt = _flatten(i, inout_str)
-        flat.extend(arg)
-        fmts.append(fmt)
-    return flat, fmts
+    leaves, spec = _tree_flatten(args, inout_str)
+    return leaves, _spec_to_fmt(spec)
 
 
 def _regroup(args, fmt):
-    if isinstance(fmt, int):
-        if fmt == 0:
-            return args[0], args[1:]
-        return args[:fmt], args[fmt:]
-    assert isinstance(args, (list, tuple)), \
-        "output must be (nested) list of Symbol or NDArray, but got %s of " \
-        "type %s" % (str(args), str(type(args)))
-    ret = []
-    for i in fmt:
-        res, args = _regroup(args, i)
-        ret.append(res)
-    return ret, args
+    queue = list(args)
+    value = _tree_unflatten(queue, _fmt_to_spec(fmt))
+    return value, queue
 
+
+def _spec_to_fmt(spec):
+    return spec.width if isinstance(spec, _Leaf) else \
+        [_spec_to_fmt(s) for s in spec]
+
+
+def _fmt_to_spec(fmt):
+    return _Leaf(fmt) if isinstance(fmt, int) else \
+        [_fmt_to_spec(f) for f in fmt]
+
+
+# ---------------------------------------------------------------------------
+# naming
+# ---------------------------------------------------------------------------
+
+class _Naming:
+    """Per-block naming scope: allocates child prefixes and pushes the
+    block's prefix onto the NameManager inside ``with`` (the role of
+    the reference's _BlockScope, block.py:34)."""
+
+    _active = threading.local()
+
+    def __init__(self, owner):
+        self._owner = owner
+        self._child_counts = {}
+        self._outer = None
+        self._prefix_guard = None
+
+    @classmethod
+    def innermost(cls):
+        return getattr(cls._active, "top", None)
+
+    @classmethod
+    def derive(cls, prefix, params, hint):
+        """Resolve (prefix, params) for a new Block under the innermost
+        active scope."""
+        scope = cls.innermost()
+        if scope is None:
+            if prefix is None:
+                from ..name import NameManager
+                prefix = NameManager.current().get(None, hint) + "_"
+            shared = params
+            params = ParameterDict(prefix) if shared is None else \
+                ParameterDict(shared.prefix, shared)
+            return prefix, params
+        if prefix is None:
+            n = scope._child_counts.get(hint, 0)
+            scope._child_counts[hint] = n + 1
+            prefix = "%s%d_" % (hint, n)
+        if params is None:
+            parent = scope._owner.params
+            params = ParameterDict(parent.prefix + prefix, parent._shared)
+        else:
+            params = ParameterDict(params.prefix, params)
+        return scope._owner.prefix + prefix, params
+
+    def __enter__(self):
+        if self._owner._empty_prefix:
+            return self
+        self._outer = _Naming.innermost()
+        _Naming._active.top = self
+        from ..name import Prefix
+        self._prefix_guard = Prefix(self._owner.prefix)
+        self._prefix_guard.__enter__()
+        return self
+
+    def __exit__(self, *exc):
+        if self._owner._empty_prefix:
+            return
+        self._prefix_guard.__exit__(*exc)
+        self._prefix_guard = None
+        _Naming._active.top = self._outer
+
+
+_BlockScope = _Naming    # legacy alias
+
+
+class _HookHandle:
+    _serial = [0]
+
+    def __init__(self, registry):
+        _HookHandle._serial[0] += 1
+        self.id = _HookHandle._serial[0]
+        self._registry = registry
+
+    def detach(self):
+        self._registry.pop(self.id, None)
+
+
+def _name_list_preview(names, limit=7):
+    names = list(names)
+    if len(names) > limit:
+        return (_name_list_preview(names[:limit // 2], limit) + ", ..., "
+                + _name_list_preview(names[-limit // 2:], limit))
+    return ", ".join("'%s'" % n for n in names)
+
+
+_brief_print_list = _name_list_preview    # legacy alias
+
+
+# ---------------------------------------------------------------------------
+# Block
+# ---------------------------------------------------------------------------
 
 class Block:
     """Base of all layers and models (reference: block.py:127)."""
 
     def __init__(self, prefix=None, params=None):
-        self._empty_prefix = prefix == ''
-        self._prefix, self._params = _BlockScope.create(
-            prefix, params, self._alias())
-        self._name = self._prefix[:-1] if self._prefix.endswith('_') \
+        self._empty_prefix = prefix == ""
+        self._prefix, self._params = _Naming.derive(prefix, params,
+                                                    self._alias())
+        self._name = self._prefix[:-1] if self._prefix.endswith("_") \
             else self._prefix
-        self._scope = _BlockScope(self)
+        self._scope = _Naming(self)
         self._children = OrderedDict()
         self._reg_params = {}
         self._forward_hooks = OrderedDict()
         self._forward_pre_hooks = OrderedDict()
 
+    def _alias(self):
+        return type(self).__name__.lower()
+
     def __repr__(self):
-        s = '{name}(\n{modstr}\n)'
-        modstr = '\n'.join(
-            ['  ({key}): {block}'.format(
-                key=key, block=_indent(block.__repr__(), 2))
-             for key, block in self.__dict__.items()
-             if isinstance(block, Block)])
-        return s.format(name=self.__class__.__name__, modstr=modstr)
+        rows = ["  ({}): {}".format(key, _indent(repr(child), 2))
+                for key, child in self.__dict__.items()
+                if isinstance(child, Block)]
+        return "{}(\n{}\n)".format(type(self).__name__, "\n".join(rows))
 
     def __setattr__(self, name, value):
         if hasattr(self, name):
-            existing = getattr(self, name)
-            if isinstance(existing, (Parameter, Block)) and \
-                    not isinstance(value, type(existing)):
-                raise TypeError('Changing attribute type for {name} from '
-                                '{type1} to {type2} is not allowed.'.format(
-                                    name=name, type1=type(existing),
-                                    type2=type(value)))
+            old = getattr(self, name)
+            if isinstance(old, (Parameter, Block)) and \
+                    not isinstance(value, type(old)):
+                raise TypeError(
+                    "Changing attribute type for {name} from {type1} to "
+                    "{type2} is not allowed.".format(
+                        name=name, type1=type(old), type2=type(value)))
         if isinstance(value, Block):
             self.register_child(value, name)
         elif isinstance(value, Parameter):
-            assert name not in self._reg_params, \
-                "Overriding Parameter attribute %s is not allowed. " \
-                "If you want to share parameters between blocks, please " \
-                "set an attribute before initializing children blocks." % name
+            if name in self._reg_params:
+                raise AssertionError(
+                    "Overriding Parameter attribute %s is not allowed. "
+                    "If you want to share parameters between blocks, "
+                    "please set an attribute before initializing children "
+                    "blocks." % name)
             self._reg_params[name] = value
         super().__setattr__(name, value)
 
     def _check_container_with_block(self):
         pass
 
-    def _alias(self):
-        return self.__class__.__name__.lower()
-
-    @property
-    def prefix(self):
-        return self._prefix
-
-    @property
-    def name(self):
-        return self._name
+    prefix = property(lambda self: self._prefix)
+    name = property(lambda self: self._name)
+    params = property(lambda self: self._params)
 
     def name_scope(self):
         return self._scope
 
-    @property
-    def params(self):
-        return self._params
-
+    # -- parameter discovery ----------------------------------------------
     def collect_params(self, select=None):
-        """All Parameters of this Block and children
-        (reference: block.py:278)."""
+        """All Parameters of this Block and children, optionally regex-
+        filtered (reference: block.py:278)."""
         self._check_container_with_block()
-        ret = ParameterDict(self._params.prefix)
-        if not select:
-            ret.update(self.params)
+        bag = ParameterDict(self._params.prefix)
+        if select is None:
+            bag.update(self.params)
         else:
-            pattern = re.compile(select)
-            ret.update({name: value for name, value in self.params.items()
-                        if pattern.match(name)})
-        for cld in self._children.values():
-            ret.update(cld.collect_params(select=select))
-        return ret
+            matcher = re.compile(select)
+            bag.update({n: p for n, p in self.params.items()
+                        if matcher.match(n)})
+        for child in self._children.values():
+            bag.update(child.collect_params(select=select))
+        return bag
 
-    def _collect_params_with_prefix(self, prefix=''):
-        if prefix:
-            prefix += '.'
-        ret = {prefix + key: val for key, val in self._reg_params.items()}
+    def _collect_params_with_prefix(self, prefix=""):
+        dot = prefix + "." if prefix else ""
+        found = {dot + n: p for n, p in self._reg_params.items()}
         for name, child in self._children.items():
-            ret.update(child._collect_params_with_prefix(prefix + name))
-        return ret
+            found.update(child._collect_params_with_prefix(dot + name))
+        return found
 
+    # -- checkpointing (structure-path keyed) -----------------------------
     def save_parameters(self, filename, deduplicate=False):
         """Save by structure path (reference: block.py:315)."""
-        params = self._collect_params_with_prefix()
-        arg_dict = {key: val._check_and_get(val._data, None)
-                    for key, val in params.items()}
-        nd.save(filename, arg_dict)
+        table = self._collect_params_with_prefix()
+        nd.save(filename, {key: p._check_and_get(p._data, None)
+                           for key, p in table.items()})
 
     def load_parameters(self, filename, ctx=None, allow_missing=False,
                         ignore_extra=False, cast_dtype=False,
-                        dtype_source='current'):
+                        dtype_source="current"):
         """Load by structure path (reference: block.py:404)."""
         loaded = nd.load(filename)
-        params = self._collect_params_with_prefix()
-        if not loaded and not params:
+        table = self._collect_params_with_prefix()
+        if not loaded and not table:
             return
-        if not any('.' in i for i in loaded.keys()):
-            # legacy loading: by parameter full name
-            del loaded
-            self.collect_params().load(
-                filename, ctx, allow_missing, ignore_extra, self.prefix)
+        if loaded and not any("." in k for k in loaded):
+            # legacy file: keyed by full parameter name, not path
+            self.collect_params().load(filename, ctx, allow_missing,
+                                       ignore_extra, self.prefix)
             return
         if not allow_missing:
-            for name in params.keys():
-                assert name in loaded, \
-                    "Parameter '%s' is missing in file '%s', which contains "\
-                    "parameters: %s." % (name, filename,
-                                         _brief_print_list(loaded.keys()))
-        for name in loaded:
-            if not ignore_extra and name not in params:
+            for key in table:
+                if key not in loaded:
+                    raise AssertionError(
+                        "Parameter '%s' is missing in file '%s', which "
+                        "contains parameters: %s." % (
+                            key, filename, _name_list_preview(loaded)))
+        for key, value in loaded.items():
+            if key not in table:
+                if ignore_extra:
+                    continue
                 raise ValueError(
-                    "Parameter '%s' loaded from file '%s' is not present in "
-                    "ParameterDict, which contains parameters %s." % (
-                        name, filename, _brief_print_list(params.keys())))
-            if name in params:
-                params[name]._load_init(loaded[name], ctx)
+                    "Parameter '%s' loaded from file '%s' is not present "
+                    "in ParameterDict, which contains parameters %s." % (
+                        key, filename, _name_list_preview(table)))
+            table[key]._load_init(value, ctx)
 
+    # -- composition ------------------------------------------------------
     def register_child(self, block, name=None):
-        if name is None:
-            name = str(len(self._children))
-        self._children[name] = block
+        self._children[name if name is not None
+                       else str(len(self._children))] = block
 
     def register_forward_pre_hook(self, hook):
         handle = _HookHandle(self._forward_pre_hooks)
@@ -255,8 +332,8 @@ class Block:
         return handle
 
     def apply(self, fn):
-        for cld in self._children.values():
-            cld.apply(fn)
+        for child in self._children.values():
+            child.apply(fn)
         fn(self)
         return self
 
@@ -268,15 +345,16 @@ class Block:
         self.collect_params().initialize(init, ctx, verbose, force_reinit)
 
     def hybridize(self, active=True, **kwargs):
-        for cld in self._children.values():
-            cld.hybridize(active, **kwargs)
+        for child in self._children.values():
+            child.hybridize(active, **kwargs)
 
     def cast(self, dtype):
         for child in self._children.values():
             child.cast(dtype)
-        for _, param in self.params.items():
+        for param in self.params.values():
             param.cast(dtype)
 
+    # -- execution --------------------------------------------------------
     def __call__(self, *args):
         for hook in self._forward_pre_hooks.values():
             hook(self, args)
@@ -288,134 +366,89 @@ class Block:
     def forward(self, *args):
         raise NotImplementedError()
 
+    # -- introspection ----------------------------------------------------
     def summary(self, *inputs):
-        summary = OrderedDict()
-        seen = set()
-        hooks = []
+        """Print a per-layer table of output shapes and param counts
+        (reference: block.py:575)."""
+        rows = OrderedDict()
+        counted = set()
+        handles = []
 
-        def _get_shape_str(args):
-            def flatten(args):
-                if not isinstance(args, (list, tuple)):
-                    return [args], int(0)
-                flat = []
-                fmts = []
-                for i in args:
-                    arg, fmt = flatten(i)
-                    flat.extend(arg)
-                    fmts.append(fmt)
-                return flat, fmts
-            flat_args, fmts = flatten(args)
-            flat_arg_shapes = [x.shape if isinstance(x, NDArray) else x
-                               for x in flat_args]
-            shapes = _regroup(flat_arg_shapes, fmts)[0] \
-                if not isinstance(fmts, int) else flat_arg_shapes[0]
-            shape_str = str(shapes).replace('L', '')
-            return shape_str
+        def shape_of(value):
+            if isinstance(value, NDArray):
+                return str(value.shape)
+            if isinstance(value, (list, tuple)):
+                return str([shape_of(v) for v in value]).replace("'", "")
+            return str(value)
 
-        def _register_summary_hook(block):
-            def _summary_hook(block, _, outputs):
-                class_name = block.__class__.__name__
-                block_idx = len(summary) - 1
-                m_key = '%s-%i' % (class_name, block_idx + 1)
-                summary[m_key] = OrderedDict()
-                summary[m_key]['output_shape'] = _get_shape_str(outputs)
-                params = 0
-                summary[m_key]['trainable'] = 0
-                summary[m_key]['shared'] = 0
-                for p in block.params.values():
-                    params += int(np.prod(p.shape)) if p.shape else 0
-                    summary[m_key]['trainable'] += 0 if p.grad_req == 'null' \
-                        else int(np.prod(p.shape)) if p.shape else 0
-                    if p in seen:
-                        summary[m_key]['shared'] += \
-                            int(np.prod(p.shape)) if p.shape else 0
-                    else:
-                        seen.add(p)
-                summary[m_key]['n_params'] = params
-            if not isinstance(block, (Sequential_like())):
-                hooks.append(block.register_forward_hook(_summary_hook))
+        def count(p):
+            return int(np.prod(p.shape)) if p.shape else 0
 
-        summary['Input'] = OrderedDict()
-        summary['Input']['output_shape'] = _get_shape_str(inputs)
-        summary['Input']['n_params'] = 0
-        summary['Input']['trainable'] = 0
-        summary['Input']['shared'] = 0
+        def on_forward(block, _, outputs):
+            key = "%s-%i" % (type(block).__name__, len(rows))
+            row = rows[key] = dict(output_shape=shape_of(outputs),
+                                   n_params=0, trainable=0, shared=0)
+            for p in block.params.values():
+                row["n_params"] += count(p)
+                if p.grad_req != "null":
+                    row["trainable"] += count(p)
+                if p in counted:
+                    row["shared"] += count(p)
+                else:
+                    counted.add(p)
+
+        def attach(block):
+            from .nn.basic_layers import Sequential, HybridSequential
+            if not isinstance(block, (Sequential, HybridSequential)):
+                handles.append(block.register_forward_hook(on_forward))
+
+        rows["Input"] = dict(output_shape=shape_of(list(inputs)),
+                             n_params=0, trainable=0, shared=0)
         try:
-            self.apply(_register_summary_hook)
+            self.apply(attach)
             self(*inputs)
-            line_format = '{:>20}  {:>42} {:>15}'
-            print('-' * 80)
-            print(line_format.format('Layer (type)', 'Output Shape',
-                                     'Param #'))
-            print('=' * 80)
-            total_params = 0
-            trainable_params = 0
-            shared_params = 0
-            for layer in summary:
-                print(line_format.format(
-                    layer, str(summary[layer]['output_shape']),
-                    summary[layer]['n_params']))
-                total_params += summary[layer]['n_params']
-                trainable_params += summary[layer]['trainable']
-                shared_params += summary[layer]['shared']
-            print('=' * 80)
-            print('Parameters in forward computation graph, duplicate '
-                  'included')
-            print('   Total params: ' + str(total_params))
-            print('   Trainable params: ' + str(trainable_params))
-            print('   Non-trainable params: '
-                  + str(total_params - trainable_params))
-            print('Shared params in forward computation graph: '
-                  + str(shared_params))
-            print('Unique parameters in model: '
-                  + str(total_params - shared_params))
-            print('-' * 80)
+            fmt = "{:>20}  {:>42} {:>15}"
+            print("-" * 80)
+            print(fmt.format("Layer (type)", "Output Shape", "Param #"))
+            print("=" * 80)
+            totals = dict(n_params=0, trainable=0, shared=0)
+            for key, row in rows.items():
+                print(fmt.format(key, row["output_shape"],
+                                 row["n_params"]))
+                for field in totals:
+                    totals[field] += row[field]
+            print("=" * 80)
+            print("Parameters in forward computation graph, duplicate "
+                  "included")
+            print("   Total params: " + str(totals["n_params"]))
+            print("   Trainable params: " + str(totals["trainable"]))
+            print("   Non-trainable params: "
+                  + str(totals["n_params"] - totals["trainable"]))
+            print("Shared params in forward computation graph: "
+                  + str(totals["shared"]))
+            print("Unique parameters in model: "
+                  + str(totals["n_params"] - totals["shared"]))
+            print("-" * 80)
         finally:
-            for h in hooks:
+            for h in handles:
                 h.detach()
 
 
-def Sequential_like():
-    from .nn.basic_layers import Sequential, HybridSequential
-    return (Sequential, HybridSequential)
-
-
-class _HookHandle:
-    _next_id = [0]
-
-    def __init__(self, hooks_dict):
-        self.id = _HookHandle._next_id[0]
-        _HookHandle._next_id[0] += 1
-        self._hooks_dict = hooks_dict
-
-    def detach(self):
-        self._hooks_dict.pop(self.id, None)
-
-
-def _indent(s_, num_spaces):
-    lines = s_.split('\n')
-    first = lines.pop(0)
-    lines = [(num_spaces * ' ') + line for line in lines]
-    return '\n'.join([first] + lines)
-
-
-def _brief_print_list(lst, limit=7):
-    lst = list(lst)
-    if len(lst) > limit:
-        return _brief_print_list(lst[:limit // 2], limit) + ', ..., ' + \
-            _brief_print_list(lst[-limit // 2:], limit)
-    return ', '.join(["'%s'" % str(i) for i in lst])
-
+# ---------------------------------------------------------------------------
+# HybridBlock
+# ---------------------------------------------------------------------------
 
 class HybridBlock(Block):
-    """Block with hybridize support (reference: block.py:671)."""
+    """Block that can trace itself into one compiled program
+    (reference: block.py:671)."""
 
     def __init__(self, prefix=None, params=None):
         super().__init__(prefix=prefix, params=params)
         self._cached_graph = ()
         self._cached_op = None
-        self._out_format = None
-        self._in_format = None
+        self._cache_sources = None      # [("data", idx) | ("param", p)]
+        self._in_spec = None
+        self._out_spec = None
         self._active = False
         self._flags = []
 
@@ -424,80 +457,63 @@ class HybridBlock(Block):
         if isinstance(value, HybridBlock):
             self._clear_cached_op()
 
+    # -- tracing ----------------------------------------------------------
     def _get_graph(self, *args):
         if not self._cached_graph:
-            flat_args, self._in_format = _flatten(args, "input")
-            inputs = [sym_mod.var('data%d' % i)
-                      for i in range(len(flat_args))]
-            grouped_inputs = _regroup(inputs, self._in_format)[0] \
-                if not isinstance(self._in_format, int) else inputs[0]
-            params = {i: j.var() for i, j in self._reg_params.items()}
+            leaves, self._in_spec = _tree_flatten(list(args), "input")
+            placeholders = [sym_mod.var("data%d" % i)
+                            for i in range(len(leaves))]
+            # args entered as a list, so the spec is always a list and
+            # `structured` unpacks positionally
+            structured = _tree_unflatten(list(placeholders), self._in_spec)
+            param_vars = {n: p.var() for n, p in self._reg_params.items()}
             with self.name_scope():
-                if isinstance(self._in_format, int):
-                    out = self.hybrid_forward(sym_mod, grouped_inputs,
-                                              **params)
-                else:
-                    out = self.hybrid_forward(sym_mod, *grouped_inputs,
-                                              **params)
-            flat_out, self._out_format = _flatten(out, "output")
-            self._cached_graph = (inputs, sym_mod.Group(flat_out)
-                                  if len(flat_out) > 1 else flat_out[0])
+                out = self.hybrid_forward(sym_mod, *structured,
+                                          **param_vars)
+            flat_out, self._out_spec = _tree_flatten(out, "output")
+            graph = sym_mod.Group(flat_out) if len(flat_out) > 1 \
+                else flat_out[0]
+            self._cached_graph = (placeholders, graph)
         return self._cached_graph
 
     def _build_cache(self, *args):
-        data, out = self._get_graph(*args)
-        data_names = {d.name: i for i, d in enumerate(data)}
-        params = self.collect_params()
-        input_names = out.list_inputs()
-
-        param_dict = {p.name: p for p in params.values()}
-        # build the ordered input source list: args + aux
-        arg_names = out.list_arguments()
-        aux_names = out.list_auxiliary_states()
-        self._cached_op_args = []
-        for name in arg_names + aux_names:
-            if name in data_names:
-                self._cached_op_args.append((True, data_names[name]))
+        placeholders, graph = self._get_graph(*args)
+        slot_of = {p.name: i for i, p in enumerate(placeholders)}
+        by_name = {p.name: p for p in self.collect_params().values()}
+        self._cache_sources = []
+        for name in graph.list_arguments() + \
+                graph.list_auxiliary_states():
+            if name in slot_of:
+                self._cache_sources.append(("data", slot_of[name]))
+            elif name in by_name:
+                self._cache_sources.append(("param", by_name[name]))
             else:
-                if name not in param_dict:
-                    raise MXNetError(
-                        "Unknown input to HybridBlock: %s" % name)
-                self._cached_op_args.append((False, param_dict[name]))
-        self._cached_op = CachedOp(out, self._flags)
-
-    def _deferred_infer_shape(self, *args):
-        try:
-            self.infer_shape(*args)
-        except Exception as e:
-            error_msg = "Deferred initialization failed because shape " \
-                "cannot be inferred. {}".format(e)
-            raise ValueError(error_msg)
+                raise MXNetError(
+                    "Unknown input to HybridBlock: %s" % name)
+        self._cached_op = CachedOp(graph, self._flags)
 
     def _call_cached_op(self, *args):
         if self._cached_op is None:
             self._build_cache(*args)
-        flat_args, fmt = _flatten(args, "input")
-        assert fmt == self._in_format, "Invalid input format"
-        cargs = []
-        for is_arg, ref in self._cached_op_args:
-            if is_arg:
-                cargs.append(flat_args[ref])
-            else:
-                cargs.append(ref.data())
-        out = self._cached_op(*cargs)
-        if isinstance(out, NDArray):
-            out = [out]
-        return _regroup(list(out), self._out_format)[0]
+        leaves, spec = _tree_flatten(list(args), "input")
+        if spec != self._in_spec:
+            raise AssertionError("Invalid input format")
+        feed = [leaves[ref] if kind == "data" else ref.data()
+                for kind, ref in self._cache_sources]
+        out = self._cached_op(*feed)
+        flat = [out] if isinstance(out, NDArray) else list(out)
+        return _tree_unflatten(flat, self._out_spec)
 
     def _clear_cached_op(self):
         self._cached_graph = ()
         self._cached_op = None
 
+    # -- composition overrides --------------------------------------------
     def register_child(self, block, name=None):
         if not isinstance(block, HybridBlock):
             raise ValueError(
-                "Children of HybridBlock must also be HybridBlock, but %s "
-                "has type %s. If you are using Sequential, please try "
+                "Children of HybridBlock must also be HybridBlock, but "
+                "%s has type %s. If you are using Sequential, please try "
                 "HybridSequential instead." % (str(block),
                                                str(type(block))))
         super().register_child(block, name)
@@ -513,55 +529,68 @@ class HybridBlock(Block):
         self._clear_cached_op()
         super().cast(dtype)
 
+    # -- shape/type inference ---------------------------------------------
     def _infer_attrs(self, infer_fn, attr, *args):
-        inputs, out = self._get_graph(*args)
-        flat_args, _ = _flatten(args, "input")
-        args_map = {}
-        for i, arg in enumerate(flat_args):
-            args_map['data%d' % i] = arg.shape if attr == 'shape' \
-                else arg.dtype
-        arg_attrs, _, aux_attrs = getattr(out, infer_fn)(**args_map)
+        _, graph = self._get_graph(*args)
+        leaves, _ = _tree_flatten(list(args), "input")
+        feed = {"data%d" % i:
+                (leaf.shape if attr == "shape" else leaf.dtype)
+                for i, leaf in enumerate(leaves)}
+        arg_attrs, _, aux_attrs = getattr(graph, infer_fn)(**feed)
         if arg_attrs is None:
             raise ValueError("Could not infer %s" % attr)
-        sdict = dict(zip(out.list_arguments(), arg_attrs))
-        sdict.update(dict(zip(out.list_auxiliary_states(), aux_attrs)))
+        known = dict(zip(graph.list_arguments(), arg_attrs))
+        known.update(zip(graph.list_auxiliary_states(), aux_attrs))
+        field = "_shape" if attr == "shape" else attr
         for name, param in self.collect_params().items():
-            if name in sdict:
-                setattr(param, "_%s" % attr if attr == "shape" else attr,
-                        sdict[name])
+            if name in known:
+                setattr(param, field, known[name])
 
     def infer_shape(self, *args):
         """Infer parameter shapes from inputs (reference: block.py:839)."""
-        self._infer_attrs('infer_shape', 'shape', *args)
+        self._infer_attrs("infer_shape", "shape", *args)
         for param in self.collect_params().values():
             if param._deferred_init:
                 param._finish_deferred_init()
 
     def infer_type(self, *args):
-        self._infer_attrs('infer_type', 'dtype', *args)
+        self._infer_attrs("infer_type", "dtype", *args)
 
+    def _deferred_infer_shape(self, *args):
+        try:
+            self.infer_shape(*args)
+        except Exception as e:
+            raise ValueError(
+                "Deferred initialization failed because shape cannot be "
+                "inferred. {}".format(e))
+
+    # -- deployment -------------------------------------------------------
     def export(self, path, epoch=0, remove_amp_cast=True):
-        """Emit symbol.json + params deploy artifact
+        """Emit the symbol.json + .params deploy pair
         (reference: block.py:868)."""
         if not self._cached_graph:
             raise RuntimeError(
                 "Please first call block.hybridize() and then run forward "
                 "with this block at least once before calling export.")
-        sym = self._cached_graph[1]
-        sym.save('%s-symbol.json' % path)
-        arg_names = set(sym.list_arguments())
-        aux_names = set(sym.list_auxiliary_states())
-        arg_dict = {}
+        graph = self._cached_graph[1]
+        sym_file = "%s-symbol.json" % path
+        graph.save(sym_file)
+        arg_names = set(graph.list_arguments())
+        aux_names = set(graph.list_auxiliary_states())
+        payload = {}
         for name, param in self.collect_params().items():
             if name in arg_names:
-                arg_dict['arg:%s' % name] = param.data()
+                payload["arg:%s" % name] = param.data()
             elif name in aux_names:
-                arg_dict['aux:%s' % name] = param.data()
-        nd.save('%s-%04d.params' % (path, epoch), arg_dict)
-        return '%s-symbol.json' % path, '%s-%04d.params' % (path, epoch)
+                payload["aux:%s" % name] = param.data()
+        params_file = "%s-%04d.params" % (path, epoch)
+        nd.save(params_file, payload)
+        return sym_file, params_file
 
+    # -- execution --------------------------------------------------------
     def forward(self, x, *args):
-        """Dispatch hybridized vs imperative (reference: block.py:795)."""
+        """Hybridized (one compiled program) vs imperative dispatch
+        (reference: block.py:795)."""
         if isinstance(x, NDArray):
             if self._active:
                 try:
@@ -570,137 +599,144 @@ class HybridBlock(Block):
                     self._deferred_infer_shape(x, *args)
                     return self._call_cached_op(x, *args)
             try:
-                params = {i: j.data() for i, j in self._reg_params.items()}
+                param_vals = {n: p.data()
+                              for n, p in self._reg_params.items()}
             except DeferredInitializationError:
                 self._deferred_infer_shape(x, *args)
-                params = {i: j.data() for i, j in self._reg_params.items()}
-            return self.hybrid_forward(nd, x, *args, **params)
-        assert isinstance(x, Symbol), \
-            "HybridBlock requires the first argument to forward be either " \
-            "Symbol or NDArray, but got %s" % type(x)
-        params = {i: j.var() for i, j in self._reg_params.items()}
+                param_vals = {n: p.data()
+                              for n, p in self._reg_params.items()}
+            return self.hybrid_forward(nd, x, *args, **param_vals)
+        if not isinstance(x, Symbol):
+            raise AssertionError(
+                "HybridBlock requires the first argument to forward be "
+                "either Symbol or NDArray, but got %s" % type(x))
+        param_vars = {n: p.var() for n, p in self._reg_params.items()}
         with self.name_scope():
-            return self.hybrid_forward(sym_mod, x, *args, **params)
+            return self.hybrid_forward(sym_mod, x, *args, **param_vars)
 
     def hybrid_forward(self, F, x, *args, **kwargs):
         raise NotImplementedError()
 
 
+# ---------------------------------------------------------------------------
+# SymbolBlock
+# ---------------------------------------------------------------------------
+
 class SymbolBlock(HybridBlock):
-    """Wrap a Symbol as a Block (reference: block.py:952)."""
+    """Wrap an existing Symbol graph as a Block
+    (reference: block.py:952)."""
 
     @staticmethod
     def imports(symbol_file, input_names, param_file=None, ctx=None):
-        sym = sym_mod.load(symbol_file)
+        graph = sym_mod.load(symbol_file)
         if isinstance(input_names, str):
             input_names = [input_names]
-        inputs = [sym_mod.var(i) for i in input_names]
-        ret = SymbolBlock(sym, inputs)
+        net = SymbolBlock(graph,
+                          [sym_mod.var(n) for n in input_names])
         if param_file is not None:
-            params = nd.load(param_file)
-            remapped = {}
-            for name, value in params.items():
-                if name.startswith('arg:') or name.startswith('aux:'):
-                    name = name[4:]
-                remapped[name] = value
-            for name, param in ret.collect_params().items():
-                if name in remapped:
-                    param._load_init(remapped[name], ctx)
-        return ret
+            saved = {}
+            for name, value in nd.load(param_file).items():
+                saved[name[4:] if name[:4] in ("arg:", "aux:")
+                      else name] = value
+            for name, param in net.collect_params().items():
+                if name in saved:
+                    param._load_init(saved[name], ctx)
+        return net
 
     def __init__(self, outputs, inputs, params=None):
         super().__init__(prefix=None, params=params)
-        self._prefix = ''
-        self._params = ParameterDict('', params)
-        if isinstance(inputs, (Symbol,)) and \
+        self._prefix = ""
+        self._params = ParameterDict("", params)
+        if isinstance(inputs, Symbol) and \
                 len(inputs.list_outputs()) == 1:
             inputs = [inputs]
-        if isinstance(outputs, (list, tuple)) and len(outputs) == 1:
-            outputs = outputs[0]
         if isinstance(outputs, (list, tuple)):
-            outputs = sym_mod.Group(outputs)
+            outputs = outputs[0] if len(outputs) == 1 \
+                else sym_mod.Group(outputs)
 
-        syms, self._in_format = _flatten(inputs, "input")
-        out, self._out_format = _flatten(outputs, "output")
-        out = sym_mod.Group(out) if len(out) > 1 else out[0]
+        in_leaves, self._in_spec = _tree_flatten(inputs, "input")
+        out_leaves, self._out_spec = _tree_flatten(outputs, "output")
+        graph = sym_mod.Group(out_leaves) if len(out_leaves) > 1 \
+            else out_leaves[0]
 
-        input_names = set()
-        for i in syms:
-            assert len(i.list_outputs()) == 1, \
-                "Input symbols must be variable, but %s is an output of " \
-                "operators" % str(i)
-            input_names.add(i.name)
+        bound_names = set()
+        for leaf in in_leaves:
+            if len(leaf.list_outputs()) != 1:
+                raise AssertionError(
+                    "Input symbols must be variable, but %s is an output "
+                    "of operators" % str(leaf))
+            bound_names.add(leaf.name)
 
-        for name in out.list_arguments():
-            if name not in input_names:
+        for name in graph.list_arguments():
+            if name not in bound_names:
                 self.params.get(name, allow_deferred_init=True)
-        for name in out.list_auxiliary_states():
-            if name not in input_names:
-                self.params.get(name, grad_req='null',
+        for name in graph.list_auxiliary_states():
+            if name not in bound_names:
+                self.params.get(name, grad_req="null",
                                 allow_deferred_init=True)
 
-        self._cached_graph = (syms, out)
-        prefix = _common_prefix(list(self._params.keys()))
-        params = {k[len(prefix):]: v for k, v in self._params.items()}
-        self._reg_params = params
+        self._cached_graph = (in_leaves, graph)
+        strip = _common_prefix(list(self._params.keys()))
+        self._reg_params = {k[len(strip):]: v
+                            for k, v in self._params.items()}
+
+    def _resolve_deferred_shapes(self, x, *args):
+        inputs, graph = self._cached_graph
+        leaves, _ = _tree_flatten([x] + list(args), "input")
+        feed = {i.name: a.shape for i, a in zip(inputs, leaves)}
+        arg_shapes, _, aux_shapes = graph.infer_shape(**feed)
+        known = dict(zip(graph.list_arguments(), arg_shapes))
+        known.update(zip(graph.list_auxiliary_states(), aux_shapes))
+        for name, param in self.params.items():
+            if param.shape is None or np.prod(param.shape) <= 0:
+                param._shape = known[name]
+            if param._deferred_init:
+                param._finish_deferred_init()
 
     def forward(self, x, *args):
         if isinstance(x, NDArray):
             try:
                 return self._call_cached_op(x, *args)
             except DeferredInitializationError:
-                # infer shapes from the cached graph directly
-                inputs, out = self._cached_graph
-                flat_args, _ = _flatten([x] + list(args), "input")
-                args_map = {i.name: a.shape
-                            for i, a in zip(inputs, flat_args)}
-                arg_shapes, _, aux_shapes = out.infer_shape(**args_map)
-                sdict = dict(zip(out.list_arguments(), arg_shapes))
-                sdict.update(zip(out.list_auxiliary_states(), aux_shapes))
-                for name, param in self.params.items():
-                    if param.shape is None or np.prod(param.shape) <= 0:
-                        param._shape = sdict[name]
-                    if param._deferred_init:
-                        param._finish_deferred_init()
+                self._resolve_deferred_shapes(x, *args)
                 return self._call_cached_op(x, *args)
-        assert isinstance(x, Symbol), \
-            "HybridBlock requires the first argument to forward be either " \
-            "Symbol or NDArray, but got %s" % type(x)
-        args, in_fmt = _flatten([x] + list(args), "input")
-        assert in_fmt == self._in_format, "Invalid input format"
-        ret = copy.copy(self._cached_graph[1])
-        return ret
+        if not isinstance(x, Symbol):
+            raise AssertionError(
+                "HybridBlock requires the first argument to forward be "
+                "either Symbol or NDArray, but got %s" % type(x))
+        leaves, spec = _tree_flatten([x] + list(args), "input")
+        if spec != self._in_spec:
+            raise AssertionError("Invalid input format")
+        return copy.copy(self._cached_graph[1])
 
     def _build_cache(self, *args):
-        inputs, out = self._cached_graph
-        data_names = {d.name: i for i, d in enumerate(inputs)}
-        param_dict = {p.name: p for p in self.params.values()}
-        arg_names = out.list_arguments()
-        aux_names = out.list_auxiliary_states()
-        self._cached_op_args = []
-        for name in arg_names + aux_names:
-            if name in data_names:
-                self._cached_op_args.append((True, data_names[name]))
+        inputs, graph = self._cached_graph
+        slot_of = {p.name: i for i, p in enumerate(inputs)}
+        by_name = {p.name: p for p in self.params.values()}
+        self._cache_sources = []
+        for name in graph.list_arguments() + \
+                graph.list_auxiliary_states():
+            if name in slot_of:
+                self._cache_sources.append(("data", slot_of[name]))
             else:
-                self._cached_op_args.append((False, param_dict[name]))
-        self._cached_op = CachedOp(out, self._flags)
+                self._cache_sources.append(("param", by_name[name]))
+        self._cached_op = CachedOp(graph, self._flags)
 
     def _clear_cached_op(self):
-        tmp = self._cached_graph
+        keep = self._cached_graph
         super()._clear_cached_op()
-        self._cached_graph = tmp
+        self._cached_graph = keep
 
     def hybrid_forward(self, F, x, *args, **kwargs):
         raise NotImplementedError()
 
 
 def _common_prefix(names):
+    """Longest common prefix of all names."""
     if not names:
-        return ''
-    prefix = names[0]
-    for name in names:
-        i = 0
-        while i < len(prefix) and i < len(name) and prefix[i] == name[i]:
-            i += 1
-        prefix = prefix[:i]
-    return prefix
+        return ""
+    lo, hi = min(names), max(names)
+    n = 0
+    while n < len(lo) and lo[n] == hi[n]:
+        n += 1
+    return lo[:n]

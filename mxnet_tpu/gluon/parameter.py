@@ -1,13 +1,20 @@
-"""Gluon Parameter / ParameterDict (parity: python/mxnet/gluon/parameter.py).
+"""Gluon Parameter / ParameterDict (API parity:
+python/mxnet/gluon/parameter.py).
+
+Own architecture: a Parameter is an explicit three-state machine —
+UNBOUND (no array, no pending init), DEFERRED (an ``_PendingInit``
+recipe waiting for shape inference at first forward), LIVE (array
+bound) — with every transition in one place (``_bind``). Shape
+reconciliation (0 = unknown dim) is one module function shared by the
+shape setter, ``ParameterDict.get`` and checkpoint loading.
 
 TPU note: a Parameter owns ONE NDArray handle (not per-device copies);
-data parallelism shards that array over the mesh instead of replicating
-python-side (SURVEY §2.2). Deferred initialization (shape inferred at
-first forward) is preserved.
+data parallelism shards/replicates that single array over the mesh
+(SURVEY §2.2) instead of keeping python-side copies per device.
 """
 from __future__ import annotations
 
-from collections import OrderedDict
+from collections import OrderedDict, namedtuple
 
 import numpy as np
 
@@ -22,72 +29,102 @@ __all__ = ["DeferredInitializationError", "Parameter", "Constant",
 
 
 class DeferredInitializationError(MXNetError):
-    """Error for unfinished deferred initialization."""
+    """Raised when touching a parameter whose init waits on shape
+    inference (reference: parameter.py:36)."""
 
 
-def _replicate_over_ctx(arr, ctx_list):
-    """Re-place ``arr`` as one array replicated over the dp mesh formed
-    by ``ctx_list``'s devices (in place, via handle swap)."""
+tensor_types = None  # populated post-import with (NDArray, Symbol)
+
+_PendingInit = namedtuple("_PendingInit", "init ctx_list default data")
+
+_GRAD_REQS = ("write", "add", "null")
+
+
+def _merge_shapes(declared, observed, owner=""):
+    """Reconcile two shapes where 0 means 'unknown'; returns the merged
+    tuple or raises on conflict."""
+    if declared is None:
+        return tuple(observed)
+    ok = len(declared) == len(observed) and all(
+        d == 0 or o == 0 or d == o
+        for d, o in zip(declared, observed))
+    if not ok:
+        raise AssertionError(
+            "Expected shape %s is incompatible with given shape %s.%s"
+            % (str(tuple(observed)), str(tuple(declared)),
+               (" (Parameter %s)" % owner) if owner else ""))
+    return tuple(d if d != 0 else o for d, o in zip(declared, observed))
+
+
+def _as_ctx_list(ctx):
+    if ctx is None:
+        return [current_context()]
+    if isinstance(ctx, Context):
+        return [ctx]
+    return list(ctx)
+
+
+def _spread_over_mesh(arr, ctx_list):
+    """Replicate ``arr`` over the dp mesh formed by distinct devices of
+    ``ctx_list`` (handle swap; no-op for a single device)."""
     import jax
     from jax.sharding import NamedSharding, PartitionSpec as P
     from ..parallel.mesh import dp_mesh, distinct_devices
     devices = distinct_devices(ctx_list)
-    if len(devices) < 2:
-        return
-    mesh = dp_mesh(devices)
-    arr._set_data(jax.device_put(arr._data, NamedSharding(mesh, P())))
-
-
-tensor_types = None  # set after import (NDArray, Symbol)
+    if len(devices) > 1:
+        mesh = dp_mesh(devices)
+        arr._set_data(jax.device_put(arr._data, NamedSharding(mesh, P())))
 
 
 class Parameter:
-    """A Block parameter (reference: parameter.py:43)."""
+    """One learnable tensor of a Block (reference: parameter.py:43)."""
 
     def __init__(self, name, grad_req='write', shape=None, dtype=np.float32,
-                 lr_mult=1.0, wd_mult=1.0, init=None, allow_deferred_init=False,
-                 differentiable=True, stype='default', grad_stype='default'):
-        self._var = None
-        self._data = None
-        self._grad = None
-        self._deferred_init = ()
+                 lr_mult=1.0, wd_mult=1.0, init=None,
+                 allow_deferred_init=False, differentiable=True,
+                 stype='default', grad_stype='default'):
+        self.name = name
+        self.init = init
+        self.lr_mult, self.wd_mult = lr_mult, wd_mult
+        self._shape = (shape,) if isinstance(shape, int) else \
+            (tuple(shape) if shape is not None else None)
+        self._dtype = dtype
+        self._stype, self._grad_stype = stype, grad_stype
         self._differentiable = differentiable
         self._allow_deferred_init = allow_deferred_init
+        # state machine fields
+        self._data = None               # LIVE when set
+        self._grad = None
+        self._pending = None            # DEFERRED when set
+        self._ctx_list = []
+        self._var = None
         self._grad_req = None
-        if isinstance(shape, int):
-            shape = (shape,)
-        self._shape = shape
-        self.name = name
-        self._dtype = dtype
-        self.lr_mult = lr_mult
-        self.wd_mult = wd_mult
-        self.init = init
-        self._stype = stype
-        self._grad_stype = grad_stype
         self.grad_req = grad_req
 
     def __repr__(self):
-        s = 'Parameter {name} (shape={shape}, dtype={dtype})'
-        return s.format(name=self.name, shape=self.shape, dtype=self.dtype)
+        return "Parameter {} (shape={}, dtype={})".format(
+            self.name, self.shape, self.dtype)
 
+    # -- simple attributes ------------------------------------------------
     @property
     def grad_req(self):
         return self._grad_req
 
     @grad_req.setter
     def grad_req(self, req):
-        assert req in ['write', 'add', 'null'], \
-            "grad_req must be one of 'write', 'add', or 'null', but got %s" \
-            % req
+        if req not in _GRAD_REQS:
+            raise AssertionError(
+                "grad_req must be one of 'write', 'add', or 'null', "
+                "but got %s" % req)
         if not self._differentiable:
             req = 'null'
-        if self._grad_req == req:
+        if req == self._grad_req:
             return
         self._grad_req = req
         if req == 'null':
             self._grad = None
         elif self._data is not None:
-            self._init_grad()
+            self._attach_grad_buffer()
 
     @property
     def dtype(self):
@@ -103,104 +140,121 @@ class Parameter:
 
     @shape.setter
     def shape(self, new_shape):
-        if self._shape is None:
-            self._shape = new_shape
-            return
-        assert len(self._shape) == len(new_shape) and \
-            all(j in (0, i) for i, j in zip(new_shape, self._shape)), \
-            "Expected shape %s is incompatible with given shape %s." % (
-                str(new_shape), str(self._shape))
-        self._shape = new_shape
+        self._shape = _merge_shapes(self._shape, new_shape, self.name)
 
     @property
     def stype(self):
         return self._stype
 
-    # -- init ------------------------------------------------------------
+    # -- state transitions ------------------------------------------------
     def initialize(self, init=None, ctx=None, default_init=None,
                    force_reinit=False):
+        """Schedule (or run) initialization. Unknown dims defer to the
+        first forward when allow_deferred_init is set."""
         if default_init is None:
             default_init = initializer.Uniform()
         if self._data is not None and not force_reinit:
             return
-        if ctx is None:
-            ctx = [current_context()]
-        if isinstance(ctx, Context):
-            ctx = [ctx]
-        if init is None:
-            init = default_init if self.init is None else self.init
-        if not self.shape or np.prod(self.shape) <= 0:
-            if self._allow_deferred_init:
-                self._deferred_init = (init, ctx, default_init, None)
-                return
-            raise ValueError("Cannot initialize Parameter '%s' because it "
-                             "has invalid shape: %s." % (self.name,
-                                                         str(self.shape)))
-        self._deferred_init = (init, ctx, default_init, None)
-        self._finish_deferred_init()
+        chosen = init if init is not None else \
+            (self.init if self.init is not None else default_init)
+        recipe = _PendingInit(chosen, _as_ctx_list(ctx), default_init, None)
+        if self._shape_known():
+            self._pending = recipe
+            self._finish_deferred_init()
+        elif self._allow_deferred_init:
+            self._pending = recipe
+        else:
+            raise ValueError(
+                "Cannot initialize Parameter '%s' because it has invalid "
+                "shape: %s." % (self.name, str(self.shape)))
+
+    def _shape_known(self):
+        return bool(self.shape) and np.prod(self.shape) > 0
 
     def _finish_deferred_init(self):
-        if not self._deferred_init:
+        if self._pending is None:
             return
-        init, ctx, default_init, data = self._deferred_init
-        self._deferred_init = ()
-        assert self.shape is not None and np.prod(self.shape) > 0, \
-            "Cannot initialize Parameter '%s' because it has invalid shape: "\
-            "%s. Please specify in_units, in_channels, etc for `Block`s." % (
-                self.name, str(self.shape))
+        recipe, self._pending = self._pending, None
+        if not self._shape_known():
+            raise AssertionError(
+                "Cannot initialize Parameter '%s' because it has invalid "
+                "shape: %s. Please specify in_units, in_channels, etc "
+                "for `Block`s." % (self.name, str(self.shape)))
         from .. import autograd
         with autograd.pause():
+            data = recipe.data
             if data is None:
-                data = nd.zeros(self.shape, dtype=self.dtype, ctx=ctx[0])
-                actual_init = init if init is not None else default_init
-                if isinstance(actual_init, str):
-                    actual_init = initializer.create(actual_init)
-                actual_init(initializer.InitDesc(self.name, {}), data)
-            self._init_impl(data, ctx)
+                data = nd.zeros(self.shape, dtype=self.dtype,
+                                ctx=recipe.ctx_list[0])
+                fill = recipe.init or recipe.default
+                if isinstance(fill, str):
+                    fill = initializer.create(fill)
+                fill(initializer.InitDesc(self.name, {}), data)
+            self._bind(data, recipe.ctx_list)
 
-    def _init_impl(self, data, ctx_list):
+    def _bind(self, data, ctx_list):
+        """UNBOUND/DEFERRED → LIVE: adopt the array (and replicate it
+        over the contexts' dp mesh for multi-context init — the Gluon
+        data-parallel path; eager ops against a batch-sharded input
+        then run SPMD with gradient psums inserted by XLA)."""
         self._ctx_list = list(ctx_list)
         if len(self._ctx_list) > 1:
-            # Multi-context init = the Gluon data-parallel path. The
-            # reference keeps one copy per device (parameter.py:43 via
-            # _init_impl per-ctx copies); here the TPU-native form is a
-            # single array replicated over the contexts' dp mesh —
-            # eager ops between it and a batch-sharded input then run
-            # SPMD with the gradient psum inserted by XLA.
-            _replicate_over_ctx(data, self._ctx_list)
+            _spread_over_mesh(data, self._ctx_list)
         self._data = data
         if self._grad_req != 'null':
-            self._init_grad()
+            self._attach_grad_buffer()
 
-    def _init_grad(self):
+    def _attach_grad_buffer(self):
         from .. import autograd
         self._grad = nd.zeros(self._data.shape, dtype=self._data.dtype,
                               ctx=self._data.context)
         if len(self._ctx_list) > 1:
-            _replicate_over_ctx(self._grad, self._ctx_list)
+            _spread_over_mesh(self._grad, self._ctx_list)
         autograd.mark_variables([self._data], [self._grad],
                                 [self._grad_req])
 
-    def _check_and_get(self, arr, ctx):
-        if arr is not None:
-            return arr
-        if self._deferred_init:
-            raise DeferredInitializationError(
-                "Parameter '%s' has not been initialized yet because "
-                "initialization was deferred. Actual initialization happens "
-                "during the first forward pass. Please pass one batch of "
-                "data through the network before accessing Parameters." %
-                self.name)
-        raise RuntimeError(
-            "Parameter '%s' has not been initialized. Note that you should "
-            "initialize parameters and create Trainer with "
-            "Block.collect_params() instead of Block.params because the "
-            "later does not include Parameters of nested child Blocks" %
-            self.name)
+    def _load_init(self, data, ctx):
+        """Adopt checkpointed values (reference: parameter.py:274)."""
+        if self.shape:
+            if len(self.shape) != len(data.shape) or any(
+                    want not in (0, got)
+                    for want, got in zip(self.shape, data.shape)):
+                raise AssertionError(
+                    "Failed loading Parameter '%s' from saved params: "
+                    "shape incompatible expected %s vs saved %s" % (
+                        self.name, str(self.shape), str(data.shape)))
+            self._shape = tuple(
+                got if want == 0 else want
+                for want, got in zip(self.shape, data.shape))
+        if self._data is None:
+            ctxes = self._pending.ctx_list if self._pending is not None \
+                else _as_ctx_list(ctx)
+            self._bind(data.astype(self.dtype), ctxes)
+        else:
+            self.set_data(data)
+        self._pending = None
 
     # -- access ----------------------------------------------------------
+    def _require_live(self):
+        if self._data is not None:
+            return
+        if self._pending is not None:
+            raise DeferredInitializationError(
+                "Parameter '%s' has not been initialized yet because "
+                "initialization was deferred. Actual initialization "
+                "happens during the first forward pass. Please pass one "
+                "batch of data through the network before accessing "
+                "Parameters." % self.name)
+        raise RuntimeError(
+            "Parameter '%s' has not been initialized. Note that you "
+            "should initialize parameters and create Trainer with "
+            "Block.collect_params() instead of Block.params because the "
+            "later does not include Parameters of nested child Blocks"
+            % self.name)
+
     def data(self, ctx=None):
-        return self._check_and_get(self._data, ctx)
+        self._require_live()
+        return self._data
 
     def list_data(self):
         return [self.data()]
@@ -210,40 +264,38 @@ class Parameter:
             raise RuntimeError(
                 "Cannot get gradient array for Parameter '%s' because "
                 "grad_req='null'" % self.name)
-        return self._check_and_get(self._grad, ctx)
+        self._require_live()
+        return self._grad
 
     def list_grad(self):
         return [self.grad()]
 
     def list_ctx(self):
-        if self._data is None:
-            if self._deferred_init:
-                return self._deferred_init[1]
-            raise RuntimeError("Parameter '%s' has not been initialized"
-                               % self.name)
-        return self._ctx_list if hasattr(self, "_ctx_list") \
-            else [self._data.context]
+        if self._data is not None:
+            return self._ctx_list or [self._data.context]
+        if self._pending is not None:
+            return self._pending.ctx_list
+        raise RuntimeError("Parameter '%s' has not been initialized"
+                           % self.name)
 
     def set_data(self, data):
         self.shape = data.shape
         if self._data is None:
-            assert self._deferred_init, \
-                "Parameter '%s' has not been initialized" % self.name
-            self._deferred_init = self._deferred_init[:3] + (data,)
+            if self._pending is None:
+                raise AssertionError(
+                    "Parameter '%s' has not been initialized" % self.name)
+            self._pending = self._pending._replace(data=data)
             return
-        if isinstance(data, nd.NDArray):
-            self._data._set_data(data.astype(self._data.dtype)._data)
-        else:
-            self._data._set_data(nd.array(
-                data, dtype=self._data.dtype)._data)
+        value = data if isinstance(data, nd.NDArray) else \
+            nd.array(data, dtype=self._data.dtype)
+        self._data._set_data(value.astype(self._data.dtype)._data)
 
     def zero_grad(self):
-        if self._grad is None:
-            return
-        self._grad[:] = 0
+        if self._grad is not None:
+            self._grad[:] = 0
 
     def reset_ctx(self, ctx):
-        pass  # single logical array on TPU; placement via sharding
+        pass  # placement is a sharding annotation on TPU, not a copy
 
     def cast(self, dtype):
         self._dtype = dtype
@@ -259,37 +311,50 @@ class Parameter:
 
     def var(self):
         if self._var is None:
-            self._var = sym_mod.var(self.name, shape=self.shape,
-                                    dtype=self.dtype, lr_mult=self.lr_mult,
-                                    wd_mult=self.wd_mult, init=self.init)
+            self._var = sym_mod.var(
+                self.name, shape=self.shape, dtype=self.dtype,
+                lr_mult=self.lr_mult, wd_mult=self.wd_mult, init=self.init)
         return self._var
 
     def row_sparse_data(self, row_id):
         return self.data().take(row_id)
 
+    # legacy spellings kept for block.py/trainer.py-era callers
+    @property
+    def _deferred_init(self):
+        return self._pending or ()
+
+    def _check_and_get(self, arr, ctx):
+        self._require_live()
+        return arr
+
 
 class Constant(Parameter):
-    """Non-trainable constant parameter (reference: parameter.py:612)."""
+    """Non-trainable constant (reference: parameter.py:612). The value
+    is captured in a one-off registered initializer so ``initialize()``
+    reproduces it on any context."""
 
     def __init__(self, name, value):
         if not isinstance(value, nd.NDArray):
             value = nd.array(value)
         self.value = value
 
-        class Init(initializer.Initializer):
+        class _Repeat(initializer.Initializer):
             def _init_weight(self, _, arr):
                 value.copyto(arr)
 
             _init_default = _init_weight
-        init_name = 'Constant_{}_{}'.format(name, id(self))
-        initializer._REG.register(init_name, allow_override=True)(Init)
+
+        alias = 'Constant_{}_{}'.format(name, id(self))
+        initializer._REG.register(alias, allow_override=True)(_Repeat)
         super().__init__(name, grad_req='null', shape=value.shape,
-                         dtype=value.dtype, init=init_name,
+                         dtype=value.dtype, init=alias,
                          differentiable=False)
 
 
 class ParameterDict:
-    """Dict of Parameters with prefix (reference: parameter.py:632)."""
+    """Prefix-scoped mapping of Parameters with sharing
+    (reference: parameter.py:632)."""
 
     def __init__(self, prefix='', shared=None):
         self._prefix = prefix
@@ -297,10 +362,9 @@ class ParameterDict:
         self._shared = shared
 
     def __repr__(self):
-        s = '{name}(\n{content}\n)'
-        name = self._prefix + ' ' if self._prefix else ''
-        return s.format(name=name, content='\n'.join(
-            [' ' + v.__repr__() for v in self.values()]))
+        head = self._prefix + ' ' if self._prefix else ''
+        body = '\n'.join(' ' + repr(v) for v in self.values())
+        return '{}(\n{}\n)'.format(head, body)
 
     def __getitem__(self, key):
         return self._params[key]
@@ -321,75 +385,70 @@ class ParameterDict:
     def prefix(self):
         return self._prefix
 
-    def _get_impl(self, name):
-        if name in self._params:
-            return self._params[name]
-        if self._shared is not None and name in self._shared._params:
-            self._params[name] = self._shared._params[name]
-            return self._shared._params[name]
-        return None
+    def _lookup(self, full_name):
+        """This dict, then the shared dict (adopting on hit)."""
+        hit = self._params.get(full_name)
+        if hit is None and self._shared is not None:
+            hit = self._shared._params.get(full_name)
+            if hit is not None:
+                self._params[full_name] = hit
+        return hit
+
+    @staticmethod
+    def _reconcile(param, key, value):
+        """Merge a requested attribute into an existing Parameter,
+        erroring on true conflicts."""
+        existing = getattr(param, key, None)
+        if existing is None:
+            setattr(param, key, value)
+            return
+        if key == 'shape' and len(value) == len(existing):
+            param._shape = _merge_shapes(existing, value, param.name)
+            return
+        if key == 'dtype' and np.dtype(value) == np.dtype(existing):
+            return
+        if value is not None and value != existing:
+            raise AssertionError(
+                "Cannot retrieve Parameter '%s' because desired "
+                "attribute does not match with stored for attribute "
+                "'%s': desired '%s' vs stored '%s'." % (
+                    param.name, key, str(value), str(existing)))
 
     def get(self, name, **kwargs):
-        name = self._prefix + name
-        param = self._get_impl(name)
+        full = self._prefix + name
+        param = self._lookup(full)
         if param is None:
-            param = Parameter(name, **kwargs)
-            self._params[name] = param
+            param = Parameter(full, **kwargs)
+            self._params[full] = param
         else:
-            for k, v in kwargs.items():
-                if hasattr(param, k) and getattr(param, k) is not None:
-                    existing = getattr(param, k)
-                    if k == 'shape' and len(v) == len(existing):
-                        inferred_shape = []
-                        matched = True
-                        for dim1, dim2 in zip(v, existing):
-                            if dim1 != dim2 and dim1 * dim2 != 0:
-                                matched = False
-                                break
-                            elif dim1 == dim2:
-                                inferred_shape.append(dim1)
-                            elif dim1 == 0:
-                                inferred_shape.append(dim2)
-                            else:
-                                inferred_shape.append(dim1)
-                        if matched:
-                            param._shape = tuple(inferred_shape)
-                            continue
-                    elif k == 'dtype' and np.dtype(v) == np.dtype(existing):
-                        continue
-                    assert v is None or v == existing, \
-                        "Cannot retrieve Parameter '%s' because desired " \
-                        "attribute does not match with stored for " \
-                        "attribute '%s': desired '%s' vs stored '%s'." % (
-                            name, k, str(v), str(getattr(param, k)))
-                else:
-                    setattr(param, k, v)
+            for key, value in kwargs.items():
+                self._reconcile(param, key, value)
         return param
 
     def get_constant(self, name, value=None):
-        name = self._prefix + name
-        param = self._get_impl(name)
+        full = self._prefix + name
+        param = self._lookup(full)
         if param is None:
             if value is None:
-                raise KeyError("No constant named '{}'. Please specify value "
-                               "if you want to create a new constant.".format(
-                                   name))
-            param = Constant(name, value)
-            self._params[name] = param
-        elif value is not None:
-            assert isinstance(param, Constant), \
-                "Parameter '{}' already exists but it is not a constant." \
-                .format(name)
+                raise KeyError(
+                    "No constant named '{}'. Please specify value if you "
+                    "want to create a new constant.".format(full))
+            param = Constant(full, value)
+            self._params[full] = param
+        elif value is not None and not isinstance(param, Constant):
+            raise AssertionError(
+                "Parameter '{}' already exists but it is not a constant."
+                .format(full))
         return param
 
     def update(self, other):
-        for k, v in other.items():
-            if k in self._params:
-                assert self._params[k] is v, \
-                    "Cannot update self with other because they have " \
-                    "different Parameters with the same name '%s'" % k
-            else:
-                self._params[k] = v
+        for name, param in other.items():
+            mine = self._params.get(name)
+            if mine is not None and mine is not param:
+                raise AssertionError(
+                    "Cannot update self with other because they have "
+                    "different Parameters with the same name '%s'" % name)
+            self._params[name] = param
 
     def initialize(self, init=None, ctx=None, verbose=False,
                    force_reinit=False):
@@ -397,76 +456,56 @@ class ParameterDict:
             init = initializer.Uniform()
         if verbose and hasattr(init, "set_verbosity"):
             init.set_verbosity(verbose=verbose)
-        for _, v in self.items():
-            v.initialize(None, ctx, init, force_reinit=force_reinit)
+        for param in self.values():
+            param.initialize(None, ctx, init, force_reinit=force_reinit)
 
     def zero_grad(self):
-        for i in self.values():
-            i.zero_grad()
+        for param in self.values():
+            param.zero_grad()
 
     def reset_ctx(self, ctx):
-        for i in self.values():
-            i.reset_ctx(ctx)
+        for param in self.values():
+            param.reset_ctx(ctx)
 
     def setattr(self, name, value):
-        for i in self.values():
-            setattr(i, name, value)
+        for param in self.values():
+            setattr(param, name, value)
 
     def save(self, filename, strip_prefix=''):
-        arg_dict = {}
+        payload = {}
         for param in self.values():
-            weight = param.data()
             if not param.name.startswith(strip_prefix):
                 raise ValueError(
                     "Prefix '%s' is to be striped before saving, but "
                     "Parameter's name '%s' does not start with '%s'" % (
                         strip_prefix, param.name, strip_prefix))
-            arg_dict[param.name[len(strip_prefix):]] = weight
-        nd.save(filename, arg_dict)
+            payload[param.name[len(strip_prefix):]] = param.data()
+        nd.save(filename, payload)
 
     def load(self, filename, ctx=None, allow_missing=False,
              ignore_extra=False, restore_prefix=''):
         if restore_prefix:
             for name in self.keys():
-                assert name.startswith(restore_prefix), \
-                    "restore_prefix is '%s' but Parameter name '%s' does " \
-                    "not start with it" % (restore_prefix, name)
-        lprefix = len(restore_prefix)
-        loaded = nd.load(filename)
-        arg_dict = {restore_prefix + k: v for k, v in loaded.items()}
+                if not name.startswith(restore_prefix):
+                    raise AssertionError(
+                        "restore_prefix is '%s' but Parameter name '%s' "
+                        "does not start with it" % (restore_prefix, name))
+        strip = len(restore_prefix)
+        loaded = {restore_prefix + k: v
+                  for k, v in nd.load(filename).items()}
         if not allow_missing:
-            for name in self.keys():
-                assert name in arg_dict, \
-                    "Parameter '%s' is missing in file '%s'" % (
-                        name[lprefix:], filename)
-        for name in arg_dict:
-            if name not in self._params:
-                assert ignore_extra, \
-                    "Parameter '%s' loaded from file '%s' is not present " \
-                    "in ParameterDict" % (name[lprefix:], filename)
+            missing = [n for n in self.keys() if n not in loaded]
+            if missing:
+                raise AssertionError(
+                    "Parameter '%s' is missing in file '%s'"
+                    % (missing[0][strip:], filename))
+        for name, value in loaded.items():
+            target = self._params.get(name)
+            if target is None:
+                if not ignore_extra:
+                    raise AssertionError(
+                        "Parameter '%s' loaded from file '%s' is not "
+                        "present in ParameterDict"
+                        % (name[strip:], filename))
                 continue
-            self[name]._load_init(arg_dict[name], ctx)
-
-
-def _param_load_init(self, data, ctx):
-    if self.shape:
-        for self_dim, data_dim in zip(self.shape, data.shape):
-            assert self_dim in (0, data_dim), \
-                "Failed loading Parameter '%s' from saved params: shape " \
-                "incompatible expected %s vs saved %s" % (
-                    self.name, str(self.shape), str(data.shape))
-        self.shape = tuple(i if i != 0 else j
-                           for i, j in zip(self.shape, data.shape))
-    if self._data is None:
-        if self._deferred_init:
-            ctx_list = self._deferred_init[1]
-        else:
-            ctx_list = [ctx] if isinstance(ctx, Context) else \
-                (ctx or [current_context()])
-        self._init_impl(data.astype(self.dtype), ctx_list)
-    else:
-        self.set_data(data)
-    self._deferred_init = ()
-
-
-Parameter._load_init = _param_load_init
+            target._load_init(value, ctx)

@@ -190,7 +190,7 @@ def _fans(name, shape):
     if len(shape) < 2:
         raise ValueError(
             "Xavier-family initializers need >= 2 dims; %r has shape %s"
-            % (str(name), (shape,)))
+            % (str(name), shape))
     field = np.prod(shape[2:]) if len(shape) > 2 else 1.0
     return shape[1] * field, shape[0] * field
 

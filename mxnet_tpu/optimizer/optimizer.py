@@ -1,9 +1,13 @@
-"""Optimizers (parity: python/mxnet/optimizer/optimizer.py).
+"""Optimizers (API parity: python/mxnet/optimizer/optimizer.py).
 
-Each ``update`` dispatches to a fused XLA update op from
-mxnet_tpu.ops.optimizer_ops where one exists (the reference's fused CUDA
-update kernels, src/operator/optimizer_op.cc); the long tail is composed
-from NDArray ops (still jit-fused per call).
+Own structure: per-index learning-rate/weight-decay scaling is one
+table-resolution helper (``_scaled_all``); the eager preprocessing
+shared by composed optimizers (rescale → clip → optional wd fold-in)
+is ``_prepared_grad``; fused update rules dispatch to the XLA update
+ops in mxnet_tpu.ops.optimizer_ops (the reference's fused kernels,
+src/operator/optimizer_op.cc) while the long tail composes NDArray
+ops. Row-lazy sparse updates gather/scatter only the touched rows
+(``_lazy_row_update``).
 """
 from __future__ import annotations
 
@@ -29,173 +33,184 @@ def register(klass):
 
 
 class Optimizer:
-    """Base optimizer (reference: optimizer.py:37)."""
+    """Base optimizer: per-index update counting, lr/wd multiplier
+    tables, multi-precision plumbing (reference: optimizer.py:37)."""
 
     def __init__(self, rescale_grad=1., param_idx2name=None, wd=0.,
                  clip_gradient=None, learning_rate=0.01,
                  lr_scheduler=None, sym=None, begin_num_update=0,
                  multi_precision=False, param_dict=None):
-        self.rescale_grad = rescale_grad
-        self.lr = learning_rate
-        self.lr_scheduler = lr_scheduler
-        if lr_scheduler is not None:
-            self.lr_scheduler.base_lr = learning_rate
-        self.wd = wd
-        self.lr_mult = {}
-        self.wd_mult = {}
-        self.begin_num_update = begin_num_update
-        self.num_update = begin_num_update
-        self._index_update_count = {}
-        self.clip_gradient = clip_gradient
-        self.multi_precision = multi_precision
-        self.aggregate_num = 0
         if param_idx2name is None:
             param_idx2name = {}
-        assert isinstance(param_idx2name, dict), \
-            'param_idx2name should be a dict of param indexes to names.'
-        self.idx2name = param_idx2name.copy()
-        self.sym_info = (sym.attr_dict(), sym.list_arguments()) if sym is not None else ()
-        self.param_dict = param_dict if param_dict else {}
+        if not isinstance(param_idx2name, dict):
+            raise AssertionError(
+                "param_idx2name should be a dict of param indexes to "
+                "names.")
+        self.rescale_grad, self.clip_gradient = rescale_grad, clip_gradient
+        self.lr, self.wd = learning_rate, wd
+        self.lr_scheduler = lr_scheduler
+        if lr_scheduler is not None:
+            lr_scheduler.base_lr = learning_rate
+        self.begin_num_update = self.num_update = begin_num_update
+        self._index_update_count = {}
+        self.multi_precision = multi_precision
+        self.aggregate_num = 0
+        self.idx2name = dict(param_idx2name)
+        self.sym_info = () if sym is None else \
+            (sym.attr_dict(), sym.list_arguments())
+        self.param_dict = param_dict or {}
         self.set_lr_mult({})
         self.set_wd_mult({})
 
-    create_optimizer = staticmethod(lambda name, **kwargs: create(name,
-                                                                  **kwargs))
+    create_optimizer = staticmethod(
+        lambda name, **kwargs: create(name, **kwargs))
 
+    # -- state ------------------------------------------------------------
     def create_state(self, index, weight):
         return None
 
     def create_state_multi_precision(self, index, weight):
-        weight_master_copy = None
-        if self.multi_precision and weight.dtype == numpy.float16:
-            weight_master_copy = weight.astype(numpy.float32)
-            return (weight_master_copy,) + (self.create_state(index,
-                                                              weight_master_copy),)
-        if weight.dtype == numpy.float16 and not self.multi_precision:
-            warnings.warn("Accumulating with float16 in optimizer can lead "
-                          "to poor accuracy or slow convergence. Consider "
-                          "using multi_precision=True option.")
+        if weight.dtype == numpy.float16:
+            if self.multi_precision:
+                master = weight.astype(numpy.float32)
+                return (master, self.create_state(index, master))
+            warnings.warn(
+                "Accumulating with float16 in optimizer can lead to poor "
+                "accuracy or slow convergence. Consider using "
+                "multi_precision=True option.")
         return self.create_state(index, weight)
 
+    # -- update protocol --------------------------------------------------
     def update(self, index, weight, grad, state):
         raise NotImplementedError()
 
     def update_multi_precision(self, index, weight, grad, state):
         if self.multi_precision and weight.dtype == numpy.float16:
-            weight_master_copy = state[0]
-            original_state = state[1]
-            grad32 = grad.astype(numpy.float32)
-            self.update(index, weight_master_copy, grad32, original_state)
-            weight[:] = weight_master_copy.astype(weight.dtype)
+            master, inner = state
+            self.update(index, master, grad.astype(numpy.float32), inner)
+            weight[:] = master.astype(weight.dtype)
         else:
             self.update(index, weight, grad, state)
 
+    # -- hyperparameter plumbing ------------------------------------------
     def set_learning_rate(self, lr):
         if self.lr_scheduler is not None:
-            raise UserWarning("LRScheduler of the optimizer has already been "
-                              "defined.")
+            raise UserWarning(
+                "LRScheduler of the optimizer has already been defined.")
         self.lr = lr
 
-    def set_lr_mult(self, args_lr_mult):
-        self.lr_mult = {}
+    def _mults_from_sym(self, attr_key):
+        table = {}
         if self.sym_info:
-            attr, arg_names = self.sym_info
+            attrs, arg_names = self.sym_info
             for name in arg_names:
-                if name in attr and '__lr_mult__' in attr[name]:
-                    self.lr_mult[name] = float(attr[name]['__lr_mult__'])
+                if name in attrs and attr_key in attrs[name]:
+                    table[name] = float(attrs[name][attr_key])
+        return table
+
+    def set_lr_mult(self, args_lr_mult):
+        self.lr_mult = self._mults_from_sym('__lr_mult__')
         self.lr_mult.update(args_lr_mult)
 
     def set_wd_mult(self, args_wd_mult):
-        self.wd_mult = {}
-        for n in self.idx2name.values():
-            # biases/beta get no decay; weights AND norm-layer gammas
-            # keep it (reference: optimizer.py set_wd_mult)
-            if not (n.endswith('_weight') or n.endswith('_gamma')):
-                self.wd_mult[n] = 0.0
-        if self.sym_info:
-            attr, arg_names = self.sym_info
-            for name in arg_names:
-                if name in attr and '__wd_mult__' in attr[name]:
-                    self.wd_mult[name] = float(attr[name]['__wd_mult__'])
+        # biases/betas get no decay; weights and norm gammas keep it
+        self.wd_mult = {n: 0.0 for n in self.idx2name.values()
+                        if not n.endswith(('_weight', '_gamma'))}
+        self.wd_mult.update(self._mults_from_sym('__wd_mult__'))
         self.wd_mult.update(args_wd_mult)
 
     def _update_count(self, index):
-        if not isinstance(index, (list, tuple)):
-            index = [index]
-        for idx in index:
-            if idx not in self._index_update_count:
-                self._index_update_count[idx] = self.begin_num_update
-            self._index_update_count[idx] += 1
-            self.num_update = max(self._index_update_count[idx],
-                                  self.num_update)
+        for idx in (index if isinstance(index, (list, tuple)) else [index]):
+            count = self._index_update_count.get(idx,
+                                                 self.begin_num_update) + 1
+            self._index_update_count[idx] = count
+            self.num_update = max(count, self.num_update)
+
+    def _scaled_all(self, indices, base, mult_table, param_attr):
+        """base value per index, scaled by (in priority order) the
+        param_dict entry, the explicit multiplier table, or the
+        name-keyed table via idx2name."""
+        out = []
+        for index in indices:
+            scale = 1.0
+            if index in self.param_dict:
+                scale = getattr(self.param_dict[index], param_attr)
+            elif index in mult_table:
+                scale = mult_table[index]
+            elif index in self.idx2name:
+                scale = mult_table.get(self.idx2name[index], 1.0)
+            out.append(base * scale)
+        return out
 
     def _get_lrs(self, indices):
-        if self.lr_scheduler is not None:
-            lr = self.lr_scheduler(self.num_update)
-        else:
-            lr = self.lr
-        lrs = [lr for _ in indices]
-        for i, index in enumerate(indices):
-            if index in self.param_dict:
-                lrs[i] *= self.param_dict[index].lr_mult
-            elif index in self.lr_mult:
-                lrs[i] *= self.lr_mult[index]
-            elif index in self.idx2name:
-                lrs[i] *= self.lr_mult.get(self.idx2name[index], 1.0)
-        return lrs
+        base = self.lr if self.lr_scheduler is None else \
+            self.lr_scheduler(self.num_update)
+        return self._scaled_all(indices, base, self.lr_mult, 'lr_mult')
+
+    def _get_wds(self, indices):
+        return self._scaled_all(indices, self.wd, self.wd_mult, 'wd_mult')
 
     def _get_lr(self, index):
         return self._get_lrs([index])[0]
 
-    def _get_wds(self, indices):
-        wds = [self.wd for _ in indices]
-        for i, index in enumerate(indices):
-            if index in self.param_dict:
-                wds[i] *= self.param_dict[index].wd_mult
-            elif index in self.wd_mult:
-                wds[i] *= self.wd_mult[index]
-            elif index in self.idx2name:
-                wds[i] *= self.wd_mult.get(self.idx2name[index], 1.0)
-        return wds
-
     def _get_wd(self, index):
         return self._get_wds([index])[0]
 
+    def _step_inputs(self, index):
+        """(lr, wd, base kwargs) for one index — the common preamble of
+        every update()."""
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        kw = {"lr": lr, "wd": wd, "rescale_grad": self.rescale_grad}
+        if self.clip_gradient is not None:
+            kw["clip_gradient"] = self.clip_gradient
+        return lr, wd, kw
+
+    def _prepared_grad(self, grad, wd=None, weight=None):
+        """Eager-path preprocessing: rescale, clip, optionally fold wd."""
+        grad = grad * self.rescale_grad
+        if wd is not None:
+            grad = grad + wd * weight
+        if self.clip_gradient is not None:
+            grad = grad.clip(-self.clip_gradient, self.clip_gradient)
+        return grad
+
     def __getstate__(self):
-        ret = self.__dict__.copy()
-        return ret
+        return self.__dict__.copy()
 
     def __setstate__(self, state):
         self.__dict__.update(state)
 
 
+# ---------------------------------------------------------------------------
+# sparse row-lazy lowering
+# ---------------------------------------------------------------------------
 
 def _lazy_row_update(op_name, weight, grad, states, attrs):
     """Row-lazy sparse update (reference: the row_sparse kernels in
     src/operator/optimizer_op.cc with ``lazy_update=True``): apply the
-    dense update rule to ONLY the rows named by the row_sparse gradient.
-    Untouched rows — and their optimizer states — receive no update at
-    all (no weight decay, no momentum decay), which is the semantic the
-    reference documents for lazy sparse training.
+    dense rule to ONLY the rows named by the row_sparse gradient;
+    untouched rows and their states receive no update at all (no wd, no
+    momentum decay) — the documented lazy sparse-training semantic.
 
-    Lowering: gather the touched rows of weight and states, run the
-    same registered update op on the row block, scatter back — the
-    TPU-friendly form of the reference's per-row kernel loop.
+    Lowering: gather touched rows of weight+states, run the registered
+    update op on the row block, scatter back — the TPU-friendly form of
+    the reference's per-row kernel loop.
     """
     import jax.numpy as jnp
     from ..ops import registry as _R
     op = _R.get_op(op_name)
     nattrs = _R.normalize_attrs(op, attrs)
-    idx = grad.indices._data
-    w = weight._data
-    w_rows = jnp.take(w, idx, axis=0)
-    st_rows = [jnp.take(s._data, idx, axis=0) for s in states]
-    out = op.forward(nattrs, w_rows, grad.data._data, *st_rows)
+    rows = grad.indices._data
+    full = weight._data
+    picked = [jnp.take(full, rows, axis=0)] + \
+        [jnp.take(s._data, rows, axis=0) for s in states]
+    out = op.forward(nattrs, picked[0], grad.data._data, *picked[1:])
     if not isinstance(out, (tuple, list)):
         out = (out,)
-    weight._set_data(w.at[idx].set(out[0]))
-    for s, ns in zip(states, out[1:]):
-        s._set_data(s._data.at[idx].set(ns))
+    weight._set_data(full.at[rows].set(out[0]))
+    for s, updated in zip(states, out[1:]):
+        s._set_data(s._data.at[rows].set(updated))
 
 
 def _rsp_grad(grad):
@@ -209,39 +224,31 @@ def _fp32_state(weight):
     reference, whose ndarray.zeros defaults to float32)."""
     return weight.zeros_like().astype(numpy.float32)
 
-def _common_kwargs(opt, lr, wd):
-    kw = {"lr": lr, "wd": wd, "rescale_grad": opt.rescale_grad}
-    if opt.clip_gradient is not None:
-        kw["clip_gradient"] = opt.clip_gradient
-    return kw
 
+# ---------------------------------------------------------------------------
+# fused-kernel optimizers
+# ---------------------------------------------------------------------------
 
 @register
 class SGD(Optimizer):
-    """SGD with momentum and multi-precision
+    """SGD with momentum, lazy sparse rows, and multi-precision
     (reference: optimizer.py:498)."""
 
     def __init__(self, momentum=0.0, lazy_update=True, **kwargs):
         super().__init__(**kwargs)
-        self.momentum = momentum
-        self.lazy_update = lazy_update
+        self.momentum, self.lazy_update = momentum, lazy_update
 
     def create_state(self, index, weight):
-        if self.momentum == 0.0:
-            return None
-        return weight.zeros_like()
+        return weight.zeros_like() if self.momentum != 0.0 else None
 
     def create_state_multi_precision(self, index, weight):
         if self.multi_precision and weight.dtype == numpy.float16:
-            w32 = weight.astype(numpy.float32)
-            return (self.create_state(index, w32), w32)
+            master = weight.astype(numpy.float32)
+            return (self.create_state(index, master), master)
         return self.create_state(index, weight)
 
     def update(self, index, weight, grad, state):
-        self._update_count(index)
-        lr = self._get_lr(index)
-        wd = self._get_wd(index)
-        kw = _common_kwargs(self, lr, wd)
+        _, _, kw = self._step_inputs(index)
         rsp = _rsp_grad(grad)
         if rsp is not None:
             if not self.lazy_update:
@@ -259,183 +266,115 @@ class SGD(Optimizer):
             invoke_nd("sgd_update", [weight, grad], kw, out=weight)
 
     def update_multi_precision(self, index, weight, grad, state):
-        if self.multi_precision and weight.dtype == numpy.float16:
-            self._update_count(index)
-            lr = self._get_lr(index)
-            wd = self._get_wd(index)
-            kw = _common_kwargs(self, lr, wd)
-            mom, w32 = state if isinstance(state, tuple) else (None, state)
-            if self.momentum != 0.0:
-                invoke_nd("mp_sgd_mom_update", [weight, grad, mom, w32],
-                          dict(kw, momentum=self.momentum), out=weight)
-            else:
-                invoke_nd("mp_sgd_update", [weight, grad, w32], kw,
-                          out=weight)
+        if not (self.multi_precision and weight.dtype == numpy.float16):
+            return self.update(index, weight, grad, state)
+        _, _, kw = self._step_inputs(index)
+        mom, master = state if isinstance(state, tuple) else (None, state)
+        if self.momentum != 0.0:
+            invoke_nd("mp_sgd_mom_update", [weight, grad, mom, master],
+                      dict(kw, momentum=self.momentum), out=weight)
         else:
-            self.update(index, weight, grad, state)
+            invoke_nd("mp_sgd_update", [weight, grad, master], kw,
+                      out=weight)
 
 
 @register
 class Signum(Optimizer):
-    def __init__(self, learning_rate=0.01, momentum=0.9, wd_lh=0.0, **kwargs):
+    """Sign-of-gradient SGD (reference: optimizer.py:728)."""
+
+    def __init__(self, learning_rate=0.01, momentum=0.9, wd_lh=0.0,
+                 **kwargs):
         super().__init__(learning_rate=learning_rate, **kwargs)
-        self.momentum = momentum
-        self.wd_lh = wd_lh
+        self.momentum, self.wd_lh = momentum, wd_lh
 
     def create_state(self, index, weight):
-        if self.momentum == 0.0:
-            return None
-        return weight.zeros_like()
+        return weight.zeros_like() if self.momentum != 0.0 else None
 
     def update(self, index, weight, grad, state):
-        self._update_count(index)
-        lr = self._get_lr(index)
-        wd = self._get_wd(index)
-        kw = _common_kwargs(self, lr, wd)
-        if state is not None:
+        _, _, kw = self._step_inputs(index)
+        if state is None:
+            invoke_nd("signsgd_update", [weight, grad], kw, out=weight)
+        else:
             invoke_nd("signum_update", [weight, grad, state],
                       dict(kw, momentum=self.momentum, wd_lh=self.wd_lh),
                       out=weight)
-        else:
-            invoke_nd("signsgd_update", [weight, grad], kw, out=weight)
 
 
 @register
 class FTML(Optimizer):
+    """Follow-the-moving-leader (reference: optimizer.py:809)."""
+
     def __init__(self, beta1=0.6, beta2=0.999, epsilon=1e-8, **kwargs):
         super().__init__(**kwargs)
-        self.beta1 = beta1
-        self.beta2 = beta2
-        self.epsilon = epsilon
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
 
     def create_state(self, index, weight):
-        return (weight.zeros_like(),
-                weight.zeros_like(),
-                weight.zeros_like())
+        return tuple(weight.zeros_like() for _ in range(3))
 
     def update(self, index, weight, grad, state):
-        self._update_count(index)
-        lr = self._get_lr(index)
-        wd = self._get_wd(index)
-        t = self._index_update_count[index]
-        kw = _common_kwargs(self, lr, wd)
+        _, _, kw = self._step_inputs(index)
         d, v, z = state
         invoke_nd("ftml_update", [weight, grad, d, v, z],
                   dict(kw, beta1=self.beta1, beta2=self.beta2,
-                       epsilon=self.epsilon, t=t), out=weight)
-
-
-@register
-class DCASGD(Optimizer):
-    def __init__(self, momentum=0.0, lamda=0.04, **kwargs):
-        super().__init__(**kwargs)
-        self.momentum = momentum
-        self.weight_previous = {}
-        self.lamda = lamda
-
-    def create_state(self, index, weight):
-        if self.momentum == 0.0:
-            return (None, weight.copy())
-        return (weight.zeros_like(),
-                weight.copy())
-
-    def update(self, index, weight, grad, state):
-        self._update_count(index)
-        lr = self._get_lr(index)
-        wd = self._get_wd(index)
-        grad = grad * self.rescale_grad
-        if self.clip_gradient is not None:
-            grad = grad.clip(-self.clip_gradient, self.clip_gradient)
-        mom, previous_weight = state
-        d = grad + wd * weight + self.lamda * grad * grad * \
-            (weight - previous_weight)
-        if mom is not None:
-            mom[:] = self.momentum * mom - lr * d
-            update = mom
-        else:
-            update = -lr * d
-        previous_weight[:] = weight
-        weight[:] = weight + update
+                       epsilon=self.epsilon,
+                       t=self._index_update_count[index]), out=weight)
 
 
 @register
 class NAG(Optimizer):
+    """Nesterov momentum (reference: optimizer.py:1026)."""
+
     def __init__(self, momentum=0.0, **kwargs):
         super().__init__(**kwargs)
         self.momentum = momentum
 
     def create_state(self, index, weight):
-        if self.momentum == 0.0:
-            return None
-        return weight.zeros_like()
+        return weight.zeros_like() if self.momentum != 0.0 else None
 
     def update(self, index, weight, grad, state):
-        self._update_count(index)
-        lr = self._get_lr(index)
-        wd = self._get_wd(index)
-        kw = _common_kwargs(self, lr, wd)
-        if state is not None:
+        _, _, kw = self._step_inputs(index)
+        if state is None:
+            invoke_nd("sgd_update", [weight, grad], kw, out=weight)
+        else:
             invoke_nd("nag_mom_update", [weight, grad, state],
                       dict(kw, momentum=self.momentum), out=weight)
-        else:
-            invoke_nd("sgd_update", [weight, grad], kw, out=weight)
-
-
-@register
-class SGLD(Optimizer):
-    """Stochastic Gradient Langevin Dynamics."""
-
-    def update(self, index, weight, grad, state):
-        from ..ndarray import random as nd_random
-        self._update_count(index)
-        lr = self._get_lr(index)
-        wd = self._get_wd(index)
-        grad = grad * self.rescale_grad
-        if self.clip_gradient is not None:
-            grad = grad.clip(-self.clip_gradient, self.clip_gradient)
-        noise = nd_random.normal(0, math.sqrt(lr), shape=weight.shape,
-                                 dtype=weight.dtype, ctx=weight.context)
-        weight[:] = weight - lr / 2 * (grad + wd * weight) + noise
 
 
 @register
 class Adam(Optimizer):
+    """Adam with bias correction folded into the step size
+    (reference: optimizer.py:1148)."""
+
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
                  epsilon=1e-8, lazy_update=True, **kwargs):
         super().__init__(learning_rate=learning_rate, **kwargs)
-        self.beta1 = beta1
-        self.beta2 = beta2
-        self.epsilon = epsilon
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
         self.lazy_update = lazy_update
 
     def create_state(self, index, weight):
-        return (weight.zeros_like(),
-                weight.zeros_like())
+        return (weight.zeros_like(), weight.zeros_like())
 
     def update(self, index, weight, grad, state):
-        self._update_count(index)
-        lr = self._get_lr(index)
-        wd = self._get_wd(index)
+        lr, _, kw = self._step_inputs(index)
         t = self._index_update_count[index]
-        coef1 = 1. - self.beta1 ** t
-        coef2 = 1. - self.beta2 ** t
-        lr *= math.sqrt(coef2) / coef1
-        kw = _common_kwargs(self, lr, wd)
+        kw["lr"] = lr * math.sqrt(1. - self.beta2 ** t) \
+            / (1. - self.beta1 ** t)
         mean, var = state
-        kw_adam = dict(kw, beta1=self.beta1, beta2=self.beta2,
-                       epsilon=self.epsilon)
+        kw.update(beta1=self.beta1, beta2=self.beta2, epsilon=self.epsilon)
         rsp = _rsp_grad(grad)
         if rsp is not None:
             if self.lazy_update:
                 return _lazy_row_update("adam_update", weight, rsp,
-                                        [mean, var], kw_adam)
+                                        [mean, var], kw)
             grad = rsp.tostype("default")
-        invoke_nd("adam_update", [weight, grad, mean, var], kw_adam,
-                  out=weight)
+        invoke_nd("adam_update", [weight, grad, mean, var], kw, out=weight)
 
 
 @register
 class AdaGrad(Optimizer):
+    """Accumulated squared-gradient scaling (reference:
+    optimizer.py:1280); sparse updates are always row-lazy."""
+
     def __init__(self, eps=1e-7, **kwargs):
         super().__init__(**kwargs)
         self.float_stable_eps = eps
@@ -444,194 +383,217 @@ class AdaGrad(Optimizer):
         return weight.zeros_like()
 
     def update(self, index, weight, grad, state):
-        self._update_count(index)
-        lr = self._get_lr(index)
-        wd = self._get_wd(index)
-        kw = dict(_common_kwargs(self, lr, wd),
-                  epsilon=self.float_stable_eps)
+        _, _, kw = self._step_inputs(index)
+        kw["epsilon"] = self.float_stable_eps
         rsp = _rsp_grad(grad)
         if rsp is not None:
-            # reference sparse adagrad is always row-lazy
             return _lazy_row_update("adagrad_update", weight, rsp,
                                     [state], kw)
         invoke_nd("adagrad_update", [weight, grad, state], kw, out=weight)
 
 
 @register
-class AdaDelta(Optimizer):
-    def __init__(self, rho=0.90, epsilon=1e-5, **kwargs):
-        super().__init__(**kwargs)
-        self.rho = rho
-        self.epsilon = epsilon
-
-    def create_state(self, index, weight):
-        return (_fp32_state(weight),
-                _fp32_state(weight))
-
-    def update(self, index, weight, grad, state):
-        from ..ndarray import sqrt as nd_sqrt
-        self._update_count(index)
-        wd = self._get_wd(index)
-        grad = grad * self.rescale_grad
-        if self.clip_gradient is not None:
-            grad = grad.clip(-self.clip_gradient, self.clip_gradient)
-        acc_g, acc_delta = state
-        acc_g[:] = self.rho * acc_g + (1. - self.rho) * grad * grad
-        current_delta = ((acc_delta + self.epsilon).sqrt()
-                         / (acc_g + self.epsilon).sqrt()) * grad
-        acc_delta[:] = self.rho * acc_delta + \
-            (1. - self.rho) * current_delta * current_delta
-        weight[:] = weight - current_delta - wd * weight
-
-
-@register
 class RMSProp(Optimizer):
+    """Tieleman/Hinton (plain) or Graves (centered) variant
+    (reference: optimizer.py:1347)."""
+
     def __init__(self, learning_rate=0.001, gamma1=0.9, gamma2=0.9,
-                 epsilon=1e-8, centered=False, clip_weights=None, **kwargs):
+                 epsilon=1e-8, centered=False, clip_weights=None,
+                 **kwargs):
         super().__init__(learning_rate=learning_rate, **kwargs)
-        self.gamma1 = gamma1
-        self.gamma2 = gamma2
-        self.centered = centered
-        self.epsilon = epsilon
+        self.gamma1, self.gamma2 = gamma1, gamma2
+        self.epsilon, self.centered = epsilon, centered
         self.clip_weights = clip_weights
 
     def create_state(self, index, weight):
-        if self.centered:
-            return (_fp32_state(weight),
-                    _fp32_state(weight),
-                    _fp32_state(weight))
-        return _fp32_state(weight)
+        n = 3 if self.centered else 1
+        states = tuple(_fp32_state(weight) for _ in range(n))
+        return states if self.centered else states[0]
 
     def update(self, index, weight, grad, state):
-        self._update_count(index)
-        lr = self._get_lr(index)
-        wd = self._get_wd(index)
-        kw = _common_kwargs(self, lr, wd)
-        if not self.centered:
-            invoke_nd("rmsprop_update", [weight, grad, state],
-                      dict(kw, gamma1=self.gamma1, epsilon=self.epsilon),
-                      out=weight)
-        else:
+        _, _, kw = self._step_inputs(index)
+        if self.centered:
             n, g, delta = state
             invoke_nd("rmspropalex_update", [weight, grad, n, g, delta],
                       dict(kw, gamma1=self.gamma1, gamma2=self.gamma2,
                            epsilon=self.epsilon), out=weight)
+        else:
+            invoke_nd("rmsprop_update", [weight, grad, state],
+                      dict(kw, gamma1=self.gamma1, epsilon=self.epsilon),
+                      out=weight)
         if self.clip_weights:
             weight[:] = weight.clip(-self.clip_weights, self.clip_weights)
 
 
 @register
 class Ftrl(Optimizer):
+    """FTRL-proximal (reference: optimizer.py:1440); sparse updates are
+    row-lazy."""
+
     def __init__(self, lamda1=0.01, learning_rate=0.1, beta=1, **kwargs):
         super().__init__(learning_rate=learning_rate, **kwargs)
-        self.lamda1 = lamda1
-        self.beta = beta
+        self.lamda1, self.beta = lamda1, beta
 
     def create_state(self, index, weight):
-        return (_fp32_state(weight),
-                _fp32_state(weight))
+        return (_fp32_state(weight), _fp32_state(weight))
 
     def update(self, index, weight, grad, state):
-        self._update_count(index)
-        lr = self._get_lr(index)
-        wd = self._get_wd(index)
-        kw = dict(_common_kwargs(self, lr, wd),
-                  lamda1=self.lamda1, beta=self.beta)
+        _, _, kw = self._step_inputs(index)
+        kw.update(lamda1=self.lamda1, beta=self.beta)
         z, n = state
         rsp = _rsp_grad(grad)
         if rsp is not None:
-            # reference sparse ftrl is row-lazy
             return _lazy_row_update("ftrl_update", weight, rsp, [z, n], kw)
         invoke_nd("ftrl_update", [weight, grad, z, n], kw, out=weight)
 
 
+# ---------------------------------------------------------------------------
+# composed (NDArray-op) optimizers
+# ---------------------------------------------------------------------------
+
 @register
-class Adamax(Optimizer):
-    def __init__(self, learning_rate=0.002, beta1=0.9, beta2=0.999, **kwargs):
-        super().__init__(learning_rate=learning_rate, **kwargs)
-        self.beta1 = beta1
-        self.beta2 = beta2
+class DCASGD(Optimizer):
+    """Delay-compensated async SGD (reference: optimizer.py:778)."""
+
+    def __init__(self, momentum=0.0, lamda=0.04, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum, self.lamda = momentum, lamda
+        self.weight_previous = {}
 
     def create_state(self, index, weight):
-        return (_fp32_state(weight),
-                _fp32_state(weight))
+        mom = weight.zeros_like() if self.momentum != 0.0 else None
+        return (mom, weight.copy())
 
     def update(self, index, weight, grad, state):
-        self._update_count(index)
-        lr = self._get_lr(index)
-        wd = self._get_wd(index)
-        t = self._index_update_count[index]
-        lr /= (1. - self.beta1 ** t)
-        grad = grad * self.rescale_grad + wd * weight
-        if self.clip_gradient is not None:
-            grad = grad.clip(-self.clip_gradient, self.clip_gradient)
-        m_t, u_t = state
-        m_t[:] = self.beta1 * m_t + (1. - self.beta1) * grad
+        lr, wd, _ = self._step_inputs(index)
+        grad = self._prepared_grad(grad)
+        mom, prev = state
+        compensated = grad + wd * weight + \
+            self.lamda * grad * grad * (weight - prev)
+        if mom is None:
+            step = -lr * compensated
+        else:
+            mom[:] = self.momentum * mom - lr * compensated
+            step = mom
+        prev[:] = weight
+        weight[:] = weight + step
+
+
+@register
+class SGLD(Optimizer):
+    """Stochastic Gradient Langevin Dynamics: SGD plus step-scaled
+    Gaussian noise (reference: optimizer.py:1108)."""
+
+    def update(self, index, weight, grad, state):
+        from ..ndarray import random as nd_random
+        lr, wd, _ = self._step_inputs(index)
+        grad = self._prepared_grad(grad)
+        noise = nd_random.normal(0, math.sqrt(lr), shape=weight.shape,
+                                 dtype=weight.dtype, ctx=weight.context)
+        weight[:] = weight - lr / 2 * (grad + wd * weight) + noise
+
+
+@register
+class AdaDelta(Optimizer):
+    """Adaptive-delta with two squared accumulators
+    (reference: optimizer.py:1500)."""
+
+    def __init__(self, rho=0.90, epsilon=1e-5, **kwargs):
+        super().__init__(**kwargs)
+        self.rho, self.epsilon = rho, epsilon
+
+    def create_state(self, index, weight):
+        return (_fp32_state(weight), _fp32_state(weight))
+
+    def update(self, index, weight, grad, state):
+        _, wd, _ = self._step_inputs(index)
+        grad = self._prepared_grad(grad)
+        sq_grad, sq_delta = state
+        sq_grad[:] = self.rho * sq_grad + (1. - self.rho) * grad * grad
+        delta = ((sq_delta + self.epsilon).sqrt()
+                 / (sq_grad + self.epsilon).sqrt()) * grad
+        sq_delta[:] = self.rho * sq_delta + (1. - self.rho) * delta * delta
+        weight[:] = weight - delta - wd * weight
+
+
+@register
+class Adamax(Optimizer):
+    """Infinity-norm Adam variant (reference: optimizer.py:1553)."""
+
+    def __init__(self, learning_rate=0.002, beta1=0.9, beta2=0.999,
+                 **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1, self.beta2 = beta1, beta2
+
+    def create_state(self, index, weight):
+        return (_fp32_state(weight), _fp32_state(weight))
+
+    def update(self, index, weight, grad, state):
         from ..ndarray import maximum as nd_maximum
-        u_t[:] = nd_maximum(self.beta2 * u_t, grad.abs())
-        weight[:] = weight - lr * m_t / (u_t + 1e-8)
+        lr, wd, _ = self._step_inputs(index)
+        lr /= 1. - self.beta1 ** self._index_update_count[index]
+        grad = self._prepared_grad(grad, wd, weight)
+        m, u = state
+        m[:] = self.beta1 * m + (1. - self.beta1) * grad
+        u[:] = nd_maximum(self.beta2 * u, grad.abs())
+        weight[:] = weight - lr * m / (u + 1e-8)
 
 
 @register
 class Nadam(Optimizer):
+    """Adam with Nesterov momentum schedule
+    (reference: optimizer.py:1591)."""
+
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
                  epsilon=1e-8, schedule_decay=0.004, **kwargs):
         super().__init__(learning_rate=learning_rate, **kwargs)
-        self.beta1 = beta1
-        self.beta2 = beta2
-        self.epsilon = epsilon
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
         self.schedule_decay = schedule_decay
         self.m_schedule = 1.
 
     def create_state(self, index, weight):
-        return (_fp32_state(weight),
-                _fp32_state(weight))
+        return (_fp32_state(weight), _fp32_state(weight))
+
+    def _momentum_at(self, t):
+        return self.beta1 * (1. - 0.5 * 0.96 ** (t * self.schedule_decay))
 
     def update(self, index, weight, grad, state):
-        self._update_count(index)
-        lr = self._get_lr(index)
-        wd = self._get_wd(index)
+        lr, wd, _ = self._step_inputs(index)
         t = self._index_update_count[index]
-        grad = grad * self.rescale_grad + wd * weight
-        if self.clip_gradient is not None:
-            grad = grad.clip(-self.clip_gradient, self.clip_gradient)
-        momentum_t = self.beta1 * (1. - 0.5 * (pow(0.96, t
-                                                   * self.schedule_decay)))
-        momentum_t_1 = self.beta1 * (1. - 0.5 * (pow(0.96, (t + 1)
-                                                     * self.schedule_decay)))
-        self.m_schedule = self.m_schedule * momentum_t
-        m_schedule_next = self.m_schedule * momentum_t_1
-        m_t, v_t = state
-        m_t[:] = self.beta1 * m_t + (1. - self.beta1) * grad
-        v_t[:] = self.beta2 * v_t + (1. - self.beta2) * grad * grad
-        grad_prime = grad / (1. - self.m_schedule)
-        m_t_prime = m_t / (1. - m_schedule_next)
-        v_t_prime = v_t / (1. - pow(self.beta2, t))
-        m_t_bar = (1. - momentum_t) * grad_prime + momentum_t_1 * m_t_prime
-        weight[:] = weight - lr * m_t_bar / \
-            (v_t_prime.sqrt() + self.epsilon)
+        grad = self._prepared_grad(grad, wd, weight)
+        mu_t, mu_next = self._momentum_at(t), self._momentum_at(t + 1)
+        self.m_schedule *= mu_t
+        schedule_next = self.m_schedule * mu_next
+        m, v = state
+        m[:] = self.beta1 * m + (1. - self.beta1) * grad
+        v[:] = self.beta2 * v + (1. - self.beta2) * grad * grad
+        g_hat = grad / (1. - self.m_schedule)
+        m_hat = m / (1. - schedule_next)
+        v_hat = v / (1. - self.beta2 ** t)
+        blended = (1. - mu_t) * g_hat + mu_next * m_hat
+        weight[:] = weight - lr * blended / (v_hat.sqrt() + self.epsilon)
 
 
 @register
 class LBSGD(SGD):
     """Large-batch SGD with LARS-style warmup (reference:
-    optimizer.py LBSGD); implemented as layer-wise-scaled SGD."""
+    optimizer.py:856); implemented as layer-wise-scaled SGD."""
 
-    def __init__(self, momentum=0.0, multi_precision=False, warmup_strategy
-                 ='linear', warmup_epochs=5, batch_scale=1, updates_per_epoch
-                 =32, begin_epoch=0, num_epochs=60, **kwargs):
+    def __init__(self, momentum=0.0, multi_precision=False,
+                 warmup_strategy='linear', warmup_epochs=5, batch_scale=1,
+                 updates_per_epoch=32, begin_epoch=0, num_epochs=60,
+                 **kwargs):
         super().__init__(momentum=momentum,
                          multi_precision=multi_precision, **kwargs)
         self.warmup_strategy = warmup_strategy
-        self.warmup_epochs = warmup_epochs
+        self.warmup_epochs, self.num_epochs = warmup_epochs, num_epochs
         self.batch_scale = batch_scale
         self.updates_per_epoch = updates_per_epoch
-        self.num_epochs = num_epochs
 
 
 @register
 class Test(Optimizer):
-    """Test optimizer: w -= lr*grad (reference keeps one too)."""
+    """Plain w -= lr*grad (the reference keeps one too)."""
 
     def create_state(self, index, weight):
         return _fp32_state(weight)
@@ -640,7 +602,7 @@ class Test(Optimizer):
         weight[:] = weight - self.lr * (grad * self.rescale_grad)
 
 
-# aliases matching the reference registry
+# registry aliases matching the reference
 _REG.register("ccsgd", allow_override=True)(SGD)
 
 
@@ -654,7 +616,8 @@ def create(name, **kwargs):
 
 
 class Updater:
-    """KVStore updater wrapper (reference: optimizer.py:1608)."""
+    """KVStore-side state bookkeeping around one Optimizer
+    (reference: optimizer.py:1608)."""
 
     def __init__(self, optimizer):
         self.optimizer = optimizer
@@ -675,12 +638,12 @@ class Updater:
 
     def set_states(self, states):
         import pickle
-        states = pickle.loads(states)
-        if isinstance(states, tuple) and len(states) == 2:
-            self.states, self.optimizer = states
+        payload = pickle.loads(states)
+        if isinstance(payload, tuple) and len(payload) == 2:
+            self.states, self.optimizer = payload
         else:
-            self.states = states
-        self.states_synced = dict.fromkeys(self.states.keys(), False)
+            self.states = payload
+        self.states_synced = dict.fromkeys(self.states, False)
 
     def get_states(self, dump_optimizer=False):
         import pickle
