@@ -86,28 +86,6 @@ def _tree_unflatten(leaves, spec):
     return [_tree_unflatten(leaves, s) for s in spec]
 
 
-# legacy names (reference-compatible signatures) used by older callers
-def _flatten(args, inout_str):
-    leaves, spec = _tree_flatten(args, inout_str)
-    return leaves, _spec_to_fmt(spec)
-
-
-def _regroup(args, fmt):
-    queue = list(args)
-    value = _tree_unflatten(queue, _fmt_to_spec(fmt))
-    return value, queue
-
-
-def _spec_to_fmt(spec):
-    return spec.width if isinstance(spec, _Leaf) else \
-        [_spec_to_fmt(s) for s in spec]
-
-
-def _fmt_to_spec(fmt):
-    return _Leaf(fmt) if isinstance(fmt, int) else \
-        [_fmt_to_spec(f) for f in fmt]
-
-
 # ---------------------------------------------------------------------------
 # naming
 # ---------------------------------------------------------------------------
@@ -171,9 +149,6 @@ class _Naming:
         _Naming._active.top = self._outer
 
 
-_BlockScope = _Naming    # legacy alias
-
-
 class _HookHandle:
     _serial = [0]
 
@@ -192,9 +167,6 @@ def _name_list_preview(names, limit=7):
         return (_name_list_preview(names[:limit // 2], limit) + ", ..., "
                 + _name_list_preview(names[-limit // 2:], limit))
     return ", ".join("'%s'" % n for n in names)
-
-
-_brief_print_list = _name_list_preview    # legacy alias
 
 
 # ---------------------------------------------------------------------------

@@ -1,4 +1,12 @@
-"""Basic Gluon layers (parity: python/mxnet/gluon/nn/basic_layers.py)."""
+"""Basic Gluon layers (API parity: python/mxnet/gluon/nn/basic_layers.py
++ activations.py).
+
+Own structure: the two Sequential containers share one ``_Stack``
+mixin; the three norm layers share gamma/beta parameter creation and
+repr scaffolding in ``_NormScaffold``; the LeakyReLU-family activations
+are one table-driven base. Everything lowers to registered ops, so a
+hybridized stack becomes one fused XLA program.
+"""
 from __future__ import annotations
 
 import numpy as np
@@ -7,45 +15,44 @@ from ...base import MXNetError
 from ..block import Block, HybridBlock
 from ..utils import _indent
 
-__all__ = ["Sequential", "HybridSequential", "Dense", "Dropout", "Embedding",
-           "BatchNorm", "InstanceNorm", "LayerNorm", "Flatten", "Lambda",
-           "HybridLambda", "Activation", "LeakyReLU", "PReLU", "ELU", "SELU",
-           "Swish", "GELU"]
+__all__ = ["Sequential", "HybridSequential", "Dense", "Dropout",
+           "Embedding", "BatchNorm", "InstanceNorm", "LayerNorm",
+           "Flatten", "Lambda", "HybridLambda", "Activation", "LeakyReLU",
+           "PReLU", "ELU", "SELU", "Swish", "GELU"]
 
 
-class Sequential(Block):
-    """Stack of Blocks (reference: basic_layers.py:35)."""
-
-    def __init__(self, prefix=None, params=None):
-        super().__init__(prefix=prefix, params=params)
+class _Stack:
+    """Shared container plumbing for Sequential/HybridSequential."""
 
     def add(self, *blocks):
         for block in blocks:
             self.register_child(block)
 
-    def forward(self, x):
-        for block in self._children.values():
-            x = block(x)
-        return x
-
-    def __repr__(self):
-        s = '{name}(\n{modstr}\n)'
-        modstr = '\n'.join(['  ({key}): {block}'.format(
-            key=key, block=_indent(block.__repr__(), 2))
-            for key, block in self._children.items()])
-        return s.format(name=self.__class__.__name__, modstr=modstr)
-
     def __getitem__(self, key):
-        layers = list(self._children.values())[key]
-        if isinstance(layers, list):
-            net = type(self)(prefix=self._prefix)
-            with net.name_scope():
-                net.add(*layers)
-            return net
-        return layers
+        picked = list(self._children.values())[key]
+        if not isinstance(picked, list):
+            return picked
+        sub = type(self)(prefix=self._prefix)
+        with sub.name_scope():
+            sub.add(*picked)
+        return sub
 
     def __len__(self):
         return len(self._children)
+
+    def __repr__(self):
+        rows = ["  ({}): {}".format(key, _indent(repr(child), 2))
+                for key, child in self._children.items()]
+        return "{}(\n{}\n)".format(type(self).__name__, "\n".join(rows))
+
+
+class Sequential(_Stack, Block):
+    """Imperative stack of Blocks (reference: basic_layers.py:35)."""
+
+    def forward(self, x):
+        for child in self._children.values():
+            x = child(x)
+        return x
 
     def hybridize(self, active=True, **kwargs):
         if self._children and all(isinstance(c, HybridBlock)
@@ -58,144 +65,142 @@ class Sequential(Block):
         super().hybridize(active, **kwargs)
 
 
-class HybridSequential(HybridBlock):
+class HybridSequential(_Stack, HybridBlock):
     """Hybridizable stack (reference: basic_layers.py:117)."""
 
-    def __init__(self, prefix=None, params=None):
-        super().__init__(prefix=prefix, params=params)
-
-    def add(self, *blocks):
-        for block in blocks:
-            self.register_child(block)
-
     def hybrid_forward(self, F, x):
-        for block in self._children.values():
-            x = block(x)
+        for child in self._children.values():
+            x = child(x)
         return x
-
-    def __repr__(self):
-        s = '{name}(\n{modstr}\n)'
-        modstr = '\n'.join(['  ({key}): {block}'.format(
-            key=key, block=_indent(block.__repr__(), 2))
-            for key, block in self._children.items()])
-        return s.format(name=self.__class__.__name__, modstr=modstr)
-
-    def __getitem__(self, key):
-        layers = list(self._children.values())[key]
-        if isinstance(layers, list):
-            net = type(self)(prefix=self._prefix)
-            with net.name_scope():
-                net.add(*layers)
-            return net
-        return layers
-
-    def __len__(self):
-        return len(self._children)
 
 
 class Dense(HybridBlock):
-    """Fully-connected layer (reference: basic_layers.py:142)."""
+    """Affine layer, optionally flattening trailing dims and applying
+    an activation (reference: basic_layers.py:142)."""
 
     def __init__(self, units, activation=None, use_bias=True, flatten=True,
                  dtype='float32', weight_initializer=None,
                  bias_initializer='zeros', in_units=0, **kwargs):
         super().__init__(**kwargs)
+        self._units, self._in_units = units, in_units
         self._flatten = flatten
         with self.name_scope():
-            self._units = units
-            self._in_units = in_units
             self.weight = self.params.get(
-                'weight', shape=(units, in_units),
-                init=weight_initializer, dtype=dtype,
-                allow_deferred_init=True)
-            if use_bias:
-                self.bias = self.params.get(
-                    'bias', shape=(units,), init=bias_initializer,
-                    dtype=dtype, allow_deferred_init=True)
-            else:
-                self.bias = None
-            if activation is not None:
-                self.act = Activation(activation, prefix=activation + '_')
-            else:
-                self.act = None
+                'weight', shape=(units, in_units), dtype=dtype,
+                init=weight_initializer, allow_deferred_init=True)
+            self.bias = self.params.get(
+                'bias', shape=(units,), dtype=dtype,
+                init=bias_initializer, allow_deferred_init=True) \
+                if use_bias else None
+            self.act = Activation(activation,
+                                  prefix=activation + '_') \
+                if activation is not None else None
 
     def hybrid_forward(self, F, x, weight, bias=None):
-        act = F.FullyConnected(x, weight, bias, no_bias=bias is None,
+        out = F.FullyConnected(x, weight, bias, no_bias=bias is None,
                                num_hidden=self._units,
                                flatten=self._flatten, name='fwd')
-        if self.act is not None:
-            act = self.act(act)
-        return act
+        return out if self.act is None else self.act(out)
 
     def __repr__(self):
-        s = '{name}({layout}, {act})'
-        shape = self.weight.shape
-        return s.format(name=self.__class__.__name__,
-                        act=self.act if self.act else 'linear',
-                        layout='{0} -> {1}'.format(
-                            shape[1] if shape[1] else None, shape[0]))
+        n_out, n_in = self.weight.shape
+        return "{}({} -> {}, {})".format(
+            type(self).__name__, n_in if n_in else None, n_out,
+            self.act if self.act else 'linear')
 
 
 class Dropout(HybridBlock):
+    """Train-time random zeroing (reference: basic_layers.py:226)."""
+
     def __init__(self, rate, axes=(), **kwargs):
         super().__init__(**kwargs)
-        self._rate = rate
-        self._axes = axes
+        self._rate, self._axes = rate, axes
 
     def hybrid_forward(self, F, x):
-        if self._rate > 0:
-            return F.Dropout(x, p=self._rate, axes=self._axes,
-                             name='fwd', cudnn_off=False)
-        return F._copy(x, name='fwd') if hasattr(F, "_copy") else x
+        if self._rate <= 0:
+            return F._copy(x, name='fwd') if hasattr(F, "_copy") else x
+        return F.Dropout(x, p=self._rate, axes=self._axes, name='fwd',
+                         cudnn_off=False)
 
     def __repr__(self):
-        s = '{name}(p = {_rate}, axes={_axes})'
-        return s.format(name=self.__class__.__name__, **self.__dict__)
+        return "{}(p = {}, axes={})".format(type(self).__name__,
+                                            self._rate, self._axes)
 
 
 class Embedding(HybridBlock):
+    """Index → row lookup (reference: basic_layers.py:372)."""
+
     def __init__(self, input_dim, output_dim, dtype='float32',
                  weight_initializer=None, sparse_grad=False, **kwargs):
         super().__init__(**kwargs)
-        self._input_dim = input_dim
-        self._output_dim = output_dim
         self._kwargs = {'input_dim': input_dim, 'output_dim': output_dim,
                         'dtype': dtype, 'sparse_grad': sparse_grad}
         self.weight = self.params.get(
-            'weight', shape=(input_dim, output_dim),
-            init=weight_initializer, dtype=dtype, allow_deferred_init=True,
+            'weight', shape=(input_dim, output_dim), dtype=dtype,
+            init=weight_initializer, allow_deferred_init=True,
             grad_stype='row_sparse' if sparse_grad else 'default')
+
+    def _note_touched_rows(self, x):
+        """Stash looked-up row ids so Trainer builds the row_sparse
+        gradient from true touched rows (accumulating across forwards)
+        instead of scanning the dense grad — the reference gets these
+        ids from its sparse embedding kernel's rsp output."""
+        from ...ndarray import NDArray
+        from ... import autograd
+        if isinstance(x, NDArray) and autograd.is_recording():
+            stash = getattr(self.weight, '_sparse_row_ids', None) or []
+            stash.append(x)
+            self.weight._sparse_row_ids = stash
 
     def hybrid_forward(self, F, x, weight):
         if self._kwargs['sparse_grad']:
-            # stash the looked-up rows so Trainer can build the
-            # row_sparse gradient from the true touched-row ids instead
-            # of scanning the dense grad for non-zero rows (which both
-            # syncs the host every step and drops touched rows whose
-            # gradient is exactly zero) — the reference gets these ids
-            # from its sparse embedding kernel's rsp grad output
-            from ...ndarray import NDArray
-            from ... import autograd
-            if isinstance(x, NDArray) and autograd.is_recording():
-                # accumulate (don't overwrite): several forwards of a
-                # shared weight before one step must union their rows
-                ids = getattr(self.weight, '_sparse_row_ids', None) or []
-                ids.append(x)
-                self.weight._sparse_row_ids = ids
+            self._note_touched_rows(x)
         return F.Embedding(x, weight, name='fwd', **self._kwargs)
 
     def __repr__(self):
-        s = '{block_name}({input_dim} -> {output_dim}, {dtype})'
-        return s.format(block_name=self.__class__.__name__,
-                        **self._kwargs)
+        return "{}({input_dim} -> {output_dim}, {dtype})".format(
+            type(self).__name__, **self._kwargs)
 
 
-class BatchNorm(HybridBlock):
-    """Batch normalization (reference: basic_layers.py:276)."""
+# ---------------------------------------------------------------------------
+# normalization layers
+# ---------------------------------------------------------------------------
+
+class _NormScaffold(HybridBlock):
+    """Shared gamma/beta creation + repr for the norm family."""
+
+    def _make_gain_bias(self, scale, center, in_channels, gamma_init,
+                        beta_init, tie_differentiable=False):
+        """``tie_differentiable`` permanently freezes gamma/beta when
+        scale/center is off (BatchNorm semantics); otherwise they stay
+        differentiable and can be unfrozen via grad_req later."""
+        self.gamma = self.params.get(
+            'gamma', grad_req='write' if scale else 'null',
+            shape=(in_channels,), init=gamma_init,
+            allow_deferred_init=True,
+            differentiable=scale if tie_differentiable else True)
+        self.beta = self.params.get(
+            'beta', grad_req='write' if center else 'null',
+            shape=(in_channels,), init=beta_init,
+            allow_deferred_init=True,
+            differentiable=center if tie_differentiable else True)
+
+    def __repr__(self):
+        inner = ', '.join('='.join((k, repr(v)))
+                          for k, v in self._kwargs.items())
+        c = self.gamma.shape[0]
+        return "{}({}, in_channels={})".format(
+            type(self).__name__, inner, c if c else None)
+
+
+class BatchNorm(_NormScaffold):
+    """Batch normalization with running stats
+    (reference: basic_layers.py:276)."""
 
     def __init__(self, axis=1, momentum=0.9, epsilon=1e-5, center=True,
-                 scale=True, use_global_stats=False, beta_initializer='zeros',
-                 gamma_initializer='ones', running_mean_initializer='zeros',
+                 scale=True, use_global_stats=False,
+                 beta_initializer='zeros', gamma_initializer='ones',
+                 running_mean_initializer='zeros',
                  running_variance_initializer='ones', in_channels=0,
                  **kwargs):
         super().__init__(**kwargs)
@@ -204,28 +209,17 @@ class BatchNorm(HybridBlock):
                         'use_global_stats': use_global_stats}
         if in_channels != 0:
             self.in_channels = in_channels
-        self.gamma = self.params.get('gamma',
-                                     grad_req='write' if scale else 'null',
-                                     shape=(in_channels,),
-                                     init=gamma_initializer,
-                                     allow_deferred_init=True,
-                                     differentiable=scale)
-        self.beta = self.params.get('beta',
-                                    grad_req='write' if center else 'null',
-                                    shape=(in_channels,),
-                                    init=beta_initializer,
-                                    allow_deferred_init=True,
-                                    differentiable=center)
-        self.running_mean = self.params.get(
-            'running_mean', grad_req='null', shape=(in_channels,),
-            init=running_mean_initializer, allow_deferred_init=True,
-            differentiable=False)
-        self.running_var = self.params.get(
-            'running_var', grad_req='null', shape=(in_channels,),
-            init=running_variance_initializer, allow_deferred_init=True,
-            differentiable=False)
+        self._make_gain_bias(scale, center, in_channels,
+                             gamma_initializer, beta_initializer,
+                             tie_differentiable=True)
+        for stat, init in (('running_mean', running_mean_initializer),
+                           ('running_var', running_variance_initializer)):
+            setattr(self, stat, self.params.get(
+                stat, grad_req='null', shape=(in_channels,), init=init,
+                allow_deferred_init=True, differentiable=False))
 
     def cast(self, dtype):
+        # bf16/fp16 batch stats lose too much precision; keep fp32
         if np.dtype(dtype).name == 'float16':
             dtype = 'float32'
         super().cast(dtype)
@@ -234,165 +228,130 @@ class BatchNorm(HybridBlock):
         return F.BatchNorm(x, gamma, beta, running_mean, running_var,
                            name='fwd', **self._kwargs)
 
-    def __repr__(self):
-        s = '{name}({content}'
-        in_channels = self.gamma.shape[0]
-        s += ', in_channels={0}'.format(in_channels if in_channels else None)
-        s += ')'
-        return s.format(name=self.__class__.__name__,
-                        content=', '.join(
-                            ['='.join([k, v.__repr__()])
-                             for k, v in self._kwargs.items()]))
 
+class InstanceNorm(_NormScaffold):
+    """Per-sample, per-channel normalization
+    (reference: basic_layers.py:457)."""
 
-class InstanceNorm(HybridBlock):
     def __init__(self, axis=1, epsilon=1e-5, center=True, scale=False,
                  beta_initializer='zeros', gamma_initializer='ones',
                  in_channels=0, **kwargs):
         super().__init__(**kwargs)
         self._kwargs = {'eps': epsilon, 'axis': axis, 'center': center,
                         'scale': scale}
-        self._axis = axis
-        self._epsilon = epsilon
-        self.gamma = self.params.get('gamma',
-                                     grad_req='write' if scale else 'null',
-                                     shape=(in_channels,),
-                                     init=gamma_initializer,
-                                     allow_deferred_init=True)
-        self.beta = self.params.get('beta',
-                                    grad_req='write' if center else 'null',
-                                    shape=(in_channels,),
-                                    init=beta_initializer,
-                                    allow_deferred_init=True)
+        self._axis, self._epsilon = axis, epsilon
+        self._make_gain_bias(scale, center, in_channels,
+                             gamma_initializer, beta_initializer)
 
     def hybrid_forward(self, F, x, gamma, beta):
         if self._axis == 1:
             return F.InstanceNorm(x, gamma, beta, name='fwd',
                                   eps=self._epsilon)
-        x = x.swapaxes(1, self._axis)
-        return F.InstanceNorm(x, gamma, beta, name='fwd',
-                              eps=self._epsilon).swapaxes(1, self._axis)
-
-    def __repr__(self):
-        s = '{name}({content}'
-        in_channels = self.gamma.shape[0]
-        s += ', in_channels={0}'.format(in_channels)
-        s += ')'
-        return s.format(name=self.__class__.__name__,
-                        content=', '.join(
-                            ['='.join([k, v.__repr__()])
-                             for k, v in self._kwargs.items()]))
+        moved = x.swapaxes(1, self._axis)
+        out = F.InstanceNorm(moved, gamma, beta, name='fwd',
+                             eps=self._epsilon)
+        return out.swapaxes(1, self._axis)
 
 
-class LayerNorm(HybridBlock):
+class LayerNorm(_NormScaffold):
+    """Normalization over the last axis (reference:
+    basic_layers.py:535)."""
+
     def __init__(self, axis=-1, epsilon=1e-5, center=True, scale=True,
                  beta_initializer='zeros', gamma_initializer='ones',
                  in_channels=0, prefix=None, params=None):
         super().__init__(prefix=prefix, params=params)
         self._kwargs = {'eps': epsilon, 'axis': axis, 'center': center,
                         'scale': scale}
-        self._axis = axis
-        self._epsilon = epsilon
-        self._center = center
-        self._scale = scale
-        self.gamma = self.params.get('gamma',
-                                     grad_req='write' if scale else 'null',
-                                     shape=(in_channels,),
-                                     init=gamma_initializer,
-                                     allow_deferred_init=True)
-        self.beta = self.params.get('beta',
-                                    grad_req='write' if center else 'null',
-                                    shape=(in_channels,),
-                                    init=beta_initializer,
-                                    allow_deferred_init=True)
+        self._axis, self._epsilon = axis, epsilon
+        self._center, self._scale = center, scale
+        self._make_gain_bias(scale, center, in_channels,
+                             gamma_initializer, beta_initializer)
 
     def hybrid_forward(self, F, data, gamma, beta):
         return F.LayerNorm(data, gamma=gamma, beta=beta, axis=self._axis,
                            eps=self._epsilon)
 
-    def __repr__(self):
-        s = '{name}({content}'
-        in_channels = self.gamma.shape[0]
-        s += ', in_channels={0}'.format(in_channels)
-        s += ')'
-        return s.format(name=self.__class__.__name__,
-                        content=', '.join(
-                            ['='.join([k, v.__repr__()])
-                             for k, v in self._kwargs.items()]))
-
 
 class Flatten(HybridBlock):
-    def __init__(self, **kwargs):
-        super().__init__(**kwargs)
+    """Collapse all but the batch dim (reference: basic_layers.py:418)."""
 
     def hybrid_forward(self, F, x):
         return F.Flatten(x)
 
     def __repr__(self):
-        return self.__class__.__name__
+        return type(self).__name__
+
+
+# ---------------------------------------------------------------------------
+# function wrappers
+# ---------------------------------------------------------------------------
+
+def _resolve_function(function, *namespaces):
+    """(impl, display_name) from a callable or an op name looked up in
+    the given namespaces."""
+    if callable(function):
+        return function, function.__name__
+    if isinstance(function, str):
+        for ns in namespaces:
+            if not hasattr(ns, function):
+                raise AssertionError(
+                    "Function name %s is not found in %s." % (
+                        function,
+                        "/".join(n.__name__.split(".")[-1]
+                                 for n in namespaces)))
+        return None, function
+    raise ValueError(
+        "Unrecognized function in lambda: {} of type {}".format(
+            function, type(function)))
 
 
 class Lambda(Block):
-    """Wrap a function as a Block (reference: basic_layers.py:573)."""
+    """Wrap a function (or nd op name) as a Block
+    (reference: basic_layers.py:573)."""
 
     def __init__(self, function, prefix=None):
         super().__init__(prefix=prefix)
-        if isinstance(function, str):
-            from ... import ndarray as F
-            assert hasattr(F, function), \
-                "Function name %s is not found in ndarray." % function
-            self._func_impl = getattr(F, function)
-            self._func_name = function
-        elif callable(function):
-            self._func_impl = function
-            self._func_name = function.__name__
-        else:
-            raise ValueError(
-                "Unrecognized function in lambda: {} of type {}".format(
-                    function, type(function)))
+        from ... import ndarray
+        impl, name = _resolve_function(function, ndarray)
+        self._func_impl = impl if impl is not None \
+            else getattr(ndarray, name)
+        self._func_name = name
 
     def forward(self, *args):
         return self._func_impl(*args)
 
     def __repr__(self):
-        return '{name}({function})'.format(name=self.__class__.__name__,
-                                           function=self._func_name)
+        return "{}({})".format(type(self).__name__, self._func_name)
 
 
 class HybridLambda(HybridBlock):
+    """Wrap a dual nd/sym function as a HybridBlock
+    (reference: basic_layers.py:602)."""
+
     def __init__(self, function, prefix=None):
         super().__init__(prefix=prefix)
-        if isinstance(function, str):
-            from ... import ndarray, symbol
-            assert hasattr(ndarray, function) and \
-                hasattr(symbol, function), \
-                "Function name %s is not found in symbol/ndarray." % function
-
-            def _func_impl(F, *args, **kwargs):
-                return getattr(F, function)(*args, **kwargs)
-            self._func = _func_impl
-            self._func_name = function
-        elif callable(function):
-            self._func = function
-            self._func_name = function.__name__
-        else:
-            raise ValueError(
-                "Unrecognized function in lambda: {} of type {}".format(
-                    function, type(function)))
+        from ... import ndarray, symbol
+        impl, name = _resolve_function(function, ndarray, symbol)
+        if impl is None:
+            def impl(F, *args, **kwargs):
+                return getattr(F, name)(*args, **kwargs)
+        self._func, self._func_name = impl, name
 
     def hybrid_forward(self, F, x, *args):
         return self._func(F, x, *args)
 
     def __repr__(self):
-        return '{name}({function})'.format(name=self.__class__.__name__,
-                                           function=self._func_name)
+        return "{}({})".format(type(self).__name__, self._func_name)
 
 
 # ---------------------------------------------------------------------------
-# Activations (reference: gluon/nn/activations.py)
+# activations (reference: gluon/nn/activations.py)
 # ---------------------------------------------------------------------------
 
 class Activation(HybridBlock):
+    """Named activation via the Activation op."""
+
     def __init__(self, activation, **kwargs):
         self._act_type = activation
         super().__init__(**kwargs)
@@ -404,14 +363,24 @@ class Activation(HybridBlock):
         return F.Activation(x, act_type=self._act_type, name='fwd')
 
     def __repr__(self):
-        s = '{name}({_act_type})'
-        return s.format(name=self.__class__.__name__, **self.__dict__)
+        return "{}({})".format(type(self).__name__, self._act_type)
+
+
+class _LeakyFamily(HybridBlock):
+    """Activations that lower to the LeakyReLU op with a fixed
+    act_type (slope-less variants)."""
+
+    _ACT_TYPE = None
+
+    def hybrid_forward(self, F, x):
+        return F.LeakyReLU(x, act_type=self._ACT_TYPE, name='fwd')
 
 
 class LeakyReLU(HybridBlock):
     def __init__(self, alpha, **kwargs):
-        assert alpha >= 0, "Slope coefficient for LeakyReLU must be " \
-            "no less than 0."
+        if alpha < 0:
+            raise AssertionError(
+                "Slope coefficient for LeakyReLU must be no less than 0.")
         super().__init__(**kwargs)
         self._alpha = alpha
 
@@ -420,22 +389,7 @@ class LeakyReLU(HybridBlock):
                            name='fwd')
 
     def __repr__(self):
-        s = '{name}({alpha})'
-        return s.format(name=self.__class__.__name__, alpha=self._alpha)
-
-
-class PReLU(HybridBlock):
-    def __init__(self, alpha_initializer=None, **kwargs):
-        super().__init__(**kwargs)
-        from ... import initializer
-        if alpha_initializer is None:
-            alpha_initializer = initializer.Constant(0.25)
-        with self.name_scope():
-            self.alpha = self.params.get('alpha', shape=(1,),
-                                         init=alpha_initializer)
-
-    def hybrid_forward(self, F, x, alpha):
-        return F.LeakyReLU(x, gamma=alpha, act_type='prelu', name='fwd')
+        return "{}({})".format(type(self).__name__, self._alpha)
 
 
 class ELU(HybridBlock):
@@ -447,23 +401,33 @@ class ELU(HybridBlock):
         return F.LeakyReLU(x, act_type='elu', slope=self._alpha)
 
 
-class SELU(HybridBlock):
-    def __init__(self, **kwargs):
+class SELU(_LeakyFamily):
+    _ACT_TYPE = 'selu'
+
+
+class GELU(_LeakyFamily):
+    _ACT_TYPE = 'gelu'
+
+
+class PReLU(HybridBlock):
+    """Leaky slope learned per layer (reference: activations.py PReLU)."""
+
+    def __init__(self, alpha_initializer=None, **kwargs):
         super().__init__(**kwargs)
+        if alpha_initializer is None:
+            from ... import initializer
+            alpha_initializer = initializer.Constant(0.25)
+        with self.name_scope():
+            self.alpha = self.params.get('alpha', shape=(1,),
+                                         init=alpha_initializer)
 
-    def hybrid_forward(self, F, x):
-        return F.LeakyReLU(x, act_type='selu', name='fwd')
-
-
-class GELU(HybridBlock):
-    def __init__(self, **kwargs):
-        super().__init__(**kwargs)
-
-    def hybrid_forward(self, F, x):
-        return F.LeakyReLU(x, act_type='gelu', name='fwd')
+    def hybrid_forward(self, F, x, alpha):
+        return F.LeakyReLU(x, gamma=alpha, act_type='prelu', name='fwd')
 
 
 class Swish(HybridBlock):
+    """x * sigmoid(beta x) (reference: activations.py Swish)."""
+
     def __init__(self, beta=1.0, **kwargs):
         super().__init__(**kwargs)
         self._beta = beta
