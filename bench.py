@@ -1,4 +1,4 @@
-"""Benchmark: ResNet-50 throughput (images/sec) on one chip.
+"""Benchmark: ResNet-50 throughput + MFU on one chip.
 
 Reference baselines (BASELINE.md, from the reference's docs/faq/perf.md):
   - inference fp32 batch 32 : 1,076.81 img/s on 1x V100 (perf.md:176)
@@ -11,12 +11,24 @@ round 1), ITERS iterations are folded into ONE compiled lax.scan — the
 per-batch device time is what's measured, exactly the quantity the
 reference reports (it, too, excludes host-side input prep).
 
-bf16 weights/activations: the MXU-native dtype (fp32 accumulation inside
-XLA conv/dot), the apples-to-apples "native precision" config like fp16
-tensor cores on the V100.
+Training runs the FRAMEWORK'S OWN compiled train program: the bound
+Executor's forward+backward (`Executor._get_fn("fwdbwd")` — the same
+program `Module.fit`/`ex.backward()` executes) chained into the
+registered fused `sgd_update` operator (the same op `Trainer`/`Updater`
+dispatches), scanned. A 3-step eager run through the Executor +
+Updater API is asserted to follow the same loss trajectory, proving
+the scanned program IS the framework path, not a hand-rolled twin.
+
+MFU comes from XLA's own cost analysis (compiled.cost_analysis flops)
+against the chip's bf16 peak. bf16 weights/activations are the
+MXU-native dtype (fp32 accumulation inside XLA conv/dot) — the
+apples-to-apples "native precision" config like fp16 tensor cores on
+the V100. Conv layout note: NCHW vs NHWC measured identical on TPU
+(XLA assigns internal layouts itself), so the lowering keeps the
+reference's NCHW convention.
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}
-with the training number included as extra keys.
+with training, MFU, batch-sweep, and allreduce-bandwidth extras.
 """
 from __future__ import annotations
 
@@ -30,104 +42,252 @@ BASELINE_TRAIN = 298.51   # V100 fp32 batch 32 (perf.md:234)
 BATCH = 32
 IMAGE = 224
 ITERS = 128
+SWEEP = (128, 256)        # extra inference batch sizes
+TRAIN_ITERS = 64
+
+# bf16 peak FLOP/s by device kind (public chip specs)
+_PEAK = {"TPU v4": 275e12, "TPU v5 lite": 197e12, "TPU v5e": 197e12,
+         "TPU v5p": 459e12, "TPU v6 lite": 918e12, "TPU v6e": 918e12}
 
 
-def _build(classes=1000):
-    import jax.numpy as jnp
-    import mxnet_tpu as mx
-    from mxnet_tpu.gluon.model_zoo import vision
-    from mxnet_tpu.cached_op import build_graph_callable
-    from mxnet_tpu import symbol as sym_mod
+def _peak_flops():
+    import jax
+    kind = jax.devices()[0].device_kind
+    return _PEAK.get(kind, 197e12), kind
 
-    net = vision.resnet50_v1(classes=classes)
-    net.initialize(mx.init.Xavier())
-    x_nd = mx.nd.zeros((BATCH, 3, IMAGE, IMAGE))
-    net(x_nd)  # materialize params
 
-    data = sym_mod.var("data")
-    out_sym = net(data)
-    fn, arg_names, aux_names, n_rng, n_out = build_graph_callable(out_sym)
-    params = {p.name: p for p in net.collect_params().values()}
-    param_vals = {n: params[n].data()._data.astype(jnp.bfloat16)
-                  for n in arg_names if n != "data"}
-    aux_vals = {n: params[n].data()._data.astype(jnp.bfloat16)
-                for n in aux_names}
-    return fn, arg_names, aux_names, param_vals, aux_vals
+def _flops(compiled):
+    try:
+        ca = compiled.cost_analysis()
+        ca = ca[0] if isinstance(ca, list) else ca
+        return float(ca.get("flops", 0.0))
+    except Exception:
+        return 0.0
 
 
 def _timed(compiled, *args):
-    """Time one call of ``compiled`` (which returns a scalar). Sync is a
-    host fetch of the result — on the tunnel transport,
-    ``block_until_ready`` returns before the device is done, so the
-    fetch is the only reliable completion barrier."""
+    """Time one call (scalar result). The host fetch is the completion
+    barrier — on the tunnel transport block_until_ready returns before
+    the device is done."""
     float(compiled(*args))                   # compile + warmup
     t0 = time.perf_counter()
     float(compiled(*args))
     return time.perf_counter() - t0
 
 
-def main():
+def _build(batch, classes=1000):
+    import jax.numpy as jnp
+    import mxnet_tpu as mx
+    from mxnet_tpu.cached_op import build_graph_callable
+    from mxnet_tpu.gluon.model_zoo import vision
+    from mxnet_tpu import symbol as sym_mod
+
+    net = vision.resnet50_v1(classes=classes)
+    net.initialize(mx.init.Xavier())
+    net(mx.nd.zeros((batch, 3, IMAGE, IMAGE)))   # materialize params
+
+    data = sym_mod.var("data")
+    out_sym = net(data)
+    fn, arg_names, aux_names, _, _ = build_graph_callable(out_sym)
+    params = {p.name: p for p in net.collect_params().values()}
+    pv = {n: params[n].data()._data.astype(jnp.bfloat16)
+          for n in arg_names if n != "data"}
+    av = {n: params[n].data()._data.astype(jnp.bfloat16)
+          for n in aux_names}
+    return out_sym, fn, arg_names, aux_names, pv, av
+
+
+def _bench_inference(batch, iters, peak):
     import jax
     import jax.numpy as jnp
 
-    fn, arg_names, aux_names, param_vals, aux_vals = _build()
-    x = jnp.asarray(
-        np.random.uniform(0, 1, (BATCH, 3, IMAGE, IMAGE)).astype(np.float32)
+    _, fn, arg_names, aux_names, pv, av = _build(batch)
+    x = jnp.asarray(np.random.uniform(
+        0, 1, (batch, 3, IMAGE, IMAGE)).astype(np.float32)
     ).astype(jnp.bfloat16)
 
-    def fwd(x, pv, av, train):
+    def fwd(x, pv, av):
         vals = [x if n == "data" else pv[n] for n in arg_names]
         vals.extend(av[n] for n in aux_names)
-        return fn({"__train__": train}, *vals)[0]
+        return fn({"__train__": False}, *vals)[0]
 
-    # --- inference: scan ITERS batches inside one program ---------------
-    def infer_many(x, pv, av):
-        # Serial dependence iteration->iteration (the +acc*1e-12 term)
-        # so XLA cannot hoist the loop-invariant forward out of the scan.
+    def many(x, pv, av):
+        # serial dependence step->step so XLA can't hoist the forward
         def body(acc, _):
             xi = x + (acc * 1e-12).astype(x.dtype)
-            out = fwd(xi, pv, av, False)
-            return jnp.mean(out.astype(jnp.float32)), None
-        acc, _ = jax.lax.scan(body, jnp.float32(0), None, length=ITERS)
+            return jnp.mean(fwd(xi, pv, av).astype(jnp.float32)), None
+        acc, _ = jax.lax.scan(body, jnp.float32(0), None, length=iters)
         return acc
 
-    dt = _timed(jax.jit(infer_many), x, param_vals, aux_vals)
-    infer_img_s = BATCH * ITERS / dt
+    dt = _timed(jax.jit(many), x, pv, av)
+    img_s = batch * iters / dt
+    fwd_flops = _flops(jax.jit(fwd).lower(x, pv, av).compile())
+    mfu = fwd_flops * iters / dt / peak
+    return img_s, mfu, fwd_flops / batch
 
-    # --- training: fwd + bwd + SGD update, scanned ----------------------
-    labels = jnp.asarray(np.random.randint(0, 1000, (BATCH,)))
 
-    def loss_fn(pv, x, av):
-        logits = fwd(x, pv, av, True).astype(jnp.float32)
-        logp = jax.nn.log_softmax(logits, axis=-1)
-        return -jnp.mean(jnp.take_along_axis(logp, labels[:, None],
-                                             axis=-1))
+def _bench_training_framework_path(peak, flops_per_img):
+    """Train step = the Executor's own compiled fwd+bwd program + the
+    registered fused sgd_update op, scanned; trajectory-checked against
+    the eager Executor + Updater API."""
+    import jax
+    import jax.numpy as jnp
+    import mxnet_tpu as mx
+    from mxnet_tpu import symbol as sym_mod
+    from mxnet_tpu.ops.registry import get_op, normalize_attrs
 
-    def train_many(pv, x, av):
-        def body(pv, _):
-            loss, grads = jax.value_and_grad(loss_fn)(pv, x, av)
-            pv = jax.tree_util.tree_map(
-                lambda w, g: w - 0.01 * g.astype(w.dtype), pv, grads)
-            return pv, loss
-        pv, losses = jax.lax.scan(body, pv, None, length=ITERS)
-        # scalar result: cheap to fetch, and summing a final-params leaf
-        # keeps the last update step live (no DCE of the tail).
-        leaf = jax.tree_util.tree_leaves(pv)[0]
-        return jnp.mean(losses) + 1e-20 * jnp.sum(leaf.astype(jnp.float32))
+    out_sym, _, arg_names, aux_names, pv, av = _build(BATCH)
+    label_sym = sym_mod.var("softmax_label")
+    loss_sym = sym_mod.create("SoftmaxOutput", [out_sym, label_sym],
+                              {"normalization": "batch"}, name="softmax")
 
-    dt_t = _timed(jax.jit(train_many), param_vals, x, aux_vals)
-    train_img_s = BATCH * ITERS / dt_t
+    labels = np.random.randint(0, 1000, (BATCH,)).astype(np.float32)
+    x_np = np.random.uniform(0, 1, (BATCH, 3, IMAGE, IMAGE)) \
+        .astype(np.float32)
 
-    print(json.dumps({
+    args = {n: mx.nd.array(v) for n, v in pv.items()}
+    args["data"] = mx.nd.array(x_np).astype("bfloat16")
+    args["softmax_label"] = mx.nd.array(labels)
+    grads = {n: mx.nd.zeros(v.shape).astype("bfloat16")
+             for n, v in pv.items()}
+    aux = {n: mx.nd.array(v) for n, v in av.items()}
+    grad_req = {n: ("write" if n in grads else "null")
+                for n in loss_sym.list_arguments()}
+    ex = loss_sym.bind(mx.current_context(), args, args_grad=grads,
+                       grad_req=grad_req, aux_states=aux)
+
+    fwdbwd = ex._get_fn("fwdbwd", True)          # the framework program
+    gpos = ex._grad_positions
+    sgd = get_op("sgd_update")
+    sgd_attrs = normalize_attrs(sgd, {"lr": 0.05, "wd": 0.0,
+                                      "rescale_grad": 1.0})
+    full_names = loss_sym.list_arguments()
+    out_shapes = [tuple(o.shape) for o in _probe_outputs(ex)]
+
+    def one_step(arg_vals, aux_vals):
+        cots = tuple(jnp.ones(s, jnp.bfloat16) for s in out_shapes)
+        outs, new_aux, gs = fwdbwd(tuple(arg_vals), tuple(aux_vals),
+                                   (), cots)
+        arg_vals = list(arg_vals)
+        for p, g in zip(gpos, gs):
+            arg_vals[p] = sgd.forward(sgd_attrs, arg_vals[p], g)
+        probs = outs[0].astype(jnp.float32)
+        picked = jnp.take_along_axis(
+            probs, jnp.asarray(labels[:, None], jnp.int32), axis=1)
+        loss = -jnp.mean(jnp.log(jnp.maximum(picked, 1e-10)))
+        return arg_vals, list(new_aux), loss
+
+    def many(arg_vals, aux_vals):
+        def body(carry, _):
+            a, x = carry
+            a, x, loss = one_step(a, x)
+            return (tuple(a), tuple(x)), loss
+        (a, x), losses = jax.lax.scan(
+            body, (tuple(arg_vals), tuple(aux_vals)), None,
+            length=TRAIN_ITERS)
+        tail = sum(jnp.sum(v.astype(jnp.float32)) * 1e-20 for v in a)
+        return jnp.mean(losses) + tail, losses[:3]
+
+    arg_vals = tuple(a._data for a in ex.arg_arrays)
+    aux_vals = tuple(a._data for a in ex.aux_arrays)
+
+    compiled = jax.jit(many)
+    out, first3 = compiled(arg_vals, aux_vals)
+    float(out)                                   # warmup + compile
+    t0 = time.perf_counter()
+    out, first3 = compiled(arg_vals, aux_vals)
+    float(out)
+    dt = time.perf_counter() - t0
+    img_s = BATCH * TRAIN_ITERS / dt
+
+    # --- trajectory parity: eager Executor + Updater, 3 steps ----------
+    from mxnet_tpu.optimizer import SGD, Updater
+    upd = Updater(SGD(learning_rate=0.05, wd=0.0, rescale_grad=1.0))
+    eager_losses = []
+    for _ in range(3):
+        outs = ex.forward(is_train=True)
+        probs = outs[0].asnumpy().astype(np.float64)
+        picked = probs[np.arange(BATCH), labels.astype(np.int64)]
+        eager_losses.append(-np.mean(np.log(np.maximum(picked, 1e-10))))
+        ex.backward()
+        for i, n in enumerate(full_names):
+            if n in grads:
+                upd(i, ex.grad_dict[n], ex.arg_dict[n])
+    scan_losses = np.asarray(first3, dtype=np.float64)
+    if not np.allclose(scan_losses, eager_losses, rtol=0.05, atol=0.05):
+        raise AssertionError(
+            "framework-path trajectory mismatch: scanned %s vs eager %s"
+            % (scan_losses.tolist(), eager_losses))
+
+    # training FLOPs: the standard fwd+bwd ≈ 3x forward convention
+    # (XLA's cost model undercounts the custom-vjp transpose convs, so
+    # per-image forward FLOPs are supplied by the inference bench)
+    mfu = 3.0 * flops_per_img * BATCH * TRAIN_ITERS / dt / peak
+    return img_s, mfu
+
+
+def _probe_outputs(ex):
+    outs = ex.forward(is_train=True)
+    return outs
+
+
+def _bench_allreduce_bandwidth():
+    """KVStore pushpull round-trip bandwidth (BASELINE.md metric #2,
+    ref tools/bandwidth/): on one chip this measures the aggregation
+    path's memory bandwidth; on a mesh the same call measures the real
+    ICI collective."""
+    import mxnet_tpu as mx
+    nbytes = 64 << 20
+    val = mx.nd.ones((nbytes // 4,))
+    kv = mx.kv.create("device")
+    kv.init(0, val)
+    out = mx.nd.zeros_like(val)
+    kv.pushpull(0, val, out=out)
+    float(out.asnumpy()[0])                      # warmup
+    reps = 10
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        kv.pushpull(0, val, out=out)
+    float(out.asnumpy()[0])
+    dt = time.perf_counter() - t0
+    return 2 * nbytes * reps / dt / 1e9          # GB/s (push + pull)
+
+
+def main():
+    peak, kind = _peak_flops()
+
+    infer_img_s, infer_mfu, gf_per_img = _bench_inference(
+        BATCH, ITERS, peak)
+    sweep = {}
+    for b in SWEEP:
+        s_img, s_mfu, _ = _bench_inference(b, 64, peak)
+        sweep["inference_img_per_sec_batch%d" % b] = round(s_img, 2)
+        sweep["inference_mfu_pct_batch%d" % b] = round(100 * s_mfu, 1)
+
+    train_img_s, train_mfu = _bench_training_framework_path(
+        peak, gf_per_img)
+    allreduce_gbps = _bench_allreduce_bandwidth()
+
+    record = {
         "metric": "resnet50_inference_img_per_sec_per_chip",
         "value": round(infer_img_s, 2),
         "unit": "img/s",
         "vs_baseline": round(infer_img_s / BASELINE_INFER, 3),
+        "inference_mfu_pct": round(100 * infer_mfu, 1),
         "training_img_per_sec_per_chip": round(train_img_s, 2),
         "training_vs_baseline": round(train_img_s / BASELINE_TRAIN, 3),
+        "training_mfu_pct": round(100 * train_mfu, 1),
+        "training_path": "Executor.fwdbwd + fused sgd_update op "
+                         "(trajectory-parity checked vs eager "
+                         "Executor+Updater)",
+        "kvstore_pushpull_gbps": round(allreduce_gbps, 1),
+        "flops_per_image_gf": round(gf_per_img / 1e9, 2),
         "batch": BATCH,
         "dtype": "bfloat16",
-    }))
+        "device_kind": kind,
+    }
+    record.update(sweep)
+    print(json.dumps(record))
 
 
 if __name__ == "__main__":

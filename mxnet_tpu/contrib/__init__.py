@@ -1,0 +1,2 @@
+"""Contrib namespace (reference: python/mxnet/contrib/)."""
+from . import quantization  # noqa: F401

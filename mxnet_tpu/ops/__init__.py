@@ -19,3 +19,6 @@ from . import optimizer_ops  # noqa: F401
 from . import linalg        # noqa: F401
 from . import rnn_op        # noqa: F401
 from . import control_flow  # noqa: F401
+from . import quantization  # noqa: F401
+from . import detection     # noqa: F401
+from . import extra         # noqa: F401

@@ -1,0 +1,432 @@
+"""Detection operators (reference: src/operator/contrib/ —
+multibox_prior.cc, multibox_target.cc, multibox_detection.cc,
+bounding_box.cc (_contrib_box_nms/_contrib_box_iou/
+_contrib_bipartite_matching), roi_align.cc, and the legacy
+ROIPooling (src/operator/roi_pooling.cc).
+
+TPU-native design: everything is static-shape dense math — NMS is the
+O(k²) suppression-matrix form over the top-k scored boxes (no
+data-dependent loops), ROIAlign/ROIPooling gather fixed sampling grids
+— so all of it jits into the surrounding program.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .registry import register
+
+
+# ---------------------------------------------------------------------------
+# box geometry helpers
+# ---------------------------------------------------------------------------
+
+def _iou_corner(a, b):
+    """Pairwise IoU of corner-format boxes a (..., Na, 4) x b (..., Nb, 4)."""
+    ax1, ay1, ax2, ay2 = [a[..., :, None, i] for i in range(4)]
+    bx1, by1, bx2, by2 = [b[..., None, :, i] for i in range(4)]
+    iw = jnp.clip(jnp.minimum(ax2, bx2) - jnp.maximum(ax1, bx1), 0, None)
+    ih = jnp.clip(jnp.minimum(ay2, by2) - jnp.maximum(ay1, by1), 0, None)
+    inter = iw * ih
+    area_a = jnp.clip(ax2 - ax1, 0, None) * jnp.clip(ay2 - ay1, 0, None)
+    area_b = jnp.clip(bx2 - bx1, 0, None) * jnp.clip(by2 - by1, 0, None)
+    return inter / jnp.maximum(area_a + area_b - inter, 1e-12)
+
+
+def _center_to_corner(boxes):
+    cx, cy, w, h = [boxes[..., i] for i in range(4)]
+    return jnp.stack([cx - w / 2, cy - h / 2, cx + w / 2, cy + h / 2],
+                     axis=-1)
+
+
+# ---------------------------------------------------------------------------
+# MultiBox family (SSD)
+# ---------------------------------------------------------------------------
+
+def _multibox_prior(attrs, data):
+    """Anchor boxes per feature-map cell (reference:
+    multibox_prior.cc). Output (1, H*W*num_anchors, 4) corner format."""
+    sizes = [float(s) for s in attrs.get("sizes", (1.0,))]
+    ratios = [float(r) for r in attrs.get("ratios", (1.0,))]
+    steps = attrs.get("steps", (-1.0, -1.0))
+    offsets = attrs.get("offsets", (0.5, 0.5))
+    H, W = data.shape[2], data.shape[3]
+    step_y = float(steps[0]) if steps and float(steps[0]) > 0 else 1.0 / H
+    step_x = float(steps[1]) if steps and float(steps[1]) > 0 else 1.0 / W
+    cy = (jnp.arange(H) + float(offsets[0])) * step_y
+    cx = (jnp.arange(W) + float(offsets[1])) * step_x
+    # reference ordering (multibox_prior.cc): every size with ratio[0]
+    # first, then size[0] with the remaining ratios
+    shapes = []
+    for s in sizes:
+        r = ratios[0]
+        shapes.append((s * np.sqrt(r), s / np.sqrt(r)))
+    for r in ratios[1:]:
+        s = sizes[0]
+        shapes.append((s * np.sqrt(r), s / np.sqrt(r)))
+    ws = jnp.asarray([w for w, _ in shapes])
+    hs = jnp.asarray([h for _, h in shapes])
+    cyg, cxg = jnp.meshgrid(cy, cx, indexing="ij")      # (H, W)
+    cxg = cxg[..., None]
+    cyg = cyg[..., None]
+    x1 = cxg - ws / 2
+    y1 = cyg - hs / 2
+    x2 = cxg + ws / 2
+    y2 = cyg + hs / 2
+    out = jnp.stack([x1, y1, x2, y2], axis=-1).reshape(1, -1, 4)
+    if bool(attrs.get("clip", False)):
+        out = jnp.clip(out, 0.0, 1.0)
+    return out.astype(jnp.float32)
+
+
+register("_contrib_MultiBoxPrior", _multibox_prior, arg_names=("data",),
+         defaults={"sizes": (1.0,), "ratios": (1.0,), "clip": False,
+                   "steps": (-1.0, -1.0), "offsets": (0.5, 0.5)},
+         aliases=("MultiBoxPrior",))
+
+
+def _multibox_target(attrs, anchor, label, cls_pred):
+    """Assign ground-truth to anchors (reference: multibox_target.cc).
+
+    anchor (1, N, 4) corners; label (B, M, 5) [cls, x1, y1, x2, y2]
+    with cls = -1 padding; cls_pred (B, C+1, N) (unused for matching,
+    shape source only). Returns (loc_target (B, N*4),
+    loc_mask (B, N*4), cls_target (B, N))."""
+    overlap_thr = float(attrs.get("overlap_threshold", 0.5))
+    variances = [float(v) for v in attrs.get("variances",
+                                             (0.1, 0.1, 0.2, 0.2))]
+    B = label.shape[0]
+    N = anchor.shape[1]
+    anchors = anchor[0]                                  # (N, 4)
+
+    def per_sample(lab):
+        gt_valid = lab[:, 0] >= 0                        # (M,)
+        gt_boxes = lab[:, 1:5]
+        iou = _iou_corner(anchors, gt_boxes)             # (N, M)
+        iou = jnp.where(gt_valid[None, :], iou, -1.0)
+        best_gt = jnp.argmax(iou, axis=1)                # (N,)
+        best_iou = jnp.max(iou, axis=1)
+        matched = best_iou >= overlap_thr
+        # force-match the best anchor of every valid gt
+        best_anchor = jnp.argmax(iou, axis=0)            # (M,)
+        forced = jnp.zeros((N,), bool).at[best_anchor].set(gt_valid)
+        gt_for_forced = jnp.zeros((N,), jnp.int32).at[best_anchor].set(
+            jnp.arange(lab.shape[0], dtype=jnp.int32))
+        matched = matched | forced
+        assigned = jnp.where(forced, gt_for_forced,
+                             best_gt.astype(jnp.int32))
+        cls_t = jnp.where(
+            matched, lab[assigned, 0].astype(jnp.int32) + 1, 0)
+        # location targets: encode matched gt vs anchor (center form)
+        aw = anchors[:, 2] - anchors[:, 0]
+        ah = anchors[:, 3] - anchors[:, 1]
+        acx = (anchors[:, 0] + anchors[:, 2]) / 2
+        acy = (anchors[:, 1] + anchors[:, 3]) / 2
+        g = gt_boxes[assigned]
+        gw = jnp.maximum(g[:, 2] - g[:, 0], 1e-12)
+        gh = jnp.maximum(g[:, 3] - g[:, 1], 1e-12)
+        gcx = (g[:, 0] + g[:, 2]) / 2
+        gcy = (g[:, 1] + g[:, 3]) / 2
+        tx = (gcx - acx) / jnp.maximum(aw, 1e-12) / variances[0]
+        ty = (gcy - acy) / jnp.maximum(ah, 1e-12) / variances[1]
+        tw = jnp.log(gw / jnp.maximum(aw, 1e-12)) / variances[2]
+        th = jnp.log(gh / jnp.maximum(ah, 1e-12)) / variances[3]
+        loc_t = jnp.stack([tx, ty, tw, th], axis=-1)     # (N, 4)
+        mask = matched[:, None].astype(loc_t.dtype)
+        return (loc_t * mask).reshape(-1), \
+            jnp.broadcast_to(mask, (N, 4)).reshape(-1), cls_t
+
+    loc_t, loc_m, cls_t = jax.vmap(per_sample)(label)
+    return loc_t, loc_m, cls_t.astype(cls_pred.dtype)
+
+
+register("_contrib_MultiBoxTarget", _multibox_target,
+         arg_names=("anchor", "label", "cls_pred"),
+         defaults={"overlap_threshold": 0.5, "ignore_label": -1.0,
+                   "negative_mining_ratio": -1.0,
+                   "negative_mining_thresh": 0.5,
+                   "minimum_negative_samples": 0,
+                   "variances": (0.1, 0.1, 0.2, 0.2)},
+         num_outputs=3, aliases=("MultiBoxTarget",))
+
+
+def _decode_boxes(anchors, loc_pred, variances):
+    aw = anchors[:, 2] - anchors[:, 0]
+    ah = anchors[:, 3] - anchors[:, 1]
+    acx = (anchors[:, 0] + anchors[:, 2]) / 2
+    acy = (anchors[:, 1] + anchors[:, 3]) / 2
+    p = loc_pred.reshape(-1, 4)
+    cx = p[:, 0] * variances[0] * aw + acx
+    cy = p[:, 1] * variances[1] * ah + acy
+    w = jnp.exp(p[:, 2] * variances[2]) * aw
+    h = jnp.exp(p[:, 3] * variances[3]) * ah
+    return jnp.stack([cx - w / 2, cy - h / 2, cx + w / 2, cy + h / 2],
+                     axis=-1)
+
+
+def _nms_mask(boxes, scores, thresh, cls_id=None):
+    """Keep-mask of greedy NMS as a static suppression chain: box i is
+    kept iff no higher-scored KEPT box overlaps it above thresh. The
+    O(k²) masked form of the reference's sorted scan. With ``cls_id``
+    given, suppression only happens within a class (the reference's
+    force_suppress=False semantics)."""
+    n = boxes.shape[0]
+    order = jnp.argsort(-scores)
+    sb = boxes[order]
+    iou = _iou_corner(sb, sb)
+    overlapping = iou > thresh
+    if cls_id is not None:
+        sc = cls_id[order]
+        overlapping = overlapping & (sc[:, None] == sc[None, :])
+    above = jnp.triu(overlapping, k=1)           # [i, j]: i<j overlaps j
+
+    def body(keep, i):
+        sup = jnp.any(above[:, i] & keep & (jnp.arange(n) < i))
+        keep = keep.at[i].set(~sup)
+        return keep, None
+
+    keep, _ = jax.lax.scan(body, jnp.ones((n,), bool), jnp.arange(n))
+    inv = jnp.zeros((n,), jnp.int32).at[order].set(jnp.arange(n))
+    return keep[inv]
+
+
+def _multibox_detection(attrs, cls_prob, loc_pred, anchor):
+    """Decode + per-class NMS (reference: multibox_detection.cc).
+    Returns (B, N, 6) rows [cls_id, score, x1, y1, x2, y2], cls_id -1
+    for suppressed/background entries."""
+    nms_thr = float(attrs.get("nms_threshold", 0.5))
+    score_thr = float(attrs.get("threshold", 0.01))
+    variances = [float(v) for v in attrs.get("variances",
+                                             (0.1, 0.1, 0.2, 0.2))]
+    clip = bool(attrs.get("clip", True))
+    force = bool(attrs.get("force_suppress", False))
+    anchors = anchor[0]
+
+    def per_sample(probs, locs):
+        boxes = _decode_boxes(anchors, locs, variances)     # (N, 4)
+        if clip:
+            boxes = jnp.clip(boxes, 0.0, 1.0)
+        cls_id = jnp.argmax(probs[1:, :], axis=0)           # skip bg
+        score = jnp.max(probs[1:, :], axis=0)
+        keep = _nms_mask(boxes, score, nms_thr,
+                         cls_id=None if force else cls_id)
+        keep = keep & (score > score_thr)
+        out_id = jnp.where(keep, cls_id.astype(jnp.float32), -1.0)
+        return jnp.concatenate(
+            [out_id[:, None], score[:, None], boxes], axis=-1)
+
+    return jax.vmap(per_sample)(cls_prob, loc_pred)
+
+
+register("_contrib_MultiBoxDetection", _multibox_detection,
+         arg_names=("cls_prob", "loc_pred", "anchor"),
+         defaults={"clip": True, "threshold": 0.01, "background_id": 0,
+                   "nms_threshold": 0.5, "force_suppress": False,
+                   "variances": (0.1, 0.1, 0.2, 0.2), "nms_topk": -1},
+         aliases=("MultiBoxDetection",))
+
+
+# ---------------------------------------------------------------------------
+# bounding_box.cc ops
+# ---------------------------------------------------------------------------
+
+def _box_iou(attrs, lhs, rhs):
+    fmt = attrs.get("format", "corner")
+    if fmt == "center":
+        lhs = _center_to_corner(lhs)
+        rhs = _center_to_corner(rhs)
+    return _iou_corner(lhs, rhs)
+
+
+register("_contrib_box_iou", _box_iou, arg_names=("lhs", "rhs"),
+         defaults={"format": "corner"})
+
+
+def _box_nms(attrs, data):
+    """Greedy NMS over (..., N, K>=5) records
+    (reference: bounding_box.cc BoxNMS). Suppressed rows get score -1;
+    output keeps input order (id_index semantics simplified)."""
+    thr = float(attrs.get("overlap_thresh", 0.5))
+    score_thr = float(attrs.get("valid_thresh", 0.0))
+    score_index = int(attrs.get("score_index", 1))
+    coord_start = int(attrs.get("coord_start", 2))
+    id_index = int(attrs.get("id_index", -1))
+    force = bool(attrs.get("force_suppress", False))
+    fmt = attrs.get("in_format", "corner"), attrs.get("out_format",
+                                                      "corner")
+
+    flat = data.reshape((-1,) + data.shape[-2:])
+
+    def per_batch(rows):
+        boxes = rows[:, coord_start:coord_start + 4]
+        if fmt[0] == "center":
+            boxes = _center_to_corner(boxes)
+        scores = rows[:, score_index]
+        ids = rows[:, id_index] if (id_index >= 0 and not force) else None
+        keep = _nms_mask(boxes, scores, thr, cls_id=ids) \
+            & (scores >= score_thr)
+        return rows.at[:, score_index].set(
+            jnp.where(keep, scores, -1.0))
+
+    out = jax.vmap(per_batch)(flat)
+    return out.reshape(data.shape)
+
+
+register("_contrib_box_nms", _box_nms, arg_names=("data",),
+         defaults={"overlap_thresh": 0.5, "valid_thresh": 0.0,
+                   "topk": -1, "coord_start": 2, "score_index": 1,
+                   "id_index": -1, "background_id": -1,
+                   "force_suppress": False, "in_format": "corner",
+                   "out_format": "corner"},
+         aliases=("_contrib_box_non_maximum_suppression",))
+
+
+def _bipartite_matching(attrs, data):
+    """Greedy bipartite matching on a score matrix (reference:
+    bounding_box.cc BipartiteMatching). data (..., M, N); returns
+    (row_match (..., M), col_match (..., N))."""
+    thr = float(attrs.get("threshold", 0.5))
+    is_ascend = bool(attrs.get("is_ascend", False))
+
+    flat = data.reshape((-1,) + data.shape[-2:])
+
+    def per_batch(score):
+        M, N = score.shape
+        s = score if not is_ascend else -score
+        thr_ok = (score >= thr) if not is_ascend else (score <= thr)
+
+        def body(carry, _):
+            s_cur, rows, cols = carry
+            idx = jnp.argmax(s_cur)
+            i, j = idx // N, idx % N
+            ok = s_cur[i, j] > -jnp.inf
+            valid = ok & thr_ok[i, j]
+            rows = jnp.where(valid, rows.at[i].set(j), rows)
+            cols = jnp.where(valid, cols.at[j].set(i), cols)
+            s_cur = jnp.where(valid,
+                              s_cur.at[i, :].set(-jnp.inf)
+                              .at[:, j].set(-jnp.inf), s_cur)
+            return (s_cur, rows, cols), None
+
+        init = (s, -jnp.ones((M,), jnp.float32),
+                -jnp.ones((N,), jnp.float32))
+        (_, rows, cols), _ = jax.lax.scan(body, init,
+                                          None, length=min(M, N))
+        return rows, cols
+
+    rows, cols = jax.vmap(per_batch)(flat)
+    return rows.reshape(data.shape[:-1]), \
+        cols.reshape(data.shape[:-2] + data.shape[-1:])
+
+
+register("_contrib_bipartite_matching", _bipartite_matching,
+         arg_names=("data",),
+         defaults={"threshold": 0.5, "is_ascend": False, "topk": -1},
+         num_outputs=2)
+
+
+# ---------------------------------------------------------------------------
+# ROI pooling / align
+# ---------------------------------------------------------------------------
+
+def _bilinear_at(feat, y, x):
+    """Bilinear sample feat (C, H, W) at float coords y, x (...,)."""
+    H, W = feat.shape[-2:]
+    y = jnp.clip(y, 0.0, H - 1.0)
+    x = jnp.clip(x, 0.0, W - 1.0)
+    y0 = jnp.floor(y).astype(jnp.int32)
+    x0 = jnp.floor(x).astype(jnp.int32)
+    y1 = jnp.minimum(y0 + 1, H - 1)
+    x1 = jnp.minimum(x0 + 1, W - 1)
+    wy = y - y0
+    wx = x - x0
+    v00 = feat[:, y0, x0]
+    v01 = feat[:, y0, x1]
+    v10 = feat[:, y1, x0]
+    v11 = feat[:, y1, x1]
+    return (v00 * (1 - wy) * (1 - wx) + v01 * (1 - wy) * wx
+            + v10 * wy * (1 - wx) + v11 * wy * wx)
+
+
+def _roi_align(attrs, data, rois):
+    """ROIAlign (reference: roi_align.cc). data (B, C, H, W); rois
+    (R, 5) [batch_idx, x1, y1, x2, y2]; output (R, C, PH, PW)."""
+    ph, pw = [int(s) for s in attrs["pooled_size"]]
+    scale = float(attrs.get("spatial_scale", 1.0))
+    ratio = int(attrs.get("sample_ratio", -1))
+    ns = ratio if ratio > 0 else 2
+
+    def one_roi(roi):
+        b = roi[0].astype(jnp.int32)
+        x1, y1, x2, y2 = roi[1] * scale, roi[2] * scale, \
+            roi[3] * scale, roi[4] * scale
+        rw = jnp.maximum(x2 - x1, 1.0)
+        rh = jnp.maximum(y2 - y1, 1.0)
+        bh = rh / ph
+        bw = rw / pw
+        feat = data[b]
+        iy = jnp.arange(ph)
+        ix = jnp.arange(pw)
+        sy = jnp.arange(ns)
+        sx = jnp.arange(ns)
+        yy = y1 + bh * (iy[:, None, None, None]
+                        + (sy[None, None, :, None] + 0.5) / ns)
+        xx = x1 + bw * (ix[None, :, None, None]
+                        + (sx[None, None, None, :] + 0.5) / ns)
+        yy = jnp.broadcast_to(yy, (ph, pw, ns, ns))
+        xx = jnp.broadcast_to(xx, (ph, pw, ns, ns))
+        vals = _bilinear_at(feat, yy.reshape(-1), xx.reshape(-1))
+        vals = vals.reshape(feat.shape[0], ph, pw, ns * ns)
+        return vals.mean(axis=-1)
+
+    return jax.vmap(one_roi)(rois)
+
+
+register("_contrib_ROIAlign", _roi_align, arg_names=("data", "rois"),
+         defaults={"pooled_size": (7, 7), "spatial_scale": 1.0,
+                   "sample_ratio": -1, "position_sensitive": False},
+         aliases=("ROIAlign",))
+
+
+def _roi_pooling(attrs, data, rois):
+    """Max ROI pooling (reference: roi_pooling.cc). Same IO contract as
+    ROIAlign but hard max over integer bins."""
+    ph, pw = [int(s) for s in attrs["pooled_size"]]
+    scale = float(attrs.get("spatial_scale", 1.0))
+    H, W = data.shape[2], data.shape[3]
+
+    def one_roi(roi):
+        b = roi[0].astype(jnp.int32)
+        x1 = jnp.round(roi[1] * scale).astype(jnp.int32)
+        y1 = jnp.round(roi[2] * scale).astype(jnp.int32)
+        x2 = jnp.round(roi[3] * scale).astype(jnp.int32)
+        y2 = jnp.round(roi[4] * scale).astype(jnp.int32)
+        rw = jnp.maximum(x2 - x1 + 1, 1)
+        rh = jnp.maximum(y2 - y1 + 1, 1)
+        feat = data[b]                                  # (C, H, W)
+        ys = jnp.arange(H)
+        xs = jnp.arange(W)
+
+        def one_bin(i, j):
+            by0 = y1 + (i * rh) // ph
+            by1 = y1 + ((i + 1) * rh + ph - 1) // ph
+            bx0 = x1 + (j * rw) // pw
+            bx1 = x1 + ((j + 1) * rw + pw - 1) // pw
+            m = ((ys[:, None] >= by0) & (ys[:, None] < by1)
+                 & (xs[None, :] >= bx0) & (xs[None, :] < bx1))
+            neg = jnp.full(feat.shape, -jnp.inf, feat.dtype)
+            sel = jnp.where(m[None], feat, neg)
+            best = jnp.max(sel, axis=(1, 2))
+            return jnp.where(jnp.any(m), best, 0.0)
+
+        grid_i, grid_j = jnp.meshgrid(jnp.arange(ph), jnp.arange(pw),
+                                      indexing="ij")
+        vals = jax.vmap(jax.vmap(one_bin))(grid_i, grid_j)
+        return jnp.moveaxis(vals, -1, 0)                # (C, PH, PW)
+
+    return jax.vmap(one_roi)(rois)
+
+
+register("ROIPooling", _roi_pooling, arg_names=("data", "rois"),
+         defaults={"pooled_size": (7, 7), "spatial_scale": 1.0})

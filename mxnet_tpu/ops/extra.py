@@ -1,0 +1,735 @@
+"""Breadth operators: spatial sampling, FFT, image, tensor utilities,
+multi-tensor optimizer updates, and small contrib ops.
+
+Reference sites:
+- SpatialTransformer/GridGenerator/BilinearSampler:
+  src/operator/spatial_transformer.cc, grid_generator.cc,
+  bilinear_sampler.cc — all share one bilinear-sampling core here.
+- Correlation: src/operator/correlation.cc. Crop: src/operator/crop.cc.
+- FFT/IFFT: src/operator/contrib/fft.cc, ifft.cc.
+- image ops: src/operator/image/image_random.cc, resize.cc.
+- histogram/ravel/unravel/square_sum/hard_sigmoid/add_n/split_v2:
+  src/operator/tensor/.
+- multi-tensor SGD: src/operator/optimizer_op.cc multi_sgd_*.
+- quadratic/gradientmultiplier/adamw/group_adagrad/AdaptiveAvgPooling2D/
+  BilinearResize2D/SyncBatchNorm: src/operator/contrib/.
+- SVMOutput: src/operator/svm_output.cc.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .registry import register, get_op
+
+_D = ("data",)
+
+
+# ---------------------------------------------------------------------------
+# shared bilinear sampling core
+# ---------------------------------------------------------------------------
+
+def _sample_bilinear(data, grid_x, grid_y):
+    """data (B, C, H, W); grid_x/grid_y (B, Ho, Wo) in [-1, 1]
+    normalized coords. Out-of-range samples are zero (the reference's
+    border behavior for bilinear_sampler is zero padding)."""
+    B, C, H, W = data.shape
+    x = (grid_x + 1.0) * (W - 1) / 2.0
+    y = (grid_y + 1.0) * (H - 1) / 2.0
+    x0 = jnp.floor(x)
+    y0 = jnp.floor(y)
+    out = 0.0
+    for dy in (0, 1):
+        for dx in (0, 1):
+            xi = x0 + dx
+            yi = y0 + dy
+            w = (1 - jnp.abs(x - xi)) * (1 - jnp.abs(y - yi))
+            inside = (xi >= 0) & (xi <= W - 1) & (yi >= 0) & (yi <= H - 1)
+            xc = jnp.clip(xi, 0, W - 1).astype(jnp.int32)
+            yc = jnp.clip(yi, 0, H - 1).astype(jnp.int32)
+            gathered = jax.vmap(
+                lambda f, yy, xx: f[:, yy, xx])(data, yc, xc)
+            out = out + gathered * (w * inside)[:, None]
+    return out
+
+
+def _affine_grid(theta, H, W):
+    """theta (B, 6) affine params → sampling grid (B, H, W) x/y pairs
+    in [-1, 1] (reference: grid_generator.cc affine path)."""
+    ys = jnp.linspace(-1.0, 1.0, H)
+    xs = jnp.linspace(-1.0, 1.0, W)
+    gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+    ones = jnp.ones_like(gx)
+    base = jnp.stack([gx, gy, ones], axis=0).reshape(3, -1)  # (3, H*W)
+    t = theta.reshape(-1, 2, 3)
+    out = jnp.einsum("bij,jk->bik", t, base)                 # (B, 2, H*W)
+    return out[:, 0].reshape(-1, H, W), out[:, 1].reshape(-1, H, W)
+
+
+def _grid_generator(attrs, data):
+    """(reference: grid_generator.cc). affine: data (B, 6) + attr
+    target_shape; warp: data (B, 2, H, W) flow added to identity."""
+    ttype = attrs.get("transform_type", "affine")
+    if ttype == "affine":
+        H, W = [int(s) for s in attrs["target_shape"]]
+        gx, gy = _affine_grid(data, H, W)
+        return jnp.stack([gx, gy], axis=1)
+    # warp: data is a flow field in pixels
+    B, _, H, W = data.shape
+    ys = jnp.arange(H, dtype=data.dtype)
+    xs = jnp.arange(W, dtype=data.dtype)
+    gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+    px = gx + data[:, 0]
+    py = gy + data[:, 1]
+    nx = 2.0 * px / jnp.maximum(W - 1, 1) - 1.0
+    ny = 2.0 * py / jnp.maximum(H - 1, 1) - 1.0
+    return jnp.stack([nx, ny], axis=1)
+
+
+register("GridGenerator", _grid_generator, arg_names=_D,
+         defaults={"transform_type": "affine", "target_shape": (0, 0)})
+
+
+def _bilinear_sampler(attrs, data, grid):
+    """(reference: bilinear_sampler.cc). grid (B, 2, Ho, Wo)."""
+    return _sample_bilinear(data, grid[:, 0], grid[:, 1])
+
+
+register("BilinearSampler", _bilinear_sampler,
+         arg_names=("data", "grid"), defaults={"cudnn_off": None})
+
+
+def _spatial_transformer(attrs, data, loc):
+    """(reference: spatial_transformer.cc): affine loc net + bilinear
+    sampling at the target size."""
+    H, W = [int(s) for s in attrs["target_shape"]]
+    gx, gy = _affine_grid(loc, H, W)
+    return _sample_bilinear(data, gx, gy)
+
+
+register("SpatialTransformer", _spatial_transformer,
+         arg_names=("data", "loc"),
+         defaults={"target_shape": (0, 0),
+                   "transform_type": "affine",
+                   "sampler_type": "bilinear", "cudnn_off": None})
+
+
+def _correlation(attrs, data1, data2):
+    """Correlation layer (reference: correlation.cc): mean of patch
+    dot-products across a displacement neighborhood. kernel_size sums
+    the product over a k×k window; stride1 subsamples the output grid;
+    stride2 strides the displacement neighborhood."""
+    max_disp = int(attrs.get("max_displacement", 1))
+    stride1 = int(attrs.get("stride1", 1))
+    stride2 = int(attrs.get("stride2", 1))
+    ksize = int(attrs.get("kernel_size", 1))
+    kr = (ksize - 1) // 2
+    pad = max_disp + kr
+    B, C, H, W = data1.shape
+    p1 = jnp.pad(data1, ((0, 0), (0, 0), (kr, kr), (kr, kr)))
+    p2 = jnp.pad(data2, ((0, 0), (0, 0), (pad, pad), (pad, pad)))
+    offsets = range(-max_disp, max_disp + 1, stride2)
+    norm = C * ksize * ksize
+    maps = []
+    for dy in offsets:
+        for dx in offsets:
+            acc = 0.0
+            for ky in range(ksize):
+                for kx in range(ksize):
+                    a = jax.lax.dynamic_slice(
+                        p1, (0, 0, ky, kx), (B, C, H, W))
+                    b = jax.lax.dynamic_slice(
+                        p2, (0, 0, pad + dy - kr + ky,
+                             pad + dx - kr + kx), (B, C, H, W))
+                    acc = acc + jnp.sum(a * b, axis=1)
+            maps.append(acc / norm)
+    out = jnp.stack(maps, axis=1)
+    return out[:, :, ::stride1, ::stride1]
+
+
+register("Correlation", _correlation, arg_names=("data1", "data2"),
+         defaults={"kernel_size": 1, "max_displacement": 1, "stride1": 1,
+                   "stride2": 1, "pad_size": 0, "is_multiply": True})
+
+
+def _crop(attrs, *inputs):
+    """(reference: crop.cc): center or offset crop to h_w or like the
+    second input's spatial dims."""
+    data = inputs[0]
+    offset = tuple(int(o) for o in attrs.get("offset", (0, 0)))
+    if len(inputs) > 1 and bool(attrs.get("num_args", 1) == 2):
+        th, tw = inputs[1].shape[2], inputs[1].shape[3]
+    else:
+        th, tw = [int(s) for s in attrs.get("h_w", (0, 0))]
+    if bool(attrs.get("center_crop", False)):
+        oy = (data.shape[2] - th) // 2
+        ox = (data.shape[3] - tw) // 2
+    else:
+        oy, ox = offset
+    return data[:, :, oy:oy + th, ox:ox + tw]
+
+
+register("Crop", _crop, arg_names=_D,
+         defaults={"num_args": 1, "offset": (0, 0), "h_w": (0, 0),
+                   "center_crop": False},
+         key_var_num_args="num_args")
+
+
+# ---------------------------------------------------------------------------
+# FFT family (reference: contrib/fft.cc — real input, interleaved
+# re/im output of length 2n on the last axis)
+# ---------------------------------------------------------------------------
+
+def _fft(attrs, data):
+    spec = jnp.fft.fft(data.astype(jnp.complex64), axis=-1)
+    out = jnp.stack([spec.real, spec.imag], axis=-1)
+    return out.reshape(data.shape[:-1] + (2 * data.shape[-1],)) \
+        .astype(jnp.float32)
+
+
+register("_contrib_fft", _fft, arg_names=_D,
+         defaults={"compute_size": 128})
+
+
+def _ifft(attrs, data):
+    n = data.shape[-1] // 2
+    pairs = data.reshape(data.shape[:-1] + (n, 2))
+    spec = pairs[..., 0] + 1j * pairs[..., 1]
+    return jnp.fft.ifft(spec, axis=-1).real.astype(jnp.float32) * n
+
+
+register("_contrib_ifft", _ifft, arg_names=_D,
+         defaults={"compute_size": 128})
+
+
+# ---------------------------------------------------------------------------
+# image ops (reference: src/operator/image/)
+# ---------------------------------------------------------------------------
+
+def _image_to_tensor(attrs, data):
+    """HWC uint8 [0,255] → CHW float [0,1] (batched or not)."""
+    x = data.astype(jnp.float32) / 255.0
+    if x.ndim == 3:
+        return jnp.transpose(x, (2, 0, 1))
+    return jnp.transpose(x, (0, 3, 1, 2))
+
+
+register("_image_to_tensor", _image_to_tensor, arg_names=_D,
+         aliases=("_image_totensor",))
+
+
+def _image_normalize(attrs, data):
+    mean = jnp.asarray(attrs.get("mean", (0.0,)), jnp.float32)
+    std = jnp.asarray(attrs.get("std", (1.0,)), jnp.float32)
+    bshape = [1] * data.ndim
+    bshape[data.ndim - 3] = -1      # channel axis of CHW/NCHW
+    return (data - mean.reshape(bshape)) / std.reshape(bshape)
+
+
+register("_image_normalize", _image_normalize, arg_names=_D,
+         defaults={"mean": (0.0,), "std": (1.0,)})
+
+
+def _image_resize(attrs, data):
+    size = attrs.get("size", 0)
+    if isinstance(size, int):
+        size = (size, size)
+    w, h = int(size[0]), int(size[1])
+    if data.ndim == 3:                       # HWC
+        return jax.image.resize(data, (h, w, data.shape[2]), "bilinear")
+    return jax.image.resize(
+        data, (data.shape[0], h, w, data.shape[3]), "bilinear")
+
+
+register("_image_resize", _image_resize, arg_names=_D,
+         defaults={"size": 0, "keep_ratio": False, "interp": 1})
+
+
+def _bilinear_resize_2d(attrs, data):
+    h = int(attrs.get("height", 1))
+    w = int(attrs.get("width", 1))
+    B, C = data.shape[0], data.shape[1]
+    return jax.image.resize(data, (B, C, h, w), "bilinear")
+
+
+register("_contrib_BilinearResize2D", _bilinear_resize_2d, arg_names=_D,
+         defaults={"height": 1, "width": 1, "scale_height": None,
+                   "scale_width": None})
+
+
+def _adaptive_avg_pool_2d(attrs, data):
+    out = attrs.get("output_size", None)
+    if not out:
+        oh = ow = 1
+    elif isinstance(out, int):
+        oh = ow = int(out)
+    else:
+        oh, ow = [int(s) for s in out]
+    B, C, H, W = data.shape
+    if H % oh == 0 and W % ow == 0:
+        x = data.reshape(B, C, oh, H // oh, ow, W // ow)
+        return x.mean(axis=(3, 5))
+    return jax.image.resize(data, (B, C, oh, ow), "linear")
+
+
+register("_contrib_AdaptiveAvgPooling2D", _adaptive_avg_pool_2d,
+         arg_names=_D, defaults={"output_size": None})
+
+
+# ---------------------------------------------------------------------------
+# tensor utilities
+# ---------------------------------------------------------------------------
+
+def _histogram(attrs, data, bins=None):
+    if bins is not None:
+        hist = jnp.histogram(data.reshape(-1), bins=bins)[0]
+        return hist, bins
+    cnt = int(attrs.get("bin_cnt", 10))
+    rng = attrs.get("range", (0.0, 1.0))
+    lo, hi = float(rng[0]), float(rng[1])
+    edges = jnp.linspace(lo, hi, cnt + 1)
+    hist = jnp.histogram(data.reshape(-1), bins=edges)[0]
+    return hist, edges
+
+
+register("_histogram", _histogram, arg_names=("data", "bins"),
+         defaults={"bin_cnt": None, "range": None}, num_outputs=2,
+         arg_names_fn=lambda a: ["data"] if a.get("bin_cnt")
+         else ["data", "bins"])
+
+
+def _ravel_multi_index(attrs, data):
+    shape = tuple(int(s) for s in attrs["shape"])
+    idx = [data[i].astype(jnp.int64) for i in range(len(shape))]
+    return jnp.ravel_multi_index(idx, shape, mode="clip") \
+        .astype(data.dtype)
+
+
+register("_ravel_multi_index", _ravel_multi_index, arg_names=_D,
+         defaults={"shape": ()})
+
+
+def _unravel_index(attrs, data):
+    shape = tuple(int(s) for s in attrs["shape"])
+    unraveled = jnp.unravel_index(data.astype(jnp.int64).reshape(-1),
+                                  shape)
+    return jnp.stack(unraveled, axis=0).reshape(
+        (len(shape),) + data.shape).astype(data.dtype)
+
+
+register("_unravel_index", _unravel_index, arg_names=_D,
+         defaults={"shape": ()})
+
+
+def _square_sum(attrs, data):
+    axis = attrs.get("axis", None)
+    keepdims = bool(attrs.get("keepdims", False))
+    if isinstance(axis, (list, tuple)):
+        axis = tuple(axis)
+    return jnp.sum(data * data, axis=axis, keepdims=keepdims)
+
+
+register("_square_sum", _square_sum, arg_names=_D,
+         defaults={"axis": None, "keepdims": False, "exclude": False})
+
+
+register("hard_sigmoid",
+         lambda attrs, x: jnp.clip(
+             float(attrs.get("alpha", 0.2)) * x
+             + float(attrs.get("beta", 0.5)), 0.0, 1.0),
+         arg_names=_D, defaults={"alpha": 0.2, "beta": 0.5})
+
+
+def _add_n(attrs, *inputs):
+    total = inputs[0]
+    for x in inputs[1:]:
+        total = total + x
+    return total
+
+
+register("add_n", _add_n, arg_names=("args",),
+         defaults={"num_args": 1}, key_var_num_args="num_args",
+         aliases=("ElementWiseSum",))
+
+register("_grad_add", lambda attrs, a, b: a + b, arg_names=("lhs", "rhs"))
+
+register("_identity_with_attr_like_rhs",
+         lambda attrs, lhs, rhs: lhs, arg_names=("lhs", "rhs"))
+
+register("_zeros_without_dtype",
+         lambda attrs, : jnp.zeros(tuple(attrs.get("shape", ())),
+                                   jnp.float32),
+         arg_names=(), defaults={"shape": (), "ctx": None, "dtype": None})
+
+
+def _split_v2(attrs, data):
+    axis = int(attrs.get("axis", 1))
+    sections = int(attrs.get("sections", 0))
+    indices = attrs.get("indices", ())
+    squeeze = bool(attrs.get("squeeze_axis", False))
+    if sections > 0:
+        parts = jnp.split(data, sections, axis=axis)
+    else:
+        parts = jnp.split(data, [int(i) for i in indices], axis=axis)
+    if squeeze:
+        parts = [jnp.squeeze(p, axis=axis) for p in parts]
+    return tuple(parts)
+
+
+def _split_v2_nout(attrs):
+    s = int(attrs.get("sections", 0))
+    return s if s > 0 else len(tuple(attrs.get("indices", ()))) + 1
+
+
+register("_split_v2", _split_v2, arg_names=_D,
+         defaults={"indices": (), "axis": 1, "squeeze_axis": False,
+                   "sections": 0},
+         num_outputs=_split_v2_nout)
+
+
+def _slice_assign(attrs, lhs, rhs):
+    key = _slice_key(attrs, lhs.ndim)
+    return lhs.at[key].set(rhs)
+
+
+def _slice_assign_scalar(attrs, lhs):
+    key = _slice_key(attrs, lhs.ndim)
+    return lhs.at[key].set(float(attrs.get("scalar", 0.0)))
+
+
+def _slice_key(attrs, ndim):
+    begin = attrs.get("begin", ())
+    end = attrs.get("end", ())
+    step = attrs.get("step", ())
+    key = []
+    for i in range(len(begin)):
+        st = step[i] if i < len(step) and step[i] not in (None, 0) else 1
+        key.append(slice(begin[i], end[i], st))
+    return tuple(key)
+
+
+register("_slice_assign", _slice_assign, arg_names=("lhs", "rhs"),
+         defaults={"begin": (), "end": (), "step": ()})
+register("_slice_assign_scalar", _slice_assign_scalar, arg_names=("lhs",),
+         defaults={"begin": (), "end": (), "step": (), "scalar": 0.0})
+
+
+def _scatter_set_nd(attrs, lhs, indices, rhs):
+    idx = tuple(indices[i].astype(jnp.int32)
+                for i in range(indices.shape[0]))
+    return lhs.at[idx].set(rhs)
+
+
+register("_scatter_set_nd", _scatter_set_nd,
+         arg_names=("lhs", "indices", "rhs"),
+         defaults={"shape": ()})
+
+
+# -- per-element samplers (reference: sample_op.cc _sample_*) -----------
+
+def _sample_family(draw):
+    def impl(attrs, *params, rng=None):
+        shape = tuple(attrs.get("shape", ()) or ())
+        out_shape = params[0].shape + shape
+        return draw(rng, params, out_shape).astype(
+            attrs.get("dtype") or jnp.float32)
+    return impl
+
+
+register("_sample_exponential", _sample_family(
+    lambda key, p, s: jax.random.exponential(key, s)
+    / p[0].reshape(p[0].shape + (1,) * (len(s) - p[0].ndim))),
+    arg_names=("lam",), defaults={"shape": (), "dtype": None},
+    needs_rng=True)
+
+register("_sample_poisson", _sample_family(
+    lambda key, p, s: jax.random.poisson(
+        key, p[0].reshape(p[0].shape + (1,) * (len(s) - p[0].ndim)),
+        shape=s).astype(jnp.float32)),
+    arg_names=("lam",), defaults={"shape": (), "dtype": None},
+    needs_rng=True)
+
+
+def _neg_binomial(key, p, s):
+    k = p[0].reshape(p[0].shape + (1,) * (len(s) - p[0].ndim))
+    prob = p[1].reshape(p[1].shape + (1,) * (len(s) - p[1].ndim))
+    lam = jax.random.gamma(key, k, shape=s) * (1 - prob) / prob
+    return jax.random.poisson(jax.random.split(key)[0], lam,
+                              shape=s).astype(jnp.float32)
+
+
+register("_sample_negative_binomial", _sample_family(_neg_binomial),
+         arg_names=("k", "p"), defaults={"shape": (), "dtype": None},
+         needs_rng=True)
+
+
+def _gen_neg_binomial(key, p, s):
+    mu = p[0].reshape(p[0].shape + (1,) * (len(s) - p[0].ndim))
+    alpha = p[1].reshape(p[1].shape + (1,) * (len(s) - p[1].ndim))
+    shape_k = 1.0 / jnp.maximum(alpha, 1e-12)
+    lam = jax.random.gamma(key, shape_k, shape=s) * mu * alpha
+    return jax.random.poisson(jax.random.split(key)[0], lam,
+                              shape=s).astype(jnp.float32)
+
+
+register("_sample_generalized_negative_binomial",
+         _sample_family(_gen_neg_binomial),
+         arg_names=("mu", "alpha"), defaults={"shape": (), "dtype": None},
+         needs_rng=True)
+
+
+# ---------------------------------------------------------------------------
+# multi-tensor optimizer updates (reference: optimizer_op.cc multi_sgd_*)
+# ---------------------------------------------------------------------------
+
+def _multi_sgd(attrs, *inputs, with_mom=False, with_master=False):
+    """Aggregated SGD over n weights in one call (reference:
+    optimizer_op.cc MultiSGDUpdate). Input stride per weight:
+    (weight, grad[, mom][, weight32]); mp variants update the fp32
+    master copy and cast back."""
+    n = int(attrs["num_weights"])
+    lrs = [float(x) for x in attrs["lrs"]]
+    wds = [float(x) for x in attrs["wds"]]
+    rescale = float(attrs.get("rescale_grad", 1.0))
+    clip = attrs.get("clip_gradient", None)
+    momentum = float(attrs.get("momentum", 0.0))
+    per = 2 + (1 if with_mom else 0) + (1 if with_master else 0)
+    outs = []
+    for i in range(n):
+        chunk = list(inputs[i * per:(i + 1) * per])
+        w, g = chunk[0], chunk[1]
+        mom = chunk[2] if with_mom else None
+        master = chunk[-1] if with_master else None
+        acc = (master if master is not None else w).astype(jnp.float32)
+        g = g.astype(jnp.float32) * rescale
+        if clip is not None and clip > 0:
+            g = jnp.clip(g, -float(clip), float(clip))
+        g = g + wds[i] * acc
+        row = []
+        if mom is not None:
+            mom_new = momentum * mom.astype(jnp.float32) - lrs[i] * g
+            acc_new = acc + mom_new
+            row.append(mom_new.astype(mom.dtype))
+        else:
+            acc_new = acc - lrs[i] * g
+        out_w = acc_new.astype(w.dtype)
+        if master is not None:
+            outs.append((out_w, *row, acc_new))
+        else:
+            outs.append((out_w, *row))
+    return tuple(x for pack in outs for x in pack)
+
+
+register("multi_sgd_update",
+         lambda attrs, *ins: _multi_sgd(attrs, *ins),
+         arg_names=("data",),
+         defaults={"num_weights": 1, "lrs": (), "wds": (),
+                   "rescale_grad": 1.0, "clip_gradient": None},
+         key_var_num_args="__num_args__",
+         num_outputs=lambda a: int(a["num_weights"]))
+
+register("multi_sgd_mom_update",
+         lambda attrs, *ins: _multi_sgd(attrs, *ins, with_mom=True),
+         arg_names=("data",),
+         defaults={"num_weights": 1, "lrs": (), "wds": (),
+                   "momentum": 0.0, "rescale_grad": 1.0,
+                   "clip_gradient": None},
+         key_var_num_args="__num_args__",
+         num_outputs=lambda a: 2 * int(a["num_weights"]))
+
+register("multi_mp_sgd_update",
+         lambda attrs, *ins: _multi_sgd(attrs, *ins, with_master=True),
+         arg_names=("data",),
+         defaults={"num_weights": 1, "lrs": (), "wds": (),
+                   "rescale_grad": 1.0, "clip_gradient": None},
+         key_var_num_args="__num_args__",
+         num_outputs=lambda a: 2 * int(a["num_weights"]))
+
+register("multi_mp_sgd_mom_update",
+         lambda attrs, *ins: _multi_sgd(attrs, *ins, with_mom=True,
+                                        with_master=True),
+         arg_names=("data",),
+         defaults={"num_weights": 1, "lrs": (), "wds": (),
+                   "momentum": 0.0, "rescale_grad": 1.0,
+                   "clip_gradient": None},
+         key_var_num_args="__num_args__",
+         num_outputs=lambda a: 3 * int(a["num_weights"]))
+
+
+def _group_adagrad_update(attrs, weight, grad, history):
+    """Row-grouped AdaGrad (reference: contrib/optimizer_op.cc)."""
+    lr = float(attrs["lr"])
+    eps = float(attrs.get("epsilon", 1e-5))
+    rescale = float(attrs.get("rescale_grad", 1.0))
+    g = grad.astype(jnp.float32) * rescale
+    clip = attrs.get("clip_gradient")
+    if clip is not None and clip > 0:
+        g = jnp.clip(g, -float(clip), float(clip))
+    grp = jnp.mean(g * g, axis=tuple(range(1, g.ndim)), keepdims=True)
+    hist_new = history.astype(jnp.float32) + grp
+    w_new = weight.astype(jnp.float32) - lr * g / (
+        jnp.sqrt(hist_new) + eps)
+    return w_new.astype(weight.dtype), hist_new.astype(history.dtype)
+
+
+register("_contrib_group_adagrad_update", _group_adagrad_update,
+         arg_names=("weight", "grad", "history"),
+         defaults={"lr": 0.01, "epsilon": 1e-5, "rescale_grad": 1.0,
+                   "clip_gradient": None},
+         num_outputs=1, mutable_inputs=(2,))
+
+
+def _mp_adamw_update(attrs, weight, grad, mean, var, weight32, rescale):
+    """Multi-precision AdamW (reference: contrib/adamw.cc): the tensor
+    ``rescale`` scales the gradient (the loss-scale reciprocal), the
+    fp32 master copy takes the update, and the low-precision weight is
+    a cast of it."""
+    adamw = get_op("_contrib_adamw_update")
+    g32 = grad.astype(jnp.float32) * rescale.astype(jnp.float32)
+    inner = {k: v for k, v in attrs.items() if v is not None}
+    out = adamw.forward(dict(inner, rescale_grad=1.0), weight32, g32,
+                        mean, var)
+    if not isinstance(out, (tuple, list)):
+        out = (out,)
+    w32 = out[0]
+    return (w32.astype(weight.dtype),) + tuple(out[1:]) + (w32,)
+
+
+register("_contrib_mp_adamw_update", _mp_adamw_update,
+         arg_names=("weight", "grad", "mean", "var", "weight32",
+                    "rescale_grad"),
+         defaults={"lr": 0.001, "beta1": 0.9, "beta2": 0.999,
+                   "epsilon": 1e-8, "wd": 0.0, "eta": 1.0,
+                   "clip_gradient": None},
+         num_outputs=1, mutable_inputs=(2, 3, 4))
+
+
+# ---------------------------------------------------------------------------
+# small contrib / legacy ops
+# ---------------------------------------------------------------------------
+
+register("_contrib_quadratic",
+         lambda attrs, x: (float(attrs.get("a", 0.0)) * x * x
+                           + float(attrs.get("b", 0.0)) * x
+                           + float(attrs.get("c", 0.0))),
+         arg_names=_D, defaults={"a": 0.0, "b": 0.0, "c": 0.0})
+
+
+def _gradient_multiplier(attrs, data):
+    scalar = float(attrs.get("scalar", 1.0))
+
+    @jax.custom_vjp
+    def f(x):
+        return x
+
+    f.defvjp(lambda x: (x, None), lambda _, g: (g * scalar,))
+    return f(data)
+
+
+register("_contrib_gradientmultiplier", _gradient_multiplier,
+         arg_names=_D, defaults={"scalar": 1.0})
+
+
+def _getnnz(attrs, data):
+    axis = attrs.get("axis", None)
+    return jnp.sum((data != 0).astype(jnp.int64), axis=axis)
+
+
+register("_contrib_getnnz", _getnnz, arg_names=_D,
+         defaults={"axis": None})
+
+
+def _edge_id(attrs, data, u, v):
+    """CSR edge-id lookup is a sparse-frontend op; the dense fallback
+    looks up data[u, v] (reference: contrib/dgl ops)."""
+    return data[u.astype(jnp.int32), v.astype(jnp.int32)]
+
+
+register("_contrib_edge_id", _edge_id, arg_names=("data", "u", "v"))
+
+
+def _svm_output(attrs, data, label):
+    """Hinge-loss output layer (reference: svm_output.cc): identity
+    forward; margin hinge gradient on backward."""
+    margin = float(attrs.get("margin", 1.0))
+    reg = float(attrs.get("regularization_coefficient", 1.0))
+    linear = bool(attrs.get("use_linear", False))
+
+    @jax.custom_vjp
+    def f(d, l):
+        return d
+
+    def fwd(d, l):
+        return d, (d, l)
+
+    def bwd(res, g):
+        d, l = res
+        li = l.astype(jnp.int32)
+        onehot = jax.nn.one_hot(li, d.shape[-1], dtype=d.dtype)
+        sign = 2 * onehot - 1
+        slack = margin - sign * d
+        viol = slack > 0
+        if linear:                       # L1-SVM hinge
+            grad = jnp.where(viol, -sign * reg, 0.0)
+        else:                            # L2-SVM squared hinge (default)
+            grad = jnp.where(viol, -2.0 * reg * sign * slack, 0.0)
+        return grad.astype(d.dtype), jnp.zeros_like(l)
+
+    f.defvjp(fwd, bwd)
+    return f(data, label)
+
+
+register("SVMOutput", _svm_output, arg_names=("data", "label"),
+         defaults={"margin": 1.0, "regularization_coefficient": 1.0,
+                   "use_linear": False})
+
+
+def _identity_attach_kl(attrs, data):
+    return data
+
+
+register("IdentityAttachKLSparseReg", _identity_attach_kl, arg_names=_D,
+         defaults={"sparseness_target": 0.1, "penalty": 0.001,
+                   "momentum": 0.9})
+
+
+def _sync_batch_norm(attrs, data, gamma, beta, moving_mean, moving_var):
+    """Cross-device BatchNorm (reference: contrib/sync_batch_norm.cc).
+    Under pjit/shard_map the batch statistics are computed over the
+    GLOBAL batch automatically (mean over the sharded axis lowers to a
+    psum) — so the dense BatchNorm body IS the synchronized version."""
+    bn = get_op("BatchNorm")
+    return bn.forward(dict(attrs), data, gamma, beta, moving_mean,
+                      moving_var)
+
+
+register("_contrib_SyncBatchNorm", _sync_batch_norm,
+         arg_names=("data", "gamma", "beta", "moving_mean", "moving_var"),
+         defaults={"eps": 1e-3, "momentum": 0.9, "fix_gamma": True,
+                   "use_global_stats": False, "output_mean_var": False,
+                   "ndev": 1, "key": "", "__train__": False},
+         mutable_inputs=(3, 4))
+
+
+def _sparse_embedding(attrs, data, weight):
+    emb = get_op("Embedding")
+    return emb.forward(dict(attrs), data, weight)
+
+
+register("_contrib_SparseEmbedding", _sparse_embedding,
+         arg_names=("data", "weight"),
+         defaults={"input_dim": 0, "output_dim": 0, "dtype": "float32",
+                   "sparse_grad": True})
+
+
+# legacy _v1 aliases: same math, older interface names
+for _v1, _cur in (("BatchNorm_v1", "BatchNorm"),
+                  ("Convolution_v1", "Convolution"),
+                  ("Pooling_v1", "Pooling")):
+    _op = get_op(_cur)
+    register(_v1, _op.forward, arg_names=tuple(_op.arg_names),
+             defaults=dict(_op.defaults),
+             num_outputs=_op.num_outputs,
+             mutable_inputs=_op.mutable_inputs,
+             arg_names_fn=_op.arg_names_fn)

@@ -1,0 +1,260 @@
+"""INT8 quantization operators (reference: src/operator/quantization/ —
+quantize.cc, quantize_v2.cc, dequantize.cc, requantize.cc,
+quantized_conv.cc, quantized_fully_connected.cc, quantized_pooling.cc,
+quantized_flatten.cc, quantized_concat.cc; python flow
+python/mxnet/contrib/quantization.py).
+
+TPU-native design: int8 values live in jnp.int8 arrays; the quantized
+compute ops run the MXU in int8xint8→int32 where XLA supports it
+(jax.lax.dot_general/conv with preferred_element_type=int32), exactly
+the role of the reference's cuDNN/MKLDNN int8 kernels. Ranges ride as
+(min, max) scalar tensors, the reference's calibration contract.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .registry import register
+
+_INT8_RANGE = 127.0
+_INT32_RANGE = 2147483647.0
+_D = ("data",)
+
+
+def _scale_of(mn, mx):
+    amax = jnp.maximum(jnp.abs(mn), jnp.abs(mx))
+    return jnp.maximum(amax, 1e-8) / _INT8_RANGE
+
+
+def _scale32_of(mn, mx):
+    """int32 tensors use the amax/(2^31-1) convention (reference:
+    quantization_utils.h FloatForOneQuantizedLevel<int32>)."""
+    amax = jnp.maximum(jnp.abs(mn), jnp.abs(mx))
+    return jnp.maximum(amax, 1e-30) / _INT32_RANGE
+
+
+def _quantize(attrs, data, min_range, max_range):
+    """float → int8 with given range (reference: quantize.cc)."""
+    scale = _scale_of(min_range, max_range)
+    q = jnp.clip(jnp.round(data / scale), -_INT8_RANGE, _INT8_RANGE)
+    amax = scale * _INT8_RANGE
+    return q.astype(jnp.int8), -amax, amax
+
+
+register("_contrib_quantize", _quantize,
+         arg_names=("data", "min_range", "max_range"),
+         defaults={"out_type": "int8"}, num_outputs=3)
+
+
+def _quantize_v2(attrs, data):
+    """float → int8, range from data or calibrated attrs
+    (reference: quantize_v2.cc)."""
+    mn = attrs.get("min_calib_range")
+    mx = attrs.get("max_calib_range")
+    if mn is None or mx is None:
+        mn = jnp.min(data)
+        mx = jnp.max(data)
+    else:
+        mn = jnp.asarray(float(mn), data.dtype)
+        mx = jnp.asarray(float(mx), data.dtype)
+    out, omin, omax = _quantize(attrs, data, mn, mx)
+    return out, omin, omax
+
+
+register("_contrib_quantize_v2", _quantize_v2, arg_names=_D,
+         defaults={"out_type": "int8", "min_calib_range": None,
+                   "max_calib_range": None},
+         num_outputs=3)
+
+
+def _dequantize(attrs, data, min_range, max_range):
+    """int8 → float (reference: dequantize.cc)."""
+    return data.astype(jnp.float32) * _scale_of(min_range, max_range)
+
+
+register("_contrib_dequantize", _dequantize,
+         arg_names=("data", "min_range", "max_range"),
+         defaults={"out_type": "float32"})
+
+
+def _requantize(attrs, data, min_range, max_range):
+    """int32 accumulator → int8 with a narrowed range
+    (reference: requantize.cc)."""
+    mn = attrs.get("min_calib_range")
+    mx = attrs.get("max_calib_range")
+    real = data.astype(jnp.float32) * _scale32_of(min_range, max_range)
+    if mn is not None and mx is not None:
+        new_min = jnp.asarray(float(mn), jnp.float32)
+        new_max = jnp.asarray(float(mx), jnp.float32)
+    else:
+        new_min = jnp.min(real)
+        new_max = jnp.max(real)
+    scale = _scale_of(new_min, new_max)
+    q = jnp.clip(jnp.round(real / scale), -_INT8_RANGE, _INT8_RANGE)
+    amax = scale * _INT8_RANGE
+    return q.astype(jnp.int8), -amax, amax
+
+
+register("_contrib_requantize", _requantize,
+         arg_names=("data", "min_range", "max_range"),
+         defaults={"out_type": "int8", "min_calib_range": None,
+                   "max_calib_range": None},
+         num_outputs=3)
+
+
+def _out_range(a_min, a_max, b_min, b_max, k):
+    """Declared float range of the int32 accumulator: one accumulator
+    unit is worth a_scale*b_scale, and the int32 range convention maps
+    2^31-1 to amax (the reference's
+    quantization_range_for_multiplication). ``k`` is unused under this
+    convention but kept for signature parity with call sites."""
+    a_scale = _scale_of(a_min, a_max)
+    b_scale = _scale_of(b_min, b_max)
+    amax = a_scale * b_scale * _INT32_RANGE
+    return -amax, amax
+
+
+def _quantized_fully_connected(attrs, *inputs):
+    """int8 GEMM on the MXU with int32 accumulation
+    (reference: quantized_fully_connected.cc)."""
+    no_bias = bool(attrs.get("no_bias", False))
+    if no_bias:
+        data, weight, d_min, d_max, w_min, w_max = inputs
+        bias = b_min = b_max = None
+    else:
+        data, weight, bias, d_min, d_max, w_min, w_max, b_min, b_max = \
+            inputs
+    x2 = data.reshape(data.shape[0], -1) if bool(
+        attrs.get("flatten", True)) else data
+    acc = jax.lax.dot_general(
+        x2.astype(jnp.int8), weight.astype(jnp.int8),
+        (((x2.ndim - 1,), (1,)), ((), ())),
+        preferred_element_type=jnp.int32)
+    omin, omax = _out_range(d_min, d_max, w_min, w_max, x2.shape[-1])
+    if bias is not None:
+        # one accumulator unit is worth a_scale*b_scale in real terms;
+        # rescale the int8 bias into those units before adding
+        acc_unit = _scale_of(d_min, d_max) * _scale_of(w_min, w_max)
+        b_real = bias.astype(jnp.float32) * _scale_of(b_min, b_max)
+        acc = acc + jnp.round(b_real / acc_unit).astype(jnp.int32)
+    return acc, omin, omax
+
+
+register("_contrib_quantized_fully_connected", _quantized_fully_connected,
+         arg_names=("data", "weight", "bias", "min_data", "max_data",
+                    "min_weight", "max_weight", "min_bias", "max_bias"),
+         defaults={"num_hidden": 0, "no_bias": False, "flatten": True},
+         num_outputs=3,
+         arg_names_fn=lambda a: (
+             ["data", "weight", "min_data", "max_data", "min_weight",
+              "max_weight"] if a.get("no_bias") else
+             ["data", "weight", "bias", "min_data", "max_data",
+              "min_weight", "max_weight", "min_bias", "max_bias"]))
+
+
+def _quantized_conv(attrs, *inputs):
+    """int8 convolution with int32 accumulation
+    (reference: quantized_conv.cc)."""
+    bias = b_min = b_max = None
+    if bool(attrs.get("no_bias", True)):
+        data, weight, d_min, d_max, w_min, w_max = inputs
+    else:
+        data, weight, bias, d_min, d_max, w_min, w_max, b_min, b_max = \
+            inputs
+    from .nn import _tup
+    from jax import lax
+    kernel = tuple(attrs["kernel"])
+    nd = len(kernel)
+    stride = _tup(attrs.get("stride"), nd, 1)
+    dilate = _tup(attrs.get("dilate"), nd, 1)
+    pad = _tup(attrs.get("pad"), nd, 0)
+    spec = {1: ("NCW", "OIW", "NCW"), 2: ("NCHW", "OIHW", "NCHW"),
+            3: ("NCDHW", "OIDHW", "NCDHW")}[nd]
+    dn = lax.conv_dimension_numbers(data.shape, weight.shape, spec)
+    acc = lax.conv_general_dilated(
+        data.astype(jnp.int8), weight.astype(jnp.int8),
+        window_strides=stride, padding=[(p, p) for p in pad],
+        rhs_dilation=dilate, dimension_numbers=dn,
+        feature_group_count=int(attrs.get("num_group", 1)),
+        preferred_element_type=jnp.int32)
+    k = int(np.prod(kernel)) * data.shape[1]
+    omin, omax = _out_range(d_min, d_max, w_min, w_max, k)
+    if bias is not None:
+        # rescale the int8 bias into accumulator units (= a·b scales)
+        acc_unit = _scale_of(d_min, d_max) * _scale_of(w_min, w_max)
+        b_real = bias.astype(jnp.float32) * _scale_of(b_min, b_max)
+        b_acc = jnp.round(b_real / acc_unit).astype(jnp.int32)
+        acc = acc + b_acc.reshape((1, -1) + (1,) * nd)
+    return acc, omin, omax
+
+
+register("_contrib_quantized_conv", _quantized_conv,
+         arg_names=("data", "weight", "bias", "min_data", "max_data",
+                    "min_weight", "max_weight", "min_bias", "max_bias"),
+         defaults={"kernel": (), "stride": (), "dilate": (), "pad": (),
+                   "num_filter": 0, "num_group": 1, "no_bias": True,
+                   "layout": None},
+         num_outputs=3,
+         arg_names_fn=lambda a: (
+             ["data", "weight", "min_data", "max_data", "min_weight",
+              "max_weight"] if a.get("no_bias", True) else
+             ["data", "weight", "bias", "min_data", "max_data",
+              "min_weight", "max_weight", "min_bias", "max_bias"]))
+
+
+def _quantized_pooling(attrs, data, d_min, d_max):
+    """Pooling over int8 (reference: quantized_pooling.cc) — range is
+    unchanged; max pool stays exact, avg pool rounds back to int8."""
+    from .nn import _pooling
+    out = _pooling(attrs, data.astype(jnp.float32))
+    if attrs.get("pool_type", "max") == "max":
+        out = out.astype(jnp.int8)
+    else:
+        out = jnp.clip(jnp.round(out), -128, 127).astype(jnp.int8)
+    return out, d_min, d_max
+
+
+register("_contrib_quantized_pooling", _quantized_pooling,
+         arg_names=("data", "min_data", "max_data"),
+         defaults={"kernel": (), "pool_type": "max", "stride": (),
+                   "pad": (), "global_pool": False,
+                   "pooling_convention": "valid", "cudnn_off": False},
+         num_outputs=3)
+
+
+def _quantized_flatten(attrs, data, d_min, d_max):
+    return data.reshape(data.shape[0], -1), d_min, d_max
+
+
+register("_contrib_quantized_flatten", _quantized_flatten,
+         arg_names=("data", "min_data", "max_data"), num_outputs=3)
+
+
+def _quantized_concat(attrs, *inputs):
+    """Concat int8 inputs after rescaling to the widest range
+    (reference: quantized_concat.cc)."""
+    n = int(attrs.get("num_args", len(inputs) // 3))
+    datas = inputs[:n]
+    mins = inputs[n:2 * n]
+    maxs = inputs[2 * n:3 * n]
+    wide_min = mins[0]
+    wide_max = maxs[0]
+    for m in mins[1:]:
+        wide_min = jnp.minimum(wide_min, m)
+    for m in maxs[1:]:
+        wide_max = jnp.maximum(wide_max, m)
+    wide_scale = _scale_of(wide_min, wide_max)
+    parts = []
+    for d, mn, mx in zip(datas, mins, maxs):
+        ratio = _scale_of(mn, mx) / wide_scale
+        parts.append(jnp.clip(jnp.round(d.astype(jnp.float32) * ratio),
+                              -128, 127).astype(jnp.int8))
+    axis = int(attrs.get("dim", 1))
+    return jnp.concatenate(parts, axis=axis), wide_min, wide_max
+
+
+register("_contrib_quantized_concat", _quantized_concat,
+         arg_names=("data",), defaults={"num_args": 1, "dim": 1},
+         key_var_num_args="__qconcat_args__", num_outputs=3)
