@@ -26,24 +26,41 @@ _QUANTIZABLE = {"FullyConnected", "Convolution"}
 
 
 def _collect_layer_ranges(symbol, arg_params, aux_params, ctx,
-                          calib_data, num_calib_batches, data_name):
+                          calib_data, num_calib_batches, data_name,
+                          label_names=()):
     """Run calibration batches eagerly, recording min/max of every
-    quantizable node's input and output (naive calibration)."""
+    quantizable node's input and output (naive calibration). Label
+    variables get the batch's labels when provided, else zeros — loss
+    heads like SoftmaxOutput pass activations through unchanged, so
+    the recorded ranges are label-independent."""
     from ..ndarray.ndarray import invoke_nd
     ranges = {}
     batches = 0
     for batch in calib_data:
         datas = batch.data if hasattr(batch, "data") else [batch]
         x = datas[0]
+        labels = list(getattr(batch, "label", None) or [])
         env = {}
+        label_cursor = [0]
+
+        def _label_value():
+            if label_cursor[0] < len(labels):
+                val = labels[label_cursor[0]]
+                label_cursor[0] += 1
+                return val
+            return nd.zeros((x.shape[0],))
+
         for node in symbol._topo_nodes():
             if node.is_variable():
                 if node.name == data_name:
                     env[(id(node), 0)] = x
                 elif node.name in arg_params:
                     env[(id(node), 0)] = arg_params[node.name]
-                else:
+                elif node.name in aux_params:
                     env[(id(node), 0)] = aux_params[node.name]
+                else:
+                    # label (or other unbound) variable
+                    env[(id(node), 0)] = _label_value()
                 continue
             ins = [env[(id(s), i)] for (s, i) in node.inputs]
             outs = invoke_nd(node.op, ins, dict(node.attrs))
@@ -164,7 +181,8 @@ def quantize_model(sym, arg_params, aux_params, data_names=("data",),
             num_calib_batches = max(1, -(-int(num_calib_examples) // bs))
         ranges = _collect_layer_ranges(
             sym, arg_params, aux_params, ctx, calib_data,
-            num_calib_batches, data_names[0])
+            num_calib_batches, data_names[0],
+            label_names=tuple(label_names or ()))
     qsym = quantize_symbol(sym, excluded_symbols=set(excluded_sym_names),
                            calib_ranges=ranges)
     return qsym, dict(arg_params), dict(aux_params)

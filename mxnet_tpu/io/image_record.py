@@ -1,0 +1,193 @@
+"""ImageRecordIter — the flagship image input path (reference:
+src/io/iter_image_recordio_2.cc:748 + PrefetcherIter/BatchLoader
+layering, SURVEY §3.5).
+
+Design: one reader walks the .rec file (keyed by the .idx sidecar when
+present), a thread pool decodes + augments images ahead of the
+consumer (cv2/PIL release the GIL during JPEG decode — the role of the
+reference's OMP parser threads), and whole batches land as NDArrays.
+Augmentations cover the training-relevant core of
+image_aug_default.cc: resize-shorter-edge, random/center crop, random
+mirror, mean/std normalization.
+"""
+from __future__ import annotations
+
+import concurrent.futures
+import logging
+import os
+import threading
+
+import numpy as np
+
+from ..base import MXNetError
+from ..recordio import MXRecordIO, MXIndexedRecordIO, unpack
+from .io import DataBatch, DataDesc, DataIter
+
+__all__ = ["ImageRecordIter"]
+
+
+def _decode_jpeg(payload):
+    try:
+        import cv2
+        img = cv2.imdecode(np.frombuffer(payload, np.uint8),
+                           cv2.IMREAD_COLOR)
+        return img[:, :, ::-1]                  # BGR → RGB
+    except ImportError:
+        pass
+    import io as _io
+    from PIL import Image
+    return np.asarray(Image.open(_io.BytesIO(payload)).convert("RGB"))
+
+
+def _resize_shorter(img, size):
+    import math
+    h, w = img.shape[:2]
+    if min(h, w) == size:
+        return img
+    if h < w:
+        nh, nw = size, max(1, int(round(w * size / h)))
+    else:
+        nh, nw = max(1, int(round(h * size / w))), size
+    try:
+        import cv2
+        return cv2.resize(img, (nw, nh), interpolation=cv2.INTER_LINEAR)
+    except ImportError:
+        from PIL import Image
+        return np.asarray(Image.fromarray(img).resize((nw, nh)))
+
+
+class ImageRecordIter(DataIter):
+    """Batched, augmented iteration over an image RecordIO file
+    (reference: ImageRecordIter, iter_image_recordio_2.cc:748)."""
+
+    def __init__(self, path_imgrec, data_shape, batch_size,
+                 path_imgidx=None, label_width=1, shuffle=False,
+                 rand_crop=False, rand_mirror=False, resize=-1,
+                 mean_r=0.0, mean_g=0.0, mean_b=0.0,
+                 std_r=1.0, std_g=1.0, std_b=1.0, scale=1.0,
+                 preprocess_threads=4, prefetch_buffer=4, seed=0,
+                 data_name="data", label_name="softmax_label", **kwargs):
+        super().__init__(batch_size)
+        if len(data_shape) != 3:
+            raise MXNetError(
+                "ImageRecordIter data_shape must be (C, H, W), got %s"
+                % (data_shape,))
+        self._shape = tuple(int(s) for s in data_shape)
+        self._label_width = int(label_width)
+        self._shuffle = shuffle
+        self._rand_crop = rand_crop
+        self._rand_mirror = rand_mirror
+        self._resize = int(resize)
+        self._mean = np.asarray([mean_r, mean_g, mean_b], np.float32)
+        self._std = np.asarray([std_r, std_g, std_b], np.float32)
+        self._scale = float(scale)
+        self._rng = np.random.RandomState(seed)
+        self._pool = concurrent.futures.ThreadPoolExecutor(
+            max_workers=max(1, int(preprocess_threads)),
+            thread_name_prefix="imgrec")
+        self._depth = max(1, int(prefetch_buffer))
+
+        if path_imgidx and os.path.exists(path_imgidx):
+            self._rec = MXIndexedRecordIO(path_imgidx, path_imgrec, "r")
+            self._keys = list(self._rec.keys)
+        else:
+            if shuffle:
+                raise MXNetError(
+                    "ImageRecordIter(shuffle=True) needs the .idx "
+                    "sidecar (pass path_imgidx; im2rec writes one) — "
+                    "sequential .rec scans cannot be shuffled")
+            self._rec = MXRecordIO(path_imgrec, "r")
+            self._keys = None           # sequential-scan mode
+        self._lock = threading.Lock()   # serializes record reads
+
+        c, h, w = self._shape
+        self.provide_data = [DataDesc(data_name,
+                                      (batch_size, c, h, w))]
+        lshape = (batch_size,) if self._label_width == 1 \
+            else (batch_size, self._label_width)
+        self.provide_label = [DataDesc(label_name, lshape)]
+        self.reset()
+
+    # -- record access ----------------------------------------------------
+    def _read_raw(self, key):
+        with self._lock:
+            if key is None:
+                return self._rec.read()
+            return self._rec.read_idx(key)
+
+    def _epoch_keys(self):
+        if self._keys is None:
+            return None
+        order = list(self._keys)
+        if self._shuffle:
+            self._rng.shuffle(order)
+        return order
+
+    # -- decode + augment -------------------------------------------------
+    def _prepare(self, payload, mirror, crop_pos):
+        header, body = unpack(payload)
+        img = _decode_jpeg(body).astype(np.float32)
+        c, th, tw = self._shape
+        if self._resize > 0:
+            img = _resize_shorter(img.astype(np.uint8),
+                                  self._resize).astype(np.float32)
+        h, w = img.shape[:2]
+        if h < th or w < tw:
+            img = _resize_shorter(img.astype(np.uint8),
+                                  max(th, tw)).astype(np.float32)
+            h, w = img.shape[:2]
+        if self._rand_crop:
+            oy = int(crop_pos[0] * (h - th))
+            ox = int(crop_pos[1] * (w - tw))
+        else:
+            oy, ox = (h - th) // 2, (w - tw) // 2
+        img = img[oy:oy + th, ox:ox + tw]
+        if mirror:
+            img = img[:, ::-1]
+        img = (img - self._mean) / self._std * self._scale
+        chw = np.transpose(img, (2, 0, 1))
+        label = np.asarray(header.label, np.float32).reshape(-1)
+        if label.size == 0:
+            label = np.zeros((self._label_width,), np.float32)
+        return chw, label[:self._label_width]
+
+    def _load_batch(self, keys):
+        payloads = []
+        for k in keys:
+            raw = self._read_raw(k)
+            if raw is None:
+                return None
+            payloads.append(raw)
+        mirrors = self._rng.rand(len(payloads)) < 0.5 \
+            if self._rand_mirror else [False] * len(payloads)
+        crops = self._rng.rand(len(payloads), 2)
+        futures = [self._pool.submit(self._prepare, p, m, cp)
+                   for p, m, cp in zip(payloads, mirrors, crops)]
+        images, labels = zip(*[f.result() for f in futures])
+        from ..ndarray import array as nd_array
+        data = nd_array(np.stack(images))
+        lab = np.stack(labels)
+        if self._label_width == 1:
+            lab = lab[:, 0]
+        return DataBatch([data], [nd_array(lab)], pad=0)
+
+    # -- DataIter protocol ------------------------------------------------
+    def reset(self):
+        self._order = self._epoch_keys()
+        self._cursor = 0
+        if self._keys is None:
+            self._rec.reset()
+
+    def next(self):
+        bs = self.batch_size
+        if self._order is not None:
+            if self._cursor + bs > len(self._order):
+                raise StopIteration
+            keys = self._order[self._cursor:self._cursor + bs]
+            self._cursor += bs
+        else:
+            keys = [None] * bs
+        batch = self._load_batch(keys)
+        if batch is None:
+            raise StopIteration
+        return batch

@@ -121,8 +121,17 @@ class Module(BaseModule):
     @property
     def output_shapes(self):
         assert self.binded
-        return [(name, tuple(o.shape)) for name, o in
-                zip(self._output_names, self._exec.outputs)]
+        outs = getattr(self._exec, "outputs", None)
+        if outs and all(o is not None for o in outs):
+            return [(name, tuple(o.shape)) for name, o in
+                    zip(self._output_names, outs)]
+        # before the first forward: infer from the bound input shapes
+        feed = {d.name: tuple(d.shape) for d in self._data_shapes}
+        for d in (self._label_shapes or []):
+            feed[d.name] = tuple(d.shape)
+        _, out_shapes, _ = self._symbol.infer_shape(**feed)
+        return list(zip(self._output_names,
+                        [tuple(s) for s in out_shapes]))
 
     # -- params ----------------------------------------------------------
     def get_params(self):

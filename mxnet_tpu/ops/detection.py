@@ -108,11 +108,14 @@ def _multibox_target(attrs, anchor, label, cls_pred):
         best_gt = jnp.argmax(iou, axis=1)                # (N,)
         best_iou = jnp.max(iou, axis=1)
         matched = best_iou >= overlap_thr
-        # force-match the best anchor of every valid gt
+        # force-match the best anchor of every valid gt. Padding rows
+        # (cls = -1) scatter into a dummy slot N so they can never
+        # clobber a real gt's forced match.
         best_anchor = jnp.argmax(iou, axis=0)            # (M,)
-        forced = jnp.zeros((N,), bool).at[best_anchor].set(gt_valid)
-        gt_for_forced = jnp.zeros((N,), jnp.int32).at[best_anchor].set(
-            jnp.arange(lab.shape[0], dtype=jnp.int32))
+        slot = jnp.where(gt_valid, best_anchor, N)
+        forced = jnp.zeros((N + 1,), bool).at[slot].set(True)[:N]
+        gt_for_forced = jnp.zeros((N + 1,), jnp.int32).at[slot].set(
+            jnp.arange(lab.shape[0], dtype=jnp.int32))[:N]
         matched = matched | forced
         assigned = jnp.where(forced, gt_for_forced,
                              best_gt.astype(jnp.int32))

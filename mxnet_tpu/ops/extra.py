@@ -124,6 +124,7 @@ def _correlation(attrs, data1, data2):
     stride1 = int(attrs.get("stride1", 1))
     stride2 = int(attrs.get("stride2", 1))
     ksize = int(attrs.get("kernel_size", 1))
+    multiply = bool(attrs.get("is_multiply", True))
     kr = (ksize - 1) // 2
     pad = max_disp + kr
     B, C, H, W = data1.shape
@@ -142,7 +143,8 @@ def _correlation(attrs, data1, data2):
                     b = jax.lax.dynamic_slice(
                         p2, (0, 0, pad + dy - kr + ky,
                              pad + dx - kr + kx), (B, C, H, W))
-                    acc = acc + jnp.sum(a * b, axis=1)
+                    term = a * b if multiply else jnp.abs(a - b)
+                    acc = acc + jnp.sum(term, axis=1)
             maps.append(acc / norm)
     out = jnp.stack(maps, axis=1)
     return out[:, :, ::stride1, ::stride1]

@@ -1,0 +1,182 @@
+"""ImageRecordIter + im2rec, SequentialModule/PythonModule, 2-bit
+gradient compression (VERDICT r2 missing items 7, 9, 10-partial)."""
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.test_utils import default_context
+
+
+def _make_image_tree(root, classes=2, per_class=6, size=(40, 32)):
+    from PIL import Image
+    rng = np.random.RandomState(0)
+    truth = {}
+    for c in range(classes):
+        d = os.path.join(root, "class%d" % c)
+        os.makedirs(d, exist_ok=True)
+        for i in range(per_class):
+            # distinct mean per class so labels are checkable post-decode
+            base = np.full(size + (3,), 40 + 120 * c, np.uint8)
+            noise = rng.randint(0, 20, size + (3,), dtype=np.uint8)
+            img = np.clip(base + noise, 0, 255).astype(np.uint8)
+            Image.fromarray(img).save(
+                os.path.join(d, "img%d.jpg" % i), quality=95)
+        truth["class%d" % c] = c
+    return truth
+
+
+class TestIm2RecAndImageRecordIter:
+    def test_end_to_end(self, tmp_path):
+        from mxnet_tpu.tools import im2rec as tool
+        root = str(tmp_path / "imgs")
+        prefix = str(tmp_path / "data")
+        _make_image_tree(root)
+        lst, classes = tool.make_list(root, prefix)
+        assert len(classes) == 2
+        n = tool.im2rec(lst, root, prefix, quality=95)
+        assert n == 12
+        assert os.path.exists(prefix + ".rec")
+        assert os.path.exists(prefix + ".idx")
+
+        it = mx.io.ImageRecordIter(
+            path_imgrec=prefix + ".rec", path_imgidx=prefix + ".idx",
+            data_shape=(3, 28, 28), batch_size=4, shuffle=True,
+            rand_mirror=True, preprocess_threads=2)
+        seen = 0
+        labels = []
+        for batch in it:
+            data = batch.data[0]
+            assert data.shape == (4, 3, 28, 28)
+            lab = batch.label[0].asnumpy()
+            img = data.asnumpy()
+            # class 1 images are bright (~160), class 0 dark (~50)
+            for b in range(4):
+                mean = img[b].mean()
+                want = 1.0 if mean > 100 else 0.0
+                assert lab[b] == want, (mean, lab[b])
+            labels.extend(lab.tolist())
+            seen += 4
+        assert seen == 12
+        assert set(labels) == {0.0, 1.0}
+        it.reset()
+        assert sum(1 for _ in it) == 3
+
+    def test_mean_std_normalization(self, tmp_path):
+        from mxnet_tpu.tools import im2rec as tool
+        root = str(tmp_path / "imgs")
+        prefix = str(tmp_path / "d2")
+        _make_image_tree(root, classes=1, per_class=2)
+        lst, _ = tool.make_list(root, prefix)
+        tool.im2rec(lst, root, prefix)
+        it = mx.io.ImageRecordIter(
+            path_imgrec=prefix + ".rec", path_imgidx=prefix + ".idx",
+            data_shape=(3, 28, 28), batch_size=2,
+            mean_r=50.0, mean_g=50.0, mean_b=50.0,
+            std_r=20.0, std_g=20.0, std_b=20.0)
+        batch = next(iter(it))
+        # class-0 pixels ~N(50, small) -> normalized near 0
+        assert abs(float(batch.data[0].asnumpy().mean())) < 1.0
+
+
+class TestSequentialModule:
+    def test_two_stage_training(self):
+        B, I, H, C = 8, 10, 16, 3
+        d1 = mx.sym.var("data")
+        feat = mx.sym.Activation(
+            mx.sym.FullyConnected(d1, num_hidden=H, name="fc1"),
+            act_type="relu", name="act1")
+        m1 = mx.mod.Module(feat, data_names=("data",), label_names=None,
+                           context=default_context())
+
+        d2 = mx.sym.var("data")
+        lbl = mx.sym.var("softmax_label")
+        out = mx.sym.SoftmaxOutput(
+            mx.sym.FullyConnected(d2, num_hidden=C, name="fc2"), lbl,
+            name="softmax")
+        m2 = mx.mod.Module(out, data_names=("data",),
+                           label_names=("softmax_label",),
+                           context=default_context())
+
+        seq = mx.mod.SequentialModule()
+        seq.add(m1).add(m2, take_labels=True)
+
+        from mxnet_tpu.io.io import DataDesc, DataBatch
+        seq.bind(data_shapes=[DataDesc("data", (B, I))],
+                 label_shapes=[DataDesc("softmax_label", (B,))])
+        seq.init_params(mx.init.Xavier())
+        seq.init_optimizer(optimizer="sgd",
+                           optimizer_params={"learning_rate": 0.5})
+
+        rng = np.random.RandomState(0)
+        x = rng.randn(B, I).astype(np.float32)
+        y = rng.randint(0, C, (B,)).astype(np.float32)
+        batch = DataBatch([mx.nd.array(x)], [mx.nd.array(y)])
+
+        losses = []
+        for _ in range(25):
+            seq.forward(batch, is_train=True)
+            probs = seq.get_outputs()[0].asnumpy()
+            picked = probs[np.arange(B), y.astype(np.int64)]
+            losses.append(-np.log(np.maximum(picked, 1e-9)).mean())
+            seq.backward()
+            seq.update()
+        assert losses[-1] < losses[0] * 0.5, (losses[0], losses[-1])
+
+
+class TestPythonLossModule:
+    def test_pipeline_with_python_tail(self):
+        B, I, C = 6, 8, 4
+        data = mx.sym.var("data")
+        net = mx.sym.softmax(
+            mx.sym.FullyConnected(data, num_hidden=C, name="fc"))
+        m1 = mx.mod.Module(net, data_names=("data",), label_names=None,
+                           context=default_context())
+        tail = mx.mod.PythonLossModule()
+
+        seq = mx.mod.SequentialModule()
+        seq.add(m1).add(tail, take_labels=True)
+        from mxnet_tpu.io.io import DataDesc, DataBatch
+        seq.bind(data_shapes=[DataDesc("data", (B, I))],
+                 label_shapes=[DataDesc("softmax_label", (B,))])
+        seq.init_params(mx.init.Xavier())
+        seq.init_optimizer(optimizer="sgd",
+                           optimizer_params={"learning_rate": 2.0})
+        rng = np.random.RandomState(1)
+        x = rng.randn(B, I).astype(np.float32)
+        y = rng.randint(0, C, (B,)).astype(np.float32)
+        batch = DataBatch([mx.nd.array(x)], [mx.nd.array(y)])
+        first = last = None
+        for _ in range(60):
+            seq.forward(batch, is_train=True)
+            probs = seq.get_outputs()[0].asnumpy()
+            loss = -np.log(np.maximum(
+                probs[np.arange(B), y.astype(np.int64)], 1e-9)).mean()
+            first = loss if first is None else first
+            last = loss
+            seq.backward()
+            seq.update()
+        assert last < first * 0.7, (first, last)
+
+
+class TestGradientCompression:
+    def test_two_bit_quantization_and_feedback(self):
+        kv = mx.kv.create("device")
+        kv.set_gradient_compression({"type": "2bit", "threshold": 0.5})
+        g = mx.nd.array([0.3, 0.7, -0.9, 0.1])
+        kv.init(0, mx.nd.zeros((4,)))
+        kv.push(0, g)
+        out = mx.nd.zeros((4,))
+        kv.pull(0, out=out)
+        # quantized to {-t, 0, +t}
+        np.testing.assert_allclose(out.asnumpy(), [0.0, 0.5, -0.5, 0.0])
+        # error feedback: residual [0.3, 0.2, -0.4, 0.1] joins push 2
+        kv.push(0, g)
+        kv.pull(0, out=out)
+        np.testing.assert_allclose(out.asnumpy(), [0.5, 0.5, -0.5, 0.0])
+
+    def test_rejects_unknown_type(self):
+        kv = mx.kv.create("device")
+        with pytest.raises(ValueError):
+            kv.set_gradient_compression({"type": "fp8"})

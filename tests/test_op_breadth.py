@@ -460,3 +460,65 @@ class TestReviewRegressions:
                 patch = pad[0, :, i:i + 3, j:j + 3]
                 want[i, j] = (patch * patch).sum() / (2 * 9)
         np.testing.assert_allclose(out[0, 0], want, atol=1e-4)
+
+
+class TestReviewRegressions2:
+    def test_multibox_target_with_padding_rows(self):
+        """Padded gt rows (cls=-1) must not clobber anchor 0's forced
+        match."""
+        anchors = np.array([[[0.0, 0.0, 0.2, 0.2],
+                             [0.5, 0.5, 0.9, 0.9]]], np.float32)
+        label = np.array([[[1.0, 0.0, 0.0, 0.35, 0.35],
+                           [-1.0, 0, 0, 0, 0],
+                           [-1.0, 0, 0, 0, 0]]], np.float32)
+        cls_pred = np.zeros((1, 3, 2), np.float32)
+        _, _, cls_t = run("_contrib_MultiBoxTarget",
+                          [anchors, label, cls_pred], {})
+        assert cls_t[0, 0] == 2.0, cls_t    # forced match survived
+
+    def test_correlation_subtract_mode(self):
+        a = np.ones((1, 1, 3, 3), np.float32)
+        b = np.zeros((1, 1, 3, 3), np.float32)
+        out = run("Correlation", [a, b],
+                  {"max_displacement": 0, "is_multiply": False})
+        np.testing.assert_allclose(out[0, 0], 1.0)
+        out2 = run("Correlation", [a, b],
+                   {"max_displacement": 0, "is_multiply": True})
+        np.testing.assert_allclose(out2[0, 0], 0.0)
+
+    def test_quantize_model_with_loss_head(self):
+        import mxnet_tpu as mx
+        from mxnet_tpu.contrib.quantization import quantize_model
+        rng = np.random.RandomState(5)
+        data = mx.sym.var("data")
+        lbl = mx.sym.var("softmax_label")
+        fc = mx.sym.FullyConnected(data, num_hidden=4, name="fcq")
+        out = mx.sym.SoftmaxOutput(fc, lbl, name="softmax")
+        args = {"fcq_weight": mx.nd.array(
+                    rng.randn(4, 6).astype(np.float32)),
+                "fcq_bias": mx.nd.zeros((4,))}
+        x = mx.nd.array(rng.randn(3, 6).astype(np.float32))
+
+        class OneBatch:
+            def __iter__(self):
+                return iter([type("B", (), {"data": [x],
+                                            "label": None})()])
+
+            def reset(self):
+                pass
+
+        qsym, qargs, _ = quantize_model(
+            out, args, {}, calib_mode="naive", calib_data=OneBatch(),
+            num_calib_batches=1)
+        assert any("quantized" in n.name for n in qsym._topo_nodes())
+
+    def test_image_record_shuffle_without_idx_raises(self, tmp_path):
+        import mxnet_tpu as mx
+        from mxnet_tpu.recordio import MXRecordIO
+        rec = MXRecordIO(str(tmp_path / "x.rec"), "w")
+        rec.write(b"payload")
+        rec.close()
+        with pytest.raises(mx.base.MXNetError, match="idx"):
+            mx.io.ImageRecordIter(path_imgrec=str(tmp_path / "x.rec"),
+                                  data_shape=(3, 8, 8), batch_size=1,
+                                  shuffle=True)
