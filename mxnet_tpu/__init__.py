@@ -56,6 +56,8 @@ from . import visualization as viz
 from . import profiler
 from . import model
 from . import rnn
+from . import storage
+from . import contrib
 from .model import save_checkpoint, load_checkpoint
 from . import module
 from . import module as mod

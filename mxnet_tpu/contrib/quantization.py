@@ -26,8 +26,7 @@ _QUANTIZABLE = {"FullyConnected", "Convolution"}
 
 
 def _collect_layer_ranges(symbol, arg_params, aux_params, ctx,
-                          calib_data, num_calib_batches, data_name,
-                          label_names=()):
+                          calib_data, num_calib_batches, data_name):
     """Run calibration batches eagerly, recording min/max of every
     quantizable node's input and output (naive calibration). Label
     variables get the batch's labels when provided, else zeros — loss
@@ -181,8 +180,7 @@ def quantize_model(sym, arg_params, aux_params, data_names=("data",),
             num_calib_batches = max(1, -(-int(num_calib_examples) // bs))
         ranges = _collect_layer_ranges(
             sym, arg_params, aux_params, ctx, calib_data,
-            num_calib_batches, data_names[0],
-            label_names=tuple(label_names or ()))
+            num_calib_batches, data_names[0])
     qsym = quantize_symbol(sym, excluded_symbols=set(excluded_sym_names),
                            calib_ranges=ranges)
     return qsym, dict(arg_params), dict(aux_params)

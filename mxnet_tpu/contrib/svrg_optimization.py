@@ -1,0 +1,125 @@
+"""SVRG — stochastic variance-reduced gradient training (reference:
+python/mxnet/contrib/svrg_optimization/{svrg_module,svrg_optimizer}.py).
+
+The recipe: every ``update_freq`` epochs snapshot the parameters and
+compute the FULL-dataset gradient at the snapshot; each step then uses
+the corrected gradient  g_i(w) - g_i(w_snap) + g_full(w_snap), which
+has the same expectation as g_i(w) but shrinking variance.
+"""
+from __future__ import annotations
+
+import logging
+
+import numpy as np
+
+from ..base import MXNetError
+from .. import ndarray as nd
+from ..module import Module
+
+__all__ = ["SVRGModule"]
+
+
+class SVRGModule(Module):
+    """Module with SVRG-corrected updates (reference:
+    svrg_module.py:29). Call :meth:`update_full_grads` once per
+    ``update_freq`` epochs, then train normally."""
+
+    def __init__(self, symbol, data_names=("data",),
+                 label_names=("softmax_label",), logger=logging,
+                 context=None, update_freq=2, **kwargs):
+        super().__init__(symbol, data_names=data_names,
+                         label_names=label_names, logger=logger,
+                         context=context, **kwargs)
+        if update_freq < 1:
+            raise MXNetError("update_freq must be >= 1")
+        self.update_freq = update_freq
+        self._snap_params = None        # params at snapshot
+        self._full_grads = None         # full grad at snapshot
+        self._snap_mod = None
+
+    def _ensure_snapshot_module(self):
+        if self._snap_mod is None:
+            self._snap_mod = Module(self._symbol,
+                                    data_names=self.data_names,
+                                    label_names=self.label_names,
+                                    context=self._context)
+            self._snap_mod.bind(self.data_shapes, self.label_shapes,
+                                for_training=True, grad_req="add")
+        return self._snap_mod
+
+    def update_full_grads(self, train_data):
+        """Snapshot current params and accumulate the full-dataset
+        gradient at that snapshot (reference: svrg_module.py:214)."""
+        assert self.binded and self.params_initialized
+        args, auxs = self.get_params()
+        self._snap_params = {k: v.copy() for k, v in args.items()}
+        mod = self._ensure_snapshot_module()
+        mod.init_params(arg_params=args, aux_params=auxs,
+                        allow_missing=False, force_init=True)
+        for g in mod._exec.grad_arrays:
+            if g is not None:
+                g[:] = 0
+        train_data.reset()
+        n_batches = 0
+        for batch in train_data:
+            mod.forward(batch, is_train=True)
+            mod.backward()
+            n_batches += 1
+        train_data.reset()
+        self._full_grads = {}
+        for name, g in zip(mod._exec.arg_names,
+                           mod._exec.grad_arrays):
+            if g is not None:
+                self._full_grads[name] = g / float(n_batches)
+
+    def _svrg_correct(self, batch):
+        """g(w) - g(w_snap) + g_full — leaves the corrected gradient in
+        this module's grad arrays."""
+        mod = self._ensure_snapshot_module()
+        args, auxs = self.get_params()
+        mod.init_params(arg_params=self._snap_params, aux_params=auxs,
+                        allow_missing=False, force_init=True)
+        for g in mod._exec.grad_arrays:
+            if g is not None:
+                g[:] = 0
+        mod.forward(batch, is_train=True)
+        mod.backward()
+        snap_grads = dict(zip(mod._exec.arg_names,
+                              mod._exec.grad_arrays))
+        for name, g in zip(self._exec.arg_names,
+                           self._exec.grad_arrays):
+            if g is None:
+                continue
+            sg = snap_grads.get(name)
+            fg = self._full_grads.get(name)
+            if sg is not None and fg is not None:
+                g[:] = g - sg + fg
+
+    def forward_backward(self, data_batch):
+        self.forward(data_batch, is_train=True)
+        self.backward()
+        if self._full_grads is not None:
+            self._svrg_correct(data_batch)
+
+    def fit(self, train_data, **kwargs):
+        """Standard fit loop with a full-grad snapshot every
+        ``update_freq`` epochs (reference: svrg_module.py:351)."""
+        begin_epoch = kwargs.get("begin_epoch", 0)
+        epoch_cb = kwargs.pop("epoch_end_callback", None)
+
+        # snapshot before the very first epoch, then per update_freq
+        def wrapped_epoch_cb(epoch, *cb_args):
+            if (epoch + 1 - begin_epoch) % self.update_freq == 0:
+                self.update_full_grads(train_data)
+            if epoch_cb is not None:
+                epoch_cb(epoch, *cb_args)
+
+        self.bind(train_data.provide_data, train_data.provide_label,
+                  for_training=True)
+        if not self.params_initialized:
+            from ..initializer import Uniform
+            self.init_params(kwargs.get("initializer", Uniform(0.01)))
+        self.update_full_grads(train_data)
+        return super().fit(train_data,
+                           epoch_end_callback=wrapped_epoch_cb,
+                           **kwargs)

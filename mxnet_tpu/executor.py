@@ -163,6 +163,8 @@ class Executor:
                             aux_wb.append(aux_pos[src.name])
                         else:
                             aux_wb.append(None)
+                self._plan_names = getattr(self, "_plan_names", [])
+                self._plan_names.append(nd_.name)
                 self._plan.append((nd_.op, nattrs, tuple(bindings), rs,
                                    aux_wb, slot))
                 node_slot[id(nd_)] = ("res", slot)
@@ -179,13 +181,14 @@ class Executor:
 
     def _make_graph_fn(self, is_train):
         plan = self._plan
+        plan_names = getattr(self, "_plan_names", [])
         head_refs = self._head_refs
         n_aux = len(self.aux_names)
-
         def run(arg_vals, aux_vals, rng_keys):
             results: List[tuple] = []
             new_aux = list(aux_vals)
-            for (op, nattrs, bindings, rs, aux_wb, slot) in plan:
+            for pi, (op, nattrs, bindings, rs, aux_wb, slot) \
+                    in enumerate(plan):
                 vals = []
                 for b in bindings:
                     if b[0] == "arg":
@@ -204,6 +207,14 @@ class Executor:
                 if not isinstance(out, (tuple, list)):
                     out = (out,)
                 n_out = op.resolve_num_outputs(attrs)
+                if getattr(self, "_tap_eager", False):
+                    # per-op monitor taps: only reached on the eager
+                    # interpreted debug path (_forward_monitored) —
+                    # values here are concrete arrays
+                    for oi in range(n_out):
+                        tag = plan_names[pi] + "_output" + \
+                            (str(oi) if n_out > 1 else "")
+                        self._host_tap(tag, out[oi])
                 results.append(tuple(out[:n_out]))
                 extras = out[n_out:]
                 for wb, val in zip(aux_wb, extras):
@@ -333,9 +344,25 @@ class Executor:
 
     def forward(self, is_train=False, **kwargs):
         args, aux = self._gather_inputs(kwargs)
-        fn = self._get_fn("fwd", bool(is_train))
         rngs = self._rngs()
         self._last_rngs = rngs  # backward() must replay this draw
+        if self._monitor_callback is not None and \
+                getattr(self, "_monitor_all", False):
+            # per-op monitoring runs the plan EAGERLY (interpreted,
+            # like the reference's NaiveEngine debug mode) so every
+            # intermediate can be tapped on any backend — the tunnel's
+            # PJRT has no host-callback support inside compiled code
+            self._tap_eager = True
+            try:
+                run = self._make_graph_fn(bool(is_train))
+                outs, new_aux = run(args, aux, rngs)
+            finally:
+                self._tap_eager = False
+            self._store_outputs(outs)
+            if is_train:
+                self._store_aux(new_aux)
+            return self.outputs
+        fn = self._get_fn("fwd", bool(is_train))
         outs, new_aux = fn(args, aux, rngs)
         self._store_outputs(outs)
         if is_train:
@@ -464,6 +491,15 @@ class Executor:
 
     def set_monitor_callback(self, callback, monitor_all=False):
         self._monitor_callback = callback
+        self._monitor_all = monitor_all
+        self._fns.clear()       # rebuild programs with per-op taps
+
+    def _host_tap(self, name, value):
+        """jax.debug.callback target: value arrives as host numpy."""
+        from .ndarray import array as nd_array
+        cb = self._monitor_callback
+        if cb is not None:
+            cb(name, nd_array(value))
 
     def _run_monitor(self):
         for name, out in zip(self.output_names, self.outputs):

@@ -158,6 +158,9 @@ class ImageRecordIter(DataIter):
             if raw is None:
                 return None
             payloads.append(raw)
+        return self._assemble(payloads)
+
+    def _assemble(self, payloads):
         mirrors = self._rng.rand(len(payloads)) < 0.5 \
             if self._rand_mirror else [False] * len(payloads)
         crops = self._rng.rand(len(payloads), 2)
@@ -181,13 +184,47 @@ class ImageRecordIter(DataIter):
     def next(self):
         bs = self.batch_size
         if self._order is not None:
-            if self._cursor + bs > len(self._order):
+            if self._cursor >= len(self._order):
                 raise StopIteration
             keys = self._order[self._cursor:self._cursor + bs]
             self._cursor += bs
-        else:
-            keys = [None] * bs
-        batch = self._load_batch(keys)
-        if batch is None:
+            pad = bs - len(keys)
+            if pad:
+                # round_batch semantics: wrap to the epoch start and
+                # report the pad count so score()/metrics can mask
+                keys = keys + self._order[:pad]
+            batch = self._load_batch(keys)
+            if batch is None:
+                raise StopIteration
+            batch.pad = pad
+            return batch
+        # sequential scan: read up to bs records, pad from this batch
+        payloads = []
+        for _ in range(bs):
+            raw = self._read_raw(None)
+            if raw is None:
+                break
+            payloads.append(raw)
+        if not payloads:
             raise StopIteration
+        pad = bs - len(payloads)
+        if pad:
+            reps = [payloads[i % len(payloads)] for i in range(pad)]
+            payloads = payloads + reps
+        batch = self._assemble(payloads)
+        batch.pad = pad
         return batch
+
+    def close(self):
+        """Shut the decode pool and the record reader down."""
+        pool = getattr(self, "_pool", None)
+        if pool is not None:
+            pool.shutdown(wait=False)
+            self._pool = None
+        rec = getattr(self, "_rec", None)
+        if rec is not None:
+            rec.close()
+            self._rec = None
+
+    def __del__(self):
+        self.close()

@@ -99,7 +99,9 @@ class SequentialModule(BaseModule):
         for i, (m, meta) in enumerate(zip(self._modules, self._metas)):
             labels = label_shapes if meta.get(self.META_TAKE_LABELS) \
                 else None
-            need_grad = inputs_need_grad if i == 0 else True
+            need_grad = inputs_need_grad if i == 0 \
+                else for_training          # grads flow between stages
+                                           # only when training
             m.bind(cur_shapes, labels, for_training=for_training,
                    inputs_need_grad=need_grad,
                    force_rebind=force_rebind, grad_req=grad_req)
@@ -162,14 +164,14 @@ class SequentialModule(BaseModule):
         return self._modules[0].get_input_grads(merge_multi_context)
 
     def update_metric(self, eval_metric, labels, pre_sliced=False):
-        for m, meta in zip(self._modules, self._metas):
-            if meta.get(self.META_TAKE_LABELS):
+        takers = [m for m, meta in zip(self._modules, self._metas)
+                  if meta.get(self.META_TAKE_LABELS)]
+        if takers:
+            for m in takers:
                 m.update_metric(eval_metric, labels, pre_sliced)
         else:
             # no module claimed labels: score against the tail output
-            if not any(mt.get(self.META_TAKE_LABELS)
-                       for mt in self._metas):
-                eval_metric.update(labels, self.get_outputs())
+            eval_metric.update(labels, self.get_outputs())
 
     def install_monitor(self, mon):
         for m in self._modules:

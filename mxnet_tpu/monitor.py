@@ -33,8 +33,11 @@ class Monitor:
             self.queue.append((self.step, name, self.stat_func(arr)))
         self.stat_helper = stat_helper
 
-    def install(self, exe):
-        exe.set_monitor_callback(self.stat_helper)
+    def install(self, exe, monitor_all=False):
+        """Attach to an executor; with ``monitor_all`` every operator
+        output is tapped inside the compiled program (reference:
+        MXExecutorSetMonitorCallback monitor_all)."""
+        exe.set_monitor_callback(self.stat_helper, monitor_all)
         self.exes.append(exe)
 
     def tic(self):

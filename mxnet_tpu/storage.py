@@ -1,0 +1,40 @@
+"""Device-memory introspection (the TPU stand-in for the reference's
+pooled Storage managers, src/storage/ — SURVEY §7: HBM pooling is
+XLA's job, so this module exposes the *stats* surface instead)."""
+from __future__ import annotations
+
+__all__ = ["memory_stats", "bytes_allocated", "bytes_limit",
+           "pool_snapshot"]
+
+
+def _device(dev=None):
+    import jax
+    return jax.devices()[dev] if isinstance(dev, int) else \
+        (dev if dev is not None else jax.devices()[0])
+
+
+def memory_stats(device=None):
+    """Raw allocator statistics for one device (bytes_in_use,
+    peak_bytes_in_use, bytes_limit, num_allocs, ...) as reported by the
+    runtime; {} when the backend exposes none (CPU)."""
+    d = _device(device)
+    stats = getattr(d, "memory_stats", None)
+    try:
+        return dict(stats() or {}) if callable(stats) else {}
+    except Exception:
+        return {}
+
+
+def bytes_allocated(device=None):
+    return int(memory_stats(device).get("bytes_in_use", 0))
+
+
+def bytes_limit(device=None):
+    return int(memory_stats(device).get("bytes_limit", 0))
+
+
+def pool_snapshot():
+    """Per-device {device: stats} across all visible devices — the
+    analogue of dumping every pooled storage manager's counters."""
+    import jax
+    return {str(d): memory_stats(d) for d in jax.devices()}

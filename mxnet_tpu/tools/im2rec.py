@@ -113,8 +113,9 @@ def _load_image(path, resize):
             else:
                 nh, nw = int(round(h * resize / w)), resize
             img = img.resize((nw, nh))
-        # PIL gives RGB; pack_img's cv2 path expects BGR ndarray
-        return np.asarray(img)[:, :, ::-1]
+        # cv2 absent ⇒ pack_img will also encode via PIL, which
+        # expects RGB — keep PIL's native channel order
+        return np.asarray(img)
 
 
 def main():

@@ -1,0 +1,141 @@
+"""Per-op monitor taps, storage stats, contrib.text, contrib SVRG
+(VERDICT r2 coverage rows: Monitor 'outputs-only', Storage partial,
+contrib 'no')."""
+import collections
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.test_utils import default_context
+
+
+class TestMonitorPerOpTaps:
+    def test_monitor_all_taps_intermediate_ops(self):
+        data = mx.sym.var("data")
+        h = mx.sym.Activation(
+            mx.sym.FullyConnected(data, num_hidden=4, name="fc1"),
+            act_type="relu", name="act1")
+        out = mx.sym.FullyConnected(h, num_hidden=2, name="fc2")
+        rng = np.random.RandomState(0)
+        args = {"data": mx.nd.array(rng.randn(3, 5).astype(np.float32)),
+                "fc1_weight": mx.nd.array(
+                    rng.randn(4, 5).astype(np.float32)),
+                "fc1_bias": mx.nd.zeros((4,)),
+                "fc2_weight": mx.nd.array(
+                    rng.randn(2, 4).astype(np.float32)),
+                "fc2_bias": mx.nd.zeros((2,))}
+        ex = out.bind(default_context(), args)
+
+        mon = mx.monitor.Monitor(interval=1, pattern=".*")
+        mon.install(ex, monitor_all=True)
+        mon.tic()
+        ex.forward()
+        _ = ex.outputs[0].asnumpy()      # flush debug callbacks
+        stats = mon.toc()
+        names = {n for (_, n, _) in stats}
+        # intermediate op outputs were tapped inside the program
+        assert any("fc1" in n for n in names), names
+        assert any("act1" in n for n in names), names
+
+    def test_monitor_without_all_still_outputs(self):
+        data = mx.sym.var("data")
+        out = mx.sym.FullyConnected(data, num_hidden=2, name="fc")
+        args = {"data": mx.nd.ones((2, 3)),
+                "fc_weight": mx.nd.ones((2, 3)),
+                "fc_bias": mx.nd.zeros((2,))}
+        ex = out.bind(default_context(), args)
+        seen = []
+        ex.set_monitor_callback(lambda n, a: seen.append(n))
+        ex.forward()
+        assert seen          # head outputs tapped
+
+
+class TestStorageStats:
+    def test_memory_stats_shape(self):
+        stats = mx.storage.memory_stats()
+        assert isinstance(stats, dict)
+        snap = mx.storage.pool_snapshot()
+        assert isinstance(snap, dict) and len(snap) >= 1
+        assert mx.storage.bytes_allocated() >= 0
+
+
+class TestContribText:
+    def test_vocabulary_order_and_lookup(self):
+        counter = collections.Counter(
+            {"the": 10, "cat": 5, "sat": 5, "mat": 1})
+        v = mx.contrib.text.Vocabulary(counter, min_freq=2,
+                                       reserved_tokens=["<pad>"])
+        assert v.idx_to_token[:2] == ["<unk>", "<pad>"]
+        # freq desc, ties alphabetical: the, cat, sat; mat dropped
+        assert v.idx_to_token[2:] == ["the", "cat", "sat"]
+        assert v.to_indices(["the", "zzz"]) == [2, 0]
+        assert v.to_tokens(3) == "cat"
+
+    def test_count_tokens(self):
+        c = mx.contrib.text.count_tokens_from_str(
+            "a b\nb c", to_lower=False)
+        assert c["b"] == 2 and c["a"] == 1
+
+    def test_custom_embedding_file(self, tmp_path):
+        f = tmp_path / "emb.txt"
+        f.write_text("cat 1.0 2.0\ndog 3.0 4.0\n")
+        emb = mx.contrib.text.CustomEmbedding(str(f))
+        assert emb.vec_len == 2
+        vec = emb.get_vecs_by_tokens(["cat", "dog", "bird"]).asnumpy()
+        np.testing.assert_allclose(vec[0], [1.0, 2.0])
+        np.testing.assert_allclose(vec[1], [3.0, 4.0])
+        np.testing.assert_allclose(vec[2], [0.0, 0.0])   # unknown
+        emb.update_token_vectors(
+            ["cat"], mx.nd.array([[9.0, 9.0]]))
+        np.testing.assert_allclose(
+            emb.get_vecs_by_tokens("cat").asnumpy(), [9.0, 9.0])
+
+
+class TestSVRG:
+    def test_svrg_module_converges_least_squares(self):
+        from mxnet_tpu.contrib.svrg_optimization import SVRGModule
+        rng = np.random.RandomState(0)
+        N, D = 64, 5
+        w_true = rng.randn(D, 1).astype(np.float32)
+        X = rng.randn(N, D).astype(np.float32)
+        y = (X @ w_true).ravel()
+
+        data = mx.sym.var("data")
+        label = mx.sym.var("lin_label")
+        pred = mx.sym.FullyConnected(data, num_hidden=1, no_bias=True,
+                                     name="fc")
+        out = mx.sym.LinearRegressionOutput(pred, label, name="lin")
+
+        it = mx.io.NDArrayIter({"data": X}, {"lin_label": y},
+                               batch_size=16, shuffle=False,
+                               label_name="lin_label")
+        mod = SVRGModule(out, data_names=("data",),
+                         label_names=("lin_label",),
+                         context=default_context(), update_freq=1)
+        mod.bind(it.provide_data, it.provide_label, for_training=True)
+        mod.init_params(mx.init.Normal(0.1))
+        mod.init_optimizer(optimizer="sgd",
+                           optimizer_params={"learning_rate": 0.05})
+        mod.update_full_grads(it)
+
+        def epoch_loss():
+            losses = []
+            it.reset()
+            for batch in it:
+                mod.forward(batch, is_train=False)
+                p = mod.get_outputs()[0].asnumpy().ravel()
+                losses.append(np.mean(
+                    (p - batch.label[0].asnumpy().ravel()) ** 2))
+            it.reset()
+            return float(np.mean(losses))
+
+        first = epoch_loss()
+        for epoch in range(6):
+            it.reset()
+            for batch in it:
+                mod.forward_backward(batch)
+                mod.update()
+            mod.update_full_grads(it)
+        last = epoch_loss()
+        assert last < first * 0.2, (first, last)

@@ -180,3 +180,41 @@ class TestGradientCompression:
         kv = mx.kv.create("device")
         with pytest.raises(ValueError):
             kv.set_gradient_compression({"type": "fp8"})
+
+
+class TestImageRecordPartialBatch:
+    def test_round_batch_pad(self, tmp_path):
+        from mxnet_tpu.tools import im2rec as tool
+        root = str(tmp_path / "imgs")
+        prefix = str(tmp_path / "d3")
+        _make_image_tree(root, classes=1, per_class=7)
+        lst, _ = tool.make_list(root, prefix)
+        tool.im2rec(lst, root, prefix)
+        it = mx.io.ImageRecordIter(
+            path_imgrec=prefix + ".rec", path_imgidx=prefix + ".idx",
+            data_shape=(3, 16, 16), batch_size=5)
+        pads = [b.pad for b in it]
+        assert pads == [0, 3]          # 7 = 5 + (2 real + 3 pad)
+        it.close()
+
+    def test_seq_mode_inference_binds_sequential_module(self):
+        # inference-bound SequentialModule must not assert
+        d1 = mx.sym.var("data")
+        m1 = mx.mod.Module(mx.sym.FullyConnected(d1, num_hidden=4,
+                                                 name="sfc1"),
+                           data_names=("data",), label_names=None,
+                           context=default_context())
+        d2 = mx.sym.var("data")
+        m2 = mx.mod.Module(mx.sym.softmax(
+            mx.sym.FullyConnected(d2, num_hidden=2, name="sfc2")),
+            data_names=("data",), label_names=None,
+            context=default_context())
+        seq = mx.mod.SequentialModule()
+        seq.add(m1).add(m2)
+        from mxnet_tpu.io.io import DataDesc, DataBatch
+        seq.bind(data_shapes=[DataDesc("data", (2, 6))],
+                 for_training=False)
+        seq.init_params(mx.init.Xavier())
+        seq.forward(DataBatch([mx.nd.ones((2, 6))], None),
+                    is_train=False)
+        assert seq.get_outputs()[0].shape == (2, 2)
