@@ -122,15 +122,18 @@ class TokenEmbedding(Vocabulary):
         table = {}
         dim = None
         with open(file_path, encoding=encoding) as f:
-            for line in f:
+            for lineno, line in enumerate(f):
                 cells = line.rstrip().split(elem_delim)
                 if len(cells) < 2:
                     continue
+                if lineno == 0 and len(cells) == 2 and \
+                        all(c.isdigit() for c in cells):
+                    continue            # word2vec "vocab dim" header
                 vec = [float(x) for x in cells[1:] if x]
                 if dim is None:
                     dim = len(vec)
                 if len(vec) != dim:
-                    continue            # header or malformed row
+                    continue            # malformed row
                 table[cells[0]] = vec
         if dim is None:
             raise MXNetError("no vectors found in %s" % file_path)

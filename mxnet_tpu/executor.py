@@ -490,9 +490,10 @@ class Executor:
                                      "auxiliary states" % name)
 
     def set_monitor_callback(self, callback, monitor_all=False):
+        """Per-op taps (monitor_all) run on the eager interpreted path;
+        compiled programs are untouched, so no cache invalidation."""
         self._monitor_callback = callback
         self._monitor_all = monitor_all
-        self._fns.clear()       # rebuild programs with per-op taps
 
     def _host_tap(self, name, value):
         """jax.debug.callback target: value arrives as host numpy."""

@@ -1,10 +1,13 @@
-"""Gluon Trainer (parity: python/mxnet/gluon/trainer.py).
+"""Gluon Trainer (API parity: python/mxnet/gluon/trainer.py).
 
-TPU-native: parameters are single (mesh-shardable) arrays, so
-``allreduce_grads`` is only a cross-process collective when running
-multi-host via a dist/tpu kvstore; the single-process multi-device
-reduce the reference does across GPU copies is unnecessary by
-construction (the mesh holds one sharded array).
+TPU-native: every Parameter is ONE (mesh-shardable) array, so the
+single-process multi-device reduce the reference performs across GPU
+copies is unnecessary by construction — ``allreduce_grads`` only
+becomes a real collective when a dist/tpu kvstore spans processes.
+Own structure: the parameter roster is validated once into an indexed
+list; kvstore resolution lives in a single ``_resolve_kvstore`` step;
+the update loop separates its skip conditions from the sparse-grad
+fast path.
 """
 from __future__ import annotations
 
@@ -15,104 +18,109 @@ from .parameter import Parameter, ParameterDict
 __all__ = ["Trainer"]
 
 
+def _as_param_list(params):
+    """Normalize the constructor's params argument to an ordered list
+    of Parameters, rejecting anything else loudly."""
+    if isinstance(params, (dict, ParameterDict)):
+        params = list(params.values())
+    if not isinstance(params, (list, tuple)):
+        raise ValueError(
+            "First argument must be a list or dict of Parameters, "
+            "got %s." % (type(params)))
+    for p in params:
+        if not isinstance(p, Parameter):
+            raise ValueError(
+                "First argument must be a list or dict of Parameters, "
+                "got list of %s." % (type(p)))
+    return list(params)
+
+
 class Trainer:
+    """Applies an Optimizer to a set of Parameters after backward
+    (reference: trainer.py:27)."""
+
     def __init__(self, params, optimizer, optimizer_params=None,
                  kvstore='device', compression_params=None,
                  update_on_kvstore=None):
-        if isinstance(params, (dict, ParameterDict)):
-            params = list(params.values())
-        if not isinstance(params, (list, tuple)):
-            raise ValueError(
-                "First argument must be a list or dict of Parameters, "
-                "got %s." % (type(params)))
-        self._params = []
-        self._param2idx = {}
-        for i, param in enumerate(params):
-            if not isinstance(param, Parameter):
-                raise ValueError(
-                    "First argument must be a list or dict of Parameters, "
-                    "got list of %s." % (type(param)))
-            self._param2idx[param.name] = i
-            self._params.append(param)
-            param._set_trainer = getattr(param, "_set_trainer", None)
+        self._params = _as_param_list(params)
+        self._param2idx = {p.name: i
+                           for i, p in enumerate(self._params)}
         self._compression_params = compression_params
-        optimizer_params = optimizer_params if optimizer_params else {}
-        self._scale = float(optimizer_params.get('rescale_grad', 1.0))
-        self._contexts = self._check_contexts()
-        self._init_optimizer(optimizer, optimizer_params)
-        self._kvstore_params = {
-            'kvstore': kvstore, 'update_on_kvstore': update_on_kvstore}
-        self._kv_initialized = False
-        self._kvstore = None
-        self._update_on_kvstore = None
-        self._params_to_init = []
+        opts = dict(optimizer_params or {})
+        self._scale = float(opts.get('rescale_grad', 1.0))
+        self._contexts = self._shared_contexts()
+        self._setup_optimizer(optimizer, opts)
+        self._kvstore_params = {'kvstore': kvstore,
+                                'update_on_kvstore': update_on_kvstore}
         self._reset_kvstore()
 
-    def _check_contexts(self):
-        contexts = None
-        for param in self._params:
+    # -- wiring -----------------------------------------------------------
+    def _shared_contexts(self):
+        for p in self._params:
             try:
-                ctx = param.list_ctx()
+                return p.list_ctx()
             except Exception:
-                ctx = None
-            if contexts is None:
-                contexts = ctx
-        return contexts or []
+                continue
+        return []
 
-    def _init_optimizer(self, optimizer, optimizer_params):
-        param_dict = {i: param for i, param in enumerate(self._params)}
+    def _setup_optimizer(self, optimizer, opts):
+        roster = dict(enumerate(self._params))
         if isinstance(optimizer, opt.Optimizer):
-            assert not optimizer_params, \
-                "optimizer_params must be None if optimizer is an " \
-                "instance of Optimizer instead of str"
+            if opts:
+                raise AssertionError(
+                    "optimizer_params must be None if optimizer is an "
+                    "instance of Optimizer instead of str")
             self._optimizer = optimizer
-            self._optimizer.param_dict = param_dict
+            optimizer.param_dict = roster
         else:
-            self._optimizer = opt.create(optimizer,
-                                         param_dict=param_dict,
-                                         **optimizer_params)
+            self._optimizer = opt.create(optimizer, param_dict=roster,
+                                         **opts)
         self._updaters = [opt.get_updater(self._optimizer)]
 
     def _reset_kvstore(self):
         self._kv_initialized = False
         self._kvstore = None
         self._update_on_kvstore = None
-        self._params_to_init = [p for p in self._params]
+        self._params_to_init = list(self._params)
+
+    def _resolve_kvstore(self):
+        """Pick the kvstore backend (reference: trainer.py:169). A
+        plain local/device name resolves to NO kvstore — one logical
+        sharded array needs no cross-copy reduce; dist/tpu names make
+        a real multi-process store."""
+        spec = self._kvstore_params['kvstore']
+        from .. import kvstore as kvs
+        if isinstance(spec, kvs.KVStore):
+            return spec
+        if isinstance(spec, str) and spec and \
+                ('dist' in spec or 'tpu' in spec):
+            return kvs.create(spec)
+        return None
 
     def _init_kvstore(self):
-        """KVStore wiring (reference: trainer.py:169)."""
-        config = self._kvstore_params
-        kvstore = config['kvstore']
-        update_on_kvstore = config['update_on_kvstore']
-        kv = None
-        if kvstore:
-            from .. import kvstore as kvs
-            if isinstance(kvstore, kvs.KVStore):
-                kv = kvstore
-            elif isinstance(kvstore, str):
-                if 'dist' in kvstore or 'tpu' in kvstore:
-                    kv = kvs.create(kvstore)
-                else:
-                    kv = None  # single logical device: no kvstore needed
-        if kv is not None and self._compression_params:
-            kv.set_gradient_compression(self._compression_params)
-        self._kvstore = kv
-        self._update_on_kvstore = bool(update_on_kvstore) \
-            if update_on_kvstore is not None else False
+        kv = self._resolve_kvstore()
         if kv is not None:
+            if self._compression_params:
+                kv.set_gradient_compression(self._compression_params)
             for i, param in enumerate(self._params):
                 if param._data is not None:
                     kv.init(i, param.data())
-            if self._update_on_kvstore:
-                kv.set_optimizer(self._optimizer)
+        self._kvstore = kv
+        wanted = self._kvstore_params['update_on_kvstore']
+        self._update_on_kvstore = bool(wanted) if wanted is not None \
+            else False
+        if kv is not None and self._update_on_kvstore:
+            kv.set_optimizer(self._optimizer)
         self._kv_initialized = True
         self._params_to_init = [p for p in self._params_to_init
                                 if p._deferred_init]
 
+    # -- properties -------------------------------------------------------
     @property
     def learning_rate(self):
-        return self._optimizer.lr if self._optimizer.lr_scheduler is None \
-            else self._optimizer.lr_scheduler(self._optimizer.num_update)
+        sched = self._optimizer.lr_scheduler
+        return self._optimizer.lr if sched is None \
+            else sched(self._optimizer.num_update)
 
     @property
     def optimizer(self):
@@ -121,8 +129,9 @@ class Trainer:
     def set_learning_rate(self, lr):
         self._optimizer.set_learning_rate(lr)
 
+    # -- the step ---------------------------------------------------------
     def allreduce_grads(self):
-        """Reduce gradients across workers (reference: trainer.py:331)."""
+        """Cross-worker gradient reduction (reference: trainer.py:331)."""
         if not self._kv_initialized:
             self._init_kvstore()
         if self._kvstore is None:
@@ -134,79 +143,80 @@ class Trainer:
                     self._kvstore.pull(i, param.grad())
 
     def step(self, batch_size, ignore_stale_grad=False):
-        """One optimization step (reference: trainer.py:302)."""
-        rescale_grad = self._scale / batch_size
-        self._check_and_rescale_grad(rescale_grad)
+        """allreduce + update, rescaled by batch size
+        (reference: trainer.py:302)."""
+        self._sync_rescale(self._scale / batch_size)
         if not self._kv_initialized:
             self._init_kvstore()
         if self._kvstore is not None:
             self.allreduce_grads()
-        self._update(ignore_stale_grad)
+        self._apply_updates(ignore_stale_grad)
 
-    def _check_and_rescale_grad(self, scale):
+    def update(self, batch_size, ignore_stale_grad=False):
+        """Update only — the caller already ran allreduce_grads
+        (reference: trainer.py:363)."""
+        if not self._kv_initialized:
+            self._init_kvstore()
+        if self._kvstore and self._update_on_kvstore:
+            raise AssertionError(
+                'update() when parameters are updated on kvstore is '
+                'not supported. Try setting `update_on_kvstore` to '
+                'False when creating trainer.')
+        self._sync_rescale(self._scale / batch_size)
+        self._apply_updates(ignore_stale_grad)
+
+    def _sync_rescale(self, scale):
         if self._optimizer.rescale_grad != scale:
             self._optimizer.rescale_grad = scale
 
-    def update(self, batch_size, ignore_stale_grad=False):
-        if not self._kv_initialized:
-            self._init_kvstore()
-        assert not (self._kvstore and self._update_on_kvstore), \
-            'update() when parameters are updated on kvstore is not ' \
-            'supported. Try setting `update_on_kvstore` to False when ' \
-            'creating trainer.'
-        self._check_and_rescale_grad(self._scale / batch_size)
-        self._update(ignore_stale_grad)
+    @staticmethod
+    def _stale(param):
+        return not param._data._fresh_grad
+
+    def _raise_stale(self, param):
+        raise UserWarning(
+            "Gradient of Parameter `%s` on context %s has not been "
+            "updated by backward since last `step`. This could mean a "
+            "bug in your model that made it only use a subset of the "
+            "Parameters (Blocks) for this iteration. If you are "
+            "intentionally only using a subset, call step with "
+            "ignore_stale_grad=True to suppress this warning and skip "
+            "updating of Parameters with stale gradient"
+            % (param.name, str(param.list_ctx()[0])))
 
     @staticmethod
     def _to_row_sparse(param, grad):
+        """Build the row_sparse gradient view from the row ids the
+        forward recorded (true touched rows — keeps rows whose grad is
+        exactly zero and avoids scanning the dense grad); falls back to
+        a non-zero-row scan when nothing was stashed."""
         ids = getattr(param, '_sparse_row_ids', None)
         if ids is None:
             return grad.tostype('row_sparse')
         import numpy as _np
+        from ..ndarray import array as _nd_array
         from ..ndarray.sparse import RowSparseNDArray
         param._sparse_row_ids = None
         rows = _np.unique(_np.concatenate(
             [i.asnumpy().astype(_np.int64).ravel() for i in ids]))
-        from ..ndarray import array as _nd_array
         rows_nd = _nd_array(rows, ctx=grad.context, dtype='int64')
         return RowSparseNDArray(grad.take(rows_nd), rows_nd, grad.shape,
                                 ctx=grad.context)
 
-    def _update(self, ignore_stale_grad=False):
-        import warnings
+    def _apply_updates(self, ignore_stale_grad=False):
         updater = self._updaters[0]
+        hosted = self._kvstore is not None and self._update_on_kvstore
         for i, param in enumerate(self._params):
-            if param.grad_req == 'null':
+            if param.grad_req == 'null' or param._data is None:
                 continue
-            if param._data is None:
-                continue
-            if not param._data._fresh_grad:
-                # grads are marked fresh by autograd.backward; a param
-                # untouched since its last update has a stale (or zero)
-                # gradient (reference: trainer.py:380-392)
+            if self._stale(param):
                 if not ignore_stale_grad:
-                    raise UserWarning(
-                        "Gradient of Parameter `%s` on context %s has "
-                        "not been updated by backward since last "
-                        "`step`. This could mean a bug in your model "
-                        "that made it only use a subset of the "
-                        "Parameters (Blocks) for this iteration. If "
-                        "you are intentionally only using a subset, "
-                        "call step with ignore_stale_grad=True to "
-                        "suppress this warning and skip updating of "
-                        "Parameters with stale gradient"
-                        % (param.name, str(param.list_ctx()[0])))
-                continue  # skip stale params entirely
-            if self._kvstore is not None and self._update_on_kvstore:
-                continue  # kvstore hosted the update in allreduce_grads
+                    self._raise_stale(param)
+                continue
+            if hosted:
+                continue        # kvstore ran the update in allreduce
             grad = param.grad()
             if param._grad_stype == 'row_sparse':
-                # sparse_grad params (Embedding, SparseEmbedding): the
-                # backward produced a dense grad; build the row_sparse
-                # view from the row ids the forward recorded (true
-                # touched rows — keeps rows whose grad is exactly zero
-                # and avoids scanning the dense grad), falling back to
-                # a non-zero-row scan when no ids were stashed
                 grad = self._to_row_sparse(param, grad)
             updater(i, grad, param.data())
             param._data._fresh_grad = False
@@ -215,21 +225,26 @@ class Trainer:
         for param in self._params:
             if getattr(param, '_sparse_row_ids', None) is not None:
                 param._sparse_row_ids = None
-        if self._kvstore is not None and self._update_on_kvstore:
+        if hosted:
             for i, param in enumerate(self._params):
                 if param.grad_req != 'null':
                     self._kvstore.pull(i, param.data())
 
+    # legacy spelling used by older call sites
+    _update = _apply_updates
+
+    # -- optimizer-state checkpointing ------------------------------------
     def save_states(self, fname):
-        assert self._optimizer is not None
+        if self._optimizer is None:
+            raise AssertionError("no optimizer to save")
         if not self._kv_initialized:
             self._init_kvstore()
         if self._update_on_kvstore and self._kvstore is not None:
-            self._kvstore.save_optimizer_states(fname, dump_optimizer=True)
-        else:
-            with open(fname, 'wb') as fout:
-                fout.write(self._updaters[0].get_states(
-                    dump_optimizer=True))
+            self._kvstore.save_optimizer_states(fname,
+                                                dump_optimizer=True)
+            return
+        with open(fname, 'wb') as sink:
+            sink.write(self._updaters[0].get_states(dump_optimizer=True))
 
     def load_states(self, fname):
         if not self._kv_initialized:
@@ -238,11 +253,10 @@ class Trainer:
             self._kvstore.load_optimizer_states(fname)
             self._optimizer = self._kvstore._updater.optimizer
         else:
-            with open(fname, 'rb') as f:
-                states = f.read()
+            with open(fname, 'rb') as src:
+                blob = src.read()
             for updater in self._updaters:
-                updater.set_states(states)
+                updater.set_states(blob)
                 updater.optimizer = self._updaters[0].optimizer
             self._optimizer = self._updaters[0].optimizer
-        param_dict = {i: param for i, param in enumerate(self._params)}
-        self._optimizer.param_dict = param_dict
+        self._optimizer.param_dict = dict(enumerate(self._params))

@@ -190,9 +190,11 @@ class ImageRecordIter(DataIter):
             self._cursor += bs
             pad = bs - len(keys)
             if pad:
-                # round_batch semantics: wrap to the epoch start and
-                # report the pad count so score()/metrics can mask
-                keys = keys + self._order[:pad]
+                # round_batch semantics: wrap to the epoch start (cycling
+                # if the dataset is smaller than one batch) and report
+                # the pad count so score()/metrics can mask
+                keys = keys + [self._order[i % len(self._order)]
+                               for i in range(pad)]
             batch = self._load_batch(keys)
             if batch is None:
                 raise StopIteration

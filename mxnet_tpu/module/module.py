@@ -1,12 +1,13 @@
-"""Module — symbol + one compiled executor (parity:
+"""Module — a Symbol bound to ONE compiled executor (API parity:
 python/mxnet/module/module.py).
 
 TPU-native design: where the reference builds a
 DataParallelExecutorGroup with one executor per GPU and reduces
-gradients through KVStore (executor_group.py:143), this Module binds
-ONE executor whose compiled program can span the whole device mesh —
-batch sharding replaces batch slicing (SURVEY §2.2 row 1). The KVStore
-path is kept for API parity and multi-process training.
+gradients through KVStore (executor_group.py:143), this Module binds a
+single executor whose compiled program can span the whole device mesh —
+batch sharding replaces batch slicing (SURVEY §2.2 row 1), and
+forward+backward fuse into one XLA computation. The KVStore path stays
+for API parity and multi-process training.
 """
 from __future__ import annotations
 
@@ -27,58 +28,57 @@ from .base_module import BaseModule, _check_input_names, _parse_data_desc
 __all__ = ["Module"]
 
 
+def _names_or_empty(names):
+    return list(names) if names is not None else []
+
+
 class Module(BaseModule):
+    """Symbolic training/inference module (reference: module.py:42)."""
+
     def __init__(self, symbol, data_names=('data',),
                  label_names=('softmax_label',), logger=logging,
-                 context=cpu(), work_load_list=None, fixed_param_names=None,
-                 state_names=None, group2ctxs=None,
-                 compression_params=None):
+                 context=cpu(), work_load_list=None,
+                 fixed_param_names=None, state_names=None,
+                 group2ctxs=None, compression_params=None):
         super().__init__(logger=logger)
-        if isinstance(context, Context):
-            context = [context]
-        self._context = context
+        self._context = [context] if isinstance(context, Context) \
+            else context
         self._work_load_list = work_load_list
-
         self._symbol = symbol
-        data_names = list(data_names) if data_names is not None else []
-        label_names = list(label_names) if label_names is not None else []
-        state_names = list(state_names) if state_names is not None else []
-        fixed_param_names = list(fixed_param_names) \
-            if fixed_param_names is not None else []
 
-        _check_input_names(symbol, data_names, "data", True)
-        _check_input_names(symbol, label_names, "label", False)
-        _check_input_names(symbol, state_names, "state", True)
-        _check_input_names(symbol, fixed_param_names, "fixed_param", True)
+        roles = {"data": _names_or_empty(data_names),
+                 "label": _names_or_empty(label_names),
+                 "state": _names_or_empty(state_names),
+                 "fixed_param": _names_or_empty(fixed_param_names)}
+        for role, names in roles.items():
+            _check_input_names(symbol, names, role, role != "label")
+        self._data_names = roles["data"]
+        self._label_names = roles["label"]
+        self._state_names = roles["state"]
+        self._fixed_param_names = roles["fixed_param"]
 
-        arg_names = symbol.list_arguments()
-        input_names = data_names + label_names + state_names
-        self._param_names = [x for x in arg_names if x not in input_names]
-        self._fixed_param_names = fixed_param_names
+        bound_inputs = set(self._data_names) | set(self._label_names) \
+            | set(self._state_names)
+        self._param_names = [a for a in symbol.list_arguments()
+                             if a not in bound_inputs]
         self._aux_names = symbol.list_auxiliary_states()
-        self._data_names = data_names
-        self._label_names = label_names
-        self._state_names = state_names
         self._output_names = symbol.list_outputs()
 
-        self._arg_params = None
-        self._aux_params = None
+        self._arg_params = self._aux_params = None
         self._params_dirty = False
         self._compression_params = compression_params
-        self._optimizer = None
-        self._kvstore = None
+        self._optimizer = self._kvstore = self._updater = None
         self._update_on_kvstore = None
-        self._updater = None
         self._preload_opt_states = None
         self._grad_req = None
         self._exec = None
 
+    # -- checkpointing -----------------------------------------------------
     @staticmethod
     def load(prefix, epoch, load_optimizer_states=False, **kwargs):
         sym, args, auxs = load_checkpoint(prefix, epoch)
         mod = Module(symbol=sym, **kwargs)
-        mod._arg_params = args
-        mod._aux_params = auxs
+        mod._arg_params, mod._aux_params = args, auxs
         mod.params_initialized = True
         if load_optimizer_states:
             mod._preload_opt_states = '%s-%04d.states' % (prefix, epoch)
@@ -87,26 +87,18 @@ class Module(BaseModule):
     def save_checkpoint(self, prefix, epoch, save_optimizer_states=False,
                         remove_amp_cast=True):
         self._symbol.save('%s-symbol.json' % prefix)
-        param_name = '%s-%04d.params' % (prefix, epoch)
-        self.save_params(param_name)
-        logging.info('Saved checkpoint to \"%s\"', param_name)
+        param_file = '%s-%04d.params' % (prefix, epoch)
+        self.save_params(param_file)
+        logging.info('Saved checkpoint to \"%s\"', param_file)
         if save_optimizer_states:
-            state_name = '%s-%04d.states' % (prefix, epoch)
-            self.save_optimizer_states(state_name)
-            logging.info('Saved optimizer state to \"%s\"', state_name)
+            state_file = '%s-%04d.states' % (prefix, epoch)
+            self.save_optimizer_states(state_file)
+            logging.info('Saved optimizer state to \"%s\"', state_file)
 
-    # -- properties ------------------------------------------------------
-    @property
-    def data_names(self):
-        return self._data_names
-
-    @property
-    def label_names(self):
-        return self._label_names
-
-    @property
-    def output_names(self):
-        return self._output_names
+    # -- properties --------------------------------------------------------
+    data_names = property(lambda self: self._data_names)
+    label_names = property(lambda self: self._label_names)
+    output_names = property(lambda self: self._output_names)
 
     @property
     def data_shapes(self):
@@ -127,56 +119,58 @@ class Module(BaseModule):
                     zip(self._output_names, outs)]
         # before the first forward: infer from the bound input shapes
         feed = {d.name: tuple(d.shape) for d in self._data_shapes}
-        for d in (self._label_shapes or []):
-            feed[d.name] = tuple(d.shape)
+        feed.update((d.name, tuple(d.shape))
+                    for d in (self._label_shapes or []))
         _, out_shapes, _ = self._symbol.infer_shape(**feed)
         return list(zip(self._output_names,
                         [tuple(s) for s in out_shapes]))
 
-    # -- params ----------------------------------------------------------
+    # -- params ------------------------------------------------------------
     def get_params(self):
         assert self.binded and self.params_initialized
         if self._params_dirty:
             self._sync_params_from_devices()
         return (self._arg_params, self._aux_params)
 
+    def _fill_param(self, name, dst, provided, initializer, attrs,
+                    allow_missing):
+        """One parameter buffer: copy the provided value, else run the
+        initializer keyed by the symbol's attributes."""
+        if provided is not None and name in provided:
+            src = provided[name]
+            if src is not dst:
+                src.copyto(dst)
+            return
+        if initializer is None:
+            if not allow_missing:
+                raise AssertionError(
+                    "initializer required when arg/aux not provided")
+            return
+        initializer(InitDesc(name, attrs.get(name, None)), dst)
+
     def init_params(self, initializer=Uniform(0.01), arg_params=None,
-                    aux_params=None, allow_missing=False, force_init=False,
-                    allow_extra=False):
+                    aux_params=None, allow_missing=False,
+                    force_init=False, allow_extra=False):
         if self.params_initialized and not force_init:
             return
         assert self.binded, 'call bind before initializing the parameters'
         attrs = self._symbol.attr_dict()
-
-        def _impl(name, arr, cache):
-            if cache is not None and name in cache:
-                cache_arr = cache[name]
-                if cache_arr is not arr:
-                    cache_arr.copyto(arr)
-            else:
-                if not allow_missing:
-                    assert initializer is not None, \
-                        "initializer required when arg/aux not provided"
-                if initializer is not None:
-                    desc = InitDesc(name, attrs.get(name, None))
-                    initializer(desc, arr)
-
         for name in self._param_names:
-            _impl(name, self._exec.arg_dict[name], arg_params)
+            self._fill_param(name, self._exec.arg_dict[name], arg_params,
+                             initializer, attrs, allow_missing)
         for name in self._aux_names:
-            _impl(name, self._exec.aux_dict[name], aux_params)
-
+            self._fill_param(name, self._exec.aux_dict[name], aux_params,
+                             initializer, attrs, allow_missing)
         self.params_initialized = True
-        self._params_dirty = False
         self._sync_params_from_devices()
 
     def set_params(self, arg_params, aux_params, allow_missing=False,
                    force_init=True, allow_extra=False):
         if not allow_missing:
             self.init_params(initializer=None, arg_params=arg_params,
-                             aux_params=aux_params,
-                             allow_missing=allow_missing,
-                             force_init=force_init, allow_extra=allow_extra)
+                             aux_params=aux_params, allow_missing=False,
+                             force_init=force_init,
+                             allow_extra=allow_extra)
             return
         if self.params_initialized and not force_init:
             return
@@ -192,145 +186,148 @@ class Module(BaseModule):
                             for n in self._aux_names}
         self._params_dirty = False
 
-    # -- bind ------------------------------------------------------------
+    # -- bind --------------------------------------------------------------
+    def _check_mesh_batch(self, batch, what="bind"):
+        if len(self._context) <= 1:
+            return
+        from ..parallel.mesh import distinct_devices
+        n_dev = len(distinct_devices(self._context))
+        if n_dev > 1 and batch % n_dev != 0:
+            raise MXNetError(
+                "%s: batch size %d not divisible by %d devices (the dp "
+                "mesh shards the batch evenly; the reference's uneven "
+                "work_load_list split is not supported)"
+                % (what, batch, n_dev))
+
+    def _grad_req_for(self, name, for_training, inputs_need_grad,
+                      grad_req):
+        """The write/add/null request for one argument."""
+        def requested():
+            return grad_req if isinstance(grad_req, str) \
+                else grad_req.get(name, 'write')
+
+        if not for_training or name in self._fixed_param_names:
+            return 'null'
+        if name in self._param_names:
+            return requested()
+        if inputs_need_grad and name in self._data_names:
+            return requested()
+        return 'null'       # labels/states and non-grad inputs
+
     def bind(self, data_shapes, label_shapes=None, for_training=True,
-             inputs_need_grad=False, force_rebind=False, shared_module=None,
-             grad_req='write'):
+             inputs_need_grad=False, force_rebind=False,
+             shared_module=None, grad_req='write'):
         if force_rebind:
             self._exec = None
             self.binded = False
         if self.binded:
             self.logger.warning('Already binded, ignoring bind()')
             return
+        if not for_training:
+            assert not inputs_need_grad
         self.for_training = for_training
         self.inputs_need_grad = inputs_need_grad
         self._grad_req = grad_req
-        if not for_training:
-            assert not inputs_need_grad
 
         self._data_shapes, self._label_shapes = _parse_data_desc(
-            self._data_names, self._label_names, data_shapes, label_shapes)
+            self._data_names, self._label_names, data_shapes,
+            label_shapes)
+        self._check_mesh_batch(self._data_shapes[0].shape[0])
 
-        shape_kwargs = {d.name: d.shape for d in self._data_shapes}
-        if self._label_shapes:
-            shape_kwargs.update({l.name: l.shape
-                                 for l in self._label_shapes})
-
-        arg_shapes, _, aux_shapes = self._symbol.infer_shape(**shape_kwargs)
+        feed = {d.name: d.shape for d in self._data_shapes}
+        feed.update((l.name, l.shape)
+                    for l in (self._label_shapes or []))
+        arg_shapes, _, aux_shapes = self._symbol.infer_shape(**feed)
         arg_names = self._symbol.list_arguments()
-        aux_names = self._aux_names
         ctx = self._context[0]
-        if len(self._context) > 1:
-            from ..parallel.mesh import distinct_devices
-            n_dev = len(distinct_devices(self._context))
-            batch = self._data_shapes[0].shape[0]
-            if n_dev > 1 and batch % n_dev != 0:
-                raise MXNetError(
-                    "batch size %d not divisible by %d devices (the dp "
-                    "mesh shards the batch evenly; the reference's uneven "
-                    "work_load_list split is not supported)"
-                    % (batch, n_dev))
+        donor = shared_module._exec if shared_module is not None else None
 
-        args = {}
-        shared = shared_module._exec if shared_module is not None else None
-        for name, shape in zip(arg_names, arg_shapes):
-            if shared is not None and name in shared.arg_dict \
-                    and name in self._param_names:
-                args[name] = shared.arg_dict[name]
-            else:
-                args[name] = nd.zeros(shape, ctx=ctx)
-        aux = {}
-        aux_shape_map = dict(zip(aux_names, aux_shapes))
-        for name in aux_names:
-            if shared is not None and name in shared.aux_dict:
-                aux[name] = shared.aux_dict[name]
-            else:
-                aux[name] = nd.zeros(aux_shape_map[name], ctx=ctx)
+        def buffer_for(name, shape, pool, share_ok):
+            if donor is not None and share_ok and name in pool:
+                return pool[name]
+            return nd.zeros(shape, ctx=ctx)
 
-        reqs = {}
-        grads = {}
-        input_names = set(self._data_names) | set(self._label_names) \
-            | set(self._state_names)
-        for name, shape in zip(arg_names, arg_shapes):
-            if not for_training:
-                reqs[name] = 'null'
-            elif name in self._fixed_param_names:
-                reqs[name] = 'null'
-            elif name in input_names:
-                if inputs_need_grad and name in self._data_names:
-                    reqs[name] = grad_req if isinstance(grad_req, str) \
-                        else grad_req.get(name, 'write')
-                else:
-                    reqs[name] = 'null'
-            else:
-                reqs[name] = grad_req if isinstance(grad_req, str) \
-                    else grad_req.get(name, 'write')
-            if reqs[name] != 'null':
-                grads[name] = nd.zeros(shape, ctx=ctx)
+        args = {name: buffer_for(name, shape,
+                                 donor.arg_dict if donor else {},
+                                 name in self._param_names)
+                for name, shape in zip(arg_names, arg_shapes)}
+        aux = {name: buffer_for(name, shape,
+                                donor.aux_dict if donor else {}, True)
+               for name, shape in zip(self._aux_names, aux_shapes)}
+
+        reqs = {name: self._grad_req_for(name, for_training,
+                                         inputs_need_grad, grad_req)
+                for name in arg_names}
+        grads = {name: nd.zeros(shape, ctx=ctx)
+                 for name, shape in zip(arg_names, arg_shapes)
+                 if reqs[name] != 'null'}
 
         from ..executor import Executor
         exec_ctx = self._context if len(self._context) > 1 else ctx
-        batch_args = set(self._data_names) | set(self._label_names)
-        self._exec = Executor(self._symbol, exec_ctx, args, grads, reqs,
-                              aux, batch_args=batch_args)
+        self._exec = Executor(
+            self._symbol, exec_ctx, args, grads, reqs, aux,
+            batch_args=set(self._data_names) | set(self._label_names))
         self.binded = True
 
         if shared_module is not None and shared_module.params_initialized:
             self.set_params(*shared_module.get_params())
         elif self.params_initialized:
-            # params were loaded before bind (Module.load path): push the
-            # cached arg/aux params into the fresh executor buffers
-            self._exec.copy_params_from(self._arg_params, self._aux_params,
+            # params loaded before bind (Module.load): push the cached
+            # values into the fresh executor buffers
+            self._exec.copy_params_from(self._arg_params,
+                                        self._aux_params,
                                         allow_extra_params=True)
 
-    # -- optimizer -------------------------------------------------------
+    # -- optimizer ---------------------------------------------------------
+    def _effective_batch(self, kvstore):
+        batch = self._data_shapes[0].shape[0]
+        if kvstore and 'dist' in kvstore.type and \
+                '_async' not in kvstore.type:
+            batch *= kvstore.num_workers
+        return batch
+
     def init_optimizer(self, kvstore='local', optimizer='sgd',
                        optimizer_params=(('learning_rate', 0.01),),
                        force_init=False):
         assert self.binded and self.params_initialized
         if self.optimizer_initialized and not force_init:
-            self.logger.warning('optimizer already initialized, ignoring...')
+            self.logger.warning(
+                'optimizer already initialized, ignoring...')
             return
         if self._params_dirty:
             self._sync_params_from_devices()
 
-        (kvstore, update_on_kvstore) = _create_kvstore(
+        kvstore, update_on_kvstore = _create_kvstore(
             kvstore, len(self._context), self._arg_params)
-        batch_size = self._data_shapes[0].shape[0]
-        if kvstore and 'dist' in kvstore.type and \
-                '_async' not in kvstore.type:
-            batch_size *= kvstore.num_workers
-        rescale_grad = 1.0 / batch_size
+        rescale = 1.0 / self._effective_batch(kvstore)
+        idx2name = dict(enumerate(self._param_names))
 
-        idx2name = {i: n for i, n in enumerate(self._param_names)}
         if isinstance(optimizer, str):
-            optimizer_params = dict(optimizer_params)
-            if 'rescale_grad' not in optimizer_params:
-                optimizer_params['rescale_grad'] = rescale_grad
+            config = dict(optimizer_params)
+            config.setdefault('rescale_grad', rescale)
             optimizer = opt.create(optimizer, sym=self.symbol,
-                                   param_idx2name=idx2name,
-                                   **optimizer_params)
+                                   param_idx2name=idx2name, **config)
         else:
             assert isinstance(optimizer, opt.Optimizer)
-            if optimizer.rescale_grad != rescale_grad:
+            if optimizer.rescale_grad != rescale:
                 self.logger.warning(
                     "Optimizer created manually outside Module but "
                     "rescale_grad is not normalized to 1.0/batch_size/"
                     "num_workers (%s vs. %s).",
-                    optimizer.rescale_grad, rescale_grad)
+                    optimizer.rescale_grad, rescale)
             if not optimizer.idx2name:
                 optimizer.idx2name = idx2name.copy()
 
         self._optimizer = optimizer
-        self._kvstore = kvstore
-        self._update_on_kvstore = update_on_kvstore
+        self._kvstore, self._update_on_kvstore = kvstore, \
+            update_on_kvstore
         self._updater = None
-
         if kvstore:
             if self._compression_params:
-                kvstore.set_gradient_compression(self._compression_params)
+                kvstore.set_gradient_compression(
+                    self._compression_params)
             if update_on_kvstore:
-                kvstore.set_optimizer(self._optimizer)
+                kvstore.set_optimizer(optimizer)
             _initialize_kvstore(
                 kvstore=kvstore,
                 param_arrays=[self._exec.arg_dict[n]
@@ -346,23 +343,24 @@ class Module(BaseModule):
             self.load_optimizer_states(self._preload_opt_states)
             self._preload_opt_states = None
 
-    # -- computation -----------------------------------------------------
+    # -- computation -------------------------------------------------------
     def forward(self, data_batch, is_train=None):
         assert self.binded and self.params_initialized
         if is_train is None:
             is_train = self.for_training
-        kwargs = {}
-        for name, arr in zip(self._data_names, data_batch.data):
-            kwargs[name] = arr
+        feed = dict(zip(self._data_names, data_batch.data))
         if self._label_names and data_batch.label:
-            for name, arr in zip(self._label_names, data_batch.label):
-                kwargs[name] = arr
-        if is_train and self.for_training:
-            # defer: the fused fwd+bwd runs in backward(); stage inputs only
-            self._exec._gather_inputs(kwargs)
+            feed.update(zip(self._label_names, data_batch.label))
+        monitored = self._exec._monitor_callback is not None and \
+            getattr(self._exec, "_monitor_all", False)
+        if is_train and self.for_training and not monitored:
+            # defer: backward() runs the fused fwd+bwd program; only
+            # stage the inputs here. (A monitor_all monitor needs the
+            # eager tapped forward, so deferral is skipped then.)
+            self._exec._gather_inputs(feed)
             self._pending_forward = True
         else:
-            self._exec.forward(is_train=is_train, **kwargs)
+            self._exec.forward(is_train=is_train, **feed)
             self._pending_forward = False
 
     def backward(self, out_grads=None):
@@ -375,17 +373,15 @@ class Module(BaseModule):
         assert self.binded and self.params_initialized \
             and self.optimizer_initialized
         self._params_dirty = True
+        weights = [self._exec.arg_dict[n] for n in self._param_names]
+        grads = [self._exec.grad_dict.get(n) for n in self._param_names]
         if self._update_on_kvstore:
-            _update_params_on_kvstore(
-                [self._exec.arg_dict[n] for n in self._param_names],
-                [self._exec.grad_dict.get(n) for n in self._param_names],
-                self._kvstore, self._param_names)
+            _update_params_on_kvstore(weights, grads, self._kvstore,
+                                      self._param_names)
         else:
-            _update_params(
-                [self._exec.arg_dict[n] for n in self._param_names],
-                [self._exec.grad_dict.get(n) for n in self._param_names],
-                updater=self._updater, num_device=1,
-                kvstore=self._kvstore, param_names=self._param_names)
+            _update_params(weights, grads, updater=self._updater,
+                           num_device=1, kvstore=self._kvstore,
+                           param_names=self._param_names)
 
     def get_outputs(self, merge_multi_context=True):
         assert self.binded and self.params_initialized
@@ -421,38 +417,33 @@ class Module(BaseModule):
         assert self.binded
         mon.install(self._exec)
 
-    # -- optimizer state serialization ----------------------------------
+    # -- optimizer state serialization --------------------------------------
     def save_optimizer_states(self, fname):
         assert self.optimizer_initialized
         if self._update_on_kvstore:
             self._kvstore.save_optimizer_states(fname)
-        else:
-            with open(fname, 'wb') as fout:
-                fout.write(self._updater.get_states())
+            return
+        with open(fname, 'wb') as sink:
+            sink.write(self._updater.get_states())
 
     def load_optimizer_states(self, fname):
         assert self.optimizer_initialized
         if self._update_on_kvstore:
             self._kvstore.load_optimizer_states(fname)
-        else:
-            self._updater.set_states(open(fname, 'rb').read())
+            return
+        with open(fname, 'rb') as src:
+            self._updater.set_states(src.read())
 
     def reshape(self, data_shapes, label_shapes=None):
         assert self.binded
         self._data_shapes, self._label_shapes = _parse_data_desc(
-            self._data_names, self._label_names, data_shapes, label_shapes)
-        if len(self._context) > 1:
-            from ..parallel.mesh import distinct_devices
-            n_dev = len(distinct_devices(self._context))
-            batch = self._data_shapes[0].shape[0]
-            if n_dev > 1 and batch % n_dev != 0:
-                raise MXNetError(
-                    "reshape: batch size %d not divisible by %d devices"
-                    % (batch, n_dev))
-        kwargs = {d.name: d.shape for d in self._data_shapes}
-        if self._label_shapes:
-            kwargs.update({l.name: l.shape for l in self._label_shapes})
-        self._exec = self._exec.reshape(**kwargs)
+            self._data_names, self._label_names, data_shapes,
+            label_shapes)
+        self._check_mesh_batch(self._data_shapes[0].shape[0], "reshape")
+        feed = {d.name: d.shape for d in self._data_shapes}
+        feed.update((l.name, l.shape)
+                    for l in (self._label_shapes or []))
+        self._exec = self._exec.reshape(**feed)
 
     def prepare(self, data_batch, sparse_row_id_fn=None):
         pass

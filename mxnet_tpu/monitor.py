@@ -13,7 +13,9 @@ __all__ = ["Monitor"]
 
 
 class Monitor:
-    def __init__(self, interval, stat_func=None, pattern='.*', sort=False):
+    def __init__(self, interval, stat_func=None, pattern='.*', sort=False,
+                 monitor_all=False):
+        self._monitor_all = monitor_all
         if stat_func is None:
             def asum_stat(x):
                 return x.norm() / sqrt(x.size)
@@ -33,10 +35,12 @@ class Monitor:
             self.queue.append((self.step, name, self.stat_func(arr)))
         self.stat_helper = stat_helper
 
-    def install(self, exe, monitor_all=False):
-        """Attach to an executor; with ``monitor_all`` every operator
-        output is tapped inside the compiled program (reference:
+    def install(self, exe, monitor_all=None):
+        """Attach to an executor; with ``monitor_all`` (here or on the
+        constructor) every operator output is tapped (reference:
         MXExecutorSetMonitorCallback monitor_all)."""
+        if monitor_all is None:
+            monitor_all = self._monitor_all
         exe.set_monitor_callback(self.stat_helper, monitor_all)
         self.exes.append(exe)
 

@@ -139,3 +139,45 @@ class TestSVRG:
             mod.update_full_grads(it)
         last = epoch_loss()
         assert last < first * 0.2, (first, last)
+
+
+class TestReviewRegressions3:
+    def test_unroll_length_one(self):
+        from mxnet_tpu.gluon import rnn as grnn
+        cell = grnn.LSTMCell(4, input_size=3)
+        cell.initialize(mx.init.Xavier())
+        x = mx.nd.ones((2, 1, 3))          # (B, T=1, C)
+        outs, states = cell.unroll(1, x, layout="NTC",
+                                   merge_outputs=False)
+        assert len(outs) == 1 and outs[0].shape == (2, 4)
+
+    def test_monitor_all_through_module_training(self):
+        data = mx.sym.var("data")
+        lbl = mx.sym.var("softmax_label")
+        out = mx.sym.SoftmaxOutput(
+            mx.sym.FullyConnected(data, num_hidden=2, name="mfc"), lbl)
+        mod = mx.mod.Module(out, context=default_context())
+        from mxnet_tpu.io.io import DataDesc, DataBatch
+        mod.bind([DataDesc("data", (4, 3))],
+                 [DataDesc("softmax_label", (4,))])
+        mod.init_params(mx.init.Xavier())
+        mod.init_optimizer()
+        mon = mx.monitor.Monitor(interval=1, pattern=".*",
+                                 monitor_all=True)
+        mod.install_monitor(mon)
+        mon.tic()
+        batch = DataBatch([mx.nd.ones((4, 3))],
+                          [mx.nd.array([0, 1, 0, 1])])
+        mod.forward(batch, is_train=True)
+        _ = mod.get_outputs()[0].asnumpy()
+        stats = mon.toc()
+        assert any("mfc" in n for (_, n, _) in stats), \
+            [n for (_, n, _) in stats]
+
+    def test_word2vec_header_skipped(self, tmp_path):
+        f = tmp_path / "w2v.txt"
+        f.write_text("2 3\ncat 1.0 2.0 3.0\ndog 4.0 5.0 6.0\n")
+        emb = mx.contrib.text.CustomEmbedding(str(f))
+        assert emb.vec_len == 3
+        np.testing.assert_allclose(
+            emb.get_vecs_by_tokens("dog").asnumpy(), [4.0, 5.0, 6.0])
