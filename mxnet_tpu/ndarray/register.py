@@ -52,7 +52,7 @@ def make_stub(op):
         return invoke_nd(op, tensors, kwargs, out=out, ctx=ctx)
 
     stub.__name__ = op.name
-    stub.__doc__ = op.description
+    stub.__doc__ = op.doc_signature()
     return stub
 
 

@@ -62,7 +62,11 @@ def _embedding(attrs, data, weight):
 
 register("Embedding", _embedding, arg_names=("data", "weight"),
          defaults={"input_dim": 0, "output_dim": 0, "dtype": "float32",
-                   "sparse_grad": False})
+                   "sparse_grad": False},
+         attr_docs={"input_dim": "vocabulary size",
+                    "output_dim": "embedding width",
+                    "sparse_grad": "produce a row_sparse gradient"},
+         attr_ranges={"input_dim": (0, None), "output_dim": (0, None)})
 
 
 def _gather_nd(attrs, data, indices):

@@ -56,7 +56,11 @@ def _bias_args(names):
 register("FullyConnected", _fully_connected,
          arg_names=("data", "weight", "bias"),
          defaults={"num_hidden": 0, "no_bias": False, "flatten": True},
-         arg_names_fn=_bias_args(["data", "weight", "bias"]))
+         arg_names_fn=_bias_args(["data", "weight", "bias"]),
+         attr_docs={"num_hidden": "output feature count",
+                    "no_bias": "skip the bias term",
+                    "flatten": "collapse trailing input dims first"},
+         attr_ranges={"num_hidden": (0, None)})
 
 
 # ---------------------------------------------------------------------------
@@ -100,7 +104,14 @@ register("Convolution", _convolution, arg_names=("data", "weight", "bias"),
                    "num_filter": 0, "num_group": 1, "workspace": 1024,
                    "no_bias": False, "cudnn_tune": None, "cudnn_off": False,
                    "layout": None},
-         arg_names_fn=_bias_args(["data", "weight", "bias"]))
+         arg_names_fn=_bias_args(["data", "weight", "bias"]),
+         attr_docs={"kernel": "spatial window, e.g. (3, 3)",
+                    "stride": "window step per spatial dim",
+                    "dilate": "kernel dilation per spatial dim",
+                    "pad": "zero padding per spatial dim",
+                    "num_filter": "output channels",
+                    "num_group": "grouped-convolution groups"},
+         attr_ranges={"num_filter": (0, None), "num_group": (1, None)})
 
 
 def _deconvolution(attrs, data, weight, bias=None):
@@ -167,7 +178,9 @@ def _activation(attrs, x):
 
 
 register("Activation", _activation, arg_names=_D,
-         defaults={"act_type": "relu"})
+         defaults={"act_type": "relu"},
+         attr_docs={"act_type": "one of relu/sigmoid/tanh/softrelu/"
+                                "softsign"})
 
 
 def _leaky_relu_outputs(attrs):
@@ -254,7 +267,14 @@ register("BatchNorm", _batch_norm,
          defaults={"eps": 1e-3, "momentum": 0.9, "fix_gamma": True,
                    "use_global_stats": False, "output_mean_var": False,
                    "axis": 1, "cudnn_off": False, "__train__": False},
-         num_outputs=_batch_norm_outputs, mutable_inputs=(3, 4))
+         num_outputs=_batch_norm_outputs, mutable_inputs=(3, 4),
+         attr_docs={"eps": "added to variance for numeric stability",
+                    "momentum": "running-stat decay factor",
+                    "fix_gamma": "freeze gamma at 1",
+                    "use_global_stats": "normalize with running stats "
+                                        "even in training",
+                    "axis": "channel axis"},
+         attr_ranges={"momentum": (0.0, 1.0), "eps": (0.0, None)})
 
 
 def _layer_norm(attrs, data, gamma, beta):
@@ -561,7 +581,13 @@ def _dropout(attrs, data, rng=None):
 
 register("Dropout", _dropout, arg_names=_D, needs_rng=True,
          defaults={"p": 0.5, "mode": "training", "axes": (),
-                   "cudnn_off": False, "__train__": False})
+                   "cudnn_off": False, "__train__": False},
+         attr_docs={"p": "fraction of inputs zeroed during training",
+                    "axes": "axes sharing one dropout mask "
+                            "(broadcast dropout)",
+                    "mode": "'training' (only when training) or "
+                            "'always'"},
+         attr_ranges={"p": (0.0, 1.0)})
 
 
 # ---------------------------------------------------------------------------

@@ -65,7 +65,9 @@ class OpDef:
                  needs_rng: bool = False,
                  mutable_inputs: Sequence[int] = (),
                  arg_names_fn: Optional[Callable] = None,
-                 description: str = ""):
+                 description: str = "",
+                 attr_docs: Optional[Dict[str, str]] = None,
+                 attr_ranges: Optional[Dict[str, tuple]] = None):
         self.name = name
         self.forward = forward
         self.arg_names = list(arg_names)
@@ -76,6 +78,45 @@ class OpDef:
         self.mutable_inputs = tuple(mutable_inputs)
         self.arg_names_fn = arg_names_fn  # attrs -> effective input names
         self.description = description or (forward.__doc__ or "")
+        # the dmlc Parameter-struct tier (SURVEY §5.6 tier 2): per-attr
+        # documentation and (lo, hi) ranges; both feed the generated
+        # frontend stubs' docstrings, ranges also validate at invoke
+        self.attr_docs = dict(attr_docs or {})
+        self.attr_ranges = dict(attr_ranges or {})
+
+    def doc_signature(self) -> str:
+        """Human signature + parameter table for generated stubs (the
+        role of the reference's codegen from DMLC_DECLARE_FIELD docs,
+        python/mxnet/ndarray/register.py:30)."""
+        lines = ["%s(%s, **attrs)" % (self.name,
+                                      ", ".join(self.arg_names)), ""]
+        if self.description:
+            lines += [self.description.strip(), ""]
+        if self.defaults:
+            lines.append("Parameters")
+            lines.append("----------")
+            for key, default in self.defaults.items():
+                if key.startswith("__"):
+                    continue
+                entry = "%s : default %r" % (key, default)
+                if key in self.attr_ranges:
+                    entry += ", range %s" % (self.attr_ranges[key],)
+                lines.append(entry)
+                if key in self.attr_docs:
+                    lines.append("    " + self.attr_docs[key])
+        return "\n".join(lines)
+
+    def validate_attrs(self, nattrs: Dict[str, Any]) -> None:
+        """Range checks from the param tier (dmlc set_range role)."""
+        for key, (lo, hi) in self.attr_ranges.items():
+            val = nattrs.get(key)
+            if val is None or not isinstance(val, (int, float)):
+                continue
+            if (lo is not None and val < lo) or \
+                    (hi is not None and val > hi):
+                raise MXNetError(
+                    "%s: attribute %s=%r outside valid range [%s, %s]"
+                    % (self.name, key, val, lo, hi))
 
     # -- helpers ---------------------------------------------------------
     def resolve_num_outputs(self, attrs: Dict[str, Any]) -> int:
@@ -151,13 +192,16 @@ def _parse_attr_value(v):
 
 
 def normalize_attrs(op: OpDef, attrs: Dict[str, Any]) -> Dict[str, Any]:
-    """Merge with defaults and parse stringly-typed values (from Symbol
-    JSON or frontend kwargs), mirroring dmlc Parameter::Init."""
+    """Merge with defaults, parse stringly-typed values (from Symbol
+    JSON or frontend kwargs), and range-check — mirroring dmlc
+    Parameter::Init + set_range."""
     out = dict(op.defaults)
     for k, v in attrs.items():
         if v is None and k in out:
             continue
         out[k] = _parse_attr_value(v)
+    if op.attr_ranges:
+        op.validate_attrs(out)
     return out
 
 

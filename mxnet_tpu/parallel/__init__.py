@@ -12,5 +12,6 @@ from .ring_attention import ring_attention, ulysses_attention, \
 from .data_parallel import (make_data_parallel_step, shard_params,
                             DistributedTrainer)
 from .pipeline import pipeline_apply, stack_stage_params
+from .flash_attention import flash_attention
 from .moe import moe_ffn, topk_route, load_balance_loss
 from . import distributed

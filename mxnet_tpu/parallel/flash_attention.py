@@ -1,0 +1,169 @@
+"""Fused (flash) attention as a Pallas TPU kernel.
+
+The hot op of the long-context path (SURVEY §5.7): K/V stream through
+VMEM one block per grid step with the numerically-stable running
+max/sum accumulation, so neither the (Tq, Tk) score matrix nor the
+full K/V sequence is ever VMEM-resident — the role cuDNN fused
+attention plays for the reference's GPU builds, written against the
+MXU/VMEM model from the Pallas guide. The TPU grid executes
+sequentially, so the accumulator lives in VMEM scratch across the
+k-block axis (the canonical TPU flash pattern).
+
+Differentiation: the kernel carries a ``jax.custom_vjp`` whose
+backward recomputes through the jnp composition — forward inference
+rides the kernel, training gradients ride XLA.
+
+``flash_attention`` dispatches to the kernel on TPU backends (when the
+sequence tiles evenly) and to the jnp composition elsewhere; tests pin
+kernel correctness on CPU via Pallas interpret mode
+(``force_pallas=True``).
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+
+__all__ = ["flash_attention"]
+
+
+def _jnp_reference(q, k, v, scale, causal):
+    import jax.numpy as jnp
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    if causal:
+        Tq, Tk = q.shape[1], k.shape[1]
+        mask = jnp.tril(jnp.ones((Tq, Tk), dtype=bool))
+        s = jnp.where(mask[None, None], s, -1e30)
+    p = jnp.asarray(
+        jnp.exp(s - jnp.max(s, axis=-1, keepdims=True)), q.dtype)
+    p = p / jnp.maximum(jnp.sum(p, axis=-1, keepdims=True), 1e-30)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v)
+
+
+def _kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
+            scale, causal, block_q, block_k, n_kb):
+    """Grid = (batch*heads, q_blocks, k_blocks), k innermost: scratch
+    accumulators carry across the sequential k steps."""
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    qi = pl.program_id(1)
+    kb = pl.program_id(2)
+
+    @pl.when(kb == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, -jnp.inf)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    # causal: K blocks fully above the diagonal contribute nothing
+    live = True
+    if causal:
+        live = kb * block_k <= (qi + 1) * block_q - 1
+
+    @pl.when(live)
+    def _step():
+        q = q_ref[...].astype(jnp.float32) * scale    # (bq, d)
+        k = k_ref[...].astype(jnp.float32)            # (bk, d)
+        v = v_ref[...].astype(jnp.float32)
+        s = q @ k.T                                   # (bq, bk)
+        if causal:
+            q_pos = qi * block_q + jax.lax.iota(
+                jnp.int32, block_q)[:, None]
+            k_pos = kb * block_k + jax.lax.iota(
+                jnp.int32, block_k)[None, :]
+            s = jnp.where(q_pos >= k_pos, s, -1e30)
+        m_prev = m_ref[...]
+        l_prev = l_ref[...]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1))
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new[:, None])
+        m_ref[...] = m_new
+        l_ref[...] = l_prev * alpha + jnp.sum(p, axis=-1)
+        acc_ref[...] = acc_ref[...] * alpha[:, None] + p @ v
+
+    @pl.when(kb == n_kb - 1)
+    def _finish():
+        o_ref[...] = (acc_ref[...] / jnp.maximum(
+            l_ref[...], 1e-30)[:, None]).astype(o_ref.dtype)
+
+
+def _pallas_attention(q, k, v, scale, causal, block_q, block_k,
+                      interpret):
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    B, Tq, H, D = q.shape
+    Tk = k.shape[1]
+    qf = jnp.moveaxis(q, 2, 1).reshape(B * H, Tq, D)
+    kf = jnp.moveaxis(k, 2, 1).reshape(B * H, Tk, D)
+    vf = jnp.moveaxis(v, 2, 1).reshape(B * H, Tk, D)
+    n_kb = Tk // block_k
+
+    scratch = [pltpu.VMEM((block_q, D), jnp.float32),
+               pltpu.VMEM((block_q,), jnp.float32),
+               pltpu.VMEM((block_q,), jnp.float32)]
+
+    out = pl.pallas_call(
+        functools.partial(_kernel, scale=scale, causal=causal,
+                          block_q=block_q, block_k=block_k, n_kb=n_kb),
+        grid=(B * H, Tq // block_q, n_kb),
+        in_specs=[
+            pl.BlockSpec((None, block_q, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((None, block_k, D), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((None, block_k, D), lambda b, i, j: (b, j, 0)),
+        ],
+        out_specs=pl.BlockSpec((None, block_q, D),
+                               lambda b, i, j: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((B * H, Tq, D), q.dtype),
+        scratch_shapes=scratch,
+        interpret=interpret,
+    )(qf, kf, vf)
+    return jnp.moveaxis(out.reshape(B, H, Tq, D), 1, 2)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash(q, k, v, scale, causal, block_q, block_k, interpret):
+    return _pallas_attention(q, k, v, scale, causal, block_q, block_k,
+                             interpret)
+
+
+def _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret):
+    out = _pallas_attention(q, k, v, scale, causal, block_q, block_k,
+                            interpret)
+    return out, (q, k, v)
+
+
+def _flash_bwd(scale, causal, block_q, block_k, interpret, res, g):
+    # backward recomputes through the jnp composition (XLA fuses it);
+    # the kernel stays a forward-path accelerator
+    q, k, v = res
+    _, vjp = jax.vjp(
+        lambda q_, k_, v_: _jnp_reference(q_, k_, v_, scale, causal),
+        q, k, v)
+    return vjp(g)
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(q, k, v, causal=False, scale=None, block_q=512,
+                    block_k=512, force_pallas=False):
+    """Attention over (B, T, H, D) tensors.
+
+    The Pallas kernel runs on TPU (or under ``force_pallas`` in
+    interpret mode) when both sequence lengths tile evenly by the
+    block sizes; otherwise the jnp composition runs — same math,
+    differentiable everywhere.
+    """
+    scale = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
+    on_tpu = jax.devices()[0].platform == "tpu"
+    Tq, Tk = q.shape[1], k.shape[1]
+    usable = (Tq % block_q == 0) and (Tk % block_k == 0)
+    if (on_tpu or force_pallas) and usable:
+        return _flash(q, k, v, scale, causal, block_q, block_k,
+                      not on_tpu)
+    return _jnp_reference(q, k, v, scale, causal)

@@ -60,16 +60,11 @@ def _merge_blocks(o1, m1, l1, o2, m2, l2):
 
 
 def local_attention(q, k, v, causal=False, scale=None):
-    """Plain attention for unsharded inputs (B, T, H, D)."""
-    import jax.numpy as jnp
-    scale = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
-    mask = None
-    if causal:
-        Tq, Tk = q.shape[1], k.shape[1]
-        mask = jnp.tril(jnp.ones((Tq, Tk), dtype=bool))[None, None]
-    o, m, l = _block_attn(q, k, v, scale, mask)
-    lt = jnp.swapaxes(l, 1, 2)[..., None]
-    return o / jnp.maximum(lt, 1e-30)
+    """Attention for unsharded inputs (B, T, H, D): delegates to
+    flash_attention, which picks the Pallas kernel on TPU and the jnp
+    composition elsewhere (one shared implementation of the math)."""
+    from .flash_attention import flash_attention
+    return flash_attention(q, k, v, causal=causal, scale=scale)
 
 
 def ring_attention(q, k, v, mesh=None, axis="sp", causal=False, scale=None):

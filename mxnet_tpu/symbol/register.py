@@ -38,7 +38,7 @@ def make_stub(op):
         return out
 
     stub.__name__ = op.name
-    stub.__doc__ = op.description
+    stub.__doc__ = op.doc_signature()
     return stub
 
 

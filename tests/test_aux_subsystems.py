@@ -181,3 +181,20 @@ class TestReviewRegressions3:
         assert emb.vec_len == 3
         np.testing.assert_allclose(
             emb.get_vecs_by_tokens("dog").asnumpy(), [4.0, 5.0, 6.0])
+
+
+class TestOpParamTier:
+    """SURVEY §5.6 tier 2: attr docs + ranges feed generated stubs."""
+
+    def test_generated_docstrings(self):
+        assert "range (0.0, 1.0)" in mx.nd.Dropout.__doc__
+        assert "vocabulary size" in mx.sym.Embedding.__doc__
+        assert "output channels" in mx.nd.Convolution.__doc__
+
+    def test_range_validation(self):
+        with pytest.raises(mx.base.MXNetError, match="outside valid"):
+            mx.nd.Dropout(mx.nd.ones((2, 2)), p=-0.1)
+        with pytest.raises(mx.base.MXNetError, match="outside valid"):
+            mx.nd.FullyConnected(mx.nd.ones((2, 2)),
+                                 mx.nd.ones((3, 2)),
+                                 mx.nd.zeros((3,)), num_hidden=-3)

@@ -1,0 +1,67 @@
+"""Pallas flash-attention kernel (parallel/flash_attention.py) — pinned
+in interpret mode on the CPU mesh; gradient flow through the jnp path."""
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from mxnet_tpu.parallel.flash_attention import (flash_attention,
+                                                _jnp_reference)
+
+
+def _rand(B, T, H, D, seed=0):
+    rng = np.random.RandomState(seed)
+    mk = lambda: jnp.asarray(rng.randn(B, T, H, D), jnp.float32)
+    return mk(), mk(), mk()
+
+
+class TestFlashAttention:
+    def test_matches_reference_noncausal(self):
+        q, k, v = _rand(2, 128, 2, 32)
+        got = flash_attention(q, k, v, force_pallas=True, block_q=64,
+                              block_k=64)
+        want = _jnp_reference(q, k, v, 1.0 / np.sqrt(32), False)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_matches_reference_causal(self):
+        q, k, v = _rand(1, 128, 2, 32, seed=1)
+        got = flash_attention(q, k, v, causal=True, force_pallas=True,
+                              block_q=32, block_k=32)
+        want = _jnp_reference(q, k, v, 1.0 / np.sqrt(32), True)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_uneven_lengths_fall_back(self):
+        q, k, v = _rand(1, 100, 2, 16, seed=2)   # 100 % 64 != 0
+        got = flash_attention(q, k, v, force_pallas=True)
+        want = _jnp_reference(q, k, v, 1.0 / np.sqrt(16), False)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_grads_flow(self):
+        q, k, v = _rand(1, 64, 1, 16, seed=3)
+
+        def loss(q):
+            return jnp.sum(flash_attention(q, k, v, causal=True) ** 2)
+
+        g = jax.grad(loss)(q)
+        assert float(jnp.abs(g).sum()) > 0
+
+    def test_grads_through_pallas_path(self):
+        """custom_vjp: kernel forward, jnp-recompute backward."""
+        q, k, v = _rand(1, 64, 1, 16, seed=4)
+
+        def loss_pallas(q):
+            return jnp.sum(flash_attention(
+                q, k, v, causal=True, force_pallas=True, block_q=32,
+                block_k=32) ** 2)
+
+        def loss_ref(q):
+            return jnp.sum(_jnp_reference(
+                q, k, v, 1.0 / np.sqrt(16), True) ** 2)
+
+        g = jax.grad(loss_pallas)(q)
+        g_ref = jax.grad(loss_ref)(q)
+        np.testing.assert_allclose(np.asarray(g), np.asarray(g_ref),
+                                   rtol=2e-4, atol=2e-5)
