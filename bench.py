@@ -126,7 +126,8 @@ def _bench_inference(batch, iters, peak):
     return img_s, mfu, fwd_flops / batch
 
 
-def _bench_training_framework_path(peak, flops_per_img):
+def _bench_training_framework_path(peak, flops_per_img, batch=None,
+                                   check_parity=True):
     """Train step = the Executor's own compiled fwd+bwd program + the
     registered fused sgd_update op, scanned; trajectory-checked against
     the eager Executor + Updater API."""
@@ -136,13 +137,14 @@ def _bench_training_framework_path(peak, flops_per_img):
     from mxnet_tpu import symbol as sym_mod
     from mxnet_tpu.ops.registry import get_op, normalize_attrs
 
-    out_sym, _, arg_names, aux_names, pv, av = _build(BATCH)
+    batch = batch if batch is not None else BATCH
+    out_sym, _, arg_names, aux_names, pv, av = _build(batch)
     label_sym = sym_mod.var("softmax_label")
     loss_sym = sym_mod.create("SoftmaxOutput", [out_sym, label_sym],
                               {"normalization": "batch"}, name="softmax")
 
-    labels = np.random.randint(0, 1000, (BATCH,)).astype(np.float32)
-    x_np = np.random.uniform(0, 1, (BATCH, 3, IMAGE, IMAGE)) \
+    labels = np.random.randint(0, 1000, (batch,)).astype(np.float32)
+    x_np = np.random.uniform(0, 1, (batch, 3, IMAGE, IMAGE)) \
         .astype(np.float32)
 
     args = {n: mx.nd.array(v) for n, v in pv.items()}
@@ -198,7 +200,12 @@ def _bench_training_framework_path(peak, flops_per_img):
     out, first3 = compiled(arg_vals, aux_vals)
     float(out)
     dt = time.perf_counter() - t0
-    img_s = BATCH * TRAIN_ITERS / dt
+    img_s = batch * TRAIN_ITERS / dt
+
+    # training FLOPs: the standard fwd+bwd ~ 3x forward convention
+    mfu = 3.0 * flops_per_img * batch * TRAIN_ITERS / dt / peak
+    if not check_parity:
+        return img_s, mfu
 
     # --- trajectory parity: eager Executor + Updater, 3 steps ----------
     from mxnet_tpu.optimizer import SGD, Updater
@@ -207,7 +214,7 @@ def _bench_training_framework_path(peak, flops_per_img):
     for _ in range(3):
         outs = ex.forward(is_train=True)
         probs = outs[0].asnumpy().astype(np.float64)
-        picked = probs[np.arange(BATCH), labels.astype(np.int64)]
+        picked = probs[np.arange(batch), labels.astype(np.int64)]
         eager_losses.append(-np.mean(np.log(np.maximum(picked, 1e-10))))
         ex.backward()
         for i, n in enumerate(full_names):
@@ -219,10 +226,6 @@ def _bench_training_framework_path(peak, flops_per_img):
             "framework-path trajectory mismatch: scanned %s vs eager %s"
             % (scan_losses.tolist(), eager_losses))
 
-    # training FLOPs: the standard fwd+bwd ≈ 3x forward convention
-    # (XLA's cost model undercounts the custom-vjp transpose convs, so
-    # per-image forward FLOPs are supplied by the inference bench)
-    mfu = 3.0 * flops_per_img * BATCH * TRAIN_ITERS / dt / peak
     return img_s, mfu
 
 
@@ -266,6 +269,8 @@ def main():
 
     train_img_s, train_mfu = _bench_training_framework_path(
         peak, gf_per_img)
+    t128_img_s, t128_mfu = _bench_training_framework_path(
+        peak, gf_per_img, batch=128, check_parity=False)
     allreduce_gbps = _bench_allreduce_bandwidth()
 
     record = {
@@ -277,6 +282,8 @@ def main():
         "training_img_per_sec_per_chip": round(train_img_s, 2),
         "training_vs_baseline": round(train_img_s / BASELINE_TRAIN, 3),
         "training_mfu_pct": round(100 * train_mfu, 1),
+        "training_img_per_sec_batch128": round(t128_img_s, 2),
+        "training_mfu_pct_batch128": round(100 * t128_mfu, 1),
         "training_path": "Executor.fwdbwd + fused sgd_update op "
                          "(trajectory-parity checked vs eager "
                          "Executor+Updater)",

@@ -1,4 +1,4 @@
 """IO namespace (parity: python/mxnet/io/)."""
 from .io import (DataDesc, DataBatch, DataIter, ResizeIter, PrefetchingIter,
                  NDArrayIter, MNISTIter, CSVIter, LibSVMIter)
-from .image_record import ImageRecordIter
+from .image_record import ImageRecordIter, ImageDetRecordIter

@@ -23,7 +23,7 @@ from ..base import MXNetError
 from ..recordio import MXRecordIO, MXIndexedRecordIO, unpack
 from .io import DataBatch, DataDesc, DataIter
 
-__all__ = ["ImageRecordIter"]
+__all__ = ["ImageRecordIter", "ImageDetRecordIter"]
 
 
 def _decode_jpeg(payload):
@@ -124,7 +124,10 @@ class ImageRecordIter(DataIter):
         return order
 
     # -- decode + augment -------------------------------------------------
-    def _prepare(self, payload, mirror, crop_pos):
+    def _prepare_image(self, payload, mirror, crop_pos):
+        """Decode + augment one record; returns (chw, header, geometry)
+        where geometry = (oy, ox, th, tw, h, w, mirrored) describes the
+        crop so subclasses can transform coordinates accordingly."""
         header, body = unpack(payload)
         img = _decode_jpeg(body).astype(np.float32)
         c, th, tw = self._shape
@@ -146,6 +149,10 @@ class ImageRecordIter(DataIter):
             img = img[:, ::-1]
         img = (img - self._mean) / self._std * self._scale
         chw = np.transpose(img, (2, 0, 1))
+        return chw, header, (oy, ox, th, tw, h, w, bool(mirror))
+
+    def _prepare(self, payload, mirror, crop_pos):
+        chw, header, _ = self._prepare_image(payload, mirror, crop_pos)
         label = np.asarray(header.label, np.float32).reshape(-1)
         if label.size == 0:
             label = np.zeros((self._label_width,), np.float32)
@@ -170,7 +177,7 @@ class ImageRecordIter(DataIter):
         from ..ndarray import array as nd_array
         data = nd_array(np.stack(images))
         lab = np.stack(labels)
-        if self._label_width == 1:
+        if self._label_width == 1 and lab.ndim == 2:
             lab = lab[:, 0]
         return DataBatch([data], [nd_array(lab)], pad=0)
 
@@ -230,3 +237,66 @@ class ImageRecordIter(DataIter):
 
     def __del__(self):
         self.close()
+
+
+class ImageDetRecordIter(ImageRecordIter):
+    """Detection variant (reference: src/io/iter_image_det_recordio.cc):
+    each record's label is a variable-length flat vector of
+    ``object_width``-wide object rows ([cls, x1, y1, x2, y2, ...]);
+    batches pad every image to ``label_pad_width`` objects with
+    ``label_pad_value`` so the label tensor is rectangular —
+    (batch, label_pad_width, object_width)."""
+
+    def __init__(self, path_imgrec, data_shape, batch_size,
+                 object_width=5, label_pad_width=16,
+                 label_pad_value=-1.0, **kwargs):
+        self._object_width = int(object_width)
+        self._label_pad_width = int(label_pad_width)
+        self._label_pad_value = float(label_pad_value)
+        kwargs.setdefault("label_width", 1)
+        super().__init__(path_imgrec, data_shape, batch_size, **kwargs)
+        self.provide_label = [DataDesc(
+            self.provide_label[0].name,
+            (self.batch_size, self._label_pad_width, self._object_width))]
+
+    def _transform_boxes(self, objs, geom):
+        """Map normalized [x1,y1,x2,y2] from the original image into
+        the cropped/mirrored frame (reference:
+        image_det_aug_default.cc); boxes left entirely outside the crop
+        become padding rows."""
+        oy, ox, th, tw, h, w, mirrored = geom
+        out = objs.copy()
+        x1 = objs[:, 1] * w - ox
+        y1 = objs[:, 2] * h - oy
+        x2 = objs[:, 3] * w - ox
+        y2 = objs[:, 4] * h - oy
+        nx1 = np.clip(x1 / tw, 0.0, 1.0)
+        ny1 = np.clip(y1 / th, 0.0, 1.0)
+        nx2 = np.clip(x2 / tw, 0.0, 1.0)
+        ny2 = np.clip(y2 / th, 0.0, 1.0)
+        if mirrored:
+            nx1, nx2 = 1.0 - nx2, 1.0 - nx1
+        out[:, 1], out[:, 2], out[:, 3], out[:, 4] = nx1, ny1, nx2, ny2
+        gone = (nx2 - nx1 <= 0) | (ny2 - ny1 <= 0)
+        out[gone] = self._label_pad_value
+        return out
+
+    def _prepare(self, payload, mirror, crop_pos):
+        img, header, geom = self._prepare_image(payload, mirror,
+                                                crop_pos)
+        flat = np.asarray(header.label, np.float32).reshape(-1)
+        ow, pw = self._object_width, self._label_pad_width
+        if flat.size % ow:
+            raise MXNetError(
+                "detection record label length %d is not a multiple of "
+                "object_width %d" % (flat.size, ow))
+        n = flat.size // ow
+        if n > pw:
+            raise MXNetError(
+                "record has %d objects but label_pad_width is %d; "
+                "raise label_pad_width" % (n, pw))
+        objs = np.full((pw, ow), self._label_pad_value, np.float32)
+        if n:
+            objs[:n] = self._transform_boxes(flat.reshape(n, ow), geom)
+        return img, objs
+

@@ -218,3 +218,96 @@ class TestImageRecordPartialBatch:
         seq.forward(DataBatch([mx.nd.ones((2, 6))], None),
                     is_train=False)
         assert seq.get_outputs()[0].shape == (2, 2)
+
+
+class TestImageDetRecordIter:
+    def test_variable_object_labels(self, tmp_path):
+        from PIL import Image
+        from mxnet_tpu.recordio import (MXIndexedRecordIO, IRHeader,
+                                        pack_img)
+        rng = np.random.RandomState(0)
+        prefix = str(tmp_path / "det")
+        rec = MXIndexedRecordIO(prefix + ".idx", prefix + ".rec", "w")
+        img = rng.randint(0, 255, (32, 32, 3), dtype=np.uint8)
+        # record 0: two objects; record 1: one object
+        labels = [np.array([0, .1, .1, .5, .5, 1, .2, .2, .8, .8],
+                           np.float32),
+                  np.array([2, .3, .3, .9, .9], np.float32)]
+        for i, lab in enumerate(labels):
+            rec.write_idx(i, pack_img(IRHeader(0, lab, i, 0), img,
+                                      quality=90))
+        rec.close()
+
+        it = mx.io.ImageDetRecordIter(
+            path_imgrec=prefix + ".rec", path_imgidx=prefix + ".idx",
+            data_shape=(3, 28, 28), batch_size=2, object_width=5,
+            label_pad_width=4)
+        batch = next(iter(it))
+        lab = batch.label[0].asnumpy()
+        assert lab.shape == (2, 4, 5)
+
+        def xform(c):       # 32->28 center crop: (c*32 - 2) / 28
+            return np.clip((np.asarray(c) * 32 - 2) / 28, 0, 1)
+
+        np.testing.assert_allclose(
+            lab[0, 0], [0] + list(xform([.1, .1, .5, .5])), atol=1e-5)
+        np.testing.assert_allclose(lab[0, 1, 0], 1)
+        assert (lab[0, 2:] == -1).all()        # padded rows
+        np.testing.assert_allclose(lab[1, 0, 0], 2)
+        assert (lab[1, 1:] == -1).all()
+        it.close()
+
+
+class TestDetBoxTransforms:
+    def _write_one(self, tmp_path, label, size=(40, 40)):
+        from mxnet_tpu.recordio import (MXIndexedRecordIO, IRHeader,
+                                        pack_img)
+        rng = np.random.RandomState(0)
+        prefix = str(tmp_path / "dt")
+        rec = MXIndexedRecordIO(prefix + ".idx", prefix + ".rec", "w")
+        img = rng.randint(0, 255, size + (3,), dtype=np.uint8)
+        rec.write_idx(0, pack_img(IRHeader(0, label, 0, 0), img,
+                                  quality=90))
+        rec.close()
+        return prefix
+
+    def test_mirror_flips_boxes(self, tmp_path):
+        label = np.array([1, .1, .2, .4, .6], np.float32)
+        prefix = self._write_one(tmp_path, label)
+        # force mirror by seeding: scan seeds until mirrored
+        for seed in range(20):
+            it = mx.io.ImageDetRecordIter(
+                path_imgrec=prefix + ".rec", path_imgidx=prefix + ".idx",
+                data_shape=(3, 40, 40), batch_size=1, object_width=5,
+                label_pad_width=2, rand_mirror=True, seed=seed)
+            lab = next(iter(it)).label[0].asnumpy()[0, 0]
+            it.close()
+            if not np.allclose(lab[1:], label[1:]):
+                np.testing.assert_allclose(
+                    lab[1:], [1 - .4, .2, 1 - .1, .6], atol=1e-5)
+                return
+        raise AssertionError("no mirrored draw in 20 seeds")
+
+    def test_crop_shifts_boxes(self, tmp_path):
+        # center crop 40 -> 20: offset 10 px each side
+        label = np.array([0, .25, .25, .75, .75], np.float32)
+        prefix = self._write_one(tmp_path, label)
+        it = mx.io.ImageDetRecordIter(
+            path_imgrec=prefix + ".rec", path_imgidx=prefix + ".idx",
+            data_shape=(3, 20, 20), batch_size=1, object_width=5,
+            label_pad_width=2)
+        lab = next(iter(it)).label[0].asnumpy()[0, 0]
+        it.close()
+        # (.25*40-10)/20 = 0 ; (.75*40-10)/20 = 1
+        np.testing.assert_allclose(lab[1:], [0, 0, 1, 1], atol=1e-5)
+
+    def test_overflow_raises(self, tmp_path):
+        label = np.tile(np.array([0, .1, .1, .2, .2], np.float32), 3)
+        prefix = self._write_one(tmp_path, label)
+        it = mx.io.ImageDetRecordIter(
+            path_imgrec=prefix + ".rec", path_imgidx=prefix + ".idx",
+            data_shape=(3, 40, 40), batch_size=1, object_width=5,
+            label_pad_width=2)
+        with pytest.raises(mx.base.MXNetError, match="label_pad_width"):
+            next(iter(it))
+        it.close()
