@@ -14,8 +14,8 @@ reference reports (it, too, excludes host-side input prep).
 Training runs the FRAMEWORK'S OWN compiled train program: the bound
 Executor's forward+backward (`Executor._get_fn("fwdbwd")` — the same
 program `Module.fit`/`ex.backward()` executes) chained into the
-registered fused `sgd_update` operator (the same op `Trainer`/`Updater`
-dispatches), scanned. A 3-step eager run through the Executor +
+registered aggregated `multi_sgd_update` operator (the reference's
+multi-tensor aggregation feature), scanned. A 3-step eager run through the Executor +
 Updater API is asserted to follow the same loss trajectory, proving
 the scanned program IS the framework path, not a hand-rolled twin.
 
@@ -128,9 +128,9 @@ def _bench_inference(batch, iters, peak):
 
 def _bench_training_framework_path(peak, flops_per_img, batch=None,
                                    check_parity=True):
-    """Train step = the Executor's own compiled fwd+bwd program + the
-    registered fused sgd_update op, scanned; trajectory-checked against
-    the eager Executor + Updater API."""
+    """Train step = the Executor's own compiled fwd+bwd program + ONE
+    aggregated multi_sgd_update op over every weight, scanned;
+    trajectory-checked against the eager Executor + Updater API."""
     import jax
     import jax.numpy as jnp
     import mxnet_tpu as mx
@@ -160,9 +160,14 @@ def _bench_training_framework_path(peak, flops_per_img, batch=None,
 
     fwdbwd = ex._get_fn("fwdbwd", True)          # the framework program
     gpos = ex._grad_positions
-    sgd = get_op("sgd_update")
-    sgd_attrs = normalize_attrs(sgd, {"lr": 0.05, "wd": 0.0,
-                                      "rescale_grad": 1.0})
+    # aggregated multi-tensor SGD: ONE registered multi_sgd_update call
+    # over every weight (the reference's MXNET_OPTIMIZER_AGGREGATION
+    # feature, optimizer_op.cc multi_sgd_update)
+    msgd = get_op("multi_sgd_update")
+    n_w = len(gpos)
+    msgd_attrs = normalize_attrs(msgd, {
+        "num_weights": n_w, "lrs": (0.05,) * n_w, "wds": (0.0,) * n_w,
+        "rescale_grad": 1.0})
     full_names = loss_sym.list_arguments()
     out_shapes = [tuple(o.shape) for o in _probe_outputs(ex)]
 
@@ -171,8 +176,12 @@ def _bench_training_framework_path(peak, flops_per_img, batch=None,
         outs, new_aux, gs = fwdbwd(tuple(arg_vals), tuple(aux_vals),
                                    (), cots)
         arg_vals = list(arg_vals)
+        flat = []
         for p, g in zip(gpos, gs):
-            arg_vals[p] = sgd.forward(sgd_attrs, arg_vals[p], g)
+            flat.extend((arg_vals[p], g))
+        new_ws = msgd.forward(msgd_attrs, *flat)
+        for p, w_new in zip(gpos, new_ws):
+            arg_vals[p] = w_new
         probs = outs[0].astype(jnp.float32)
         picked = jnp.take_along_axis(
             probs, jnp.asarray(labels[:, None], jnp.int32), axis=1)
@@ -284,8 +293,8 @@ def main():
         "training_mfu_pct": round(100 * train_mfu, 1),
         "training_img_per_sec_batch128": round(t128_img_s, 2),
         "training_mfu_pct_batch128": round(100 * t128_mfu, 1),
-        "training_path": "Executor.fwdbwd + fused sgd_update op "
-                         "(trajectory-parity checked vs eager "
+        "training_path": "Executor.fwdbwd + aggregated multi_sgd_update "
+                         "op (trajectory-parity checked vs eager "
                          "Executor+Updater)",
         "kvstore_pushpull_gbps": round(allreduce_gbps, 1),
         "flops_per_image_gf": round(gf_per_img / 1e9, 2),

@@ -1,0 +1,12 @@
+"""Shared installer for the stripped `_contrib_*` op namespaces
+(mx.nd.contrib.box_nms ≙ _contrib_box_nms), matching the reference's
+generated contrib namespaces."""
+from __future__ import annotations
+
+
+def install_contrib_ops(namespace, make_stub):
+    from .. import ops as _ops
+    for name in _ops.list_ops():
+        if name.startswith("_contrib_"):
+            short = name[len("_contrib_"):]
+            namespace.setdefault(short, make_stub(_ops.get_op(name)))

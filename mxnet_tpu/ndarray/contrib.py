@@ -109,3 +109,12 @@ def cond(pred, then_func, else_func):
     functions take no arguments (they close over outer NDArrays)."""
     taken = bool(pred.asnumpy().reshape(()))
     return then_func() if taken else else_func()
+
+
+def _install_contrib_ops():
+    from ..contrib._alias import install_contrib_ops
+    from . import register as _register
+    install_contrib_ops(globals(), _register.make_stub)
+
+
+_install_contrib_ops()

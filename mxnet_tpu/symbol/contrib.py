@@ -204,3 +204,12 @@ def cond(pred, then_func, else_func, name=None):
         name=name)
     outs = [res[i] for i in range(len(then_outs))]
     return outs[0] if then_single else outs
+
+
+def _install_contrib_ops():
+    from ..contrib._alias import install_contrib_ops
+    from . import register as _register
+    install_contrib_ops(globals(), _register.make_stub)
+
+
+_install_contrib_ops()

@@ -522,3 +522,16 @@ class TestReviewRegressions2:
             mx.io.ImageRecordIter(path_imgrec=str(tmp_path / "x.rec"),
                                   data_shape=(3, 8, 8), batch_size=1,
                                   shuffle=True)
+
+
+class TestContribNamespaces:
+    def test_stripped_contrib_aliases(self):
+        import mxnet_tpu as mx
+        a = mx.nd.array([[0.0, 0.0, 1.0, 1.0]])
+        b = mx.nd.array([[0.0, 0.0, 1.0, 1.0]])
+        np.testing.assert_allclose(
+            mx.nd.contrib.box_iou(a, b).asnumpy(), [[1.0]], atol=1e-6)
+        for name in ("box_nms", "quantize_v2", "ROIAlign", "fft",
+                     "quadratic", "MultiBoxPrior"):
+            assert hasattr(mx.nd.contrib, name), name
+            assert hasattr(mx.sym.contrib, name), name
