@@ -1,3 +1,4 @@
 """Contrib nn layers (parity: python/mxnet/gluon/contrib/nn/)."""
 from .basic_layers import (Concurrent, HybridConcurrent, Identity,
-                           SparseEmbedding, SyncBatchNorm, PixelShuffle2D)
+                           SparseEmbedding, SyncBatchNorm, PixelShuffle1D,
+                           PixelShuffle2D, PixelShuffle3D)

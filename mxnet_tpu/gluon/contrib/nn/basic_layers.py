@@ -91,6 +91,52 @@ class SyncBatchNorm(BatchNorm):
         self._num_devices = num_devices
 
 
+class PixelShuffle1D(HybridBlock):
+    """(N, C*f, W) -> (N, C, W*f) sub-pixel upsampling (reference:
+    contrib/nn/basic_layers.py PixelShuffle1D)."""
+
+    def __init__(self, factor):
+        super().__init__()
+        self._factor = int(factor)
+
+    def hybrid_forward(self, F, x):
+        f = self._factor
+        x = F.Reshape(x, shape=(0, -4, -1, f, 0))   # (N, C, f, W)
+        x = F.transpose(x, axes=(0, 1, 3, 2))       # (N, C, W, f)
+        return F.Reshape(x, shape=(0, 0, -3))       # (N, C, W*f)
+
+    def __repr__(self):
+        return "{}({})".format(self.__class__.__name__, self._factor)
+
+
+class PixelShuffle3D(HybridBlock):
+    """(N, C*f1*f2*f3, D, H, W) -> (N, C, D*f1, H*f2, W*f3)
+    (reference: contrib/nn/basic_layers.py PixelShuffle3D)."""
+
+    def __init__(self, factor):
+        super().__init__()
+        try:
+            self._factors = (int(factor),) * 3
+        except TypeError:
+            self._factors = tuple(int(fac) for fac in factor)
+            assert len(self._factors) == 3, \
+                "wrong length {}".format(len(self._factors))
+
+    def hybrid_forward(self, F, x):
+        f1, f2, f3 = self._factors
+        x = F.Reshape(x, shape=(0, -4, -1, f1 * f2 * f3, 0, 0, 0))
+        x = F.Reshape(x, shape=(0, 0, -4, f1, -1, 0, 0, 0))
+        x = F.Reshape(x, shape=(0, 0, 0, -4, f2, f3, 0, 0, 0))
+        # now (N, C, f1, f2, f3, D, H, W)
+        x = F.transpose(x, axes=(0, 1, 5, 2, 6, 3, 7, 4))
+        # (N, C, D, f1, H, f2, W, f3)
+        x = F.Reshape(x, shape=(0, 0, -3, -3, -3))
+        return x
+
+    def __repr__(self):
+        return "{}({})".format(self.__class__.__name__, self._factors)
+
+
 class PixelShuffle2D(HybridBlock):
     def __init__(self, factor):
         super().__init__()

@@ -1,2 +1,7 @@
 """Contrib RNN cells (parity: python/mxnet/gluon/contrib/rnn/)."""
 from .rnn_cell import VariationalDropoutCell, LSTMPCell
+from .conv_rnn_cell import (Conv1DRNNCell, Conv2DRNNCell,
+                            Conv3DRNNCell, Conv1DLSTMCell,
+                            Conv2DLSTMCell, Conv3DLSTMCell,
+                            Conv1DGRUCell, Conv2DGRUCell,
+                            Conv3DGRUCell)

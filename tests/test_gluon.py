@@ -351,3 +351,98 @@ def test_block_summary_and_repr():
     net.initialize()
     repr(net)
     net.summary(mx.nd.ones((1, 3)))
+
+
+def test_conv_rnn_cells():
+    """Conv RNN/LSTM/GRU cells (reference: contrib/rnn/conv_rnn_cell.py):
+    shapes preserved across unroll for all three gate types/dims."""
+    from mxnet_tpu.gluon.contrib import rnn as crnn
+    B, T, C, H, W, HC = 2, 3, 4, 8, 8, 6
+    rng = np.random.RandomState(0)
+    x = mx.nd.array(rng.randn(B, T, C, H, W).astype(np.float32))
+
+    cell = crnn.Conv2DLSTMCell((C, H, W), HC, i2h_kernel=3,
+                               h2h_kernel=3, i2h_pad=1)
+    cell.initialize(mx.init.Xavier())
+    outs, states = cell.unroll(T, x, layout="NTC", merge_outputs=False)
+    assert len(outs) == T and outs[0].shape == (B, HC, H, W)
+    assert states[0].shape == (B, HC, H, W)
+    assert states[1].shape == (B, HC, H, W)
+
+    gru = crnn.Conv1DGRUCell((C, W), HC, i2h_kernel=3, h2h_kernel=3,
+                             i2h_pad=1)
+    gru.initialize(mx.init.Xavier())
+    x1 = mx.nd.array(rng.randn(B, T, C, W).astype(np.float32))
+    outs1, st1 = gru.unroll(T, x1, layout="NTC", merge_outputs=False)
+    assert outs1[0].shape == (B, HC, W)
+
+    rnn3 = crnn.Conv3DRNNCell((C, 4, 4, 4), HC, i2h_kernel=3,
+                              h2h_kernel=3, i2h_pad=1)
+    rnn3.initialize(mx.init.Xavier())
+    x3 = mx.nd.array(rng.randn(B, T, C, 4, 4, 4).astype(np.float32))
+    outs3, _ = rnn3.unroll(T, x3, layout="NTC", merge_outputs=False)
+    assert outs3[0].shape == (B, HC, 4, 4, 4)
+
+
+def test_conv_lstm_matches_manual_math():
+    """One ConvLSTM step == explicit conv + gate math in numpy space."""
+    from mxnet_tpu.gluon.contrib import rnn as crnn
+    from mxnet_tpu.ndarray.ndarray import invoke_nd
+    B, C, H, W, HC = 1, 2, 5, 5, 3
+    rng = np.random.RandomState(7)
+    cell = crnn.Conv2DLSTMCell((C, H, W), HC, i2h_kernel=3,
+                               h2h_kernel=3, i2h_pad=1)
+    cell.initialize(mx.init.Xavier())
+    x = mx.nd.array(rng.randn(B, C, H, W).astype(np.float32))
+    h0 = mx.nd.zeros((B, HC, H, W))
+    c0 = mx.nd.zeros((B, HC, H, W))
+    out, (h1, c1) = cell(x, [h0, c0])
+
+    conv = lambda d, w, b, pad: invoke_nd(
+        "Convolution", [d, w, b],
+        {"kernel": (3, 3), "num_filter": 4 * HC, "pad": pad}).asnumpy()
+    gates = conv(x, cell.i2h_weight.data(), cell.i2h_bias.data(),
+                 (1, 1)) + \
+        conv(h0, cell.h2h_weight.data(), cell.h2h_bias.data(), (1, 1))
+    i_g, f_g, c_g, o_g = np.split(gates, 4, axis=1)
+    sig = lambda v: 1.0 / (1.0 + np.exp(-v))
+    c_want = sig(f_g) * c0.asnumpy() + sig(i_g) * np.tanh(c_g)
+    h_want = sig(o_g) * np.tanh(c_want)
+    np.testing.assert_allclose(c1.asnumpy(), c_want, rtol=1e-4,
+                               atol=1e-5)
+    np.testing.assert_allclose(h1.asnumpy(), h_want, rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_pixel_shuffle_1d_3d():
+    from mxnet_tpu.gluon.contrib import nn as cnn
+    x = mx.nd.array(np.arange(2 * 6 * 4, dtype=np.float32)
+                    .reshape(2, 6, 4))
+    out = cnn.PixelShuffle1D(3)(x)
+    assert out.shape == (2, 2, 12)
+    # reference semantics: out[n, c, w*f + i] = in[n, c*f + i, w]
+    inp = x.asnumpy()
+    got = out.asnumpy()
+    for w in range(4):
+        for i in range(3):
+            np.testing.assert_allclose(got[0, 0, w * 3 + i],
+                                       inp[0, i, w])
+
+    x3 = mx.nd.array(np.random.RandomState(1)
+                     .randn(1, 8, 2, 2, 2).astype(np.float32))
+    out3 = cnn.PixelShuffle3D(2)(x3)
+    assert out3.shape == (1, 1, 4, 4, 4)
+    # element identity: out[n,c,d*2+i,h*2+j,w*2+k] =
+    #   in[n, ((c*2+i)*2+j)*2+k, d, h, w]
+    inp3 = x3.asnumpy()
+    got3 = out3.asnumpy()
+    for d in range(2):
+        for h in range(2):
+            for w in range(2):
+                for i in range(2):
+                    for j in range(2):
+                        for k in range(2):
+                            np.testing.assert_allclose(
+                                got3[0, 0, d * 2 + i, h * 2 + j,
+                                     w * 2 + k],
+                                inp3[0, (i * 2 + j) * 2 + k, d, h, w])
