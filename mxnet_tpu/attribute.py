@@ -1,9 +1,12 @@
 """Attribute scoping for symbols (parity with python/mxnet/attribute.py).
 
 ``with mx.AttrScope(ctx_group='dev1'):`` is the reference's manual
-model-parallel placement mechanism (SURVEY §2.2); in this framework
-``ctx_group`` and ``__sharding__`` attributes become sharding annotations
-consumed at bind time.
+model-parallel placement mechanism (SURVEY §2.2). In this framework the
+``ctx_group`` attribute is consumed at bind time when ``group2ctx`` is
+passed (``Symbol.bind`` / ``Module(group2ctxs=...)``): the executor
+partitions the graph into per-group segment programs pinned to each
+group's device, with explicit cross-group activation transfer — see
+``placement.GroupedProgram`` (ref graph_executor.cc:907 AssignContext).
 """
 from __future__ import annotations
 

@@ -29,7 +29,7 @@ __all__ = ["Executor"]
 
 class Executor:
     def __init__(self, symbol, ctx, args, args_grad=None, grad_req="write",
-                 aux_states=None, batch_args=None):
+                 aux_states=None, batch_args=None, group2ctx=None):
         from .ndarray import NDArray, zeros as nd_zeros
 
         self._symbol = symbol
@@ -121,6 +121,21 @@ class Executor:
             if n.op is not None and n.op.needs_rng)
         self._monitor_callback = None
         self._build_plan()
+        # ctx_group placement: partition into device-pinned segment
+        # programs (placement.py; ref graph_executor.cc:907
+        # AssignContext) when group2ctx names any group the graph uses
+        self._grouped = None
+        if group2ctx:
+            has_groups = any(
+                n._extra_attrs.get("ctx_group") in group2ctx
+                for n in getattr(self, "_plan_nodes", []))
+            if has_groups:
+                if self._mesh is not None:
+                    raise MXNetError(
+                        "group2ctx placement cannot be combined with a "
+                        "multi-context data-parallel bind")
+                from .placement import GroupedProgram
+                self._grouped = GroupedProgram(self, group2ctx)
 
     # -- graph plan ------------------------------------------------------
     def _build_plan(self):
@@ -165,6 +180,8 @@ class Executor:
                             aux_wb.append(None)
                 self._plan_names = getattr(self, "_plan_names", [])
                 self._plan_names.append(nd_.name)
+                self._plan_nodes = getattr(self, "_plan_nodes", [])
+                self._plan_nodes.append(nd_)
                 self._plan.append((nd_.op, nattrs, tuple(bindings), rs,
                                    aux_wb, slot))
                 node_slot[id(nd_)] = ("res", slot)
@@ -474,8 +491,12 @@ class Executor:
             if is_train:
                 self._store_aux(new_aux)
             return self.outputs
-        fn = self._get_fn("fwd", bool(is_train))
-        outs, new_aux = fn(args, aux, rngs)
+        if self._grouped is not None:
+            outs, new_aux = self._grouped.forward(args, aux, rngs,
+                                                  bool(is_train))
+        else:
+            fn = self._get_fn("fwd", bool(is_train))
+            outs, new_aux = fn(args, aux, rngs)
         self._store_outputs(outs)
         if is_train:
             self._store_aux(new_aux)
@@ -507,7 +528,8 @@ class Executor:
             self.forward(is_train=is_train, **kwargs)
             return
         args, aux = self._gather_inputs(kwargs)
-        fn = self._get_fn("fwdbwd", bool(is_train))
+        fn = None if self._grouped is not None \
+            else self._get_fn("fwdbwd", bool(is_train))
         if out_grads is None:
             ogs = tuple(
                 jnp.ones(tuple(s.shape), s.dtype)
@@ -521,7 +543,11 @@ class Executor:
         if rngs is None:
             rngs = self._rngs()
         self._last_rngs = None  # one replay per forward
-        outs, new_aux, grads = fn(args, aux, rngs, ogs)
+        if self._grouped is not None:
+            outs, new_aux, grads = self._grouped.forward_backward(
+                args, aux, rngs, ogs)
+        else:
+            outs, new_aux, grads = fn(args, aux, rngs, ogs)
         if _refresh_outputs:
             self._store_outputs(outs)
         if is_train:

@@ -66,6 +66,7 @@ class Module(BaseModule):
 
         self._arg_params = self._aux_params = None
         self._params_dirty = False
+        self._group2ctxs = group2ctxs
         self._compression_params = compression_params
         self._optimizer = self._kvstore = self._updater = None
         self._update_on_kvstore = None
@@ -264,9 +265,16 @@ class Module(BaseModule):
 
         from ..executor import Executor
         exec_ctx = self._context if len(self._context) > 1 else ctx
+        # group2ctxs: the reference takes one group->ctx dict per DP
+        # replica (executor_group.py); the single-program TPU bind takes
+        # the first replica's mapping (placement.py segments the graph)
+        g2c = self._group2ctxs
+        if isinstance(g2c, (list, tuple)):
+            g2c = g2c[0] if g2c else None
         self._exec = Executor(
             self._symbol, exec_ctx, args, grads, reqs, aux,
-            batch_args=set(self._data_names) | set(self._label_names))
+            batch_args=set(self._data_names) | set(self._label_names),
+            group2ctx=g2c)
         self.binded = True
 
         if shared_module is not None and shared_module.params_initialized:

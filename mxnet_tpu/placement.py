@@ -1,0 +1,298 @@
+"""Model/operator placement: ``ctx_group`` / ``group2ctx`` → per-group
+compiled segments with explicit cross-group activation transfer.
+
+Parity: ``src/executor/graph_executor.cc:907`` (AssignContext) +
+``python/mxnet/symbol/symbol.py:1369-1416`` (bind's group2ctx). The
+reference assigns each ``AttrScope(ctx_group=...)`` subgraph to the
+device named by ``group2ctx`` and inserts ``_CrossDeviceCopy`` nodes at
+the boundaries. The TPU-native equivalent here partitions the bound
+plan into contiguous same-group segments, compiles each segment as its
+own XLA program pinned to the group's device (``jax.jit(device=...)``),
+and performs the boundary activation transfer with ``jax.device_put``
+— the copy the reference's special op did, made explicit. Training
+chains ``jax.vjp`` segment by segment in reverse, moving cotangents to
+each producer's device and accumulating argument gradients on the
+device of the argument's first consumer.
+
+This is deliberately NOT the single-fused-program path: operator
+placement exists to split a too-big model across devices, which is a
+multiple-program-multiple-device decision — the same trade the
+reference makes when AssignContext severs its graph.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+from .base import MXNetError
+from .context import Context
+
+__all__ = ["GroupedProgram"]
+
+
+class GroupedProgram:
+    """Executes an Executor's plan as device-pinned segment programs."""
+
+    def __init__(self, executor, group2ctx):
+        self._ex = executor
+        self._group2ctx = {}
+        for g, c in (group2ctx or {}).items():
+            if isinstance(c, (list, tuple)):
+                # reference semantics allow a ctx list per group (one
+                # copy per DP replica); single-replica placement takes
+                # the first
+                c = c[0]
+            self._group2ctx[g] = c if isinstance(c, Context) else Context(c)
+        self._build_segments()
+
+    # -- plan partitioning ----------------------------------------------
+    def _node_group(self, pi):
+        node = self._ex._plan_nodes[pi]
+        return node._extra_attrs.get("ctx_group")
+
+    def _group_device(self, group):
+        if group is None or group not in self._group2ctx:
+            return self._ex._ctx.jax_device()
+        return self._group2ctx[group].jax_device()
+
+    def _build_segments(self):
+        ex = self._ex
+        plan = ex._plan
+        segments: List[Dict[str, Any]] = []
+        cur = None
+        for pi in range(len(plan)):
+            dev = self._group_device(self._node_group(pi))
+            if cur is None or cur["dev"] is not dev:
+                cur = {"dev": dev, "idxs": []}
+                segments.append(cur)
+            cur["idxs"].append(pi)
+        # external references consumed by each segment
+        for si, seg in enumerate(segments):
+            inside = set(seg["idxs"])
+            ext: List[tuple] = []
+            seen = set()
+            for pi in seg["idxs"]:
+                _, _, bindings, rs, _, _ = plan[pi]
+                for b in bindings:
+                    key = None
+                    if b[0] in ("arg", "aux"):
+                        key = b
+                    elif b[1] not in inside:
+                        key = ("res", b[1], b[2])
+                    if key is not None and key not in seen:
+                        seen.add(key)
+                        ext.append(key)
+            seg["ext"] = ext
+            seg["rng_slots"] = [plan[pi][3] for pi in seg["idxs"]
+                                if plan[pi][3] is not None]
+        self.segments = segments
+        self._seg_fns: Dict[tuple, Any] = {}
+
+    # -- segment program --------------------------------------------------
+    def _segment_fn(self, si, is_train):
+        """Jitted program of segment ``si``: (ext_vals, rngs) ->
+        (per-node output tuples, aux updates)."""
+        import jax
+        key = (si, bool(is_train))
+        fn = self._seg_fns.get(key)
+        if fn is not None:
+            return fn
+        ex = self._ex
+        plan = ex._plan
+        seg = self.segments[si]
+        idxs = list(seg["idxs"])
+        ext = list(seg["ext"])
+        ext_pos = {ref: i for i, ref in enumerate(ext)}
+        rng_pos = {s: i for i, s in enumerate(seg["rng_slots"])}
+        inside_pos = {pi: j for j, pi in enumerate(idxs)}
+
+        def seg_run(ext_vals, rng_keys):
+            from . import ops as _ops
+            results = []
+            aux_updates = []          # (aux_slot, value)
+            for pi in idxs:
+                op, nattrs, bindings, rs, aux_wb, slot = plan[pi]
+                vals = []
+                for b in bindings:
+                    if b[0] in ("arg", "aux"):
+                        vals.append(ext_vals[ext_pos[b]])
+                    elif b[1] in inside_pos:
+                        vals.append(results[inside_pos[b[1]]][b[2]])
+                    else:
+                        vals.append(ext_vals[ext_pos[("res", b[1], b[2])]])
+                attrs = nattrs
+                if "__train__" in op.defaults:
+                    attrs = dict(nattrs, __train__=is_train)
+                if rs is not None:
+                    out = op.forward(attrs, *vals, rng=rng_keys[rng_pos[rs]])
+                else:
+                    out = op.forward(attrs, *vals)
+                if not isinstance(out, (tuple, list)):
+                    out = (out,)
+                n_out = op.resolve_num_outputs(attrs)
+                results.append(tuple(out[:n_out]))
+                for wb, val in zip(aux_wb, out[n_out:]):
+                    if wb is not None:
+                        aux_updates.append((wb, val))
+            return (tuple(results),
+                    tuple(v for _, v in aux_updates))
+
+        # record the aux-slot order once (static per segment)
+        aux_slots = []
+        for pi in idxs:
+            op, nattrs, _, _, aux_wb, _ = plan[pi]
+            for wb in aux_wb:
+                if wb is not None:
+                    aux_slots.append(wb)
+        seg["aux_slots"] = aux_slots
+
+        # placement comes from the committed inputs: _gather_ext puts
+        # every external value (and forward/forward_backward the rng
+        # keys) on the segment's device, so the compiled program runs
+        # there — jit(device=...) is deprecated in this jax
+        fn = jax.jit(seg_run)
+        self._seg_fns[key] = fn
+        return fn
+
+    # -- execution --------------------------------------------------------
+    def _gather_ext(self, seg, arg_vals, aux_state, res_store):
+        import jax
+        vals = []
+        for ref in seg["ext"]:
+            if ref[0] == "arg":
+                v = arg_vals[ref[1]]
+            elif ref[0] == "aux":
+                v = aux_state[ref[1]]
+            else:
+                v = res_store[(ref[1], ref[2])]
+            # the cross-group activation/parameter transfer (the
+            # reference's _CrossDeviceCopy, graph_executor.cc:907)
+            vals.append(jax.device_put(v, seg["dev"]))
+        return tuple(vals)
+
+    def forward(self, arg_vals, aux_vals, rng_keys, is_train):
+        ex = self._ex
+        res_store: Dict[Tuple[int, int], Any] = {}
+        aux_state = list(aux_vals)
+        for si, seg in enumerate(self.segments):
+            fn = self._segment_fn(si, is_train)
+            ext = self._gather_ext(seg, arg_vals, aux_state, res_store)
+            import jax
+            rngs = tuple(jax.device_put(rng_keys[s], seg["dev"])
+                         for s in seg["rng_slots"])
+            results, aux_up = fn(ext, rngs)
+            for j, pi in enumerate(seg["idxs"]):
+                for oi, v in enumerate(results[j]):
+                    res_store[(pi, oi)] = v
+            for slot, v in zip(seg["aux_slots"], aux_up):
+                aux_state[slot] = v
+        outs = []
+        for h in ex._head_refs:
+            if h[0] == "arg":
+                outs.append(arg_vals[h[1]])
+            elif h[0] == "aux":
+                outs.append(aux_state[h[1]])
+            else:
+                outs.append(res_store[(h[1], h[2])])
+        return tuple(outs), tuple(aux_state)
+
+    def forward_backward(self, arg_vals, aux_vals, rng_keys, out_grads):
+        """Chained per-segment vjp: forward pass records one vjp per
+        segment; the reverse sweep routes each segment's output
+        cotangents (head grads + downstream consumers) back through it,
+        transferring cotangents onto the producing segment's device."""
+        import jax
+        import jax.numpy as jnp
+        ex = self._ex
+        gpos = set(ex._grad_positions)
+        res_store: Dict[Tuple[int, int], Any] = {}
+        aux_state = list(aux_vals)
+        vjps = []
+        for si, seg in enumerate(self.segments):
+            fn = self._segment_fn(si, is_train=True)
+            ext = self._gather_ext(seg, arg_vals, aux_state, res_store)
+            rngs = tuple(jax.device_put(rng_keys[s], seg["dev"])
+                         for s in seg["rng_slots"])
+            diff_mask = [ref[0] == "res"
+                         or (ref[0] == "arg" and ref[1] in gpos)
+                         for ref in seg["ext"]]
+            diff_vals = tuple(v for v, m in zip(ext, diff_mask) if m)
+            nondiff = tuple(v for v, m in zip(ext, diff_mask) if not m)
+
+            def closed(diff_vals, _seg=seg, _fn=fn, _mask=tuple(diff_mask),
+                       _nondiff=nondiff, _rngs=rngs):
+                it_d = iter(diff_vals)
+                it_n = iter(_nondiff)
+                ext_vals = tuple(next(it_d) if m else next(it_n)
+                                 for m in _mask)
+                results, aux_up = _fn(ext_vals, _rngs)
+                return results, aux_up
+
+            (results, aux_up), vjp_fn = jax.vjp(closed, diff_vals)
+            vjps.append((seg, diff_mask, vjp_fn, results, aux_up))
+            for j, pi in enumerate(seg["idxs"]):
+                for oi, v in enumerate(results[j]):
+                    res_store[(pi, oi)] = v
+            for slot, v in zip(seg["aux_slots"], aux_up):
+                aux_state[slot] = v
+
+        # head cotangents seed the reverse sweep
+        cots: Dict[Tuple[int, int], Any] = {}
+
+        def add_cot(key, val, dev):
+            val = jax.device_put(val, dev)
+            if key in cots:
+                cots[key] = cots[key] + val
+            else:
+                cots[key] = val
+
+        seg_of = {}
+        for seg in self.segments:
+            for pi in seg["idxs"]:
+                seg_of[pi] = seg
+        for h, og in zip(ex._head_refs, out_grads):
+            if h[0] == "res":
+                add_cot((h[1], h[2]), og, seg_of[h[1]]["dev"])
+
+        arg_grads: Dict[int, Any] = {}
+        outs = []
+        for h in ex._head_refs:
+            if h[0] == "arg":
+                outs.append(arg_vals[h[1]])
+            elif h[0] == "aux":
+                outs.append(aux_state[h[1]])
+            else:
+                outs.append(res_store[(h[1], h[2])])
+
+        for seg, diff_mask, vjp_fn, results, aux_up in reversed(vjps):
+            out_cots = tuple(
+                tuple(cots.get((pi, oi),
+                               jnp.zeros(results[j][oi].shape,
+                                         results[j][oi].dtype))
+                      for oi in range(len(results[j])))
+                for j, pi in enumerate(seg["idxs"]))
+            aux_cots = tuple(jnp.zeros(v.shape, v.dtype) for v in aux_up)
+            (diff_cots,) = vjp_fn((out_cots, aux_cots))
+            it = iter(diff_cots)
+            for ref, m in zip(seg["ext"], diff_mask):
+                if not m:
+                    continue
+                c = next(it)
+                if ref[0] == "arg":
+                    p = ref[1]
+                    if p in arg_grads:
+                        arg_grads[p] = arg_grads[p] + jax.device_put(
+                            c, arg_grads[p].sharding)
+                    else:
+                        arg_grads[p] = c
+                else:
+                    key = (ref[1], ref[2])
+                    add_cot(key, c, seg_of[ref[1]]["dev"])
+
+        grads = []
+        for p in ex._grad_positions:
+            if p in arg_grads:
+                grads.append(arg_grads[p])
+            else:
+                a = arg_vals[p]
+                grads.append(jnp.zeros(a.shape, a.dtype))
+        return tuple(outs), tuple(aux_state), tuple(grads)

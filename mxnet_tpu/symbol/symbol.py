@@ -336,7 +336,8 @@ class Symbol:
     def bind(self, ctx, args, args_grad=None, grad_req="write",
              aux_states=None, group2ctx=None, shared_exec=None):
         from ..executor import Executor
-        return Executor(self, ctx, args, args_grad, grad_req, aux_states)
+        return Executor(self, ctx, args, args_grad, grad_req, aux_states,
+                        group2ctx=group2ctx)
 
     def simple_bind(self, ctx, grad_req="write", type_dict=None,
                     stype_dict=None, group2ctx=None, shared_arg_names=None,
@@ -360,7 +361,8 @@ class Symbol:
                      for n, s in zip(arg_names, arg_shapes)
                      if reqs.get(n, "null") != "null"}
         aux = [nd_zeros(s, ctx=ctx) for s in aux_shapes]
-        return Executor(self, ctx, args, args_grad, reqs, aux)
+        return Executor(self, ctx, args, args_grad, reqs, aux,
+                        group2ctx=group2ctx)
 
     def eval(self, ctx=None, **kwargs):
         from ..context import current_context

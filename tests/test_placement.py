@@ -1,0 +1,107 @@
+"""ctx_group / group2ctx model placement (ref: graph_executor.cc:907
+AssignContext + symbol bind's group2ctx): per-group device-pinned
+segment programs with cross-group activation transfer."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def _two_group_net():
+    data = mx.sym.var("data")
+    with mx.AttrScope(ctx_group="dev1"):
+        h = mx.sym.FullyConnected(data, num_hidden=16, name="fc1")
+        h = mx.sym.Activation(h, act_type="relu", name="relu1")
+    with mx.AttrScope(ctx_group="dev2"):
+        h = mx.sym.FullyConnected(h, num_hidden=4, name="fc2")
+        out = mx.sym.SoftmaxOutput(h, mx.sym.var("label"), name="softmax")
+    return out
+
+
+def _bind(sym, group2ctx):
+    np.random.seed(3)
+    shapes = dict(zip(sym.list_arguments(),
+                      sym.infer_shape(data=(8, 10), label=(8,))[0]))
+    args = {n: mx.nd.array(np.random.randn(*shapes[n]).astype(np.float32)
+                           * 0.1) for n in sym.list_arguments()}
+    args["label"] = mx.nd.array(np.random.randint(0, 4, (8,))
+                                .astype(np.float32))
+    grads = {n: mx.nd.zeros(shapes[n]) for n in shapes
+             if n not in ("data", "label")}
+    reqs = {n: ("write" if n in grads else "null") for n in shapes}
+    return sym.bind(mx.cpu(), args, args_grad=grads, grad_req=reqs,
+                    group2ctx=group2ctx)
+
+
+def test_group2ctx_parity_and_placement():
+    import jax
+    cpus = jax.devices("cpu")
+    if len(cpus) < 2:
+        pytest.skip("needs >=2 virtual cpu devices")
+    sym = _two_group_net()
+    g2c = {"dev1": mx.cpu(0), "dev2": mx.cpu(1)}
+
+    ex_grp = _bind(sym, g2c)
+    assert ex_grp._grouped is not None, "placement should activate"
+    # two segments on distinct devices
+    devs = [seg["dev"] for seg in ex_grp._grouped.segments]
+    assert len(devs) == 2 and devs[0] != devs[1]
+
+    ex_ref = _bind(sym, None)
+    assert ex_ref._grouped is None
+
+    for ex in (ex_grp, ex_ref):
+        ex.forward(is_train=True)
+        ex.backward()
+
+    assert_almost_equal(ex_grp.outputs[0].asnumpy(),
+                        ex_ref.outputs[0].asnumpy(), rtol=1e-5, atol=1e-6)
+    for n in ("fc1_weight", "fc1_bias", "fc2_weight", "fc2_bias"):
+        assert_almost_equal(ex_grp.grad_dict[n].asnumpy(),
+                            ex_ref.grad_dict[n].asnumpy(),
+                            rtol=1e-4, atol=1e-6)
+
+    # cross-group activation transfer: the head output was computed by
+    # the dev2 segment and lives on cpu(1), while the dev1-group
+    # gradient came back across the boundary onto cpu(0)'s segment
+    out_dev = list(ex_grp.outputs[0]._data.devices())
+    assert out_dev == [cpus[1]], out_dev
+    fc1_grad_dev = list(ex_grp.grad_dict["fc1_weight"]._data.devices())
+    assert fc1_grad_dev == [cpus[0]], fc1_grad_dev
+
+
+def test_group2ctx_module_and_eval():
+    import jax
+    cpus = jax.devices("cpu")
+    if len(cpus) < 2:
+        pytest.skip("needs >=2 virtual cpu devices")
+    sym = _two_group_net()
+    mod = mx.mod.Module(sym, data_names=("data",), label_names=("label",),
+                        context=mx.cpu(),
+                        group2ctxs={"dev1": mx.cpu(0), "dev2": mx.cpu(1)})
+    rng = np.random.RandomState(11)
+    X = rng.randn(64, 10).astype(np.float32)
+    w = rng.randn(10, 4)
+    y = np.argmax(X @ w, axis=1).astype(np.float32)
+    it = mx.io.NDArrayIter(X, y, batch_size=16, label_name="label")
+    np.random.seed(5)
+    mod.fit(it, num_epoch=10, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.2},
+            initializer=mx.init.Xavier(rnd_type="uniform", magnitude=2))
+    assert mod._exec._grouped is not None
+    it.reset()
+    score = dict(mod.score(it, "acc"))
+    assert score["accuracy"] > 0.8, score
+
+
+def test_group2ctx_ignored_without_groups():
+    data = mx.sym.var("data")
+    out = mx.sym.FullyConnected(data, num_hidden=4, name="fc")
+    ex = out.bind(mx.cpu(), {
+        "data": mx.nd.zeros((2, 3)),
+        "fc_weight": mx.nd.zeros((4, 3)),
+        "fc_bias": mx.nd.zeros((4,))},
+        group2ctx={"dev1": mx.cpu(1)})
+    assert ex._grouped is None
+    ex.forward()
