@@ -2,3 +2,4 @@
 from .basic_layers import (Concurrent, HybridConcurrent, Identity,
                            SparseEmbedding, SyncBatchNorm, PixelShuffle1D,
                            PixelShuffle2D, PixelShuffle3D)
+from .attention import MeshMultiHeadAttention

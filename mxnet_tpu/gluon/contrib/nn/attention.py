@@ -1,0 +1,65 @@
+"""Mesh-aware multi-head attention block (SURVEY §5.7 surface).
+
+The Gluon face of the long-context kernels: qkv/out projections around
+``_contrib_flash_attention``, which selects ring attention when the
+active mesh (``mxnet_tpu.parallel.mesh.use_mesh``) carries a
+sequence-parallel axis, the Pallas flash kernel on a bare TPU, and the
+dense composition elsewhere. No reference equivalent — the reference's
+gluon has no attention block (its transformer lives in contrib symbols,
+ref src/operator/contrib/transformer.cc); this is the capability
+extension mandated for the TPU build.
+"""
+from __future__ import annotations
+
+from ...block import HybridBlock
+from ...nn.basic_layers import Dense
+
+__all__ = ["MeshMultiHeadAttention"]
+
+
+class MeshMultiHeadAttention(HybridBlock):
+    """Multi-head attention over (B, T, C) inputs.
+
+    Parameters
+    ----------
+    units : int
+        Model width C (must divide by ``num_heads``).
+    num_heads : int
+    causal : bool
+    impl : str
+        'auto' | 'flash' | 'dense' | 'ring' | 'ulysses' — forwarded to
+        ``_contrib_flash_attention``.
+    use_bias : bool
+    """
+
+    def __init__(self, units, num_heads, causal=False, impl="auto",
+                 use_bias=True, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        if units % num_heads:
+            raise ValueError("units %d not divisible by num_heads %d"
+                             % (units, num_heads))
+        self._units = units
+        self._num_heads = num_heads
+        self._causal = causal
+        self._impl = impl
+        with self.name_scope():
+            self.query_proj = Dense(units, use_bias=use_bias,
+                                    flatten=False, prefix="query_")
+            self.key_proj = Dense(units, use_bias=use_bias,
+                                  flatten=False, prefix="key_")
+            self.value_proj = Dense(units, use_bias=use_bias,
+                                    flatten=False, prefix="value_")
+            self.out_proj = Dense(units, use_bias=use_bias,
+                                  flatten=False, prefix="out_")
+
+    def hybrid_forward(self, F, query, key=None, value=None):
+        key = query if key is None else key
+        value = key if value is None else value
+        H = self._num_heads
+        D = self._units // H
+        q = F.reshape(self.query_proj(query), shape=(0, 0, H, D))
+        k = F.reshape(self.key_proj(key), shape=(0, 0, H, D))
+        v = F.reshape(self.value_proj(value), shape=(0, 0, H, D))
+        o = F._contrib_flash_attention(q, k, v, causal=self._causal,
+                                       impl=self._impl)
+        return self.out_proj(F.reshape(o, shape=(0, 0, self._units)))

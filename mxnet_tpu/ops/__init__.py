@@ -22,3 +22,4 @@ from . import control_flow  # noqa: F401
 from . import quantization  # noqa: F401
 from . import detection     # noqa: F401
 from . import extra         # noqa: F401
+from . import attention     # noqa: F401

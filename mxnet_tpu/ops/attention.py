@@ -1,0 +1,80 @@
+"""Fused attention on the registered-op surface.
+
+SURVEY §5.7 requires the long-context extensions to be reachable from
+the framework API, not only from ``mxnet_tpu.parallel``: these ops put
+flash/ring/ulysses attention behind the same registry every other
+operator uses, so Symbol graphs, NDArray eager calls, and Gluon
+HybridBlocks (via ``F._contrib_flash_attention``) all reach them. The
+reference's closest surface is the proposal-era multi-head attention
+contrib ops (ref src/operator/contrib/transformer.cc); this framework
+exposes the TPU-native kernels instead.
+
+Inputs are (B, T, H, D). ``impl``:
+- ``auto``  — ring attention when the active mesh (parallel.mesh
+  ``set_current_mesh``/``use_mesh``) has an ``sp`` axis of size > 1,
+  else the Pallas flash kernel on TPU / dense composition elsewhere.
+- ``flash`` / ``dense`` / ``ring`` / ``ulysses`` — forced choice.
+"""
+from __future__ import annotations
+
+from .registry import register
+
+__all__ = []
+
+
+def _is_tracer(x):
+    import jax.core
+    return isinstance(x, jax.core.Tracer)
+
+
+def _attention(attrs, query, key, value):
+    import math
+    causal = bool(attrs.get("causal", False))
+    scale = float(attrs.get("scale", 0.0)) or \
+        1.0 / math.sqrt(query.shape[-1])
+    impl = str(attrs.get("impl", "auto"))
+    axis = str(attrs.get("mesh_axis", "sp"))
+    from ..parallel.mesh import current_mesh, mesh_axes
+    from ..parallel.flash_attention import flash_attention, _jnp_reference
+    from ..parallel.ring_attention import (ring_attention,
+                                           ulysses_attention)
+
+    mesh = current_mesh()
+    has_sp = mesh is not None and mesh_axes(mesh).get(axis, 1) > 1
+    if impl == "auto":
+        impl = "ring" if has_sp else "flash"
+    if impl in ("ring", "ulysses"):
+        if has_sp:
+            # sequence-shard eager inputs onto the mesh (T over the sp
+            # axis) — the shard_map computation spans the mesh's device
+            # set, while op inputs arrive committed to one device
+            import jax
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            sh = NamedSharding(mesh, P(None, axis))
+            if not _is_tracer(query):
+                query, key, value = (jax.device_put(x, sh)
+                                     for x in (query, key, value))
+        fn = ring_attention if impl == "ring" else ulysses_attention
+        return fn(query, key, value, mesh=mesh, axis=axis,
+                  causal=causal, scale=scale)
+    if impl == "dense":
+        return _jnp_reference(query, key, value, scale, causal)
+    if impl == "flash":
+        return flash_attention(query, key, value, causal=causal,
+                               scale=scale,
+                               block_q=int(attrs.get("block_q", 512)),
+                               block_k=int(attrs.get("block_k", 512)))
+    raise ValueError("_contrib_flash_attention: unknown impl %r" % impl)
+
+
+register("_contrib_flash_attention", _attention,
+         arg_names=("query", "key", "value"),
+         no_jit=True,   # shard_map placement is managed by the op body
+         defaults={"causal": False, "scale": 0.0, "impl": "auto",
+                   "mesh_axis": "sp", "block_q": 512, "block_k": 512},
+         attr_docs={"causal": "apply a causal (lower-triangular) mask",
+                    "scale": "score scale; 0 = 1/sqrt(head_dim)",
+                    "impl": "auto|flash|dense|ring|ulysses",
+                    "mesh_axis": "mesh axis carrying the sequence shards",
+                    "block_q": "flash kernel query block",
+                    "block_k": "flash kernel key/value block"})

@@ -67,7 +67,8 @@ class OpDef:
                  arg_names_fn: Optional[Callable] = None,
                  description: str = "",
                  attr_docs: Optional[Dict[str, str]] = None,
-                 attr_ranges: Optional[Dict[str, tuple]] = None):
+                 attr_ranges: Optional[Dict[str, tuple]] = None,
+                 no_jit: bool = False):
         self.name = name
         self.forward = forward
         self.arg_names = list(arg_names)
@@ -77,6 +78,10 @@ class OpDef:
         self.needs_rng = needs_rng
         self.mutable_inputs = tuple(mutable_inputs)
         self.arg_names_fn = arg_names_fn  # attrs -> effective input names
+        # no_jit: forward manages its own compilation/placement (e.g.
+        # shard_map over a multi-device mesh, which a single-device
+        # eager jit wrapper would reject)
+        self.no_jit = bool(no_jit)
         self.description = description or (forward.__doc__ or "")
         # the dmlc Parameter-struct tier (SURVEY §5.6 tier 2): per-attr
         # documentation and (lo, hi) ranges; both feed the generated
@@ -284,7 +289,11 @@ def invoke(op: OpDef, input_arrays: Sequence[Any], attrs: Dict[str, Any],
     new_value) for mutable inputs."""
     input_arrays = _align_device_sets(list(input_arrays))
     nattrs = normalize_attrs(op, attrs)
-    fn = _get_jitted(op, nattrs, len(input_arrays))
+    if op.no_jit:
+        fn = (lambda *a: op.forward(nattrs, *a)) if not op.needs_rng \
+            else (lambda rng_, *a: op.forward(nattrs, *a, rng=rng_))
+    else:
+        fn = _get_jitted(op, nattrs, len(input_arrays))
     if op.needs_rng:
         if rng is None:
             from .. import random as _random

@@ -1,4 +1,4 @@
-"""Fused (flash) attention as a Pallas TPU kernel.
+"""Fused (flash) attention as Pallas TPU kernels — forward AND backward.
 
 The hot op of the long-context path (SURVEY §5.7): K/V stream through
 VMEM one block per grid step with the numerically-stable running
@@ -6,16 +6,24 @@ max/sum accumulation, so neither the (Tq, Tk) score matrix nor the
 full K/V sequence is ever VMEM-resident — the role cuDNN fused
 attention plays for the reference's GPU builds, written against the
 MXU/VMEM model from the Pallas guide. The TPU grid executes
-sequentially, so the accumulator lives in VMEM scratch across the
-k-block axis (the canonical TPU flash pattern).
+sequentially, so accumulators live in VMEM scratch across the
+innermost grid axis (the canonical TPU flash pattern).
 
-Differentiation: the kernel carries a ``jax.custom_vjp`` whose
-backward recomputes through the jnp composition — forward inference
-rides the kernel, training gradients ride XLA.
+Differentiation (``jax.custom_vjp``) also rides Pallas: the forward
+kernel additionally emits the per-row logsumexp, and two backward
+kernels recompute the probability blocks from (q, k, lse) to
+accumulate dk/dv (k outer, q inner) and dq (q outer, k inner) — O(T)
+memory end to end, which is what makes long-context *training* fit
+(a dense recompute would materialize the (Tq, Tk) score matrix).
 
-``flash_attention`` dispatches to the kernel on TPU backends (when the
-sequence tiles evenly) and to the jnp composition elsewhere; tests pin
-kernel correctness on CPU via Pallas interpret mode
+Sequence lengths that do not tile by the block size are zero-padded to
+the 128-lane multiple and masked inside the kernels (k positions
+beyond the true length score -inf; padded q rows are sliced off) — no
+silent dense fallback.
+
+``flash_attention`` dispatches to the kernels on TPU backends and to
+the jnp composition elsewhere; tests pin kernel forward AND backward
+against the jnp reference on CPU via Pallas interpret mode
 (``force_pallas=True``).
 """
 from __future__ import annotations
@@ -27,6 +35,8 @@ import jax
 
 __all__ = ["flash_attention"]
 
+_NEG = -1e30
+
 
 def _jnp_reference(q, k, v, scale, causal):
     import jax.numpy as jnp
@@ -34,18 +44,29 @@ def _jnp_reference(q, k, v, scale, causal):
     if causal:
         Tq, Tk = q.shape[1], k.shape[1]
         mask = jnp.tril(jnp.ones((Tq, Tk), dtype=bool))
-        s = jnp.where(mask[None, None], s, -1e30)
+        s = jnp.where(mask[None, None], s, _NEG)
     p = jnp.asarray(
         jnp.exp(s - jnp.max(s, axis=-1, keepdims=True)), q.dtype)
     p = p / jnp.maximum(jnp.sum(p, axis=-1, keepdims=True), 1e-30)
     return jnp.einsum("bhqk,bkhd->bqhd", p, v)
 
 
-def _kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
-            scale, causal, block_q, block_k, n_kb):
+def _mask_scores(s, qi, kb, block_q, block_k, causal, kv_len):
+    """-inf the scores of padded k positions (and the causal triangle)."""
+    import jax
+    import jax.numpy as jnp
+    k_pos = kb * block_k + jax.lax.iota(jnp.int32, block_k)[None, :]
+    live = k_pos < kv_len
+    if causal:
+        q_pos = qi * block_q + jax.lax.iota(jnp.int32, block_q)[:, None]
+        live = jnp.logical_and(live, q_pos >= k_pos)
+    return jnp.where(live, s, _NEG)
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref,
+                l_ref, *, scale, causal, block_q, block_k, n_kb, kv_len):
     """Grid = (batch*heads, q_blocks, k_blocks), k innermost: scratch
     accumulators carry across the sequential k steps."""
-    import jax
     import jax.numpy as jnp
     from jax.experimental import pallas as pl
 
@@ -69,12 +90,7 @@ def _kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
         k = k_ref[...].astype(jnp.float32)            # (bk, d)
         v = v_ref[...].astype(jnp.float32)
         s = q @ k.T                                   # (bq, bk)
-        if causal:
-            q_pos = qi * block_q + jax.lax.iota(
-                jnp.int32, block_q)[:, None]
-            k_pos = kb * block_k + jax.lax.iota(
-                jnp.int32, block_k)[None, :]
-            s = jnp.where(q_pos >= k_pos, s, -1e30)
+        s = _mask_scores(s, qi, kb, block_q, block_k, causal, kv_len)
         m_prev = m_ref[...]
         l_prev = l_ref[...]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1))
@@ -86,84 +102,282 @@ def _kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
 
     @pl.when(kb == n_kb - 1)
     def _finish():
-        o_ref[...] = (acc_ref[...] / jnp.maximum(
-            l_ref[...], 1e-30)[:, None]).astype(o_ref.dtype)
+        l = jnp.maximum(l_ref[...], 1e-30)
+        o_ref[...] = (acc_ref[...] / l[:, None]).astype(o_ref.dtype)
+        lse_ref[...] = m_ref[...] + jnp.log(l)
 
 
-def _pallas_attention(q, k, v, scale, causal, block_q, block_k,
-                      interpret):
+def _bwd_dkdv_kernel(q_ref, do_ref, lse_ref, dcap_ref, k_ref, v_ref,
+                     dk_ref, dv_ref, dk_acc, dv_acc, *, scale, causal,
+                     block_q, block_k, n_qb, kv_len):
+    """Grid = (batch*heads, k_blocks, q_blocks), q innermost: dk/dv
+    accumulate in VMEM scratch while q/do/lse/D stream through."""
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    kb = pl.program_id(1)
+    qi = pl.program_id(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_acc[...] = jnp.zeros_like(dk_acc)
+        dv_acc[...] = jnp.zeros_like(dv_acc)
+
+    live = True
+    if causal:
+        # q blocks fully above this k block see none of it
+        live = (qi + 1) * block_q - 1 >= kb * block_k
+
+    @pl.when(live)
+    def _step():
+        q = q_ref[...].astype(jnp.float32)            # (bq, d)
+        do = do_ref[...].astype(jnp.float32)          # (bq, d)
+        lse = lse_ref[...]                            # (bq,)
+        dcap = dcap_ref[...]                          # (bq,) rowsum(do*o)
+        k = k_ref[...].astype(jnp.float32)            # (bk, d)
+        v = v_ref[...].astype(jnp.float32)
+        s = (q @ k.T) * scale
+        s = _mask_scores(s, qi, kb, block_q, block_k, causal, kv_len)
+        p = jnp.exp(s - lse[:, None])                 # (bq, bk)
+        dv_acc[...] += p.T @ do
+        dp = do @ v.T                                 # (bq, bk)
+        ds = p * (dp - dcap[:, None]) * scale
+        dk_acc[...] += ds.T @ q
+
+    @pl.when(qi == n_qb - 1)
+    def _finish():
+        dk_ref[...] = dk_acc[...].astype(dk_ref.dtype)
+        dv_ref[...] = dv_acc[...].astype(dv_ref.dtype)
+
+
+def _bwd_dq_kernel(q_ref, do_ref, lse_ref, dcap_ref, k_ref, v_ref,
+                   dq_ref, dq_acc, *, scale, causal, block_q, block_k,
+                   n_kb, kv_len):
+    """Grid = (batch*heads, q_blocks, k_blocks), k innermost: dq
+    accumulates in VMEM scratch while k/v stream through."""
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    qi = pl.program_id(1)
+    kb = pl.program_id(2)
+
+    @pl.when(kb == 0)
+    def _init():
+        dq_acc[...] = jnp.zeros_like(dq_acc)
+
+    live = True
+    if causal:
+        live = kb * block_k <= (qi + 1) * block_q - 1
+
+    @pl.when(live)
+    def _step():
+        q = q_ref[...].astype(jnp.float32)
+        do = do_ref[...].astype(jnp.float32)
+        lse = lse_ref[...]
+        dcap = dcap_ref[...]
+        k = k_ref[...].astype(jnp.float32)
+        v = v_ref[...].astype(jnp.float32)
+        s = (q @ k.T) * scale
+        s = _mask_scores(s, qi, kb, block_q, block_k, causal, kv_len)
+        p = jnp.exp(s - lse[:, None])
+        dp = do @ v.T
+        ds = p * (dp - dcap[:, None]) * scale
+        dq_acc[...] += ds @ k
+
+    @pl.when(kb == n_kb - 1)
+    def _finish():
+        dq_ref[...] = dq_acc[...].astype(dq_ref.dtype)
+
+
+def _pick_block(t_padded, pref):
+    return pref if t_padded % pref == 0 else 128
+
+
+def _pad_seq(x, t_padded):
+    import jax.numpy as jnp
+    pad = t_padded - x.shape[1]
+    if pad == 0:
+        return x
+    return jnp.pad(x, ((0, 0), (0, pad), (0, 0), (0, 0)))
+
+
+def _flatten(x):
+    import jax.numpy as jnp
+    B, T, H, D = x.shape
+    return jnp.moveaxis(x, 2, 1).reshape(B * H, T, D)
+
+
+def _unflatten(x, B, H):
+    import jax.numpy as jnp
+    BH, T, D = x.shape
+    return jnp.moveaxis(x.reshape(B, H, T, D), 1, 2)
+
+
+def _pallas_forward(q, k, v, scale, causal, block_q, block_k, kv_len,
+                    interpret):
+    """Padded/flattened forward; returns (out, lse) at PADDED length."""
     import jax.numpy as jnp
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
-    B, Tq, H, D = q.shape
+    BH, Tq, D = q.shape
     Tk = k.shape[1]
-    qf = jnp.moveaxis(q, 2, 1).reshape(B * H, Tq, D)
-    kf = jnp.moveaxis(k, 2, 1).reshape(B * H, Tk, D)
-    vf = jnp.moveaxis(v, 2, 1).reshape(B * H, Tk, D)
     n_kb = Tk // block_k
 
     scratch = [pltpu.VMEM((block_q, D), jnp.float32),
                pltpu.VMEM((block_q,), jnp.float32),
                pltpu.VMEM((block_q,), jnp.float32)]
 
-    out = pl.pallas_call(
-        functools.partial(_kernel, scale=scale, causal=causal,
-                          block_q=block_q, block_k=block_k, n_kb=n_kb),
-        grid=(B * H, Tq // block_q, n_kb),
+    out, lse = pl.pallas_call(
+        functools.partial(_fwd_kernel, scale=scale, causal=causal,
+                          block_q=block_q, block_k=block_k, n_kb=n_kb,
+                          kv_len=kv_len),
+        grid=(BH, Tq // block_q, n_kb),
         in_specs=[
             pl.BlockSpec((None, block_q, D), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((None, block_k, D), lambda b, i, j: (b, j, 0)),
             pl.BlockSpec((None, block_k, D), lambda b, i, j: (b, j, 0)),
         ],
-        out_specs=pl.BlockSpec((None, block_q, D),
-                               lambda b, i, j: (b, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((B * H, Tq, D), q.dtype),
+        out_specs=[
+            pl.BlockSpec((None, block_q, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((None, block_q), lambda b, i, j: (b, i)),
+        ],
+        out_shape=[jax.ShapeDtypeStruct((BH, Tq, D), q.dtype),
+                   jax.ShapeDtypeStruct((BH, Tq), jnp.float32)],
         scratch_shapes=scratch,
         interpret=interpret,
-    )(qf, kf, vf)
-    return jnp.moveaxis(out.reshape(B, H, Tq, D), 1, 2)
+    )(q, k, v)
+    return out, lse
+
+
+def _pallas_backward(q, k, v, do, o, lse, scale, causal, block_q,
+                     block_k, kv_len, interpret):
+    """Padded/flattened backward; q/k/v/do/o at padded lengths."""
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    BH, Tq, D = q.shape
+    Tk = k.shape[1]
+    n_qb = Tq // block_q
+    n_kb = Tk // block_k
+    # D_i = rowsum(dO * O): one cheap fused pass in XLA
+    dcap = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
+                   axis=-1)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkdv_kernel, scale=scale, causal=causal,
+                          block_q=block_q, block_k=block_k, n_qb=n_qb,
+                          kv_len=kv_len),
+        grid=(BH, n_kb, n_qb),
+        in_specs=[
+            pl.BlockSpec((None, block_q, D), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((None, block_q, D), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((None, block_q), lambda b, j, i: (b, i)),
+            pl.BlockSpec((None, block_q), lambda b, j, i: (b, i)),
+            pl.BlockSpec((None, block_k, D), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((None, block_k, D), lambda b, j, i: (b, j, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((None, block_k, D), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((None, block_k, D), lambda b, j, i: (b, j, 0)),
+        ],
+        out_shape=[jax.ShapeDtypeStruct((BH, Tk, D), k.dtype),
+                   jax.ShapeDtypeStruct((BH, Tk, D), v.dtype)],
+        scratch_shapes=[pltpu.VMEM((block_k, D), jnp.float32),
+                        pltpu.VMEM((block_k, D), jnp.float32)],
+        interpret=interpret,
+    )(q, do, lse, dcap, k, v)
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
+                          block_q=block_q, block_k=block_k, n_kb=n_kb,
+                          kv_len=kv_len),
+        grid=(BH, n_qb, n_kb),
+        in_specs=[
+            pl.BlockSpec((None, block_q, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((None, block_q, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((None, block_q), lambda b, i, j: (b, i)),
+            pl.BlockSpec((None, block_q), lambda b, i, j: (b, i)),
+            pl.BlockSpec((None, block_k, D), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((None, block_k, D), lambda b, i, j: (b, j, 0)),
+        ],
+        out_specs=pl.BlockSpec((None, block_q, D),
+                               lambda b, i, j: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((BH, Tq, D), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32)],
+        interpret=interpret,
+    )(q, do, lse, dcap, k, v)
+    return dq, dk, dv
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
 def _flash(q, k, v, scale, causal, block_q, block_k, interpret):
-    return _pallas_attention(q, k, v, scale, causal, block_q, block_k,
-                             interpret)
+    out, _ = _flash_fwd(q, k, v, scale, causal, block_q, block_k,
+                        interpret)
+    return out
 
 
 def _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret):
-    out = _pallas_attention(q, k, v, scale, causal, block_q, block_k,
-                            interpret)
-    return out, (q, k, v)
+    import jax.numpy as jnp
+    B, Tq, H, D = q.shape
+    Tk = k.shape[1]
+    tq_pad = -(-Tq // 128) * 128
+    tk_pad = -(-Tk // 128) * 128
+    bq = _pick_block(tq_pad, block_q)
+    bk = _pick_block(tk_pad, block_k)
+    qf = _flatten(_pad_seq(q, tq_pad))
+    kf = _flatten(_pad_seq(k, tk_pad))
+    vf = _flatten(_pad_seq(v, tk_pad))
+    outf, lse = _pallas_forward(qf, kf, vf, scale, causal, bq, bk, Tk,
+                                interpret)
+    out = _unflatten(outf, B, H)[:, :Tq]
+    return out, (q, k, v, outf, lse)
+
+
+def _flash_fwd_rule(q, k, v, scale, causal, block_q, block_k, interpret):
+    out, res = _flash_fwd(q, k, v, scale, causal, block_q, block_k,
+                          interpret)
+    return out, res
 
 
 def _flash_bwd(scale, causal, block_q, block_k, interpret, res, g):
-    # backward recomputes through the jnp composition (XLA fuses it);
-    # the kernel stays a forward-path accelerator
-    q, k, v = res
-    _, vjp = jax.vjp(
-        lambda q_, k_, v_: _jnp_reference(q_, k_, v_, scale, causal),
-        q, k, v)
-    return vjp(g)
+    import jax.numpy as jnp
+    q, k, v, outf, lse = res
+    B, Tq, H, D = q.shape
+    Tk = k.shape[1]
+    tq_pad = outf.shape[1]
+    tk_pad = -(-Tk // 128) * 128
+    bq = _pick_block(tq_pad, block_q)
+    bk = _pick_block(tk_pad, block_k)
+    qf = _flatten(_pad_seq(q, tq_pad))
+    kf = _flatten(_pad_seq(k, tk_pad))
+    vf = _flatten(_pad_seq(v, tk_pad))
+    dof = _flatten(_pad_seq(g, tq_pad))
+    dqf, dkf, dvf = _pallas_backward(qf, kf, vf, dof, outf, lse, scale,
+                                     causal, bq, bk, Tk, interpret)
+    dq = _unflatten(dqf, B, H)[:, :Tq]
+    dk = _unflatten(dkf, B, H)[:, :Tk]
+    dv = _unflatten(dvf, B, H)[:, :Tk]
+    return dq, dk, dv
 
 
-_flash.defvjp(_flash_fwd, _flash_bwd)
+_flash.defvjp(_flash_fwd_rule, _flash_bwd)
 
 
 def flash_attention(q, k, v, causal=False, scale=None, block_q=512,
                     block_k=512, force_pallas=False):
     """Attention over (B, T, H, D) tensors.
 
-    The Pallas kernel runs on TPU (or under ``force_pallas`` in
-    interpret mode) when both sequence lengths tile evenly by the
-    block sizes; otherwise the jnp composition runs — same math,
+    The Pallas kernels (forward and backward) run on TPU — or under
+    ``force_pallas`` in interpret mode — for ANY sequence length:
+    non-tiling lengths are zero-padded to the 128-lane multiple and
+    masked in-kernel. The jnp composition runs elsewhere; same math,
     differentiable everywhere.
     """
     scale = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
-    on_tpu = jax.devices()[0].platform == "tpu"
-    Tq, Tk = q.shape[1], k.shape[1]
-    usable = (Tq % block_q == 0) and (Tk % block_k == 0)
-    if (on_tpu or force_pallas) and usable:
+    on_tpu = jax.devices()[0].platform in ("tpu", "axon")
+    if on_tpu or force_pallas:
         return _flash(q, k, v, scale, causal, block_q, block_k,
                       not on_tpu)
     return _jnp_reference(q, k, v, scale, causal)

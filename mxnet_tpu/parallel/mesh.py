@@ -22,9 +22,42 @@ import numpy as np
 
 __all__ = ["create_mesh", "auto_mesh", "mesh_axes", "local_mesh",
            "PartitionSpec", "NamedSharding", "replicated", "shard_batch",
-           "dp_mesh", "distinct_devices"]
+           "dp_mesh", "distinct_devices", "use_mesh", "current_mesh",
+           "set_current_mesh"]
 
 _DP_MESH_CACHE = {}
+_CURRENT_MESH = [None]
+
+
+def set_current_mesh(mesh):
+    """Install ``mesh`` as the process-wide active parallelism mesh.
+    Ops that can exploit mesh axes (``_contrib_flash_attention``'s
+    ring/ulysses impls, gluon.contrib MeshAttention) consult it — the
+    registry's op surface has no mesh argument, same as the reference's
+    ops have no device-group argument (placement is ambient context
+    there too). Returns the previous mesh."""
+    prev = _CURRENT_MESH[0]
+    _CURRENT_MESH[0] = mesh
+    return prev
+
+
+def current_mesh():
+    return _CURRENT_MESH[0]
+
+
+class use_mesh:
+    """``with use_mesh(mesh): ...`` scoped set_current_mesh."""
+
+    def __init__(self, mesh):
+        self._mesh = mesh
+        self._prev = None
+
+    def __enter__(self):
+        self._prev = set_current_mesh(self._mesh)
+        return self._mesh
+
+    def __exit__(self, *exc):
+        set_current_mesh(self._prev)
 
 
 def dp_mesh(devices):

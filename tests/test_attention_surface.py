@@ -1,0 +1,91 @@
+"""_contrib_flash_attention op + gluon.contrib MeshMultiHeadAttention:
+the §5.7 kernels reached from the registered-op / Gluon / Symbol
+surfaces (VERDICT r3 item 5)."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import mxnet_tpu as mx
+from mxnet_tpu.parallel.flash_attention import _jnp_reference
+from mxnet_tpu.parallel.mesh import create_mesh, use_mesh
+
+
+def _qkv(B=2, T=32, H=2, D=8, seed=0):
+    rng = np.random.RandomState(seed)
+    mk = lambda: rng.randn(B, T, H, D).astype(np.float32)
+    return mk(), mk(), mk()
+
+
+def test_nd_op_dense_matches_reference():
+    q, k, v = _qkv()
+    got = mx.nd._contrib_flash_attention(
+        mx.nd.array(q), mx.nd.array(k), mx.nd.array(v), causal=True)
+    want = _jnp_reference(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v),
+                          1.0 / np.sqrt(8), True)
+    np.testing.assert_allclose(got.asnumpy(), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_symbol_op_binds_and_differentiates():
+    q, k, v = _qkv(seed=1)
+    qs, ks, vs = mx.sym.var("q"), mx.sym.var("k"), mx.sym.var("v")
+    out = mx.sym._contrib_flash_attention(qs, ks, vs, causal=False,
+                                          name="attn")
+    args = {"q": mx.nd.array(q), "k": mx.nd.array(k), "v": mx.nd.array(v)}
+    grads = {n: mx.nd.zeros(args[n].shape) for n in args}
+    ex = out.bind(mx.cpu(), args, args_grad=grads)
+    ex.forward(is_train=True)
+    want = _jnp_reference(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v),
+                          1.0 / np.sqrt(8), False)
+    np.testing.assert_allclose(ex.outputs[0].asnumpy(), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+    ex.backward()
+    assert float(np.abs(grads["q"].asnumpy()).sum()) > 0
+
+
+def test_op_ring_under_mesh_matches_dense():
+    if len(jax.devices()) < 4:
+        pytest.skip("needs >=4 devices")
+    q, k, v = _qkv(B=1, T=32, H=4, D=8, seed=2)
+    mesh = create_mesh({"sp": 4}, devices=jax.devices()[:4])
+    want = _jnp_reference(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v),
+                          1.0 / np.sqrt(8), True)
+    with use_mesh(mesh):
+        got = mx.nd._contrib_flash_attention(
+            mx.nd.array(q), mx.nd.array(k), mx.nd.array(v), causal=True)
+        # 'auto' under an sp mesh selects ring attention
+        got_ul = mx.nd._contrib_flash_attention(
+            mx.nd.array(q), mx.nd.array(k), mx.nd.array(v), causal=True,
+            impl="ulysses")
+    np.testing.assert_allclose(got.asnumpy(), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+    np.testing.assert_allclose(got_ul.asnumpy(), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_gluon_block_trains():
+    net = mx.gluon.contrib.nn.MeshMultiHeadAttention(16, 4, causal=True)
+    net.initialize(mx.init.Xavier())
+    x = mx.nd.array(np.random.RandomState(3).randn(2, 10, 16)
+                    .astype(np.float32))
+    out = net(x)
+    assert out.shape == (2, 10, 16)
+    # gradient flows through the attention op into the projections
+    from mxnet_tpu import autograd
+    params = net.collect_params()
+    with autograd.record():
+        y = net(x)
+        loss = (y ** 2).sum()
+    loss.backward()
+    g = params["meshmultiheadattention0_query_weight"].grad()
+    assert float(np.abs(g.asnumpy()).sum()) > 0
+
+
+def test_gluon_block_hybridizes():
+    net = mx.gluon.contrib.nn.MeshMultiHeadAttention(16, 2)
+    net.initialize()
+    net.hybridize()
+    x = mx.nd.ones((1, 8, 16))
+    assert net(x).shape == (1, 8, 16)
