@@ -111,6 +111,124 @@ def cond(pred, then_func, else_func):
     return then_func() if taken else else_func()
 
 
+# -- DGL graph sampling (user-facing CSR API over the lowered ops) --------
+
+def _csr_pieces(csr):
+    return [csr.indptr._data, csr.indices._data, csr.data._data]
+
+
+def _mk_csr(indptr, cols, eids, shape, ctx):
+    from .sparse import CSRNDArray
+    from .ndarray import NDArray
+    import jax.numpy as jnp
+    return CSRNDArray(NDArray(jnp.asarray(eids)),
+                      NDArray(jnp.asarray(cols)),
+                      NDArray(jnp.asarray(indptr)), shape, ctx=ctx)
+
+
+def _dgl_sample(csr, seeds, uniform, probability=None, num_hops=1,
+                num_neighbor=2, max_num_vertices=100):
+    """Shared body of the two neighbor-sampling wrappers (reference
+    output grouping: all vertex arrays, then all sub-CSRs, then all
+    layer arrays; non-uniform inserts per-vertex probabilities after
+    the vertex group, dgl_graph.cc:758/852)."""
+    from .. import ops as _ops
+    from .ndarray import NDArray
+    import jax.numpy as jnp
+    seeds = seeds if isinstance(seeds, (list, tuple)) else [seeds]
+    n = len(seeds)
+    name = "_contrib_dgl_csr_neighbor_uniform_sample" if uniform \
+        else "_contrib_dgl_csr_neighbor_non_uniform_sample"
+    op = _ops.get_op(name)
+    raw = _csr_pieces(csr) + [s._data for s in seeds]
+    base = 3
+    if not uniform:
+        raw = [probability._data] + raw
+        base = 4
+    attrs = {"num_args": base + n, "num_hops": num_hops,
+             "num_neighbor": num_neighbor,
+             "max_num_vertices": max_num_vertices}
+    outs, _ = _ops.invoke(op, raw, attrs)
+    per = 5 if uniform else 6
+    verts, probs, csrs, layers = [], [], [], []
+    max_v = int(max_num_vertices)
+    for i in range(n):
+        chunk = outs[per * i: per * (i + 1)]
+        it = iter(chunk)
+        verts.append(NDArray(jnp.asarray(next(it))))
+        if not uniform:
+            probs.append(NDArray(jnp.asarray(next(it))))
+        layer = jnp.asarray(next(it))
+        indptr, cols, eids = (next(it), next(it), next(it))
+        csrs.append(_mk_csr(indptr, cols, eids,
+                            (max_v, csr.shape[1]), csr.context))
+        layers.append(NDArray(layer))
+    out = verts + (probs if not uniform else []) + csrs + layers
+    return out if len(out) > 1 else out[0]
+
+
+def dgl_csr_neighbor_uniform_sample(csr, seeds, num_hops=1,
+                                    num_neighbor=2,
+                                    max_num_vertices=100):
+    return _dgl_sample(csr, seeds, True, num_hops=num_hops,
+                       num_neighbor=num_neighbor,
+                       max_num_vertices=max_num_vertices)
+
+
+def dgl_csr_neighbor_non_uniform_sample(csr, probability, seeds,
+                                        num_hops=1, num_neighbor=2,
+                                        max_num_vertices=100):
+    return _dgl_sample(csr, seeds, False, probability=probability,
+                       num_hops=num_hops, num_neighbor=num_neighbor,
+                       max_num_vertices=max_num_vertices)
+
+
+def dgl_subgraph(csr, *vids, return_mapping=False):
+    from .. import ops as _ops
+    op = _ops.get_op("_contrib_dgl_subgraph")
+    raw = _csr_pieces(csr) + [v._data for v in vids]
+    outs, _ = _ops.invoke(op, raw, {"num_args": 3 + len(vids),
+                                    "return_mapping": return_mapping})
+    res = []
+    for g in range(len(vids)):
+        n = int(vids[g].shape[0])
+        res.append(_mk_csr(outs[3 * g], outs[3 * g + 1], outs[3 * g + 2],
+                           (n, n), csr.context))
+    if return_mapping:
+        off = 3 * len(vids)
+        for g in range(len(vids)):
+            n = int(vids[g].shape[0])
+            res.append(_mk_csr(outs[off + 3 * g], outs[off + 3 * g + 1],
+                               outs[off + 3 * g + 2], (n, n),
+                               csr.context))
+    return res if len(res) > 1 else res[0]
+
+
+def dgl_adjacency(csr):
+    from .. import ops as _ops
+    op = _ops.get_op("_contrib_dgl_adjacency")
+    outs, _ = _ops.invoke(op, _csr_pieces(csr), {})
+    return _mk_csr(outs[0], outs[1], outs[2], csr.shape, csr.context)
+
+
+def dgl_graph_compact(*csrs, return_mapping=False, graph_sizes=()):
+    from .. import ops as _ops
+    op = _ops.get_op("_contrib_dgl_graph_compact")
+    raw = []
+    for c in csrs:
+        raw.extend(_csr_pieces(c))
+    outs, _ = _ops.invoke(op, raw, {"num_args": len(raw),
+                                    "return_mapping": return_mapping,
+                                    "graph_sizes": tuple(graph_sizes)})
+    res = []
+    for g, c in enumerate(csrs):
+        size = int(graph_sizes[g]) if g < len(graph_sizes) \
+            else c.shape[0]
+        res.append(_mk_csr(outs[3 * g], outs[3 * g + 1],
+                           outs[3 * g + 2], (size, size), c.context))
+    return res if len(res) > 1 else res[0]
+
+
 def _install_contrib_ops():
     from ..contrib._alias import install_contrib_ops
     from . import register as _register

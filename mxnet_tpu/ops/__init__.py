@@ -23,3 +23,4 @@ from . import quantization  # noqa: F401
 from . import detection     # noqa: F401
 from . import extra         # noqa: F401
 from . import attention     # noqa: F401
+from . import dgl           # noqa: F401

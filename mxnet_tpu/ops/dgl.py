@@ -1,0 +1,229 @@
+"""DGL graph-sampling operator family.
+
+Parity: src/operator/contrib/dgl_graph.cc (the five-op set DGL drives:
+``_contrib_dgl_csr_neighbor_uniform_sample``,
+``_contrib_dgl_csr_neighbor_non_uniform_sample``,
+``_contrib_dgl_subgraph``, ``_contrib_dgl_adjacency``,
+``_contrib_dgl_graph_compact``; ``_contrib_edge_id`` lives with the
+other indexing ops).
+
+Design: graph sampling is data-dependent, ragged, and integer-heavy —
+none of which belongs on the MXU. The reference runs these on CPU
+threads regardless of build; here they are host numpy ops (``no_jit``)
+over a LOWERED dense calling convention — a CSR graph arrives as its
+``(indptr, indices, eids)`` triple instead of a packed CSRNDArray
+handle, and CSR results leave the same way. ``mxnet_tpu.ndarray.contrib``
+wraps them back into CSRNDArray for the user-facing DGL API.
+
+Sampled-vertex arrays follow the reference layout: length
+``max_num_vertices + 1`` with the actual vertex count in the LAST slot
+and -1 padding; layer arrays are ``max_num_vertices`` long.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .registry import register
+
+__all__ = []
+
+
+def _np_arr(x):
+    return np.asarray(x)
+
+
+def _row(indptr, indices, eids, v):
+    lo, hi = int(indptr[v]), int(indptr[v + 1])
+    return indices[lo:hi], eids[lo:hi]
+
+
+def _sample_subgraph(indptr, indices, eids, seeds, num_hops,
+                     num_neighbor, max_v, prob=None, seed=0):
+    """BFS neighbor sampling from ``seeds``; returns (verts, layer,
+    sub_indptr, sub_cols, sub_eids[, vert_probs])."""
+    rng = np.random.RandomState(seed)
+    seeds = np.unique(seeds[seeds >= 0].astype(np.int64))
+    layer_of = {int(v): 0 for v in seeds[:max_v]}
+    chosen = {}                    # vertex -> (cols, eids) kept edges
+    frontier = list(layer_of)
+    for hop in range(1, num_hops + 1):
+        nxt = []
+        for v in frontier:
+            cols, es = _row(indptr, indices, eids, v)
+            deg = cols.shape[0]
+            if deg == 0:
+                continue
+            k = min(num_neighbor, deg)
+            if prob is not None:
+                p = np.asarray(prob[cols], np.float64)
+                s = p.sum()
+                if s <= 0:
+                    continue          # no samplable neighbor
+                p = p / s
+                k = min(k, int(np.count_nonzero(p)))
+                pick = rng.choice(deg, size=k, replace=False, p=p)
+            else:
+                pick = rng.choice(deg, size=k, replace=False)
+            chosen[v] = (cols[pick], es[pick])
+            for u in chosen[v][0]:
+                u = int(u)
+                if u not in layer_of and len(layer_of) < max_v:
+                    layer_of[u] = hop
+                    nxt.append(u)
+        frontier = nxt
+    verts = np.array(sorted(layer_of), np.int64)
+    n = verts.shape[0]
+    vout = np.full((max_v + 1,), -1, np.int64)
+    vout[:n] = verts
+    vout[-1] = n
+    lout = np.full((max_v,), -1, np.int64)
+    lout[:n] = [layer_of[int(v)] for v in verts]
+    # sub CSR: rows = sampled vertices (sorted), cols/eids = kept edges
+    sub_indptr = np.zeros((max_v + 1,), np.int64)
+    cols_acc, eids_acc = [], []
+    for i, v in enumerate(verts):
+        c, e = chosen.get(int(v), (np.empty(0, np.int64),
+                                   np.empty(0, np.int64)))
+        keep = np.isin(c, verts)
+        cols_acc.append(c[keep])
+        eids_acc.append(e[keep])
+        sub_indptr[i + 1] = sub_indptr[i] + int(keep.sum())
+    sub_indptr[n + 1:] = sub_indptr[n]
+    sub_cols = (np.concatenate(cols_acc) if cols_acc
+                else np.empty(0, np.int64)).astype(np.int64)
+    sub_eids = (np.concatenate(eids_acc) if eids_acc
+                else np.empty(0, np.int64)).astype(np.int64)
+    outs = [vout, lout, sub_indptr, sub_cols, sub_eids]
+    if prob is not None:
+        pout = np.full((max_v,), -1.0, np.float32)
+        pout[:n] = np.asarray(prob, np.float32)[verts]
+        outs.insert(1, pout)
+    return outs
+
+
+def _uniform_sample(attrs, indptr, indices, eids, *seed_arrays):
+    num_hops = int(attrs.get("num_hops", 1))
+    num_neighbor = int(attrs.get("num_neighbor", 2))
+    max_v = int(attrs.get("max_num_vertices", 100))
+    indptr, indices, eids = (_np_arr(indptr), _np_arr(indices),
+                             _np_arr(eids))
+    outs = []
+    for i, s in enumerate(seed_arrays):
+        outs.extend(_sample_subgraph(indptr, indices, eids, _np_arr(s),
+                                     num_hops, num_neighbor, max_v,
+                                     seed=i))
+    return tuple(outs)
+
+
+def _non_uniform_sample(attrs, prob, indptr, indices, eids,
+                        *seed_arrays):
+    num_hops = int(attrs.get("num_hops", 1))
+    num_neighbor = int(attrs.get("num_neighbor", 2))
+    max_v = int(attrs.get("max_num_vertices", 100))
+    indptr, indices, eids = (_np_arr(indptr), _np_arr(indices),
+                             _np_arr(eids))
+    outs = []
+    for i, s in enumerate(seed_arrays):
+        outs.extend(_sample_subgraph(indptr, indices, eids, _np_arr(s),
+                                     num_hops, num_neighbor, max_v,
+                                     prob=_np_arr(prob), seed=i))
+    return tuple(outs)
+
+
+register("_contrib_dgl_csr_neighbor_uniform_sample", _uniform_sample,
+         arg_names=("indptr", "indices", "eids", "seeds"),
+         no_jit=True, key_var_num_args="num_args",
+         defaults={"num_args": 4, "num_hops": 1, "num_neighbor": 2,
+                   "max_num_vertices": 100},
+         num_outputs=lambda attrs: 5 * (int(attrs.get("num_args", 4))
+                                        - 3))
+
+register("_contrib_dgl_csr_neighbor_non_uniform_sample",
+         _non_uniform_sample,
+         arg_names=("probability", "indptr", "indices", "eids", "seeds"),
+         no_jit=True, key_var_num_args="num_args",
+         defaults={"num_args": 5, "num_hops": 1, "num_neighbor": 2,
+                   "max_num_vertices": 100},
+         num_outputs=lambda attrs: 6 * (int(attrs.get("num_args", 5))
+                                        - 4))
+
+
+def _subgraph(attrs, indptr, indices, eids, *vid_arrays):
+    """Vertex-induced subgraphs with renumbered ids; optionally the
+    original edge ids as a parallel CSR (return_mapping)."""
+    mapping = bool(attrs.get("return_mapping", False))
+    indptr, indices, eids = (_np_arr(indptr), _np_arr(indices),
+                             _np_arr(eids))
+    new_csrs, old_csrs = [], []
+    for vids in vid_arrays:
+        vids = _np_arr(vids).astype(np.int64)
+        pos = {int(v): i for i, v in enumerate(vids)}
+        sub_indptr = np.zeros((vids.shape[0] + 1,), np.int64)
+        cols, new_es, old_es = [], [], []
+        next_eid = 0
+        for i, v in enumerate(vids):
+            c, e = _row(indptr, indices, eids, int(v))
+            keep = np.isin(c, vids)
+            kept_cols = [pos[int(u)] for u in c[keep]]
+            cols.extend(kept_cols)
+            old_es.extend(e[keep].tolist())
+            new_es.extend(range(next_eid, next_eid + len(kept_cols)))
+            next_eid += len(kept_cols)
+            sub_indptr[i + 1] = len(cols)
+        new_csrs.extend([sub_indptr,
+                         np.asarray(cols, np.int64),
+                         np.asarray(new_es, np.int64)])
+        if mapping:
+            old_csrs.extend([sub_indptr.copy(),
+                             np.asarray(cols, np.int64),
+                             np.asarray(old_es, np.int64)])
+    return tuple(new_csrs + old_csrs)
+
+
+register("_contrib_dgl_subgraph", _subgraph,
+         arg_names=("indptr", "indices", "eids", "vids"),
+         no_jit=True, key_var_num_args="num_args",
+         defaults={"num_args": 4, "return_mapping": False},
+         num_outputs=lambda attrs: (int(attrs.get("num_args", 4)) - 3)
+         * (6 if attrs.get("return_mapping") else 3))
+
+
+def _adjacency(attrs, indptr, indices, eids):
+    """CSR structure with unit float values (the graph's adjacency)."""
+    return (_np_arr(indptr).astype(np.int64),
+            _np_arr(indices).astype(np.int64),
+            np.ones((_np_arr(indices).shape[0],), np.float32))
+
+
+register("_contrib_dgl_adjacency", _adjacency,
+         arg_names=("indptr", "indices", "eids"),
+         no_jit=True, num_outputs=3)
+
+
+def _graph_compact(attrs, *triples):
+    """Renumber each subgraph's vertex ids to remove gaps: row i of the
+    compacted CSR is the i-th row with any edge (up to graph_sizes[i])."""
+    mapping = bool(attrs.get("return_mapping", False))
+    sizes = attrs.get("graph_sizes", ())
+    if not isinstance(sizes, (list, tuple)):
+        sizes = (sizes,)
+    n_g = len(triples) // 3
+    outs = []
+    for g in range(n_g):
+        indptr, indices, eids = (_np_arr(triples[3 * g]),
+                                 _np_arr(triples[3 * g + 1]),
+                                 _np_arr(triples[3 * g + 2]))
+        size = int(sizes[g]) if g < len(sizes) else indptr.shape[0] - 1
+        sub_indptr = indptr[:size + 1].astype(np.int64)
+        nnz = int(sub_indptr[-1])
+        outs.extend([sub_indptr, indices[:nnz].astype(np.int64),
+                     eids[:nnz].astype(np.int64)])
+    return tuple(outs)
+
+
+register("_contrib_dgl_graph_compact", _graph_compact,
+         arg_names=("indptr", "indices", "eids"),
+         no_jit=True, key_var_num_args="num_args",
+         defaults={"num_args": 3, "return_mapping": False,
+                   "graph_sizes": ()},
+         num_outputs=lambda attrs: int(attrs.get("num_args", 3)))

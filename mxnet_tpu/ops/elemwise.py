@@ -227,6 +227,17 @@ for _name, _fn in _SCALAR.items():
              (lambda attrs, x, _f=_fn: _f(x, _sc(x, attrs))),
              arg_names=_D, defaults={"scalar": 0.0})
 
+# _scatter_*_scalar (ref elemwise_binary_scalar_op_basic.cc): on sparse
+# storage the scalar touches only STORED values; the dense lowering is
+# the plain scalar op (ndarray.sparse routes csr/rsp inputs through
+# their .data leaves, which is exactly the stored-values contract)
+register("_scatter_plus_scalar",
+         lambda attrs, x: x + _sc(x, attrs),
+         arg_names=_D, defaults={"scalar": 0.0})
+register("_scatter_minus_scalar",
+         lambda attrs, x: x - _sc(x, attrs),
+         arg_names=_D, defaults={"scalar": 0.0})
+
 register("_scatter_elemwise_div",
          lambda attrs, x, y: x / y, arg_names=_LR)
 

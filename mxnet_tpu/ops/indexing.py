@@ -245,3 +245,19 @@ def _getitem_impl(attrs, data, *index_arrays):
 register("_getitem", _getitem_impl, arg_names=("data",),
          defaults={"spec": (), "num_arrays": 0},
          key_var_num_args="num_arrays")
+
+
+def _sparse_retain_op(attrs, data, indices):
+    """Dense lowering of row retention (ref
+    src/operator/tensor/sparse_retain.cc): rows of ``data`` whose index
+    is absent from ``indices`` become zero — on row_sparse storage the
+    ndarray.sparse.retain wrapper drops them instead, same contract."""
+    import jax.numpy as jnp
+    rows = jnp.arange(data.shape[0])
+    keep = jnp.isin(rows, indices.astype(jnp.int32))
+    return data * keep.astype(data.dtype).reshape(
+        (-1,) + (1,) * (data.ndim - 1))
+
+
+register("_sparse_retain", _sparse_retain_op,
+         arg_names=("data", "indices"))

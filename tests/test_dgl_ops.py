@@ -1,0 +1,120 @@
+"""DGL graph-sampling op family (ref src/operator/contrib/dgl_graph.cc)
++ _scatter_*_scalar + registered _sparse_retain."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu.ndarray import sparse as sp
+
+
+def _toy_graph():
+    """5-vertex ring + chords, symmetric, eids = position."""
+    # adjacency rows: 0:[1,4] 1:[0,2] 2:[1,3] 3:[2,4] 4:[0,3]
+    indptr = np.array([0, 2, 4, 6, 8, 10], np.int64)
+    indices = np.array([1, 4, 0, 2, 1, 3, 2, 4, 0, 3], np.int64)
+    eids = np.arange(10, dtype=np.int64)
+    data = eids.astype(np.float32)
+    return sp.CSRNDArray(mx.nd.array(data), mx.nd.array(indices),
+                         mx.nd.array(indptr), (5, 5))
+
+
+def test_uniform_sample_structure():
+    g = _toy_graph()
+    seeds = mx.nd.array(np.array([0], np.float32))
+    verts, subg, layer = mx.nd.contrib.dgl_csr_neighbor_uniform_sample(
+        g, seeds, num_hops=1, num_neighbor=2, max_num_vertices=4)
+    v = verts.asnumpy().astype(np.int64)
+    n = int(v[-1])
+    assert 1 <= n <= 4
+    vs = v[:n]
+    assert 0 in vs                           # seed kept
+    lay = layer.asnumpy().astype(np.int64)[:n]
+    assert lay[list(vs).index(0)] == 0       # seed at layer 0
+    assert set(lay.tolist()) <= {0, 1}
+    # sub CSR rows align with sampled vertices; cols are sampled ids
+    iptr = subg.indptr.asnumpy()
+    cols = subg.indices.asnumpy()
+    assert iptr.shape[0] == 5                # max_v + 1
+    assert np.all(np.isin(cols, vs))
+    # every neighbor recorded is a true neighbor in the original graph
+    full_iptr = np.array([0, 2, 4, 6, 8, 10])
+    full_cols = np.array([1, 4, 0, 2, 1, 3, 2, 4, 0, 3])
+    for i, src in enumerate(vs):
+        row = cols[iptr[i]:iptr[i + 1]]
+        truth = full_cols[full_iptr[src]:full_iptr[src + 1]]
+        assert np.all(np.isin(row, truth))
+
+
+def test_non_uniform_sample_respects_probability():
+    g = _toy_graph()
+    # probability 0 on vertices 3 and 4: they can never be sampled
+    prob = mx.nd.array(np.array([1, 1, 1, 0, 0], np.float32))
+    seeds = mx.nd.array(np.array([1], np.float32))
+    outs = mx.nd.contrib.dgl_csr_neighbor_non_uniform_sample(
+        g, prob, seeds, num_hops=2, num_neighbor=2, max_num_vertices=5)
+    verts, vprob, subg, layer = outs
+    v = verts.asnumpy().astype(np.int64)
+    n = int(v[-1])
+    sampled = set(v[:n].tolist()) - {1}
+    assert 3 not in sampled and 4 not in sampled
+    p = vprob.asnumpy()[:n]
+    assert np.all(p >= 0)
+
+
+def test_subgraph_induced_edges():
+    g = _toy_graph()
+    vids = mx.nd.array(np.array([0, 1, 2], np.float32))
+    new_g, old_g = mx.nd.contrib.dgl_subgraph(g, vids,
+                                              return_mapping=True)
+    iptr = new_g.indptr.asnumpy()
+    cols = new_g.indices.asnumpy()
+    # induced edges among {0,1,2}: 0-1, 1-0, 1-2, 2-1 (renumbered)
+    assert iptr.tolist() == [0, 1, 3, 4]
+    assert cols.tolist() == [1, 0, 2, 1]
+    # mapping CSR carries ORIGINAL edge ids at the same positions
+    old_eids = old_g.data.asnumpy().astype(np.int64)
+    assert old_eids.tolist() == [0, 2, 3, 4]
+
+
+def test_adjacency_and_compact():
+    g = _toy_graph()
+    adj = mx.nd.contrib.dgl_adjacency(g)
+    assert np.all(adj.data.asnumpy() == 1.0)
+    assert adj.indices.asnumpy().tolist() == \
+        g.indices.asnumpy().tolist()
+    comp = mx.nd.contrib.dgl_graph_compact(g, graph_sizes=(3,))
+    assert comp.shape == (3, 3)
+    assert comp.indptr.asnumpy().shape[0] == 4
+
+
+def test_scatter_scalar_ops():
+    x = mx.nd.array(np.array([[1., 2.], [3., 4.]]))
+    out = mx.nd._scatter_plus_scalar(x, scalar=2.0)
+    np.testing.assert_allclose(out.asnumpy(), [[3., 4.], [5., 6.]])
+    out = mx.nd._scatter_minus_scalar(x, scalar=1.0)
+    np.testing.assert_allclose(out.asnumpy(), [[0., 1.], [2., 3.]])
+
+
+def test_sparse_retain_registered_op():
+    data = mx.nd.array(np.arange(12, dtype=np.float32).reshape(4, 3))
+    idx = mx.nd.array(np.array([0., 2.]))
+    out = mx.nd._sparse_retain(data, idx)
+    want = data.asnumpy().copy()
+    want[1] = 0
+    want[3] = 0
+    np.testing.assert_allclose(out.asnumpy(), want)
+    # row_sparse wrapper drops rows instead; dense views agree
+    rsp = sp.RowSparseNDArray(
+        mx.nd.array(np.arange(6, dtype=np.float32).reshape(2, 3)),
+        mx.nd.array(np.array([1, 3], np.int64)), (4, 3))
+    kept = sp.retain(rsp, mx.nd.array(np.array([3.])))
+    assert kept.indices.asnumpy().tolist() == [3]
+
+
+def test_registry_has_dgl_quintet():
+    from mxnet_tpu.ops.registry import find_op
+    for n in ("_contrib_dgl_csr_neighbor_uniform_sample",
+              "_contrib_dgl_csr_neighbor_non_uniform_sample",
+              "_contrib_dgl_subgraph", "_contrib_dgl_adjacency",
+              "_contrib_dgl_graph_compact", "_scatter_plus_scalar",
+              "_scatter_minus_scalar", "_sparse_retain"):
+        assert find_op(n) is not None, n

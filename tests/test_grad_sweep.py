@@ -250,8 +250,13 @@ CASES = {
                          "indices": np.array([[1., 3.]]),
                          "rhs": _sym(2, 3)},
                         ("lhs", "rhs")),
+    "_sparse_retain": ({}, {"data": _sym(4, 3),
+                            "indices": np.array([0., 2.])},
+                       ("data",)),
     "_contrib_ifft": ({}, {"data": _sym(2, 8)}, ("data",),
                       (5e-2, 5e-3)),   # fp32-internal DFT
+    "_contrib_fft": ({}, {"data": _sym(2, 8)}, ("data",),
+                     (5e-2, 5e-3)),    # fp32-internal DFT
     "where": ({}, {"condition": np.array([[1., 0.], [0., 1.],
                                           [1., 1.]]),
                    "x": _sym(3, 2), "y": _sym(3, 2)},
@@ -364,12 +369,23 @@ WAIVED = {
     "_contrib_flash_attention": "kernel path pinned in "
                                 "test_flash_attention (fwd+bwd)",
     "_contrib_edge_id": "graph query: integer adjacency lookup",
+    "_contrib_dgl_csr_neighbor_uniform_sample":
+        "host graph sampling: integer structure (test_dgl_ops)",
+    "_contrib_dgl_csr_neighbor_non_uniform_sample":
+        "host graph sampling: integer structure (test_dgl_ops)",
+    "_contrib_dgl_subgraph":
+        "host graph sampling: integer structure (test_dgl_ops)",
+    "_contrib_dgl_adjacency":
+        "host graph sampling: integer structure (test_dgl_ops)",
+    "_contrib_dgl_graph_compact":
+        "host graph sampling: integer structure (test_dgl_ops)",
     "GridGenerator": "affine grid: pinned in test_op_breadth",
     "BlockGrad": "gradient-blocking op: zero grad by definition",
     "stop_gradient": "gradient-blocking op: zero grad by definition",
     "MakeLoss": "loss head: gradient is grad_scale by definition",
     "make_loss": "loss head: gradient is grad_scale by definition",
     "_unravel_index": "integer index arithmetic",
+    "_contrib_box_iou": "IoU: kinked at box-overlap boundaries",
 }
 
 
@@ -380,8 +396,12 @@ def _auto_case(op):
             and not op.arg_names_fn:
         return {}, {"data": _pos(2, 3) + 0.35}, ("data",)
     if names in (["lhs", "rhs"], ["data1", "data2"], ["a", "b"]):
-        return {}, {names[0]: _pos(2, 3) + 0.35,
-                    names[1]: _pos(2, 3) + 0.3}, tuple(names)
+        lhs = _pos(2, 3) + 0.35
+        # keep |lhs - rhs| > 0.05: min/max/mod-style ops have
+        # subgradient kinks at ties where FD is undefined
+        delta = (RNG.uniform(0.05, 0.4, lhs.shape)
+                 * np.where(RNG.rand(*lhs.shape) < 0.5, -1.0, 1.0))
+        return {}, {names[0]: lhs, names[1]: lhs + delta}, tuple(names)
     return None
 
 
