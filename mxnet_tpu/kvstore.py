@@ -64,6 +64,35 @@ class _TwoBitCompressor:
         return NDArray(q, ctx=arr._ctx)
 
 
+def _ensure_process_group():
+    """Join the process group described by the launcher's DMLC_* env
+    contract (tools/launch.py; ref dmlc tracker env in
+    python/mxnet/kvstore_server.py). A dist kvstore created in a worker
+    spawned by ``python -m mxnet_tpu.tools.launch -n N ...`` calls
+    ``jax.distributed.initialize`` against the shared coordinator; a
+    process already in a group (manual initialize, TPU pod runtime) or
+    with no contract in the env is left untouched."""
+    import jax
+    try:
+        if jax.process_count() > 1:
+            return
+    except Exception:
+        pass
+    import os
+    n = int(os.environ.get("DMLC_NUM_WORKER", "1") or 1)
+    if n <= 1 or "DMLC_WORKER_ID" not in os.environ:
+        return
+    uri = os.environ.get("DMLC_PS_ROOT_URI", "127.0.0.1")
+    port = os.environ.get("DMLC_PS_ROOT_PORT", "9091")
+    try:
+        jax.distributed.initialize(
+            coordinator_address="%s:%s" % (uri, port),
+            num_processes=n,
+            process_id=int(os.environ["DMLC_WORKER_ID"]))
+    except RuntimeError:
+        pass          # already initialized
+
+
 class KVStore:
     """Key-value store for parameter synchronization
     (reference: kvstore.py:61)."""
@@ -75,6 +104,8 @@ class KVStore:
         self._optimizer = None
         self._compression_params = None
         self._is_dist = ("dist" in kv_type) or ("tpu" in kv_type)
+        if self._is_dist:
+            _ensure_process_group()
 
     # -- identity --------------------------------------------------------
     @property

@@ -1,0 +1,100 @@
+"""Multi-process job launcher (parity: tools/launch.py:33).
+
+``python -m mxnet_tpu.tools.launch -n 4 python train.py`` spawns N
+worker processes on this host with the reference's DMLC_* environment
+contract (DMLC_NUM_WORKER / DMLC_WORKER_ID / DMLC_PS_ROOT_URI /
+DMLC_PS_ROOT_PORT). Workers need no launcher-specific code: creating a
+``tpu_sync`` (dist) KVStore reads that contract and joins the process
+group via ``jax.distributed.initialize`` — the coordinator replaces the
+reference's ps-lite scheduler, and collectives replace the server pool,
+so there is no -s/--num-servers role to launch (accepted and ignored
+for CLI compatibility).
+
+Only the ``local`` launcher is implemented: multi-host jobs on TPU
+pods are started by the cluster scheduler (GKE/xmanager), which
+provides its own coordinator wiring — ssh/mpi/sge/yarn trackers exist
+to solve a problem the TPU runtime does not have. They raise with that
+explanation.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import socket
+import subprocess
+import sys
+
+__all__ = ["launch_local", "main"]
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def launch_local(num_workers, command, extra_env=(), port=None):
+    """Spawn ``command`` num_workers times with the DMLC_* env contract;
+    returns the list of exit codes."""
+    port = port or _free_port()
+    procs = []
+    for i in range(num_workers):
+        env = dict(os.environ)
+        env.update({
+            "DMLC_ROLE": "worker",
+            "DMLC_NUM_WORKER": str(num_workers),
+            "DMLC_WORKER_ID": str(i),
+            "DMLC_PS_ROOT_URI": "127.0.0.1",
+            "DMLC_PS_ROOT_PORT": str(port),
+        })
+        for kv in extra_env:
+            k, _, v = kv.partition(":")
+            env[k] = v
+        procs.append(subprocess.Popen(command, env=env))
+    codes = []
+    try:
+        for p in procs:
+            codes.append(p.wait())
+    except KeyboardInterrupt:
+        for p in procs:
+            p.send_signal(signal.SIGTERM)
+        raise
+    return codes
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        description="Launch a distributed mxnet_tpu job (local "
+                    "multi-process; ref tools/launch.py)")
+    parser.add_argument("-n", "--num-workers", required=True, type=int)
+    parser.add_argument("-s", "--num-servers", type=int, default=None,
+                        help="accepted for CLI parity; the collective "
+                             "backend has no server role")
+    parser.add_argument("--launcher", default="local",
+                        choices=["local", "ssh", "mpi", "sge", "yarn"])
+    parser.add_argument("-H", "--hostfile", default=None)
+    parser.add_argument("--env", action="append", default=[],
+                        help="KEY:VALUE set in every worker")
+    parser.add_argument("--sync-dst-dir", default=None)
+    parser.add_argument("command", nargs=argparse.REMAINDER)
+    args = parser.parse_args(argv)
+    if not args.command:
+        parser.error("no command given")
+    if args.launcher != "local":
+        raise NotImplementedError(
+            "launcher %r: multi-host TPU jobs are started by the "
+            "cluster scheduler (see module docstring); use --launcher "
+            "local for single-host multi-process" % args.launcher)
+    codes = launch_local(args.num_workers, args.command,
+                         extra_env=args.env)
+    bad = [(i, c) for i, c in enumerate(codes) if c != 0]
+    for i, c in bad:
+        print("worker %d exited with %d" % (i, c), file=sys.stderr)
+    return 1 if bad else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

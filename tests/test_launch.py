@@ -1,0 +1,57 @@
+"""mxnet_tpu.tools.launch: local multi-process launcher (ref
+tools/launch.py:33). The worker uses NO launcher-specific code — the
+dist kvstore picks the DMLC_* contract out of the environment."""
+import os
+import subprocess
+import sys
+
+import pytest
+
+_WORKER = r'''
+import os
+import numpy as np
+import jax
+jax.config.update("jax_platforms", "cpu")
+import mxnet_tpu as mx
+
+kv = mx.kv.create("tpu_sync")      # joins the launcher's process group
+rank, nw = kv.rank, kv.num_workers
+assert nw == int(os.environ["DMLC_NUM_WORKER"]), (nw,)
+kv.init(0, mx.nd.ones((2, 2)))
+kv.push(0, mx.nd.ones((2, 2)) * (rank + 1))
+out = mx.nd.zeros((2, 2))
+kv.pull(0, out=out)
+want = sum(r + 1 for r in range(nw))
+assert np.allclose(out.asnumpy(), want), (out.asnumpy(), want)
+print("LAUNCHED_WORKER_OK", rank, flush=True)
+'''
+
+
+def test_launch_local_runs_dist_kvstore(tmp_path):
+    script = tmp_path / "worker.py"
+    script.write_text(_WORKER)
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+    env.pop("XLA_FLAGS", None)
+    env["JAX_NUM_CPU_DEVICES"] = "1"
+    cmd = [sys.executable, "-m", "mxnet_tpu.tools.launch", "-n", "2",
+           "--env", "JAX_PLATFORMS:cpu",
+           sys.executable, str(script)]
+    try:
+        out = subprocess.run(cmd, env=env, cwd=repo_root,
+                             capture_output=True, timeout=300)
+    except subprocess.TimeoutExpired:
+        pytest.skip("process group did not come up")
+    text = out.stdout.decode() + out.stderr.decode()
+    assert out.returncode == 0, text[-3000:]
+
+
+def test_launch_cli_validation():
+    from mxnet_tpu.tools import launch
+    with pytest.raises(NotImplementedError):
+        launch.main(["-n", "2", "--launcher", "ssh", "echo", "hi"])
+    assert launch.main(["-n", "1", sys.executable, "-c",
+                        "print('ok')"]) == 0
+    assert launch.main(["-n", "1", sys.executable, "-c",
+                        "import sys; sys.exit(3)"]) == 1
