@@ -371,14 +371,30 @@ class ImageDetIter(ImageIter):
         return body.reshape(-1, obj_width).copy()
 
     def _estimate_label_shape(self):
+        """Scan every label once to derive (max_objects, object_width),
+        like the reference (detection.py _estimate_label_shape) — no
+        hardcoded pad that could truncate crowded images or clip wide
+        object rows."""
         max_objs, width = 0, 5
         if self.imglist is not None:
             for _, raw in self.imglist:
                 lab = self._parse_label(raw)
                 max_objs = max(max_objs, lab.shape[0])
                 width = max(width, lab.shape[1])
-        else:
-            max_objs, width = 16, 5   # record path: conventional pad
+        elif getattr(self, "_rec", None) is not None:
+            from .. import recordio
+            if self._keys is not None:
+                recs = (self._rec.read_idx(k) for k in self._keys)
+            else:
+                self._rec.reset()
+                recs = iter(self._rec.read, None)
+            for rec in recs:
+                header, _ = recordio.unpack(rec)
+                lab = self._parse_label(header.label)
+                max_objs = max(max_objs, lab.shape[0])
+                width = max(width, lab.shape[1])
+            if self._keys is None:
+                self._rec.reset()
         return (max(max_objs, 1), width)
 
     @property
@@ -448,6 +464,11 @@ class ImageDetIter(ImageIter):
             if img.shape[:2] != (h, w):
                 img = _as_np(imresize(nd.array(img), w, h, 2))
             data[k] = np.transpose(img, (2, 0, 1))
+            if label.shape[1] > ow:
+                raise MXNetError(
+                    "object width %d exceeds label_shape width %d; call "
+                    "reshape(label_shape=...) or sync_label_shape first"
+                    % (label.shape[1], ow))
             m = min(label.shape[0], pw)
             labels[k, :m, :label.shape[1]] = label[:m]
         self._cursor += self.batch_size

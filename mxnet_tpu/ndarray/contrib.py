@@ -211,12 +211,22 @@ def dgl_adjacency(csr):
     return _mk_csr(outs[0], outs[1], outs[2], csr.shape, csr.context)
 
 
-def dgl_graph_compact(*csrs, return_mapping=False, graph_sizes=()):
+def dgl_graph_compact(*args, return_mapping=False, graph_sizes=()):
+    """``dgl_graph_compact(csr1, ..., csrN, vids1, ..., vidsN, ...)`` —
+    the reference calling convention (dgl_graph.cc SubgraphCompact):
+    each sampled subgraph CSR is paired with the neighbor-sample op's
+    vertex-id array, and every column id is renumbered through it."""
     from .. import ops as _ops
     op = _ops.get_op("_contrib_dgl_graph_compact")
+    if len(args) % 2:
+        raise ValueError("dgl_graph_compact takes N csr graphs followed "
+                         "by N vertex-id arrays")
+    n_g = len(args) // 2
+    csrs, vids = args[:n_g], args[n_g:]
     raw = []
     for c in csrs:
         raw.extend(_csr_pieces(c))
+    raw.extend(v._data for v in vids)
     outs, _ = _ops.invoke(op, raw, {"num_args": len(raw),
                                     "return_mapping": return_mapping,
                                     "graph_sizes": tuple(graph_sizes)})
@@ -226,6 +236,14 @@ def dgl_graph_compact(*csrs, return_mapping=False, graph_sizes=()):
             else c.shape[0]
         res.append(_mk_csr(outs[3 * g], outs[3 * g + 1],
                            outs[3 * g + 2], (size, size), c.context))
+    if return_mapping:
+        off = 3 * n_g
+        for g, c in enumerate(csrs):
+            size = int(graph_sizes[g]) if g < len(graph_sizes) \
+                else c.shape[0]
+            res.append(_mk_csr(outs[off + 3 * g], outs[off + 3 * g + 1],
+                               outs[off + 3 * g + 2], (size, size),
+                               c.context))
     return res if len(res) > 1 else res[0]
 
 

@@ -38,10 +38,11 @@ def _row(indptr, indices, eids, v):
 
 
 def _sample_subgraph(indptr, indices, eids, seeds, num_hops,
-                     num_neighbor, max_v, prob=None, seed=0):
+                     num_neighbor, max_v, prob=None, rng=None):
     """BFS neighbor sampling from ``seeds``; returns (verts, layer,
     sub_indptr, sub_cols, sub_eids[, vert_probs])."""
-    rng = np.random.RandomState(seed)
+    if rng is None:
+        rng = np.random
     seeds = np.unique(seeds[seeds >= 0].astype(np.int64))
     layer_of = {int(v): 0 for v in seeds[:max_v]}
     chosen = {}                    # vertex -> (cols, eids) kept edges
@@ -101,6 +102,17 @@ def _sample_subgraph(indptr, indices, eids, seeds, num_hops,
     return outs
 
 
+def _call_rngs(n):
+    """Per-call entropy: the reference seeds each sample call from
+    time(nullptr) (dgl_graph.cc:554) so successive mini-batch iterations
+    draw fresh neighborhoods. Here each call draws fresh sub-seeds from
+    numpy's GLOBAL RandomState — stochastic across calls, while
+    ``np.random.seed`` (the test-repro convention) still pins the whole
+    stream. One independent stream per seed-array."""
+    return [np.random.RandomState(np.random.randint(0, 2**31 - 1))
+            for _ in range(n)]
+
+
 def _uniform_sample(attrs, indptr, indices, eids, *seed_arrays):
     num_hops = int(attrs.get("num_hops", 1))
     num_neighbor = int(attrs.get("num_neighbor", 2))
@@ -108,10 +120,11 @@ def _uniform_sample(attrs, indptr, indices, eids, *seed_arrays):
     indptr, indices, eids = (_np_arr(indptr), _np_arr(indices),
                              _np_arr(eids))
     outs = []
+    rngs = _call_rngs(len(seed_arrays))
     for i, s in enumerate(seed_arrays):
         outs.extend(_sample_subgraph(indptr, indices, eids, _np_arr(s),
                                      num_hops, num_neighbor, max_v,
-                                     seed=i))
+                                     rng=rngs[i]))
     return tuple(outs)
 
 
@@ -123,10 +136,11 @@ def _non_uniform_sample(attrs, prob, indptr, indices, eids,
     indptr, indices, eids = (_np_arr(indptr), _np_arr(indices),
                              _np_arr(eids))
     outs = []
+    rngs = _call_rngs(len(seed_arrays))
     for i, s in enumerate(seed_arrays):
         outs.extend(_sample_subgraph(indptr, indices, eids, _np_arr(s),
                                      num_hops, num_neighbor, max_v,
-                                     prob=_np_arr(prob), seed=i))
+                                     prob=_np_arr(prob), rng=rngs[i]))
     return tuple(outs)
 
 
@@ -200,30 +214,68 @@ register("_contrib_dgl_adjacency", _adjacency,
          no_jit=True, num_outputs=3)
 
 
-def _graph_compact(attrs, *triples):
-    """Renumber each subgraph's vertex ids to remove gaps: row i of the
-    compacted CSR is the i-th row with any edge (up to graph_sizes[i])."""
+def _graph_compact(attrs, *args):
+    """Compact sampled subgraphs: renumber every column id from the
+    ORIGINAL graph's id space into the subgraph's 0..size-1 row space.
+
+    Input contract mirrors reference CompactSubgraph
+    (dgl_graph.cc:1444): num_g CSR graphs followed by num_g sampled
+    vertex-id arrays (the neighbor-sample ops' vertex output — length
+    indptr-1..., last slot = actual vertex count, -1 padding). In the
+    lowered convention that is 3*num_g CSR pieces then num_g vid
+    arrays. Per graph g the id map is ``vids[g][i] -> i`` for
+    i < graph_sizes[g]; output columns go through the map, output data
+    are fresh edge ids 0..nnz-1 (sub_eids[i]=i in the reference). With
+    ``return_mapping`` a parallel CSR per graph carries the ORIGINAL
+    edge ids so callers can map subgraph edges back to the parent."""
     mapping = bool(attrs.get("return_mapping", False))
     sizes = attrs.get("graph_sizes", ())
     if not isinstance(sizes, (list, tuple)):
         sizes = (sizes,)
-    n_g = len(triples) // 3
-    outs = []
+    n_g = len(args) // 4
+    if n_g * 4 != len(args):
+        raise ValueError(
+            "_contrib_dgl_graph_compact expects num_g CSR triples plus "
+            "num_g vertex-id arrays (got %d pieces)" % len(args))
+    outs, map_outs = [], []
     for g in range(n_g):
-        indptr, indices, eids = (_np_arr(triples[3 * g]),
-                                 _np_arr(triples[3 * g + 1]),
-                                 _np_arr(triples[3 * g + 2]))
-        size = int(sizes[g]) if g < len(sizes) else indptr.shape[0] - 1
+        indptr, indices, eids = (_np_arr(args[3 * g]),
+                                 _np_arr(args[3 * g + 1]),
+                                 _np_arr(args[3 * g + 2]))
+        vids = _np_arr(args[3 * n_g + g]).astype(np.int64)
+        size = int(sizes[g]) if g < len(sizes) else int(vids[-1])
+        row_ids = vids[:size]
+        if np.any(row_ids < 0):
+            raise ValueError(
+                "graph %d: sampled vertex array has -1 inside its "
+                "first graph_sizes=%d slots" % (g, size))
         sub_indptr = indptr[:size + 1].astype(np.int64)
         nnz = int(sub_indptr[-1])
-        outs.extend([sub_indptr, indices[:nnz].astype(np.int64),
-                     eids[:nnz].astype(np.int64)])
-    return tuple(outs)
+        old_cols = indices[:nnz].astype(np.int64)
+        # O(subgraph) remap via sorted search — never O(parent graph)
+        order = np.argsort(row_ids, kind="stable")
+        sorted_ids = row_ids[order]
+        slot = np.searchsorted(sorted_ids, old_cols)
+        slot_c = np.minimum(slot, size - 1 if size else 0)
+        bad = ((old_cols < 0) | (slot >= size)
+               | (sorted_ids[slot_c] != old_cols))
+        if np.any(bad):
+            raise ValueError(
+                "graph %d: %d column ids are not in the sampled vertex "
+                "set" % (g, int(bad.sum())))
+        new_cols = order[slot_c].astype(np.int64)
+        outs.extend([sub_indptr, new_cols,
+                     np.arange(nnz, dtype=np.int64)])
+        if mapping:
+            map_outs.extend([sub_indptr.copy(), new_cols.copy(),
+                             eids[:nnz].astype(np.int64)])
+    return tuple(outs + map_outs)
 
 
 register("_contrib_dgl_graph_compact", _graph_compact,
-         arg_names=("indptr", "indices", "eids"),
+         arg_names=("graph", "vids"),
          no_jit=True, key_var_num_args="num_args",
-         defaults={"num_args": 3, "return_mapping": False,
+         defaults={"num_args": 4, "return_mapping": False,
                    "graph_sizes": ()},
-         num_outputs=lambda attrs: int(attrs.get("num_args", 3)))
+         num_outputs=lambda attrs: (int(attrs.get("num_args", 4)) // 4)
+         * 3 * (2 if attrs.get("return_mapping") else 1))

@@ -81,9 +81,53 @@ def test_adjacency_and_compact():
     assert np.all(adj.data.asnumpy() == 1.0)
     assert adj.indices.asnumpy().tolist() == \
         g.indices.asnumpy().tolist()
-    comp = mx.nd.contrib.dgl_graph_compact(g, graph_sizes=(3,))
-    assert comp.shape == (3, 3)
-    assert comp.indptr.asnumpy().shape[0] == 4
+    # the normal pipeline: compact a neighbor-sample output whose
+    # vertex set is NOT 0..size-1, so columns must be renumbered
+    np.random.seed(7)
+    seeds = mx.nd.array(np.array([3], np.float32))
+    verts, subg, _ = mx.nd.contrib.dgl_csr_neighbor_uniform_sample(
+        g, seeds, num_hops=1, num_neighbor=2, max_num_vertices=4)
+    v = verts.asnumpy().astype(np.int64)
+    n = int(v[-1])
+    comp, mapping = mx.nd.contrib.dgl_graph_compact(
+        subg, verts, graph_sizes=(n,), return_mapping=True)
+    assert comp.shape == (n, n)
+    assert comp.indptr.asnumpy().shape[0] == n + 1
+    cols = comp.indices.asnumpy().astype(np.int64)
+    # columns are renumbered into the compacted 0..n-1 id space …
+    assert cols.shape[0] == 0 or cols.max() < n
+    # … through the vertex-id map: new id i ↔ old id v[i]
+    old_cols = subg.indices.asnumpy().astype(np.int64)[:cols.shape[0]]
+    assert np.all(v[:n][cols] == old_cols)
+    # compacted edge ids are fresh 0..nnz-1; mapping keeps originals
+    assert comp.data.asnumpy().astype(np.int64).tolist() == \
+        list(range(cols.shape[0]))
+    orig_eids = subg.data.asnumpy().astype(np.int64)[:cols.shape[0]]
+    assert mapping.data.asnumpy().astype(np.int64).tolist() == \
+        orig_eids.tolist()
+
+
+def test_neighbor_sample_stochastic_across_calls():
+    """Reference seeds from time(nullptr) (dgl_graph.cc:554): repeated
+    calls must be able to draw different neighborhoods. num_neighbor=1
+    over a degree-2 graph flips a coin per vertex; 32 calls landing
+    identical would be a 2^-31-scale fluke."""
+    g = _toy_graph()
+    seeds = mx.nd.array(np.array([0, 1, 2, 3, 4], np.float32))
+    draws = set()
+    for _ in range(32):
+        _, subg, _ = mx.nd.contrib.dgl_csr_neighbor_uniform_sample(
+            g, seeds, num_hops=1, num_neighbor=1, max_num_vertices=6)
+        draws.add(tuple(subg.indices.asnumpy().astype(np.int64)))
+    assert len(draws) > 1
+    # while np.random.seed still pins the stream end-to-end
+    outs = []
+    for _ in range(2):
+        np.random.seed(123)
+        _, subg, _ = mx.nd.contrib.dgl_csr_neighbor_uniform_sample(
+            g, seeds, num_hops=1, num_neighbor=1, max_num_vertices=6)
+        outs.append(tuple(subg.indices.asnumpy().astype(np.int64)))
+    assert outs[0] == outs[1]
 
 
 def test_scatter_scalar_ops():

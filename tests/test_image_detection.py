@@ -153,3 +153,38 @@ def test_image_det_iter_end_to_end(tmp_path):
     it2.label_shape = (5, 6)
     shape = it.sync_label_shape(it2)
     assert shape == (5, 6) and it.label_shape == (5, 6)
+
+
+def test_image_det_iter_record_label_shape(tmp_path):
+    """Record path derives (max_objects, object_width) from the records
+    themselves (ref detection.py _estimate_label_shape) — width-6 rows
+    and 17-object samples must survive, not be clipped to (16, 5)."""
+    import imageio.v2 as imageio
+    pytest.importorskip("PIL")
+    from mxnet_tpu import recordio
+    import io as _io_mod
+    rng = np.random.RandomState(5)
+    rec_path = str(tmp_path / "det.rec")
+    rec = recordio.MXRecordIO(rec_path, "w")
+    # sample 0: 17 objects, width 6 (extra difficulty column)
+    labels = [
+        [2.0, 6.0] + sum(([float(c % 3), 0.1, 0.1, 0.6, 0.7, 0.0]
+                          for c in range(17)), []),
+        [2.0, 6.0, 1.0, 0.2, 0.2, 0.8, 0.9, 1.0],
+    ]
+    for i, lab in enumerate(labels):
+        buf = _io_mod.BytesIO()
+        imageio.imwrite(buf, rng.randint(0, 255, (40, 60, 3), np.uint8),
+                        format="png")
+        rec.write(recordio.pack(
+            recordio.IRHeader(0, np.asarray(lab, np.float32), i, 0),
+            buf.getvalue()))
+    rec.close()
+    it = ImageDetIter(batch_size=2, data_shape=(3, 32, 32),
+                      path_imgrec=rec_path)
+    assert it.label_shape == (17, 6)
+    batch = it.next()
+    lab = batch.label[0].asnumpy()
+    assert lab.shape == (2, 17, 6)
+    assert int((lab[0, :, 0] >= 0).sum()) == 17   # nothing truncated
+    assert lab[1, 1, 0] == -1                     # second sample padded
