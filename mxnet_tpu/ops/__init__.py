@@ -21,6 +21,7 @@ from . import rnn_op        # noqa: F401
 from . import control_flow  # noqa: F401
 from . import quantization  # noqa: F401
 from . import detection     # noqa: F401
+from . import deformable    # noqa: F401
 from . import extra         # noqa: F401
 from . import attention     # noqa: F401
 from . import dgl           # noqa: F401

@@ -1,0 +1,566 @@
+"""Deformable/proposal detection op family + count_sketch + cast_storage.
+
+Parity targets (all `/root/reference` C++/CUDA, re-designed as jax/lax
+compositions that keep the heavy contractions on the MXU):
+
+- ``_contrib_DeformableConvolution``
+  (src/operator/contrib/deformable_convolution.cc:61): sampling offsets
+  per kernel tap + bilinear interpolation (zero outside), then a grouped
+  im2col x weight contraction — here an einsum XLA maps to the MXU.
+- ``_contrib_PSROIPooling`` (src/operator/contrib/psroi_pooling.cc:43):
+  position-sensitive average ROI pooling, computed via a 2D integral
+  image so every bin sum is four gathers instead of an H*W mask.
+- ``_contrib_DeformablePSROIPooling``
+  (src/operator/contrib/deformable_psroi_pooling.cu:71 — the CPU build
+  is NOT_IMPLEMENTED in the reference; semantics follow the CUDA
+  kernel): per-part learned offsets, sample_per_part^2 bilinear taps
+  per bin, mean over in-bounds taps.
+- ``_contrib_Proposal`` / ``_contrib_MultiProposal``
+  (src/operator/contrib/proposal.cc, multi_proposal.cc): RPN anchor
+  decode -> clip -> min-size filter -> top-k -> greedy NMS -> cyclic
+  pad, with the reference's exact +1 box conventions and anchor
+  enumeration order (index = h*(W*A) + w*A + a).
+- ``_contrib_count_sketch`` (src/operator/contrib/count_sketch.cc):
+  hashed feature projection, a scatter-add.
+- ``cast_storage`` (src/operator/tensor/cast_storage.cc): registered op
+  surface for storage casts. Inside a jit graph every array is dense,
+  so the compiled body is identity; the NDArray frontend
+  (``mx.nd.cast_storage``) performs the real dense<->csr/row_sparse
+  conversion via ``tostype``.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..base import MXNetError
+from .registry import register
+from .detection import _iou_corner  # noqa: F401  (shared box helper)
+
+__all__ = []
+
+
+def _pair(v, default):
+    if v is None or v == ():
+        return (default, default)
+    if isinstance(v, int):
+        return (v, v)
+    t = tuple(int(x) for x in v)
+    return t if len(t) == 2 else (t[0], t[0])
+
+
+# ---------------------------------------------------------------------------
+# DeformableConvolution
+# ---------------------------------------------------------------------------
+
+def _bilinear_zero(img, y, x):
+    """Bilinear sample ``img`` (C, H, W) at float coords y, x (...)
+    with ZERO outside the open range (-1, H) x (-1, W) — the
+    deformable_im2col boundary rule (deformable_im2col.cuh)."""
+    H, W = img.shape[-2:]
+    in_range = (y > -1.0) & (y < H) & (x > -1.0) & (x < W)
+    y0f = jnp.floor(y)
+    x0f = jnp.floor(x)
+    y0 = y0f.astype(jnp.int32)
+    x0 = x0f.astype(jnp.int32)
+    wy = y - y0f
+    wx = x - x0f
+    out = jnp.zeros(img.shape[:1] + y.shape, img.dtype)
+    for dy in (0, 1):
+        for dx in (0, 1):
+            yy = y0 + dy
+            xx = x0 + dx
+            valid = ((yy >= 0) & (yy < H) & (xx >= 0) & (xx < W)
+                     & in_range)
+            w = (wy if dy else 1.0 - wy) * (wx if dx else 1.0 - wx)
+            v = img[:, jnp.clip(yy, 0, H - 1), jnp.clip(xx, 0, W - 1)]
+            out = out + v * jnp.where(valid, w, 0.0)[None]
+    return out
+
+
+def _deformable_convolution(attrs, data, offset, weight, bias=None):
+    """data (B, C, H, W); offset (B, NDG*2*kh*kw, OH, OW) with per-tap
+    (dy, dx) pairs t-major inside each deformable group; weight
+    (F, C/G, kh, kw). Sampling + grouped MXU contraction."""
+    kernel = tuple(int(k) for k in attrs["kernel"])
+    if len(kernel) != 2:
+        raise MXNetError("DeformableConvolution supports 2D kernels "
+                         "(reference GPU impl is 2D-only)")
+    kh, kw = kernel
+    sh, sw = _pair(attrs.get("stride"), 1)
+    dh, dw = _pair(attrs.get("dilate"), 1)
+    ph, pw = _pair(attrs.get("pad"), 0)
+    G = int(attrs.get("num_group", 1))
+    NDG = int(attrs.get("num_deformable_group", 1))
+    B, C, H, W = data.shape
+    F = weight.shape[0]
+    OH = (H + 2 * ph - (dh * (kh - 1) + 1)) // sh + 1
+    OW = (W + 2 * pw - (dw * (kw - 1) + 1)) // sw + 1
+    taps = kh * kw
+
+    coord_dt = jnp.promote_types(offset.dtype, jnp.float32)
+    off = offset.reshape(B, NDG, taps, 2, OH, OW).astype(coord_dt)
+    tap_y = ((jnp.arange(taps) // kw) * dh).astype(coord_dt)
+    tap_x = ((jnp.arange(taps) % kw) * dw).astype(coord_dt)
+    base_y = (jnp.arange(OH) * sh - ph).astype(coord_dt)
+    base_x = (jnp.arange(OW) * sw - pw).astype(coord_dt)
+    # (taps, OH, 1/OW) broadcast against offset (B, NDG, taps, OH, OW)
+    y = tap_y[:, None, None] + base_y[None, :, None] + off[:, :, :, 0]
+    x = tap_x[:, None, None] + base_x[None, None, :] + off[:, :, :, 1]
+
+    dg = data.reshape(B, NDG, C // NDG, H, W)
+    samp = jax.vmap(jax.vmap(_bilinear_zero))(dg, y, x)
+    # (B, NDG, C/NDG, taps, OH, OW) -> grouped contraction
+    vals = samp.reshape(B, G, C // G, taps, OH, OW)
+    wg = weight.reshape(G, F // G, C // G, taps).astype(vals.dtype)
+    out = jnp.einsum("bgcthw,gfct->bgfhw", vals, wg)
+    out = out.reshape(B, F, OH, OW).astype(data.dtype)
+    if bias is not None and not bool(attrs.get("no_bias", False)):
+        out = out + bias.reshape(1, -1, 1, 1)
+    return out
+
+
+def _bias_args(names):
+    def fn(attrs):
+        return names[:-1] if attrs.get("no_bias", False) else names
+    return fn
+
+
+register("_contrib_DeformableConvolution", _deformable_convolution,
+         arg_names=("data", "offset", "weight", "bias"),
+         arg_names_fn=_bias_args(["data", "offset", "weight", "bias"]),
+         defaults={"kernel": (), "stride": (), "dilate": (), "pad": (),
+                   "num_filter": 0, "num_group": 1,
+                   "num_deformable_group": 1, "workspace": 1024,
+                   "no_bias": False, "layout": None},
+         attr_docs={"kernel": "(h, w) convolution window",
+                    "num_deformable_group": "offset group partitions"},
+         attr_ranges={"num_filter": (1, 100000), "num_group": (1, None),
+                      "num_deformable_group": (1, None)})
+
+
+# ---------------------------------------------------------------------------
+# PSROIPooling
+# ---------------------------------------------------------------------------
+
+def _psroi_channel_index(output_dim, pooled, group_size):
+    """Static (output_dim, pooled, pooled) channel map: bin (i, j) of
+    output channel ctop reads input channel (ctop*gs + gh)*gs + gw."""
+    ii, jj = np.meshgrid(np.arange(pooled), np.arange(pooled),
+                         indexing="ij")
+    gh = np.clip((ii * group_size) // pooled, 0, group_size - 1)
+    gw = np.clip((jj * group_size) // pooled, 0, group_size - 1)
+    return ((np.arange(output_dim)[:, None, None] * group_size
+             + gh[None]) * group_size + gw[None]).astype(np.int32)
+
+
+def _psroi_pooling(attrs, data, rois):
+    """data (B, output_dim*gs*gs, H, W); rois (R, 5); out
+    (R, output_dim, pooled, pooled). Average pooling over integer bins
+    via a 2D integral image (psroi_pooling.cc:43 semantics)."""
+    scale = float(attrs["spatial_scale"])
+    od = int(attrs["output_dim"])
+    pooled = int(attrs["pooled_size"])
+    gs = int(attrs.get("group_size", 0) or 0) or pooled
+    B, C, H, W = data.shape
+    c_idx = jnp.asarray(_psroi_channel_index(od, pooled, gs))
+
+    # accumulate in >= fp32 (never downcast: the x64 numeric-gradient
+    # sweep needs full precision through the integral image)
+    acc_dt = jnp.promote_types(data.dtype, jnp.float32)
+    S = jnp.cumsum(jnp.cumsum(data.astype(acc_dt), axis=2), axis=3)
+    S = jnp.pad(S, ((0, 0), (0, 0), (1, 0), (1, 0)))
+
+    def one_roi(roi):
+        b = roi[0].astype(jnp.int32)
+        x1 = jnp.round(roi[1]) * scale
+        y1 = jnp.round(roi[2]) * scale
+        x2 = (jnp.round(roi[3]) + 1.0) * scale
+        y2 = (jnp.round(roi[4]) + 1.0) * scale
+        rw = jnp.maximum(x2 - x1, 0.1)
+        rh = jnp.maximum(y2 - y1, 0.1)
+        bh = rh / pooled
+        bw = rw / pooled
+        i = jnp.arange(pooled, dtype=jnp.float32)
+        hs = jnp.clip(jnp.floor(i * bh + y1), 0, H).astype(jnp.int32)
+        he = jnp.clip(jnp.ceil((i + 1) * bh + y1), 0, H) \
+            .astype(jnp.int32)
+        ws = jnp.clip(jnp.floor(i * bw + x1), 0, W).astype(jnp.int32)
+        we = jnp.clip(jnp.ceil((i + 1) * bw + x1), 0, W) \
+            .astype(jnp.int32)
+        Sb = S[b]                                     # (C, H+1, W+1)
+        rect = (Sb[:, he[:, None], we[None, :]]
+                - Sb[:, hs[:, None], we[None, :]]
+                - Sb[:, he[:, None], ws[None, :]]
+                + Sb[:, hs[:, None], ws[None, :]])    # (C, p, p)
+        area = ((he - hs)[:, None] * (we - ws)[None, :]) \
+            .astype(jnp.float32)
+        vals = jnp.take_along_axis(rect, c_idx, axis=0)
+        return jnp.where(area > 0, vals / jnp.maximum(area, 1.0), 0.0) \
+            .astype(data.dtype)
+
+    return jax.vmap(one_roi)(rois)
+
+
+register("_contrib_PSROIPooling", _psroi_pooling,
+         arg_names=("data", "rois"),
+         defaults={"spatial_scale": 1.0, "output_dim": 0,
+                   "pooled_size": 0, "group_size": 0},
+         attr_ranges={"spatial_scale": (0.0, 1.0)})
+
+
+# ---------------------------------------------------------------------------
+# DeformablePSROIPooling
+# ---------------------------------------------------------------------------
+
+def _bilinear_clamp(img2d, y, x):
+    """Bilinear sample one-channel ``img2d`` (H, W) at coords already
+    clamped inside [0, H-1] x [0, W-1]."""
+    H, W = img2d.shape
+    y0f = jnp.floor(y)
+    x0f = jnp.floor(x)
+    y0 = y0f.astype(jnp.int32)
+    x0 = x0f.astype(jnp.int32)
+    y1 = jnp.minimum(y0 + 1, H - 1)
+    x1 = jnp.minimum(x0 + 1, W - 1)
+    wy = y - y0f
+    wx = x - x0f
+    return (img2d[y0, x0] * (1 - wy) * (1 - wx)
+            + img2d[y0, x1] * (1 - wy) * wx
+            + img2d[y1, x0] * wy * (1 - wx)
+            + img2d[y1, x1] * wy * wx)
+
+
+def _deformable_psroi_pooling(attrs, data, rois, trans=None):
+    """deformable_psroi_pooling.cu:71 semantics. data
+    (B, od*gs*gs, H, W); rois (R, 5); trans (R, num_classes*2, part,
+    part) channel-ordered [x, y] per class. out (R, od, pooled,
+    pooled)."""
+    scale = float(attrs["spatial_scale"])
+    od = int(attrs["output_dim"])
+    gs = int(attrs["group_size"])
+    pooled = int(attrs["pooled_size"])
+    part = int(attrs.get("part_size", 0) or 0) or pooled
+    ns = int(attrs.get("sample_per_part", 1))
+    tstd = float(attrs.get("trans_std", 0.0))
+    no_trans = bool(attrs.get("no_trans", False)) or trans is None
+    B, C, H, W = data.shape
+    num_classes = 1 if no_trans else trans.shape[1] // 2
+    cec = max(od // num_classes, 1)        # channels_each_class
+
+    c_idx = jnp.asarray(_psroi_channel_index(od, pooled, gs))
+    class_id = jnp.asarray(
+        (np.arange(od) // cec).astype(np.int32))
+    ii, jj = np.meshgrid(np.arange(pooled), np.arange(pooled),
+                         indexing="ij")
+    part_h = jnp.asarray((ii * part // pooled).astype(np.int32))
+    part_w = jnp.asarray((jj * part // pooled).astype(np.int32))
+
+    def one_roi(roi, tr):
+        b = roi[0].astype(jnp.int32)
+        x1 = jnp.round(roi[1]) * scale - 0.5
+        y1 = jnp.round(roi[2]) * scale - 0.5
+        x2 = (jnp.round(roi[3]) + 1.0) * scale - 0.5
+        y2 = (jnp.round(roi[4]) + 1.0) * scale - 0.5
+        rw = jnp.maximum(x2 - x1, 0.1)
+        rh = jnp.maximum(y2 - y1, 0.1)
+        bh = rh / pooled
+        bw = rw / pooled
+        sub_h = bh / ns
+        sub_w = bw / ns
+        if no_trans:
+            trans_x = jnp.zeros((num_classes, pooled, pooled))
+            trans_y = jnp.zeros((num_classes, pooled, pooled))
+        else:
+            t = tr.reshape(num_classes, 2, part, part)
+            trans_x = t[:, 0][:, part_h, part_w] * tstd
+            trans_y = t[:, 1][:, part_h, part_w] * tstd
+        i = jnp.arange(pooled, dtype=jnp.float32)
+        # (num_classes, pooled_i, pooled_j) bin starts incl. offsets
+        hstart = (i * bh + y1)[None, :, None] + trans_y * rh
+        wstart = (i * bw + x1)[None, None, :] + trans_x * rw
+        si = jnp.arange(ns, dtype=jnp.float32)
+        hh = hstart[..., None, None] + (si * sub_h)[:, None]
+        ww = wstart[..., None, None] + (si * sub_w)[None, :]
+        hh = jnp.broadcast_to(
+            hh, (num_classes, pooled, pooled, ns, ns))
+        ww = jnp.broadcast_to(
+            ww, (num_classes, pooled, pooled, ns, ns))
+        valid = ((ww >= -0.5) & (ww <= W - 0.5)
+                 & (hh >= -0.5) & (hh <= H - 0.5))
+        hc = jnp.clip(hh, 0.0, H - 1.0)
+        wc = jnp.clip(ww, 0.0, W - 1.0)
+        feat = data[b].astype(
+            jnp.promote_types(data.dtype, jnp.float32))  # (C, H, W)
+
+        def per_ctop(ct):
+            ch = c_idx[ct]                        # (pooled, pooled)
+            cl = class_id[ct]
+            y_s = hc[cl]
+            x_s = wc[cl]                          # (p, p, ns, ns)
+            v = jax.vmap(jax.vmap(lambda c_, ys, xs: _bilinear_clamp(
+                feat[c_], ys, xs)))(ch, y_s, x_s)
+            ok = valid[cl]
+            cnt = ok.sum(axis=(-1, -2))
+            s = jnp.where(ok, v, 0.0).sum(axis=(-1, -2))
+            return jnp.where(cnt > 0, s / jnp.maximum(cnt, 1), 0.0)
+
+        out = jax.vmap(per_ctop)(jnp.arange(od))
+        return out.astype(data.dtype)
+
+    if no_trans:
+        tr_dummy = jnp.zeros((rois.shape[0], 2, part, part), data.dtype)
+        return jax.vmap(one_roi)(rois, tr_dummy)
+    return jax.vmap(one_roi)(rois, trans)
+
+
+def _trans_args(names):
+    def fn(attrs):
+        return names[:-1] if attrs.get("no_trans", False) else names
+    return fn
+
+
+register("_contrib_DeformablePSROIPooling", _deformable_psroi_pooling,
+         arg_names=("data", "rois", "trans"),
+         arg_names_fn=_trans_args(["data", "rois", "trans"]),
+         defaults={"spatial_scale": 1.0, "output_dim": 0,
+                   "group_size": 0, "pooled_size": 0, "part_size": 0,
+                   "sample_per_part": 1, "trans_std": 0.0,
+                   "no_trans": False},
+         attr_ranges={"spatial_scale": (0.0, 1.0),
+                      "trans_std": (0.0, 1.0)})
+
+
+# ---------------------------------------------------------------------------
+# Proposal / MultiProposal
+# ---------------------------------------------------------------------------
+
+def _generate_anchors(stride, scales, ratios):
+    """proposal-inl.h:214 GenerateAnchors — ratio-major, the
+    reference's exact floor/round arithmetic."""
+    base = np.array([0, 0, stride - 1, stride - 1], np.float32)
+    w = base[2] - base[0] + 1.0
+    h = base[3] - base[1] + 1.0
+    x_ctr = base[0] + 0.5 * (w - 1.0)
+    y_ctr = base[1] + 0.5 * (h - 1.0)
+    size = w * h
+    out = []
+    for r in ratios:
+        size_r = np.floor(size / r)
+        new_w = np.floor(np.sqrt(size_r) + 0.5)
+        new_h = np.floor((new_w * r) + 0.5)
+        for s in scales:
+            ws, hs = new_w * s, new_h * s
+            out.append([x_ctr - 0.5 * (ws - 1.0),
+                        y_ctr - 0.5 * (hs - 1.0),
+                        x_ctr + 0.5 * (ws - 1.0),
+                        y_ctr + 0.5 * (hs - 1.0)])
+    return np.asarray(out, np.float32)
+
+
+def _greedy_nms_keep(boxes, thresh):
+    """Keep-flags of the reference's sorted greedy NMS over
+    already-score-ordered corner boxes, +1 area convention."""
+    n = boxes.shape[0]
+    x1, y1, x2, y2 = (boxes[:, 0], boxes[:, 1], boxes[:, 2],
+                      boxes[:, 3])
+    area = (x2 - x1 + 1.0) * (y2 - y1 + 1.0)
+    ix1 = jnp.maximum(x1[:, None], x1[None, :])
+    iy1 = jnp.maximum(y1[:, None], y1[None, :])
+    ix2 = jnp.minimum(x2[:, None], x2[None, :])
+    iy2 = jnp.minimum(y2[:, None], y2[None, :])
+    iw = jnp.maximum(ix2 - ix1 + 1.0, 0.0)
+    ih = jnp.maximum(iy2 - iy1 + 1.0, 0.0)
+    inter = iw * ih
+    iou = inter / (area[:, None] + area[None, :] - inter)
+    above = jnp.triu(iou > thresh, k=1)          # [i, j], i < j
+
+    def body(keep, i):
+        sup = jnp.any(above[:, i] & keep & (jnp.arange(n) < i))
+        return keep.at[i].set(~sup), None
+
+    keep, _ = jax.lax.scan(body, jnp.ones((n,), bool), jnp.arange(n))
+    return keep
+
+
+def _proposal_one_image(fg_scores, deltas, im_info, anchors, attrs):
+    """One image of the RPN proposal pipeline (proposal.cc Forward).
+    fg_scores (A, Hf, Wf); deltas (4A, Hf, Wf); im_info (3,) =
+    (height, width, scale). Returns (rois (post_n, 4), scores
+    (post_n,))."""
+    stride = int(attrs["feature_stride"])
+    iou_loss = bool(attrs.get("iou_loss", False))
+    A, Hf, Wf = fg_scores.shape
+    count = A * Hf * Wf
+    pre_n = int(attrs["rpn_pre_nms_top_n"])
+    pre_n = min(pre_n, count) if pre_n > 0 else count
+    post_n = min(int(attrs["rpn_post_nms_top_n"]), pre_n)
+
+    im_h, im_w, im_scale = im_info[0], im_info[1], im_info[2]
+    sx = (jnp.arange(Wf) * stride).astype(jnp.float32)
+    sy = (jnp.arange(Hf) * stride).astype(jnp.float32)
+    shifts = jnp.stack([
+        jnp.broadcast_to(sx[None, :], (Hf, Wf)),
+        jnp.broadcast_to(sy[:, None], (Hf, Wf)),
+        jnp.broadcast_to(sx[None, :], (Hf, Wf)),
+        jnp.broadcast_to(sy[:, None], (Hf, Wf))], axis=-1)
+    boxes = anchors[None, None] + shifts[:, :, None]   # (Hf, Wf, A, 4)
+    d = deltas.reshape(A, 4, Hf, Wf).transpose(2, 3, 0, 1)
+
+    if iou_loss:
+        pred = boxes + d
+    else:
+        bw = boxes[..., 2] - boxes[..., 0] + 1.0
+        bh = boxes[..., 3] - boxes[..., 1] + 1.0
+        cx = boxes[..., 0] + 0.5 * (bw - 1.0)
+        cy = boxes[..., 1] + 0.5 * (bh - 1.0)
+        pcx = d[..., 0] * bw + cx
+        pcy = d[..., 1] * bh + cy
+        pw_ = jnp.exp(d[..., 2]) * bw
+        ph_ = jnp.exp(d[..., 3]) * bh
+        pred = jnp.stack([pcx - 0.5 * (pw_ - 1.0),
+                          pcy - 0.5 * (ph_ - 1.0),
+                          pcx + 0.5 * (pw_ - 1.0),
+                          pcy + 0.5 * (ph_ - 1.0)], axis=-1)
+    lim = jnp.stack([im_w - 1.0, im_h - 1.0, im_w - 1.0, im_h - 1.0])
+    pred = jnp.clip(pred, 0.0, lim)
+
+    scores = fg_scores.transpose(1, 2, 0)              # (Hf, Wf, A)
+    # prevent padded feature-map predictions (proposal.cc:82)
+    real_h = (im_h / stride).astype(jnp.int32)
+    real_w = (im_w / stride).astype(jnp.int32)
+    pad_mask = ((jnp.arange(Hf)[:, None, None] >= real_h)
+                | (jnp.arange(Wf)[None, :, None] >= real_w))
+    scores = jnp.where(pad_mask, -1.0, scores)
+    # FilterBox (proposal.cc:145): sub-min boxes expand and drop
+    min_size = float(attrs["rpn_min_size"]) * im_scale
+    bw_ = pred[..., 2] - pred[..., 0] + 1.0
+    bh_ = pred[..., 3] - pred[..., 1] + 1.0
+    small = (bw_ < min_size) | (bh_ < min_size)
+    half = min_size / 2.0
+    grow = jnp.stack([-half, -half, half, half])
+    pred = jnp.where(small[..., None], pred + grow, pred)
+    scores = jnp.where(small, -1.0, scores)
+
+    flat_scores = scores.reshape(-1)      # index h*(Wf*A) + w*A + a
+    flat_boxes = pred.reshape(-1, 4)
+    top_sc, order = jax.lax.top_k(flat_scores, pre_n)
+    props = flat_boxes[order]
+    keep = _greedy_nms_keep(props, float(attrs["threshold"]))
+    out_size = keep.sum()
+    rank = jnp.where(keep, jnp.arange(pre_n),
+                     pre_n + jnp.arange(pre_n))
+    kept_first = jnp.argsort(rank)
+    idx = kept_first[jnp.mod(jnp.arange(post_n),
+                             jnp.maximum(out_size, 1))]
+    return props[idx], top_sc[idx]
+
+
+def _proposal(attrs, cls_prob, bbox_pred, im_info):
+    """cls_prob (1, 2A, Hf, Wf) — batch 1, like the reference op
+    (MultiProposal is the batched form)."""
+    A2 = cls_prob.shape[1]
+    anchors = jnp.asarray(_generate_anchors(
+        int(attrs["feature_stride"]),
+        [float(s) for s in attrs["scales"]],
+        [float(r) for r in attrs["ratios"]]))
+    fg = cls_prob[0, A2 // 2:]
+    rois, sc = _proposal_one_image(fg, bbox_pred[0], im_info[0],
+                                   anchors, attrs)
+    post_n = rois.shape[0]
+    out = jnp.concatenate(
+        [jnp.zeros((post_n, 1), rois.dtype), rois], axis=1)
+    if bool(attrs.get("output_score", False)):
+        return out, sc[:, None]
+    return out
+
+
+def _multi_proposal(attrs, cls_prob, bbox_pred, im_info):
+    """Batched proposal (multi_proposal.cc): output
+    (B*post_n, 5) with the image index in column 0."""
+    B, A2 = cls_prob.shape[:2]
+    anchors = jnp.asarray(_generate_anchors(
+        int(attrs["feature_stride"]),
+        [float(s) for s in attrs["scales"]],
+        [float(r) for r in attrs["ratios"]]))
+
+    def per_image(fg, d, info):
+        return _proposal_one_image(fg, d, info, anchors, attrs)
+
+    rois, sc = jax.vmap(per_image)(cls_prob[:, A2 // 2:], bbox_pred,
+                                   im_info)
+    post_n = rois.shape[1]
+    bidx = jnp.broadcast_to(
+        jnp.arange(B, dtype=rois.dtype)[:, None, None], (B, post_n, 1))
+    out = jnp.concatenate([bidx, rois], axis=2).reshape(B * post_n, 5)
+    if bool(attrs.get("output_score", False)):
+        return out, sc.reshape(B * post_n, 1)
+    return out
+
+
+_PROPOSAL_DEFAULTS = {
+    "rpn_pre_nms_top_n": 6000, "rpn_post_nms_top_n": 300,
+    "threshold": 0.7, "rpn_min_size": 16,
+    "scales": (4.0, 8.0, 16.0, 32.0), "ratios": (0.5, 1.0, 2.0),
+    "feature_stride": 16, "output_score": False, "iou_loss": False,
+}
+
+register("_contrib_Proposal", _proposal,
+         arg_names=("cls_prob", "bbox_pred", "im_info"),
+         defaults=dict(_PROPOSAL_DEFAULTS),
+         num_outputs=lambda attrs: 2 if attrs.get("output_score") else 1,
+         aliases=("Proposal",))
+
+register("_contrib_MultiProposal", _multi_proposal,
+         arg_names=("cls_prob", "bbox_pred", "im_info"),
+         defaults=dict(_PROPOSAL_DEFAULTS),
+         num_outputs=lambda attrs: 2 if attrs.get("output_score") else 1,
+         aliases=("MultiProposal",))
+
+
+# ---------------------------------------------------------------------------
+# count_sketch
+# ---------------------------------------------------------------------------
+
+def _count_sketch(attrs, data, h, s):
+    """out[..., h[j]] += s[j] * data[..., j] (count_sketch-inl.h:66).
+    h holds hash buckets in [0, out_dim); s holds +-1 signs."""
+    out_dim = int(attrs["out_dim"])
+    lead = data.shape[:-1]
+    in_dim = data.shape[-1]
+    d2 = data.reshape(-1, in_dim)
+    hv = h.reshape(-1).astype(jnp.int32)
+    sv = s.reshape(-1).astype(d2.dtype)
+    out = jnp.zeros((d2.shape[0], out_dim), d2.dtype)
+    out = out.at[:, hv].add(d2 * sv[None, :])
+    return out.reshape(lead + (out_dim,))
+
+
+register("_contrib_count_sketch", _count_sketch,
+         arg_names=("data", "h", "s"),
+         defaults={"out_dim": 0, "processing_batch_size": 32},
+         attr_ranges={"out_dim": (1, None)})
+
+
+# ---------------------------------------------------------------------------
+# cast_storage
+# ---------------------------------------------------------------------------
+
+def _cast_storage(attrs, data):
+    """Registered-op surface of cast_storage.cc. Dense jit graphs carry
+    every array dense, so the compiled body is the identity on values;
+    the stype attr is honored at the NDArray layer
+    (``mx.nd.cast_storage`` -> ``tostype``), where sparse containers
+    exist."""
+    stype = attrs.get("stype", "default")
+    if stype not in ("default", "row_sparse", "csr"):
+        raise MXNetError("cast_storage: unknown stype %r" % (stype,))
+    return data
+
+
+register("cast_storage", _cast_storage, arg_names=("data",),
+         defaults={"stype": "default"},
+         attr_docs={"stype": "target storage type: default | "
+                             "row_sparse | csr"})

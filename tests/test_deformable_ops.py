@@ -1,0 +1,466 @@
+"""Deformable/proposal op family + count_sketch + cast_storage
+(ref src/operator/contrib/{deformable_convolution, psroi_pooling,
+deformable_psroi_pooling, proposal, multi_proposal, count_sketch}.cc,
+src/operator/tensor/cast_storage.cc). Each op is pinned against a
+direct numpy oracle mirroring the reference kernel."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+RNG = np.random.RandomState(11)
+
+
+# ---------------------------------------------------------------------------
+# numpy oracles
+# ---------------------------------------------------------------------------
+
+def _np_bilinear_zero(img, y, x):
+    """img (C, H, W); scalar y, x; zero outside (-1, H) x (-1, W)."""
+    C, H, W = img.shape
+    if y <= -1 or y >= H or x <= -1 or x >= W:
+        return np.zeros(C)
+    y0, x0 = int(np.floor(y)), int(np.floor(x))
+    out = np.zeros(C)
+    for dy in (0, 1):
+        for dx in (0, 1):
+            yy, xx = y0 + dy, x0 + dx
+            if 0 <= yy < H and 0 <= xx < W:
+                w = ((y - y0 if dy else 1 - (y - y0))
+                     * (x - x0 if dx else 1 - (x - x0)))
+                out += img[:, yy, xx] * w
+    return out
+
+
+def _np_deform_conv(data, offset, weight, bias, stride, pad, dilate,
+                    num_group, ndg):
+    B, C, H, W = data.shape
+    F, _, kh, kw = weight.shape
+    sh, sw = stride
+    ph, pw = pad
+    dh, dw = dilate
+    OH = (H + 2 * ph - (dh * (kh - 1) + 1)) // sh + 1
+    OW = (W + 2 * pw - (dw * (kw - 1) + 1)) // sw + 1
+    out = np.zeros((B, F, OH, OW))
+    cpg = C // num_group
+    fpg = F // num_group
+    cpd = C // ndg
+    for b in range(B):
+        off = offset[b].reshape(ndg, kh * kw, 2, OH, OW)
+        for f in range(F):
+            g = f // fpg
+            for oh in range(OH):
+                for ow in range(OW):
+                    acc = 0.0
+                    for t in range(kh * kw):
+                        i, j = t // kw, t % kw
+                        for cg in range(cpg):
+                            c = g * cpg + cg
+                            dg = c // cpd
+                            y = (oh * sh - ph + i * dh
+                                 + off[dg, t, 0, oh, ow])
+                            x = (ow * sw - pw + j * dw
+                                 + off[dg, t, 1, oh, ow])
+                            v = _np_bilinear_zero(
+                                data[b, c:c + 1], y, x)[0]
+                            acc += v * weight[f, cg, i, j]
+                    out[b, f, oh, ow] = acc + (
+                        bias[f] if bias is not None else 0.0)
+    return out
+
+
+def _np_psroi_pool(data, rois, scale, od, pooled, gs):
+    B, C, H, W = data.shape
+    R = rois.shape[0]
+    out = np.zeros((R, od, pooled, pooled))
+    for n in range(R):
+        b = int(rois[n, 0])
+        x1 = round(rois[n, 1]) * scale
+        y1 = round(rois[n, 2]) * scale
+        x2 = (round(rois[n, 3]) + 1.0) * scale
+        y2 = (round(rois[n, 4]) + 1.0) * scale
+        rw = max(x2 - x1, 0.1)
+        rh = max(y2 - y1, 0.1)
+        bh, bw = rh / pooled, rw / pooled
+        for ct in range(od):
+            for i in range(pooled):
+                for j in range(pooled):
+                    hs = min(max(int(np.floor(i * bh + y1)), 0), H)
+                    he = min(max(int(np.ceil((i + 1) * bh + y1)), 0), H)
+                    ws = min(max(int(np.floor(j * bw + x1)), 0), W)
+                    we = min(max(int(np.ceil((j + 1) * bw + x1)), 0), W)
+                    gh = min(max(i * gs // pooled, 0), gs - 1)
+                    gw = min(max(j * gs // pooled, 0), gs - 1)
+                    c = (ct * gs + gh) * gs + gw
+                    if he <= hs or we <= ws:
+                        continue
+                    out[n, ct, i, j] = data[b, c, hs:he, ws:we].mean()
+    return out
+
+
+def _np_bilinear_clamp(img2d, y, x):
+    H, W = img2d.shape
+    y0, x0 = int(np.floor(y)), int(np.floor(x))
+    y1, x1 = min(y0 + 1, H - 1), min(x0 + 1, W - 1)
+    wy, wx = y - y0, x - x0
+    return (img2d[y0, x0] * (1 - wy) * (1 - wx)
+            + img2d[y0, x1] * (1 - wy) * wx
+            + img2d[y1, x0] * wy * (1 - wx)
+            + img2d[y1, x1] * wy * wx)
+
+
+def _np_deform_psroi(data, rois, trans, scale, od, gs, pooled, part,
+                     ns, tstd, no_trans):
+    B, C, H, W = data.shape
+    R = rois.shape[0]
+    ncls = 1 if no_trans else trans.shape[1] // 2
+    cec = max(od // ncls, 1)
+    out = np.zeros((R, od, pooled, pooled))
+    for n in range(R):
+        b = int(rois[n, 0])
+        x1 = round(rois[n, 1]) * scale - 0.5
+        y1 = round(rois[n, 2]) * scale - 0.5
+        x2 = (round(rois[n, 3]) + 1.0) * scale - 0.5
+        y2 = (round(rois[n, 4]) + 1.0) * scale - 0.5
+        rw = max(x2 - x1, 0.1)
+        rh = max(y2 - y1, 0.1)
+        bh, bw = rh / pooled, rw / pooled
+        sub_h, sub_w = bh / ns, bw / ns
+        for ct in range(od):
+            cls = ct // cec
+            for i in range(pooled):
+                for j in range(pooled):
+                    p_h = i * part // pooled
+                    p_w = j * part // pooled
+                    tx = 0.0 if no_trans else \
+                        trans[n].reshape(ncls, 2, part, part)[
+                            cls, 0, p_h, p_w] * tstd
+                    ty = 0.0 if no_trans else \
+                        trans[n].reshape(ncls, 2, part, part)[
+                            cls, 1, p_h, p_w] * tstd
+                    wstart = j * bw + x1 + tx * rw
+                    hstart = i * bh + y1 + ty * rh
+                    gh = min(max(i * gs // pooled, 0), gs - 1)
+                    gw = min(max(j * gs // pooled, 0), gs - 1)
+                    c = (ct * gs + gh) * gs + gw
+                    s, cnt = 0.0, 0
+                    for ih in range(ns):
+                        for iw in range(ns):
+                            w = wstart + iw * sub_w
+                            h = hstart + ih * sub_h
+                            if w < -0.5 or w > W - 0.5 \
+                                    or h < -0.5 or h > H - 0.5:
+                                continue
+                            w = min(max(w, 0.0), W - 1.0)
+                            h = min(max(h, 0.0), H - 1.0)
+                            s += _np_bilinear_clamp(data[b, c], h, w)
+                            cnt += 1
+                    out[n, ct, i, j] = 0.0 if cnt == 0 else s / cnt
+    return out
+
+
+def _np_proposal(fg, deltas, im_info, stride, scales, ratios, pre_n,
+                 post_n, thresh, min_size, iou_loss=False):
+    """proposal.cc pipeline, one image."""
+    from mxnet_tpu.ops.deformable import _generate_anchors
+    anchors = _generate_anchors(stride, scales, ratios)
+    A, Hf, Wf = fg.shape
+    im_h, im_w, im_scale = im_info
+    props = np.zeros((Hf * Wf * A, 5))
+    for h in range(Hf):
+        for w in range(Wf):
+            for a in range(A):
+                idx = h * (Wf * A) + w * A + a
+                box = anchors[a] + np.array(
+                    [w * stride, h * stride, w * stride, h * stride])
+                d = deltas[a * 4:a * 4 + 4, h, w]
+                if iou_loss:
+                    pred = box + d
+                else:
+                    bw = box[2] - box[0] + 1.0
+                    bh = box[3] - box[1] + 1.0
+                    cx = box[0] + 0.5 * (bw - 1.0)
+                    cy = box[1] + 0.5 * (bh - 1.0)
+                    pcx, pcy = d[0] * bw + cx, d[1] * bh + cy
+                    pw_, ph_ = np.exp(d[2]) * bw, np.exp(d[3]) * bh
+                    pred = np.array([pcx - 0.5 * (pw_ - 1.0),
+                                     pcy - 0.5 * (ph_ - 1.0),
+                                     pcx + 0.5 * (pw_ - 1.0),
+                                     pcy + 0.5 * (ph_ - 1.0)])
+                pred[0::2] = np.clip(pred[0::2], 0, im_w - 1.0)
+                pred[1::2] = np.clip(pred[1::2], 0, im_h - 1.0)
+                sc = fg[a, h, w]
+                if h >= int(im_h / stride) or w >= int(im_w / stride):
+                    sc = -1.0
+                props[idx, :4] = pred
+                props[idx, 4] = sc
+    ms = min_size * im_scale
+    for i in range(props.shape[0]):
+        iw = props[i, 2] - props[i, 0] + 1.0
+        ih = props[i, 3] - props[i, 1] + 1.0
+        if iw < ms or ih < ms:
+            props[i, 0] -= ms / 2
+            props[i, 1] -= ms / 2
+            props[i, 2] += ms / 2
+            props[i, 3] += ms / 2
+            props[i, 4] = -1.0
+    pre_n = min(pre_n, props.shape[0]) if pre_n > 0 else props.shape[0]
+    order = np.argsort(-props[:, 4], kind="stable")[:pre_n]
+    props = props[order]
+    # greedy NMS, +1 convention
+    keep = []
+    suppressed = np.zeros(pre_n, bool)
+    for i in range(pre_n):
+        if suppressed[i]:
+            continue
+        keep.append(i)
+        for j in range(i + 1, pre_n):
+            if suppressed[j]:
+                continue
+            xx1 = max(props[i, 0], props[j, 0])
+            yy1 = max(props[i, 1], props[j, 1])
+            xx2 = min(props[i, 2], props[j, 2])
+            yy2 = min(props[i, 3], props[j, 3])
+            w = max(xx2 - xx1 + 1.0, 0.0)
+            h = max(yy2 - yy1 + 1.0, 0.0)
+            inter = w * h
+            ai = (props[i, 2] - props[i, 0] + 1.0) \
+                * (props[i, 3] - props[i, 1] + 1.0)
+            aj = (props[j, 2] - props[j, 0] + 1.0) \
+                * (props[j, 3] - props[j, 1] + 1.0)
+            if inter / (ai + aj - inter) > thresh:
+                suppressed[j] = True
+    post_n = min(post_n, pre_n)
+    rois = np.zeros((post_n, 4))
+    scores = np.zeros((post_n,))
+    for i in range(post_n):
+        src = keep[i] if i < len(keep) else keep[i % len(keep)]
+        rois[i] = props[src, :4]
+        scores[i] = props[src, 4]
+    return rois, scores
+
+
+# ---------------------------------------------------------------------------
+# DeformableConvolution
+# ---------------------------------------------------------------------------
+
+def test_deformable_conv_zero_offset_equals_conv():
+    data = RNG.randn(2, 4, 6, 6).astype(np.float32)
+    weight = RNG.randn(3, 4, 3, 3).astype(np.float32)
+    bias = RNG.randn(3).astype(np.float32)
+    off = np.zeros((2, 18, 6, 6), np.float32)
+    out = mx.nd.contrib.DeformableConvolution(
+        mx.nd.array(data), mx.nd.array(off), mx.nd.array(weight),
+        mx.nd.array(bias), kernel=(3, 3), num_filter=3, pad=(1, 1))
+    ref = mx.nd.Convolution(
+        mx.nd.array(data), mx.nd.array(weight), mx.nd.array(bias),
+        kernel=(3, 3), num_filter=3, pad=(1, 1))
+    np.testing.assert_allclose(out.asnumpy(), ref.asnumpy(),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_deformable_conv_matches_numpy_oracle():
+    data = RNG.randn(1, 4, 5, 5).astype(np.float64)
+    weight = RNG.randn(4, 2, 3, 3).astype(np.float64)  # num_group=2
+    bias = RNG.randn(4).astype(np.float64)
+    off = (RNG.rand(1, 2 * 2 * 9, 3, 3) * 1.5 - 0.75)  # ndg=2
+    out = mx.nd.contrib.DeformableConvolution(
+        mx.nd.array(data), mx.nd.array(off), mx.nd.array(weight),
+        mx.nd.array(bias), kernel=(3, 3), num_filter=4, num_group=2,
+        num_deformable_group=2, stride=(2, 2), pad=(1, 1))
+    ref = _np_deform_conv(data, off, weight, bias, (2, 2), (1, 1),
+                          (1, 1), 2, 2)
+    np.testing.assert_allclose(out.asnumpy(), ref, rtol=1e-4,
+                               atol=1e-4)
+
+
+# ---------------------------------------------------------------------------
+# PSROIPooling
+# ---------------------------------------------------------------------------
+
+def test_psroi_pooling_matches_numpy_oracle():
+    od, gs, pooled = 3, 2, 2
+    data = RNG.randn(2, od * gs * gs, 8, 8).astype(np.float64)
+    rois = np.array([[0., 0., 0., 6., 6.],
+                     [1., 1.2, 2.1, 6.8, 7.4],
+                     [0., 3., 3., 3., 3.]])
+    out = mx.nd.contrib.PSROIPooling(
+        mx.nd.array(data), mx.nd.array(rois), spatial_scale=1.0,
+        output_dim=od, pooled_size=pooled, group_size=gs)
+    ref = _np_psroi_pool(data, rois, 1.0, od, pooled, gs)
+    np.testing.assert_allclose(out.asnumpy(), ref, rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_psroi_pooling_spatial_scale():
+    od, gs, pooled = 2, 2, 2
+    data = RNG.randn(1, od * gs * gs, 10, 10).astype(np.float64)
+    rois = np.array([[0., 0., 0., 16., 12.]])
+    out = mx.nd.contrib.PSROIPooling(
+        mx.nd.array(data), mx.nd.array(rois), spatial_scale=0.5,
+        output_dim=od, pooled_size=pooled, group_size=gs)
+    ref = _np_psroi_pool(data, rois, 0.5, od, pooled, gs)
+    np.testing.assert_allclose(out.asnumpy(), ref, rtol=1e-4,
+                               atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# DeformablePSROIPooling
+# ---------------------------------------------------------------------------
+
+def test_deformable_psroi_no_trans_matches_oracle():
+    od, gs, pooled, ns = 2, 2, 2, 2
+    data = RNG.randn(1, od * gs * gs, 8, 8).astype(np.float64)
+    rois = np.array([[0., 1., 1., 6., 6.]])
+    out = mx.nd.contrib.DeformablePSROIPooling(
+        mx.nd.array(data), mx.nd.array(rois), spatial_scale=1.0,
+        output_dim=od, group_size=gs, pooled_size=pooled,
+        sample_per_part=ns, no_trans=True)
+    ref = _np_deform_psroi(data, rois, None, 1.0, od, gs, pooled,
+                           pooled, ns, 0.0, True)
+    np.testing.assert_allclose(out.asnumpy(), ref, rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_deformable_psroi_with_trans_matches_oracle():
+    od, gs, pooled, ns, part = 4, 2, 2, 2, 2
+    ncls = 2
+    data = RNG.randn(2, od * gs * gs, 8, 8).astype(np.float64)
+    rois = np.array([[0., 0., 0., 5., 5.], [1., 2., 1., 7., 6.]])
+    trans = (RNG.rand(2, ncls * 2, part, part) - 0.5).astype(np.float64)
+    out = mx.nd.contrib.DeformablePSROIPooling(
+        mx.nd.array(data), mx.nd.array(rois), mx.nd.array(trans),
+        spatial_scale=1.0, output_dim=od, group_size=gs,
+        pooled_size=pooled, part_size=part, sample_per_part=ns,
+        trans_std=0.2)
+    ref = _np_deform_psroi(data, rois, trans, 1.0, od, gs, pooled,
+                           part, ns, 0.2, False)
+    np.testing.assert_allclose(out.asnumpy(), ref, rtol=1e-4,
+                               atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# Proposal / MultiProposal
+# ---------------------------------------------------------------------------
+
+_PROP_KW = dict(rpn_pre_nms_top_n=12, rpn_post_nms_top_n=6,
+                threshold=0.7, rpn_min_size=4, scales=(8.,),
+                ratios=(0.5, 1., 2.), feature_stride=16)
+
+
+def _rand_proposal_inputs(B=1, Hf=3, Wf=4, A=3):
+    cls_prob = RNG.rand(B, 2 * A, Hf, Wf).astype(np.float64)
+    bbox_pred = (RNG.randn(B, 4 * A, Hf, Wf) * 0.2).astype(np.float64)
+    im_info = np.tile(np.array([[40.0, 56.0, 1.5]]), (B, 1))
+    return cls_prob, bbox_pred, im_info
+
+
+def test_proposal_matches_numpy_pipeline():
+    cls_prob, bbox_pred, im_info = _rand_proposal_inputs()
+    rois, scores = mx.nd.contrib.Proposal(
+        mx.nd.array(cls_prob), mx.nd.array(bbox_pred),
+        mx.nd.array(im_info), output_score=True, **_PROP_KW)
+    ref_rois, ref_sc = _np_proposal(
+        cls_prob[0, 3:], bbox_pred[0], im_info[0], 16, (8.,),
+        (0.5, 1., 2.), 12, 6, 0.7, 4)
+    r = rois.asnumpy()
+    assert r.shape == (6, 5)
+    np.testing.assert_allclose(r[:, 0], 0.0)
+    np.testing.assert_allclose(r[:, 1:], ref_rois, rtol=1e-4,
+                               atol=1e-4)
+    np.testing.assert_allclose(scores.asnumpy()[:, 0], ref_sc,
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_proposal_iou_loss_variant():
+    cls_prob, bbox_pred, im_info = _rand_proposal_inputs()
+    rois = mx.nd.contrib.Proposal(
+        mx.nd.array(cls_prob), mx.nd.array(bbox_pred),
+        mx.nd.array(im_info), iou_loss=True, **_PROP_KW)
+    ref_rois, _ = _np_proposal(
+        cls_prob[0, 3:], bbox_pred[0], im_info[0], 16, (8.,),
+        (0.5, 1., 2.), 12, 6, 0.7, 4, iou_loss=True)
+    np.testing.assert_allclose(rois.asnumpy()[:, 1:], ref_rois,
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_multi_proposal_batched():
+    cls_prob, bbox_pred, im_info = _rand_proposal_inputs(B=2)
+    im_info[1] = [32.0, 48.0, 1.0]
+    rois, scores = mx.nd.contrib.MultiProposal(
+        mx.nd.array(cls_prob), mx.nd.array(bbox_pred),
+        mx.nd.array(im_info), output_score=True, **_PROP_KW)
+    r = rois.asnumpy()
+    assert r.shape == (12, 5)
+    np.testing.assert_allclose(r[:6, 0], 0.0)
+    np.testing.assert_allclose(r[6:, 0], 1.0)
+    for b in range(2):
+        ref_rois, ref_sc = _np_proposal(
+            cls_prob[b, 3:], bbox_pred[b], im_info[b], 16, (8.,),
+            (0.5, 1., 2.), 12, 6, 0.7, 4)
+        np.testing.assert_allclose(r[6 * b:6 * (b + 1), 1:], ref_rois,
+                                   rtol=1e-4, atol=1e-4)
+        np.testing.assert_allclose(
+            scores.asnumpy()[6 * b:6 * (b + 1), 0], ref_sc,
+            rtol=1e-4, atol=1e-5)
+
+
+def test_proposal_boxes_inside_image():
+    cls_prob, bbox_pred, im_info = _rand_proposal_inputs(Hf=4, Wf=4)
+    rois = mx.nd.contrib.Proposal(
+        mx.nd.array(cls_prob), mx.nd.array(bbox_pred),
+        mx.nd.array(im_info), rpn_pre_nms_top_n=20,
+        rpn_post_nms_top_n=8, threshold=0.7, rpn_min_size=0,
+        scales=(8.,), ratios=(1.,), feature_stride=16).asnumpy()
+    # min_size=0 keeps every box clipped inside [0, im-1]
+    assert np.all(rois[:, 1] >= 0) and np.all(rois[:, 2] >= 0)
+    assert np.all(rois[:, 3] <= 56.0 - 1) \
+        and np.all(rois[:, 4] <= 40.0 - 1)
+
+
+# ---------------------------------------------------------------------------
+# count_sketch
+# ---------------------------------------------------------------------------
+
+def test_count_sketch_matches_oracle():
+    n, in_dim, out_dim = 4, 7, 5
+    data = RNG.randn(n, in_dim).astype(np.float64)
+    h = RNG.randint(0, out_dim, in_dim).astype(np.float64)
+    s = np.where(RNG.rand(in_dim) < 0.5, -1.0, 1.0)
+    out = mx.nd.contrib.count_sketch(
+        mx.nd.array(data), mx.nd.array(h), mx.nd.array(s),
+        out_dim=out_dim)
+    ref = np.zeros((n, out_dim))
+    for j in range(in_dim):
+        ref[:, int(h[j])] += s[j] * data[:, j]
+    np.testing.assert_allclose(out.asnumpy(), ref, rtol=1e-5,
+                               atol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# cast_storage (registered op + NDArray conversion path)
+# ---------------------------------------------------------------------------
+
+def test_cast_storage_registered_and_symbolic():
+    from mxnet_tpu.ops.registry import find_op
+    assert find_op("cast_storage") is not None
+    # symbolic graph can carry a cast_storage node (dense identity)
+    import mxnet_tpu.symbol as sym
+    x = sym.var("x")
+    y = sym.create("cast_storage", [x], {"stype": "default"})
+    z = y + 1.0
+    ex = z.bind(mx.cpu(), {"x": mx.nd.array([[1., 0.], [0., 2.]])})
+    out = ex.forward()[0].asnumpy()
+    np.testing.assert_allclose(out, [[2., 1.], [1., 3.]])
+
+
+def test_cast_storage_ndarray_roundtrip():
+    dense = mx.nd.array([[1., 0., 3.], [0., 0., 0.], [0., 5., 0.]])
+    csr = mx.nd.cast_storage(dense, "csr")
+    assert csr.stype == "csr"
+    back = mx.nd.cast_storage(csr, "default")
+    np.testing.assert_allclose(back.asnumpy(), dense.asnumpy())
+    rsp = mx.nd.cast_storage(dense, "row_sparse")
+    assert rsp.stype == "row_sparse"
+    np.testing.assert_allclose(rsp.tostype("default").asnumpy(),
+                               dense.asnumpy())

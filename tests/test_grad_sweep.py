@@ -162,6 +162,32 @@ CASES = {
                           {"data": _sym(1, 2, 6, 6),
                            "rois": np.array([[0., 0., 0., 3., 3.]])},
                           ("data",)),
+    # offsets drawn in (0.4, 0.9): sample points stay off the integer
+    # grid where bilinear interpolation kinks make FD undefined
+    "_contrib_DeformableConvolution": (
+        {"kernel": (3, 3), "num_filter": 4, "pad": (1, 1)},
+        {"data": _sym(1, 2, 5, 5), "offset": _pos(1, 18, 5, 5),
+         "weight": _sym(4, 2, 3, 3), "bias": _sym(4)},
+        ("data", "offset", "weight", "bias"), (2e-2, 1e-3)),
+    "_contrib_PSROIPooling": (
+        {"spatial_scale": 1.0, "output_dim": 2, "pooled_size": 2,
+         "group_size": 2},
+        {"data": _sym(1, 8, 6, 6),
+         "rois": np.array([[0., 0., 0., 4., 4.], [0., 1., 1., 5., 5.]])},
+        ("data",)),
+    "_contrib_DeformablePSROIPooling": (
+        {"spatial_scale": 1.0, "output_dim": 2, "group_size": 2,
+         "pooled_size": 2, "sample_per_part": 2, "trans_std": 0.1},
+        {"data": _sym(1, 8, 6, 6),
+         "rois": np.array([[0., 0., 0., 4., 4.]]),
+         "trans": _pos(1, 4, 2, 2) * 0.5},
+        ("data", "trans"), (2e-2, 1e-3)),
+    "_contrib_count_sketch": (
+        {"out_dim": 4},
+        {"data": _sym(3, 6),
+         "h": np.array([0., 3., 1., 2., 3., 0.]),
+         "s": np.array([1., -1., 1., 1., -1., 1.])},
+        ("data", "s")),
     "SpatialTransformer": ({"transform_type": "affine",
                             "sampler_type": "bilinear",
                             "target_shape": (4, 4)},
@@ -354,6 +380,14 @@ WAIVED = {
     # detection target/box assembly: piecewise-constant box logic
     "MultiBoxTarget": "box matching: piecewise constant",
     "MultiBoxDetection": "box decode+NMS: piecewise constant",
+    "_contrib_Proposal":
+        "RPN proposal: discrete top-k/NMS selection (test_deformable_ops)",
+    "Proposal":
+        "RPN proposal: discrete top-k/NMS selection (test_deformable_ops)",
+    "_contrib_MultiProposal":
+        "RPN proposal: discrete top-k/NMS selection (test_deformable_ops)",
+    "MultiProposal":
+        "RPN proposal: discrete top-k/NMS selection (test_deformable_ops)",
     "_contrib_MultiBoxTarget": "box matching: piecewise constant",
     "_contrib_MultiBoxDetection": "box decode+NMS: piecewise constant",
     # eigendecomposition: gradient defined only for distinct eigenvalues
