@@ -222,11 +222,12 @@ class KVStore:
         """
         if not self._is_dist or self.num_workers == 1:
             return arr
-        from .ndarray.sparse import BaseSparseNDArray
+        from .ndarray.sparse import BaseSparseNDArray, RowSparseNDArray
+        if isinstance(arr, RowSparseNDArray):
+            return self._global_reduce_rsp(arr)
         if isinstance(arr, BaseSparseNDArray):
-            # cross-worker sparse reduce: densify → allreduce → recast
-            # (the reference server merges rsp via row union; the dense
-            # roundtrip is the documented TPU fallback)
+            # CSR is not a reference dist-push format (the server merge
+            # at kvstore_dist_server.h:499 is rsp-only); dense roundtrip
             stype = arr.stype
             return self._global_reduce(arr.tostype("default")) \
                 .tostype(stype)
@@ -266,6 +267,43 @@ class KVStore:
                 self._inprogram_reduce = False
         summed = multihost_utils.process_allgather(arr._data)
         return NDArray(jnp.sum(summed, axis=0), ctx=arr._ctx)
+
+    def _global_reduce_rsp(self, arr):
+        """Row-union cross-worker reduce for row_sparse values — the
+        TPU-native form of the reference server's rsp merge
+        (kvstore_dist_server.h:499 ApplyUpdates row union).
+
+        Workers exchange ONE bool presence mask per row (N bools, not
+        N*D values), deterministically agree on the sorted union of
+        touched rows, scatter their local rows onto union slots, and
+        allreduce only the (U, D) union block — the embedding-gradient
+        value never densifies to (N, D)."""
+        import numpy as _np
+        import jax.numpy as jnp
+        from jax.experimental import multihost_utils
+        from .ndarray.sparse import RowSparseNDArray
+
+        N = int(arr.shape[0])
+        row_shape = tuple(arr.shape[1:])
+        idx = arr._sp_indices._data
+        mask = jnp.zeros((N,), jnp.bool_).at[idx].set(True)
+        masks = multihost_utils.process_allgather(mask)     # (W, N)
+        union = _np.nonzero(_np.asarray(masks).any(axis=0))[0] \
+            .astype(_np.int64)                              # sorted
+        dtype = arr._sp_data._data.dtype
+        if union.size == 0:
+            return RowSparseNDArray(
+                NDArray(jnp.zeros((0,) + row_shape, dtype),
+                        ctx=arr._ctx),
+                NDArray(jnp.zeros((0,), jnp.int64), ctx=arr._ctx),
+                arr.shape, ctx=arr._ctx)
+        pos = jnp.searchsorted(jnp.asarray(union), idx)
+        local = jnp.zeros((union.shape[0],) + row_shape, dtype) \
+            .at[pos].add(arr._sp_data._data)
+        summed = self._global_reduce(NDArray(local, ctx=arr._ctx))
+        return RowSparseNDArray(
+            summed, NDArray(jnp.asarray(union), ctx=arr._ctx),
+            arr.shape, ctx=arr._ctx)
 
     def pull(self, key, out=None, priority=0, ignore_sparse=True):
         from .ndarray.sparse import BaseSparseNDArray

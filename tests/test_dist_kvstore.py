@@ -66,6 +66,42 @@ want = np.arange(9, dtype=np.float32).reshape(shape) * \
     sum(r + 1 for r in range(nw))
 assert np.allclose(out.asnumpy(), want)
 
+# --- row_sparse push: row-union reduce, NEVER densified (ref
+# kvstore_dist_server.h:499 merges rsp server-side by row union) ------
+from mxnet_tpu.ndarray.sparse import RowSparseNDArray
+rsp_shape = (20, 3)
+kv.init(11, mx.nd.zeros(rsp_shape))
+rows = np.array([1 + rank, 5, 12 + rank], dtype=np.int64)  # overlap @5
+vals = np.ones((3, 3), np.float32) * (rank + 1)
+rsp = RowSparseNDArray(mx.nd.array(vals), mx.nd.array(rows), rsp_shape)
+
+orig_tostype = RowSparseNDArray.tostype
+def _no_densify(self, stype):
+    raise AssertionError("rsp cross-worker push densified")
+RowSparseNDArray.tostype = _no_densify
+kv.push(11, rsp)
+RowSparseNDArray.tostype = orig_tostype
+
+stored = kv._data[11]
+assert stored.stype == "row_sparse", stored
+dense_want = np.zeros(rsp_shape, np.float32)
+for r in range(nw):
+    for row in (1 + r, 5, 12 + r):
+        dense_want[row] += (r + 1)
+assert np.allclose(stored.tostype("default").asnumpy(), dense_want)
+assert stored.indices.asnumpy().tolist() == \
+    sorted({1 + r for r in range(nw)} | {5} | {12 + r for r in range(nw)})
+
+# row_sparse_pull returns the requested rows of the reduced value with
+# stype preserved end-to-end
+out = RowSparseNDArray(mx.nd.zeros((0, 3)),
+                       mx.nd.array(np.zeros((0,), np.int64)), rsp_shape)
+kv.row_sparse_pull(11, out=out, row_ids=mx.nd.array(
+    np.array([5, 12], np.int64)))
+assert out.stype == "row_sparse"
+assert out.indices.asnumpy().tolist() == [5, 12]
+assert np.allclose(out.data.asnumpy(), dense_want[[5, 12]])
+
 print("WORKER_OK", rank, flush=True)
 '''
 
