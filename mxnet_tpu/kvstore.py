@@ -33,6 +33,12 @@ def _to_jnp(np_arr):
     return jnp.asarray(np_arr)
 
 
+def _canonical_index_dtype():
+    from .util import canonical_dtype
+    import numpy as _np
+    return canonical_dtype(_np.int64)
+
+
 def _ctype_key_value(key, vals):
     if isinstance(key, (tuple, list)):
         return list(key), list(vals)
@@ -295,7 +301,8 @@ class KVStore:
             return RowSparseNDArray(
                 NDArray(jnp.zeros((0,) + row_shape, dtype),
                         ctx=arr._ctx),
-                NDArray(jnp.zeros((0,), jnp.int64), ctx=arr._ctx),
+                NDArray(jnp.zeros(
+                    (0,), _canonical_index_dtype()), ctx=arr._ctx),
                 arr.shape, ctx=arr._ctx)
         pos = jnp.searchsorted(jnp.asarray(union), idx)
         local = jnp.zeros((union.shape[0],) + row_shape, dtype) \

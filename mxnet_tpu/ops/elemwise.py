@@ -84,8 +84,14 @@ register("shape_array",
          arg_names=_D)
 register("size_array",
          lambda attrs, x: jnp.asarray([x.size], dtype=jnp.int32), arg_names=_D)
-register("Cast",
-         lambda attrs, x: x.astype(jnp.dtype(attrs["dtype"])),
+def _cast(attrs, x):
+    # 64-bit targets demote explicitly unless x64/int64 mode is on —
+    # never via jax's warning-emitting implicit truncation
+    from ..util import canonical_dtype
+    return x.astype(jnp.dtype(canonical_dtype(attrs["dtype"])))
+
+
+register("Cast", _cast,
          arg_names=_D, defaults={"dtype": "float32"}, aliases=("cast",))
 register("clip",
          lambda attrs, x: jnp.clip(x, float(attrs["a_min"]), float(attrs["a_max"])),

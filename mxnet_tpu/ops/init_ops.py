@@ -13,7 +13,8 @@ from .registry import register
 
 
 def _dtype(attrs, default="float32"):
-    return jnp.dtype(attrs.get("dtype") or default)
+    from ..util import canonical_dtype
+    return jnp.dtype(canonical_dtype(attrs.get("dtype") or default))
 
 
 register("_zeros",

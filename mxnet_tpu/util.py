@@ -4,7 +4,58 @@ from __future__ import annotations
 import functools
 import inspect
 
-__all__ = ["use_np_shape", "np_shape", "is_np_shape", "makedirs"]
+__all__ = ["use_np_shape", "np_shape", "is_np_shape", "makedirs",
+           "int64_enabled", "set_int64_tensor_size", "canonical_dtype"]
+
+
+# -- large-tensor / int64 index support -------------------------------------
+# The reference gates >2^31-element arrays behind the
+# USE_INT64_TENSOR_SIZE build flag (tests/nightly/test_large_array.py);
+# here it is a runtime knob: MXNET_INT64_TENSOR_SIZE=1 (or
+# set_int64_tensor_size(True)) flips jax to x64 so 64-bit index dtypes
+# exist on-device. Without it, 64-bit dtype requests demote to the
+# TPU-native 32-bit widths EXPLICITLY via canonical_dtype — never
+# through jax's implicit truncation (which warns on every call).
+
+_INT64_FLAG = [None]
+
+
+def set_int64_tensor_size(enabled: bool) -> None:
+    import jax
+    _INT64_FLAG[0] = bool(enabled)
+    if enabled:
+        jax.config.update("jax_enable_x64", True)
+
+
+def int64_enabled() -> bool:
+    if _INT64_FLAG[0] is None:
+        from .base import get_env
+        flag = get_env("MXNET_INT64_TENSOR_SIZE", False, bool)
+        if flag:
+            set_int64_tensor_size(True)
+        else:
+            _INT64_FLAG[0] = False
+    if _INT64_FLAG[0]:
+        return True
+    try:        # x64 enabled directly (JAX_ENABLE_X64 / enable_x64())
+        import jax
+        return bool(jax.config.jax_enable_x64)
+    except Exception:
+        return False
+
+
+_DEMOTE = {"i": "int32", "u": "uint32", "f": "float32"}
+
+
+def canonical_dtype(dtype):
+    """The dtype actually materialized on device: 64-bit int/uint/float
+    demote to 32-bit unless int64 tensor size (x64) is enabled."""
+    import numpy as np
+    dtype = np.dtype(dtype)
+    if dtype.itemsize == 8 and dtype.kind in _DEMOTE \
+            and not int64_enabled():
+        return np.dtype(_DEMOTE[dtype.kind])
+    return dtype
 
 
 def makedirs(d):

@@ -1,0 +1,103 @@
+"""Large-tensor / int64 index support (VERDICT r5 task 5; ref
+tests/nightly/test_large_array.py and the USE_INT64_TENSOR_SIZE build
+flag).
+
+Two contracts:
+1. WITHOUT the flag, 64-bit dtype requests demote to 32-bit EXPLICITLY
+   — jax's implicit-truncation UserWarning must never fire.
+2. WITH MXNET_INT64_TENSOR_SIZE=1 (fresh process: x64 must be set
+   before tracing), indices past 2^31 survive exactly end to end.
+"""
+import os
+import subprocess
+import sys
+import warnings
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.ndarray import sparse as sp
+
+
+def test_no_int64_truncation_warnings():
+    """The sparse paths that warned in round 4 (csr add, int64 aux
+    arrays, astype) must be silent: demotion is explicit now."""
+    with warnings.catch_warnings():
+        warnings.filterwarnings(
+            "error", message=".*will be truncated to dtype int32.*")
+        a = sp.csr_matrix((np.array([1., 2.]), np.array([0, 2]),
+                           np.array([0, 1, 2])), shape=(2, 3))
+        b = sp.csr_matrix((np.array([3.]), np.array([1]),
+                           np.array([0, 1, 1])), shape=(2, 3))
+        c = a + b
+        assert c.stype == "csr"
+        dense = mx.nd.array([[1., 0., 3.], [0., 5., 0.]])
+        csr = mx.nd.cast_storage(dense, "csr")
+        assert csr.stype == "csr"
+        _ = csr.tostype("default")
+        rsp = sp.row_sparse_array(
+            (np.ones((2, 3), np.float32), np.array([0, 2], np.int64)),
+            shape=(4, 3))
+        _ = rsp.tostype("default")
+        x = mx.nd.array([1., 2., 3.])
+        y = x.astype("int64")           # demotes explicitly, silently
+        assert y.dtype in (np.int32, np.int64)
+        _ = mx.nd.zeros((3,), dtype="int64")
+        _ = mx.nd.shape_array(x)
+
+
+_INT64_WORKER = r'''
+import os
+import numpy as np
+os.environ["MXNET_INT64_TENSOR_SIZE"] = "1"
+import jax
+jax.config.update("jax_platforms", "cpu")
+import mxnet_tpu as mx
+from mxnet_tpu.ndarray import sparse as sp
+from mxnet_tpu.util import int64_enabled
+
+assert int64_enabled()
+BIG = 2**31 + 5
+
+# explicit int64 values survive exactly on device
+x = mx.nd.array(np.array([BIG, BIG + 7], np.int64), dtype="int64")
+assert x.dtype == np.int64, x.dtype
+assert x.asnumpy().tolist() == [BIG, BIG + 7]
+
+# a row_sparse value addressing rows past 2^31 (host-small data, huge
+# logical shape — the reference large-array tests do the same)
+rsp = sp.row_sparse_array(
+    (np.ones((2, 3), np.float32),
+     np.array([100, BIG], np.int64)),
+    shape=(2**32 + 10, 3))
+idx = rsp.indices.asnumpy()
+assert idx.dtype == np.int64
+assert idx.tolist() == [100, BIG]
+
+# retain on the far row keeps the exact index
+kept = sp.retain(rsp, mx.nd.array(np.array([BIG], np.int64)))
+assert kept.indices.asnumpy().tolist() == [BIG]
+
+# Cast to int64 keeps 64-bit width under the flag
+y = mx.nd.array([1., 2.]).astype("int64")
+assert y.dtype == np.int64
+print("INT64_OK", flush=True)
+'''
+
+
+def test_int64_mode_preserves_large_indices(tmp_path):
+    script = tmp_path / "int64_worker.py"
+    script.write_text(_INT64_WORKER)
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+    out = subprocess.run([sys.executable, str(script)], env=env,
+                         cwd=repo_root, capture_output=True, text=True,
+                         timeout=300)
+    assert out.returncode == 0, \
+        "int64 worker failed:\n%s\n%s" % (out.stdout[-2000:],
+                                          out.stderr[-2000:])
+    assert "INT64_OK" in out.stdout
