@@ -860,19 +860,45 @@ def imperative_mixed_precision(enable=True):
 _SAVE_LIST_KEY = "__mxnet_tpu_list__"
 
 
+# sparse-aware serialization (the reference NDArray::Save is magic-
+# tagged and sparse-aware, ndarray.cc:1576): sparse entries spill their
+# components under reserved key prefixes inside the same npz payload
+_SP_CSR_KEY = "__sparse_csr__::"
+_SP_RSP_KEY = "__sparse_rsp__::"
+
+
+def _flatten_entry(key, val, arrays):
+    from .sparse import CSRNDArray, RowSparseNDArray
+    if isinstance(val, CSRNDArray):
+        p = _SP_CSR_KEY + key + "::"
+        arrays[p + "data"] = val.data.asnumpy()
+        arrays[p + "indices"] = val.indices.asnumpy()
+        arrays[p + "indptr"] = val.indptr.asnumpy()
+        arrays[p + "shape"] = _np.asarray(val.shape, _np.int64)
+    elif isinstance(val, RowSparseNDArray):
+        p = _SP_RSP_KEY + key + "::"
+        arrays[p + "data"] = val.data.asnumpy()
+        arrays[p + "indices"] = val.indices.asnumpy()
+        arrays[p + "shape"] = _np.asarray(val.shape, _np.int64)
+    else:
+        arrays[key] = val.asnumpy()
+
+
 def save(fname, data):
-    if isinstance(data, NDArray):
+    if isinstance(data, NDArray) or (
+            hasattr(data, "stype") and hasattr(data, "asnumpy")):
         data = [data]
+    arrays = {}
     if isinstance(data, dict):
-        arrays = {k: v.asnumpy() for k, v in data.items()}
-        _np.savez(_ensure_npz(fname), **arrays)
+        for k, v in data.items():
+            _flatten_entry(k, v, arrays)
     elif isinstance(data, (list, tuple)):
-        arrays = {"%s%d" % (_SAVE_LIST_KEY, i): v.asnumpy()
-                  for i, v in enumerate(data)}
-        _np.savez(_ensure_npz(fname), **arrays)
+        for i, v in enumerate(data):
+            _flatten_entry("%s%d" % (_SAVE_LIST_KEY, i), v, arrays)
     else:
         raise ValueError("data needs to either be a NDArray, dict of (str, "
                          "NDArray) pairs or a list of NDarrays.")
+    _np.savez(_ensure_npz(fname), **arrays)
     import os
     if not fname.endswith(".npz") and os.path.exists(fname + ".npz"):
         os.replace(fname + ".npz", fname)
@@ -882,12 +908,38 @@ def _ensure_npz(fname):
     return fname if fname.endswith(".npz") else fname
 
 
+def _unflatten(loaded):
+    from .sparse import CSRNDArray, RowSparseNDArray
+    out = {}
+    sparse_parts = {}
+    for k in loaded.keys():
+        for prefix, stype in ((_SP_CSR_KEY, "csr"),
+                              (_SP_RSP_KEY, "row_sparse")):
+            if k.startswith(prefix):
+                name, part = k[len(prefix):].rsplit("::", 1)
+                sparse_parts.setdefault((name, stype), {})[part] = \
+                    loaded[k]
+                break
+        else:
+            out[k] = array(loaded[k])
+    for (name, stype), parts in sparse_parts.items():
+        shape = tuple(int(s) for s in parts["shape"])
+        if stype == "csr":
+            out[name] = CSRNDArray(
+                array(parts["data"]), array(parts["indices"]),
+                array(parts["indptr"]), shape)
+        else:
+            out[name] = RowSparseNDArray(
+                array(parts["data"]), array(parts["indices"]), shape)
+    return out
+
+
 def load(fname):
     with open(fname, "rb") as f:
         loaded = _np.load(f, allow_pickle=False)
-        keys = list(loaded.keys())
+        out = _unflatten(loaded)
+        keys = list(out.keys())
         if keys and all(k.startswith(_SAVE_LIST_KEY) for k in keys):
-            n = len(keys)
-            return [array(loaded["%s%d" % (_SAVE_LIST_KEY, i)])
-                    for i in range(n)]
-        return {k: array(loaded[k]) for k in keys}
+            return [out["%s%d" % (_SAVE_LIST_KEY, i)]
+                    for i in range(len(keys))]
+        return out

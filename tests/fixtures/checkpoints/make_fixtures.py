@@ -1,0 +1,103 @@
+"""Generate checkpoint back-compat fixtures for the CURRENT round.
+
+Run from the repo root:
+    JAX_PLATFORMS=cpu python tests/fixtures/checkpoints/make_fixtures.py r05
+
+Writes, under tests/fixtures/checkpoints/<tag>/:
+- mlp-symbol.json / mlp-0001.params / mlp-0001.states  (Module
+  save_checkpoint + optimizer states; ref model.py:394)
+- gluon-symbol.json / gluon-0000.params                (HybridBlock
+  export deploy pair; ref block.py:868)
+- gluon.params                                         (save_parameters)
+- arrays.nd                                            (raw nd.save with
+  dense + csr + row_sparse values; ref ndarray.cc:1576)
+- manifest.json                                        (pinned forward
+  outputs on the fixed input)
+
+Committed artifacts from round N are loaded by
+tests/test_checkpoint_backcompat.py in every later round — the harness
+the reference keeps in tests/nightly/model_backwards_compatibility_check.
+"""
+import json
+import os
+import sys
+
+import numpy as np
+
+
+def main(tag):
+    import mxnet_tpu as mx
+    from mxnet_tpu.ndarray import sparse as sp
+
+    out_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           tag)
+    os.makedirs(out_dir, exist_ok=True)
+    rng = np.random.RandomState(4)
+    x_fix = rng.normal(0, 1, (2, 6)).astype(np.float32)
+    manifest = {"tag": tag}
+
+    # -- Module checkpoint + optimizer states ---------------------------
+    data = mx.sym.var("data")
+    fc1 = mx.sym.FullyConnected(data, mx.sym.var("fc1_weight"),
+                                mx.sym.var("fc1_bias"), num_hidden=5,
+                                name="fc1")
+    act = mx.sym.Activation(fc1, act_type="relu")
+    fc2 = mx.sym.FullyConnected(act, mx.sym.var("fc2_weight"),
+                                mx.sym.var("fc2_bias"), num_hidden=3,
+                                name="fc2")
+    net = mx.sym.SoftmaxOutput(fc2, mx.sym.var("softmax_label"),
+                               name="softmax")
+    mod = mx.mod.Module(net, data_names=("data",),
+                        label_names=("softmax_label",),
+                        context=mx.cpu())
+    mod.bind(data_shapes=[("data", (2, 6))],
+             label_shapes=[("softmax_label", (2,))])
+    mod.init_params(mx.init.Xavier(rnd_type="uniform", magnitude=2.0))
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.1,
+                                         "momentum": 0.9})
+    # one update so momentum states are non-trivial
+    import mxnet_tpu.io as mio
+    batch = mio.DataBatch(data=[mx.nd.array(x_fix)],
+                          label=[mx.nd.array(np.array([0., 2.]))])
+    mod.forward(batch, is_train=True)
+    mod.backward()
+    mod.update()
+    mod.save_checkpoint(os.path.join(out_dir, "mlp"), 1,
+                        save_optimizer_states=True)
+    mod.forward(batch, is_train=False)
+    manifest["mlp_forward"] = mod.get_outputs()[0].asnumpy().tolist()
+
+    # -- Gluon export pair + save_parameters ----------------------------
+    from mxnet_tpu import gluon
+    gnet = gluon.nn.HybridSequential()
+    gnet.add(gluon.nn.Dense(5, activation="relu"))
+    gnet.add(gluon.nn.Dense(3))
+    gnet.initialize(mx.init.Xavier())
+    gnet.hybridize()
+    y = gnet(mx.nd.array(x_fix))
+    manifest["gluon_forward"] = y.asnumpy().tolist()
+    gnet.export(os.path.join(out_dir, "gluon"))
+    gnet.save_parameters(os.path.join(out_dir, "gluon.params"))
+
+    # -- raw nd.save incl. sparse ---------------------------------------
+    dense = mx.nd.array(rng.normal(0, 1, (3, 4)).astype(np.float32))
+    csr = sp.csr_matrix((np.array([1.5, -2.0]), np.array([0, 3]),
+                         np.array([0, 1, 1, 2])), shape=(3, 4))
+    rsp = sp.row_sparse_array(
+        (rng.normal(0, 1, (2, 4)).astype(np.float32),
+         np.array([0, 2], np.int64)), shape=(3, 4))
+    mx.nd.save(os.path.join(out_dir, "arrays.nd"),
+               {"dense": dense, "csr": csr, "rsp": rsp})
+    manifest["dense"] = dense.asnumpy().tolist()
+    manifest["csr_dense"] = csr.tostype("default").asnumpy().tolist()
+    manifest["rsp_dense"] = rsp.tostype("default").asnumpy().tolist()
+    manifest["x_fix"] = x_fix.tolist()
+
+    with open(os.path.join(out_dir, "manifest.json"), "w") as f:
+        json.dump(manifest, f, indent=1)
+    print("fixtures written to", out_dir)
+
+
+if __name__ == "__main__":
+    main(sys.argv[1] if len(sys.argv) > 1 else "r05")

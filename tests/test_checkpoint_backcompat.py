@@ -1,0 +1,124 @@
+"""Checkpoint backwards-compatibility harness (VERDICT r5 task 6; ref
+tests/nightly/model_backwards_compatibility_check/).
+
+tests/fixtures/checkpoints/<tag>/ holds COMMITTED artifacts written by
+round <tag>'s code (generator: make_fixtures.py). Every later round
+must keep loading every committed generation: Module checkpoints (incl.
+optimizer states), the Gluon export deploy pair via SymbolBlock,
+save_parameters files, and raw nd.save payloads with sparse arrays —
+each pinned to the forward outputs recorded in the tag's manifest.
+"""
+import json
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+_FIX_ROOT = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         "fixtures", "checkpoints")
+_TAGS = sorted(d for d in os.listdir(_FIX_ROOT)
+               if os.path.isdir(os.path.join(_FIX_ROOT, d)))
+
+
+def _manifest(tag):
+    with open(os.path.join(_FIX_ROOT, tag, "manifest.json")) as f:
+        return json.load(f)
+
+
+@pytest.mark.parametrize("tag", _TAGS)
+def test_fixture_generations_exist(tag):
+    assert _TAGS, "no checkpoint fixture generations committed"
+    man = _manifest(tag)
+    assert man["tag"] == tag
+
+
+@pytest.mark.parametrize("tag", _TAGS)
+def test_module_checkpoint_loads(tag):
+    man = _manifest(tag)
+    prefix = os.path.join(_FIX_ROOT, tag, "mlp")
+    sym, arg_params, aux_params = mx.model.load_checkpoint(prefix, 1)
+    mod = mx.mod.Module(sym, data_names=("data",),
+                        label_names=("softmax_label",),
+                        context=mx.cpu())
+    x = np.asarray(man["x_fix"], np.float32)
+    mod.bind(data_shapes=[("data", x.shape)],
+             label_shapes=[("softmax_label", (x.shape[0],))],
+             for_training=False)
+    mod.set_params(arg_params, aux_params)
+    import mxnet_tpu.io as mio
+    batch = mio.DataBatch(
+        data=[mx.nd.array(x)],
+        label=[mx.nd.zeros((x.shape[0],))])
+    mod.forward(batch, is_train=False)
+    np.testing.assert_allclose(mod.get_outputs()[0].asnumpy(),
+                               np.asarray(man["mlp_forward"]),
+                               rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.parametrize("tag", _TAGS)
+def test_module_resume_with_optimizer_states(tag):
+    prefix = os.path.join(_FIX_ROOT, tag, "mlp")
+    man = _manifest(tag)
+    x = np.asarray(man["x_fix"], np.float32)
+    mod = mx.mod.Module.load(prefix, 1, load_optimizer_states=True,
+                             data_names=("data",),
+                             label_names=("softmax_label",),
+                             context=mx.cpu())
+    mod.bind(data_shapes=[("data", x.shape)],
+             label_shapes=[("softmax_label", (x.shape[0],))])
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.1,
+                                         "momentum": 0.9})
+    # resumed module can take a training step (states restored)
+    import mxnet_tpu.io as mio
+    batch = mio.DataBatch(data=[mx.nd.array(x)],
+                          label=[mx.nd.zeros((x.shape[0],))])
+    mod.forward(batch, is_train=True)
+    mod.backward()
+    mod.update()
+
+
+@pytest.mark.parametrize("tag", _TAGS)
+def test_gluon_export_pair_via_symbolblock(tag):
+    from mxnet_tpu import gluon
+    man = _manifest(tag)
+    d = os.path.join(_FIX_ROOT, tag)
+    net = gluon.SymbolBlock.imports(
+        os.path.join(d, "gluon-symbol.json"), ["data0"],
+        os.path.join(d, "gluon-0000.params"))
+    x = mx.nd.array(np.asarray(man["x_fix"], np.float32))
+    np.testing.assert_allclose(net(x).asnumpy(),
+                               np.asarray(man["gluon_forward"]),
+                               rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.parametrize("tag", _TAGS)
+def test_gluon_save_parameters_loads(tag):
+    from mxnet_tpu import gluon
+    man = _manifest(tag)
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(5, activation="relu"))
+    net.add(gluon.nn.Dense(3))
+    net.load_parameters(os.path.join(_FIX_ROOT, tag, "gluon.params"))
+    x = mx.nd.array(np.asarray(man["x_fix"], np.float32))
+    np.testing.assert_allclose(net(x).asnumpy(),
+                               np.asarray(man["gluon_forward"]),
+                               rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.parametrize("tag", _TAGS)
+def test_nd_save_payload_with_sparse(tag):
+    man = _manifest(tag)
+    payload = mx.nd.load(os.path.join(_FIX_ROOT, tag, "arrays.nd"))
+    np.testing.assert_allclose(payload["dense"].asnumpy(),
+                               np.asarray(man["dense"]), rtol=1e-6)
+    assert payload["csr"].stype == "csr"
+    np.testing.assert_allclose(
+        payload["csr"].tostype("default").asnumpy(),
+        np.asarray(man["csr_dense"]), rtol=1e-6)
+    assert payload["rsp"].stype == "row_sparse"
+    np.testing.assert_allclose(
+        payload["rsp"].tostype("default").asnumpy(),
+        np.asarray(man["rsp_dense"]), rtol=1e-6)
