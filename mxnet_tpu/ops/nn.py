@@ -576,6 +576,10 @@ def _softmax_output(attrs, data, label):
         del g  # loss layer: implicit CE gradient, head grad ignored
         d, l = res
         p = _so_fwd(d)
+        if tuple(l.shape) == tuple(d.shape):
+            # probability labels (softmax_output-inl.h:160): plain
+            # (out - label) * grad_scale, no normalization
+            return ((p - l) * grad_scale, jnp.zeros_like(l))
         axis = 1 if multi_output else (d.ndim - 1)
         nclass = d.shape[axis]
         li = l.astype(jnp.int32)
@@ -588,10 +592,20 @@ def _softmax_output(attrs, data, label):
         if use_ignore:
             valid = (l != ignore_label).astype(d.dtype)
             grad = grad * jnp.expand_dims(valid, axis)
+        # normalization (softmax_output-inl.h:191-213,251): multi_output
+        # additionally divides by the spatial size s3[2] except in
+        # 'valid' mode
+        spatial = 1
+        if multi_output:
+            spatial = 1
+            for s in d.shape[2:]:
+                spatial *= int(s)
         if normalization == "batch":
-            grad = grad / d.shape[0]
+            grad = grad / (d.shape[0] * spatial)
         elif normalization == "valid":
             grad = grad / jnp.maximum(jnp.sum(valid), 1.0)
+        elif spatial != 1:
+            grad = grad / spatial
         return (grad * grad_scale, jnp.zeros_like(l))
 
     f.defvjp(f_fwd, f_bwd)

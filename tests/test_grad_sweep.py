@@ -314,16 +314,23 @@ for _a, _b in (("_linalg_gemm", "linalg_gemm"),
 # ---------------------------------------------------------------------------
 # Waivers: op -> reason. Every name here is deliberate.
 # ---------------------------------------------------------------------------
+# loss-head ops: backward emits the implicit loss gradient regardless
+# of the head cotangent, so FD cannot apply — but they are NOT waived:
+# each is pinned EXACTLY against the reference kernel's formula in
+# tests/test_head_op_gradients.py (ANALYTIC_COVERED there must match)
+ANALYTIC = {
+    "SoftmaxOutput": "exact (softmax - onehot) pin incl. ignore/"
+                     "multi_output/smooth (test_head_op_gradients)",
+    "Softmax": "alias of SoftmaxOutput (test_head_op_gradients)",
+    "SVMOutput": "exact L1/L2 hinge pin (test_head_op_gradients)",
+    "LinearRegressionOutput": "exact minus pin (test_head_op_gradients)",
+    "LogisticRegressionOutput":
+        "exact sigmoid/minus pin (test_head_op_gradients)",
+    "MAERegressionOutput":
+        "exact minus_sign pin (test_head_op_gradients)",
+}
+
 WAIVED = {
-    # mxnet head-op semantics: backward emits (pred - label) regardless
-    # of the head cotangent, so FD of a projected scalar cannot match by
-    # design; trajectories pinned in test_operator / test_module
-    "SoftmaxOutput": "head op: backward ignores cotangent",
-    "SVMOutput": "head op: backward ignores cotangent",
-    "LinearRegressionOutput": "head op: backward ignores cotangent",
-    "LogisticRegressionOutput": "head op: backward ignores cotangent",
-    "MAERegressionOutput": "head op: backward ignores cotangent",
-    "Softmax": "deprecated head alias: backward ignores cotangent",
     # parameter-mutating optimizer kernels: pinned against the
     # reference's update math in test_operator.py optimizer tests
     "sgd_update": "optimizer kernel (test_operator)",
@@ -444,7 +451,7 @@ def _collect():
     unaccounted = []
     for name in list_ops():
         op = get_op(name)
-        if name in WAIVED:
+        if name in WAIVED or name in ANALYTIC:
             continue
         case = CASES.get(name)
         if case is None and name in CASES:
@@ -467,6 +474,13 @@ def test_every_op_swept_or_waived():
         "ops neither swept nor waived by name: %s" % _UNACCOUNTED)
     waived_unknown = [n for n in WAIVED if find_op(n) is None]
     assert not waived_unknown
+    # the ANALYTIC category is honest only if every entry really has
+    # its dedicated exact-gradient test
+    from test_head_op_gradients import ANALYTIC_COVERED
+    assert set(ANALYTIC) == set(ANALYTIC_COVERED), (
+        set(ANALYTIC) ^ set(ANALYTIC_COVERED))
+    analytic_unknown = [n for n in ANALYTIC if find_op(n) is None]
+    assert not analytic_unknown
 
 
 @pytest.mark.parametrize("name,case", _PLANS,

@@ -1,0 +1,237 @@
+"""Analytic backward pins for the loss-head ops (VERDICT r5 task 7).
+
+These ops' backward IGNORES the head cotangent by design (the implicit
+loss gradient), so finite differences of a projected scalar cannot
+check them — instead each gradient is asserted EXACTLY against the
+reference kernel's formula:
+
+- SoftmaxOutput / Softmax  (softmax_output-inl.h:160-270):
+  grad = (softmax - onehot) * grad_scale / norm, with
+  normalization in {null, batch, valid}, use_ignore/ignore_label,
+  multi_output's extra spatial division, smoothing, and
+  probability-shaped labels.
+- SVMOutput  (svm_output.cc:31-67 L1_SVM/L2_SVM hinges).
+- Linear/Logistic/MAERegressionOutput  (regression_output.cc:94-154:
+  minus / minus with sigmoid / minus_sign, scaled by
+  grad_scale/num_output).
+
+The grad-sweep collector (tests/test_grad_sweep.py) counts these ops
+as ANALYTIC: accounted for here, not waived.
+"""
+import numpy as np
+
+import mxnet_tpu as mx
+
+# consumed by tests/test_grad_sweep.py's accounting meta-test
+ANALYTIC_COVERED = (
+    "SoftmaxOutput", "Softmax", "SVMOutput", "LinearRegressionOutput",
+    "LogisticRegressionOutput", "MAERegressionOutput",
+)
+
+RNG = np.random.RandomState(23)
+
+
+def _head_grad(op_name, data, label, **attrs):
+    """Bind -> forward(train) -> backward, return d(data)."""
+    d = mx.sym.var("data")
+    l = mx.sym.var("label")
+    out = mx.sym.create(op_name, [d, l], {k: str(v) for k, v in
+                                          attrs.items()}, name="head")
+    grads = {"data": mx.nd.zeros(data.shape)}
+    ex = out.bind(mx.cpu(), {"data": mx.nd.array(data),
+                             "label": mx.nd.array(label)},
+                  args_grad=grads,
+                  grad_req={"data": "write", "label": "null"})
+    fwd = ex.forward(is_train=True)[0].asnumpy()
+    ex.backward()
+    return fwd, grads["data"].asnumpy()
+
+
+def _softmax(x, axis=-1):
+    e = np.exp(x - x.max(axis=axis, keepdims=True))
+    return e / e.sum(axis=axis, keepdims=True)
+
+
+# ---------------------------------------------------------------------------
+# SoftmaxOutput
+# ---------------------------------------------------------------------------
+
+def _softmax_output_ref_grad(data, label, grad_scale=1.0,
+                             normalization="null", use_ignore=False,
+                             ignore_label=-1.0, smooth_alpha=0.0):
+    """softmax_output-inl.h single-output branch, exact."""
+    n, k = data.shape
+    p = _softmax(data)
+    onehot = np.eye(k)[label.astype(int)]
+    if smooth_alpha > 0:
+        onehot = onehot * (1 - smooth_alpha) \
+            + smooth_alpha / (k - 1) * (1 - onehot)
+    grad = p - onehot
+    if use_ignore:
+        keep = (label != ignore_label).astype(data.dtype)
+        grad = grad * keep[:, None]
+    if normalization == "batch":
+        valid_cnt = n
+    elif normalization == "valid":
+        valid_cnt = max(int((label != ignore_label).sum()), 1)
+    else:
+        valid_cnt = 1
+    return grad * (grad_scale / valid_cnt)
+
+
+def test_softmax_output_grad_is_pred_minus_label():
+    data = RNG.randn(4, 5).astype(np.float32)
+    label = np.array([0., 2., 4., 1.], np.float32)
+    for norm in ("null", "batch", "valid"):
+        for scale in (1.0, 2.5):
+            fwd, grad = _head_grad("SoftmaxOutput", data, label,
+                                   normalization=norm,
+                                   grad_scale=scale)
+            np.testing.assert_allclose(fwd, _softmax(data), rtol=1e-5)
+            want = _softmax_output_ref_grad(data, label,
+                                            grad_scale=scale,
+                                            normalization=norm)
+            np.testing.assert_allclose(grad, want, rtol=1e-5,
+                                       atol=1e-7)
+
+
+def test_softmax_output_ignore_label():
+    data = RNG.randn(5, 4).astype(np.float32)
+    label = np.array([1., 3., 2., 3., 0.], np.float32)
+    ig = 3.0
+    for norm in ("null", "batch", "valid"):
+        _, grad = _head_grad("SoftmaxOutput", data, label,
+                             normalization=norm, use_ignore=True,
+                             ignore_label=ig)
+        want = _softmax_output_ref_grad(data, label, normalization=norm,
+                                        use_ignore=True, ignore_label=ig)
+        np.testing.assert_allclose(grad, want, rtol=1e-5, atol=1e-7)
+        # ignored rows carry exactly zero gradient
+        np.testing.assert_allclose(grad[label == ig], 0.0)
+
+
+def test_softmax_output_smoothing():
+    data = RNG.randn(3, 6).astype(np.float32)
+    label = np.array([5., 0., 3.], np.float32)
+    _, grad = _head_grad("SoftmaxOutput", data, label,
+                         smooth_alpha=0.1)
+    want = _softmax_output_ref_grad(data, label, smooth_alpha=0.1)
+    np.testing.assert_allclose(grad, want, rtol=1e-5, atol=1e-7)
+
+
+def test_softmax_output_multi_output_spatial_normalization():
+    """multi_output divides by the spatial size s3[2] in null/batch
+    modes but not in valid mode (softmax_output-inl.h:211)."""
+    n, k, s = 2, 3, 4
+    data = RNG.randn(n, k, s).astype(np.float32)
+    label = RNG.randint(0, k, (n, s)).astype(np.float32)
+    p = _softmax(data, axis=1)
+    onehot = np.moveaxis(np.eye(k)[label.astype(int)], -1, 1)
+    base = p - onehot
+    for norm, denom in (("null", s), ("batch", s * n),
+                        ("valid", n * s)):
+        _, grad = _head_grad("SoftmaxOutput", data, label,
+                             multi_output=True, normalization=norm)
+        np.testing.assert_allclose(grad, base / denom, rtol=1e-5,
+                                   atol=1e-7)
+
+
+def test_softmax_output_probability_labels():
+    """label.shape == data.shape: grad = (out - label) * grad_scale,
+    no normalization (softmax_output-inl.h:160)."""
+    data = RNG.randn(3, 4).astype(np.float32)
+    label = _softmax(RNG.randn(3, 4)).astype(np.float32)
+    _, grad = _head_grad("SoftmaxOutput", data, label, grad_scale=1.5,
+                         normalization="batch")
+    np.testing.assert_allclose(grad, (_softmax(data) - label) * 1.5,
+                               rtol=1e-5, atol=1e-7)
+
+
+def test_softmax_alias_is_same_op():
+    from mxnet_tpu.ops.registry import get_op
+    assert get_op("Softmax") is get_op("SoftmaxOutput")
+
+
+# ---------------------------------------------------------------------------
+# SVMOutput
+# ---------------------------------------------------------------------------
+
+def test_svm_output_l1_hinge():
+    """L1_SVM (svm_output.cc:31): at the label column
+    -(margin > out)*reg, elsewhere +(margin > -out)*reg."""
+    margin, reg = 0.8, 0.7
+    data = RNG.randn(4, 5).astype(np.float32)
+    label = np.array([0., 4., 2., 2.], np.float32)
+    fwd, grad = _head_grad("SVMOutput", data, label, margin=margin,
+                           regularization_coefficient=reg,
+                           use_linear=True)
+    np.testing.assert_allclose(fwd, data, rtol=1e-6)
+    want = np.zeros_like(data)
+    for y in range(4):
+        kk = int(label[y])
+        for x in range(5):
+            if x == kk:
+                want[y, x] = -float(margin > data[y, x]) * reg
+            else:
+                want[y, x] = float(margin > -data[y, x]) * reg
+    np.testing.assert_allclose(grad, want, rtol=1e-5, atol=1e-7)
+
+
+def test_svm_output_l2_squared_hinge():
+    """L2_SVM (svm_output.cc:50): -2*reg*(margin - out) at the label
+    column when violated, +2*reg*(margin + out) elsewhere."""
+    margin, reg = 1.0, 0.5
+    data = RNG.randn(3, 4).astype(np.float32)
+    label = np.array([1., 0., 3.], np.float32)
+    _, grad = _head_grad("SVMOutput", data, label, margin=margin,
+                         regularization_coefficient=reg)
+    want = np.zeros_like(data)
+    for y in range(3):
+        kk = int(label[y])
+        for x in range(4):
+            if x == kk:
+                want[y, x] = (-2 * reg * (margin - data[y, x])
+                              if margin > data[y, x] else 0.0)
+            else:
+                want[y, x] = (2 * reg * (margin + data[y, x])
+                              if margin > -data[y, x] else 0.0)
+    np.testing.assert_allclose(grad, want, rtol=1e-5, atol=1e-7)
+
+
+# ---------------------------------------------------------------------------
+# Regression heads
+# ---------------------------------------------------------------------------
+
+def test_linear_regression_grad():
+    """minus kernel scaled by grad_scale/num_output
+    (regression_output.cc:94, regression_output-inl.h:200)."""
+    data = RNG.randn(4, 3).astype(np.float32)
+    label = RNG.randn(4, 3).astype(np.float32)
+    fwd, grad = _head_grad("LinearRegressionOutput", data, label,
+                           grad_scale=2.0)
+    np.testing.assert_allclose(fwd, data, rtol=1e-6)
+    np.testing.assert_allclose(grad, (data - label) * 2.0 / 3,
+                               rtol=1e-5, atol=1e-7)
+
+
+def test_logistic_regression_grad():
+    """sigmoid forward; minus backward — NOT sigmoid'(x)-weighted
+    (regression_output.cc:125-154)."""
+    data = RNG.randn(5, 2).astype(np.float32)
+    label = RNG.rand(5, 2).astype(np.float32)
+    fwd, grad = _head_grad("LogisticRegressionOutput", data, label)
+    sig = 1 / (1 + np.exp(-data))
+    np.testing.assert_allclose(fwd, sig, rtol=1e-5)
+    np.testing.assert_allclose(grad, (sig - label) / 2, rtol=1e-5,
+                               atol=1e-7)
+
+
+def test_mae_regression_grad():
+    """minus_sign kernel (regression_output.cc:122)."""
+    data = RNG.randn(4, 6).astype(np.float32)
+    label = RNG.randn(4, 6).astype(np.float32)
+    fwd, grad = _head_grad("MAERegressionOutput", data, label,
+                           grad_scale=3.0)
+    np.testing.assert_allclose(fwd, data, rtol=1e-6)
+    np.testing.assert_allclose(grad, np.sign(data - label) * 3.0 / 6,
+                               rtol=1e-5, atol=1e-7)
