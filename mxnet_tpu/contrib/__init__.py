@@ -2,15 +2,7 @@
 from . import quantization  # noqa: F401
 from . import text          # noqa: F401
 from . import svrg_optimization  # noqa: F401
+from . import onnx          # noqa: F401
 
-
-def onnx_export(*args, **kwargs):
-    """ONNX export requires the `onnx` package, which is not present in
-    this image (environment contract: no pip installs). The deploy
-    artifact path is `HybridBlock.export` / `Symbol.save` (symbol.json
-    + .params), loadable by `SymbolBlock.imports` (reference's own
-    language-agnostic deploy pair)."""
-    raise ImportError(
-        "onnx is not available in this environment; use "
-        "HybridBlock.export()/SymbolBlock.imports() for deployment "
-        "artifacts")
+# legacy alias kept from earlier rounds
+onnx_export = onnx.export_model

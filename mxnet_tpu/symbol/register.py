@@ -13,6 +13,7 @@ def make_stub(op):
         kwargs.pop("out", None)
         attr = kwargs.pop("attr", None)
         symbols = []
+        pos_attrs = []
         for a in args:
             if a is None:
                 continue
@@ -22,9 +23,15 @@ def make_stub(op):
                     and all(isinstance(x, Symbol) for x in a):
                 symbols.extend(a)
             else:
-                raise TypeError(
-                    "%s: positional arguments must be Symbols; pass operator"
-                    " parameters as keywords (got %r)" % (op.name, type(a)))
+                pos_attrs.append(a)
+        if pos_attrs:
+            # trailing positional parameters map onto the op's attrs in
+            # declaration order, matching the NDArray stubs and the
+            # reference's generated signatures (e.g. F.clip(x, 0, 6))
+            free = [k for k in op.defaults
+                    if k not in kwargs and not k.startswith("__")]
+            for k, v in zip(free, pos_attrs):
+                kwargs[k] = v
         named = {k: kwargs.pop(k) for k in list(kwargs)
                  if isinstance(kwargs[k], Symbol)}
         if named:

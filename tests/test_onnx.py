@@ -1,0 +1,113 @@
+"""ONNX interop (ref python/mxnet/contrib/onnx/). The converter layer
+is exercised without the onnx package via the graph IR: export a real
+model-zoo network to IR, import the IR back to a Symbol, and compare
+forward outputs. Proto-file tests run only when onnx is installed."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.contrib.onnx import (symbol_to_onnx_ir, ir_to_symbol,
+                                    export_model)
+
+
+def _trace_zoo(factory, size=32):
+    from mxnet_tpu.gluon.model_zoo import vision
+    net = getattr(vision, factory)()
+    net.initialize(mx.init.Xavier())
+    net.hybridize()
+    x = mx.nd.random.uniform(0, 1, (1, 3, size, size))
+    y = net(x)
+    sym = net._cached_graph[1]
+    params = {}
+    arg_names = set(sym.list_arguments())
+    aux_names = set(sym.list_auxiliary_states())
+    for name, p in net.collect_params().items():
+        if name in arg_names or name in aux_names:
+            params[name] = p.data().asnumpy()
+    return sym, params, x, y.asnumpy()
+
+
+def test_resnet18_ir_structure():
+    sym, params, x, _ = _trace_zoo("resnet18_v1")
+    ir = symbol_to_onnx_ir(sym, params, {"data0": x.shape})
+    ops = [n["op_type"] for n in ir["nodes"]]
+    for expected in ("Conv", "BatchNormalization", "Relu", "MaxPool",
+                     "Gemm", "Add", "Flatten"):
+        assert expected in ops, (expected, set(ops))
+    assert ir["inputs"] == [("data0", (1, 3, 32, 32))]
+    assert len(ir["outputs"]) == 1
+    # every param landed as an initializer
+    for name in params:
+        assert name in ir["initializers"], name
+    # fix_gamma BatchNorms export gamma as ones
+    bn0 = next(n for n in ir["nodes"]
+               if n["op_type"] == "BatchNormalization")
+    gamma = ir["initializers"][bn0["inputs"][1]]
+    np.testing.assert_allclose(gamma, 1.0)
+
+
+@pytest.mark.parametrize("factory,size",
+                         [("resnet18_v1", 32),
+                          ("mobilenet_v2_1_0", 32),
+                          ("squeezenet1_0", 224)])
+def test_export_import_roundtrip_matches_forward(factory, size):
+    """sym -> ONNX IR -> sym': identical forward outputs. This pins the
+    converter semantics in both directions without the onnx package."""
+    sym, params, x, y_ref = _trace_zoo(factory, size)
+    ir = symbol_to_onnx_ir(sym, params,
+                           {sym.list_arguments()[0]: x.shape}
+                           if sym.list_arguments()[0] not in params
+                           else {"data0": x.shape})
+    sym2, arg_params, aux_params = ir_to_symbol(ir)
+    data_name = [n for n in sym2.list_arguments()
+                 if n not in arg_params][0]
+    args = dict(arg_params)
+    args[data_name] = mx.nd.array(x.asnumpy())
+    ex = sym2.bind(mx.cpu(), args, aux_states=aux_params)
+    y2 = ex.forward(is_train=False)[0].asnumpy()
+    np.testing.assert_allclose(y2, y_ref, rtol=2e-4, atol=2e-5)
+
+
+def test_unsupported_op_raises_cleanly():
+    d = mx.sym.var("data")
+    out = mx.sym.create("arcsinh", [d], {})
+    with pytest.raises(mx.base.MXNetError, match="no converter"):
+        symbol_to_onnx_ir(out, {}, {"data": (2, 2)})
+
+
+def test_export_model_requires_onnx_for_protos(tmp_path):
+    """export_model runs the full IR build, then fails at the proto
+    step with a clear ImportError when onnx is absent; with onnx
+    installed it writes the file (exercised via importorskip below)."""
+    sym, params, x, _ = _trace_zoo("resnet18_v1")
+    try:
+        import onnx  # noqa: F401
+        have_onnx = True
+    except ImportError:
+        have_onnx = False
+    target = str(tmp_path / "resnet18.onnx")
+    if not have_onnx:
+        with pytest.raises(ImportError, match="onnx is not available"):
+            export_model(sym, params, {"data0": x.shape}, target)
+    else:
+        export_model(sym, params, {"data0": x.shape}, target)
+        from mxnet_tpu.contrib.onnx import import_model
+        sym2, args, aux = import_model(target)
+        assert "Conv" not in sym2.list_arguments()  # rebuilt mx graph
+
+
+def test_onnx_file_roundtrip_when_package_present(tmp_path):
+    onnx = pytest.importorskip("onnx")
+    del onnx
+    sym, params, x, y_ref = _trace_zoo("resnet18_v1")
+    target = str(tmp_path / "resnet18.onnx")
+    export_model(sym, params, {"data0": x.shape}, target)
+    from mxnet_tpu.contrib.onnx import import_model
+    sym2, arg_params, aux_params = import_model(target)
+    data_name = [n for n in sym2.list_arguments()
+                 if n not in arg_params][0]
+    args = dict(arg_params)
+    args[data_name] = mx.nd.array(x.asnumpy())
+    ex = sym2.bind(mx.cpu(), args, aux_states=aux_params)
+    y2 = ex.forward(is_train=False)[0].asnumpy()
+    np.testing.assert_allclose(y2, y_ref, rtol=2e-4, atol=2e-5)
