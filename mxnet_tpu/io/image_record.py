@@ -96,7 +96,13 @@ class ImageRecordIter(DataIter):
                     "ImageRecordIter(shuffle=True) needs the .idx "
                     "sidecar (pass path_imgidx; im2rec writes one) — "
                     "sequential .rec scans cannot be shuffled")
-            self._rec = MXRecordIO(path_imgrec, "r")
+            from . import native as _native
+            if _native.available():
+                # C++ prefetch thread stays ahead of decode (the
+                # reference's PrefetcherIter, iter_prefetcher.h:47)
+                self._rec = _native.PrefetchingRecordReader(path_imgrec)
+            else:
+                self._rec = MXRecordIO(path_imgrec, "r")
             self._keys = None           # sequential-scan mode
         self._lock = threading.Lock()   # serializes record reads
 

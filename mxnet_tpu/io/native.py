@@ -1,0 +1,161 @@
+"""ctypes bindings for the native IO runtime (native/io/recordio_io.cc
+— the C++ data-plane counterpart of the reference's src/io/: buffered
+RecordIO frame reading + a dmlc::ThreadedIter-style prefetch thread).
+
+The library is optional: ``available()`` is False when
+``native/build/libmxtpu_io.so`` has not been built (``make -C
+native``), and every consumer falls back to the pure-Python
+``mxnet_tpu.recordio`` path. ``MXNET_USE_NATIVE_IO=0`` disables it
+explicitly.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+
+from ..base import get_env
+
+__all__ = ["available", "lib_path", "NativeRecordReader",
+           "PrefetchingRecordReader"]
+
+_LIB = None
+_TRIED = False
+
+
+def lib_path():
+    here = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    return os.path.join(here, "native", "build", "libmxtpu_io.so")
+
+
+def _load():
+    global _LIB, _TRIED
+    if _TRIED:
+        return _LIB
+    _TRIED = True
+    if not get_env("MXNET_USE_NATIVE_IO", True, bool):
+        return None
+    path = lib_path()
+    if not os.path.exists(path):
+        return None
+    try:
+        lib = ctypes.CDLL(path)
+    except OSError:
+        return None
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    for prefix in ("mxtpu_rec", "mxtpu_prefetch"):
+        getattr(lib, prefix + "_open").restype = ctypes.c_void_p
+        nxt = getattr(lib, prefix + "_next")
+        nxt.restype = ctypes.c_int
+        nxt.argtypes = [ctypes.c_void_p, ctypes.POINTER(u8p),
+                        ctypes.POINTER(ctypes.c_uint64)]
+        getattr(lib, prefix + "_error").restype = ctypes.c_char_p
+        getattr(lib, prefix + "_error").argtypes = [ctypes.c_void_p]
+        getattr(lib, prefix + "_close").argtypes = [ctypes.c_void_p]
+    lib.mxtpu_rec_open.argtypes = [ctypes.c_char_p]
+    lib.mxtpu_rec_seek.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+    lib.mxtpu_prefetch_open.argtypes = [ctypes.c_char_p,
+                                        ctypes.c_uint64]
+    _LIB = lib
+    return _LIB
+
+
+def available():
+    return _load() is not None
+
+
+class _ReaderBase:
+    _prefix = None
+
+    def __init__(self, handle):
+        self._h = handle
+        self._lib = _load()
+
+    def _next(self):
+        u8p = ctypes.POINTER(ctypes.c_uint8)
+        data = u8p()
+        length = ctypes.c_uint64()
+        rc = getattr(self._lib, self._prefix + "_next")(
+            self._h, ctypes.byref(data), ctypes.byref(length))
+        if rc == 0:
+            return None
+        if rc < 0:
+            err = getattr(self._lib, self._prefix + "_error")(self._h)
+            raise RuntimeError((err or b"native IO error").decode())
+        return ctypes.string_at(data, length.value)
+
+    def read(self):
+        """One record's payload bytes, or None at end of stream —
+        the MXRecordIO.read contract."""
+        return self._next()
+
+    def __iter__(self):
+        while True:
+            rec = self._next()
+            if rec is None:
+                return
+            yield rec
+
+    def close(self):
+        if self._h is not None:
+            getattr(self._lib, self._prefix + "_close")(self._h)
+            self._h = None
+
+    __enter__ = lambda self: self
+    __exit__ = lambda self, *exc: self.close()
+    __del__ = lambda self: self.close()
+
+
+class NativeRecordReader(_ReaderBase):
+    """Sequential buffered .rec reader over the native library."""
+
+    _prefix = "mxtpu_rec"
+
+    def __init__(self, path):
+        lib = _load()
+        if lib is None:
+            raise RuntimeError(
+                "native IO library not built; run `make -C native` "
+                "or use mxnet_tpu.recordio.MXRecordIO")
+        h = lib.mxtpu_rec_open(os.fsencode(path))
+        if not h:
+            raise IOError("cannot open %s" % path)
+        super().__init__(h)
+        self._path = path
+
+    def seek(self, offset):
+        self._lib.mxtpu_rec_seek(self._h, int(offset))
+
+    def reset(self):
+        self.seek(0)
+
+
+class PrefetchingRecordReader(_ReaderBase):
+    """Background-thread prefetching reader (the PrefetcherIter /
+    dmlc::ThreadedIter role, ref iter_prefetcher.h:47): a C++ producer
+    thread stays ahead of the consumer up to ``capacity_bytes``."""
+
+    _prefix = "mxtpu_prefetch"
+
+    def __init__(self, path, capacity_bytes=64 << 20):
+        lib = _load()
+        if lib is None:
+            raise RuntimeError(
+                "native IO library not built; run `make -C native`")
+        h = lib.mxtpu_prefetch_open(os.fsencode(path),
+                                    int(capacity_bytes))
+        if not h:
+            raise IOError("cannot open %s" % path)
+        super().__init__(h)
+        self._path = path
+        self._capacity = int(capacity_bytes)
+
+    def reset(self):
+        """Restart the stream (prefetch threads cannot rewind — close
+        and reopen, like the reference prefetcher's BeforeFirst)."""
+        self.close()
+        h = self._lib.mxtpu_prefetch_open(os.fsencode(self._path),
+                                          self._capacity)
+        if not h:
+            raise IOError("cannot reopen %s" % self._path)
+        self._h = h
