@@ -23,6 +23,13 @@ import numpy as np
 
 from .registry import register, get_op
 
+
+def _index_dtype():
+    """int64 when x64/int64-tensor mode is on, else int32 — explicit,
+    so jax never warns about implicit truncation."""
+    from ..util import canonical_dtype
+    return jnp.dtype(canonical_dtype(np.int64))
+
 _D = ("data",)
 
 
@@ -303,7 +310,8 @@ register("_histogram", _histogram, arg_names=("data", "bins"),
 
 def _ravel_multi_index(attrs, data):
     shape = tuple(int(s) for s in attrs["shape"])
-    idx = [data[i].astype(jnp.int64) for i in range(len(shape))]
+    it = _index_dtype()
+    idx = [data[i].astype(it) for i in range(len(shape))]
     return jnp.ravel_multi_index(idx, shape, mode="clip") \
         .astype(data.dtype)
 
@@ -314,7 +322,7 @@ register("_ravel_multi_index", _ravel_multi_index, arg_names=_D,
 
 def _unravel_index(attrs, data):
     shape = tuple(int(s) for s in attrs["shape"])
-    unraveled = jnp.unravel_index(data.astype(jnp.int64).reshape(-1),
+    unraveled = jnp.unravel_index(data.astype(_index_dtype()).reshape(-1),
                                   shape)
     return jnp.stack(unraveled, axis=0).reshape(
         (len(shape),) + data.shape).astype(data.dtype)
@@ -635,7 +643,7 @@ register("_contrib_gradientmultiplier", _gradient_multiplier,
 
 def _getnnz(attrs, data):
     axis = attrs.get("axis", None)
-    return jnp.sum((data != 0).astype(jnp.int64), axis=axis)
+    return jnp.sum((data != 0).astype(_index_dtype()), axis=axis)
 
 
 register("_contrib_getnnz", _getnnz, arg_names=_D,
