@@ -73,6 +73,7 @@ from .kvstore import KVStore
 
 from . import io
 from . import recordio
+from . import rtc
 from . import callback
 from . import monitor
 from . import visualization

@@ -1,0 +1,75 @@
+"""mx.rtc — runtime Pallas-from-source kernels (ref python/mxnet/rtc.py
+CudaModule/CudaKernel; SURVEY §7 "RTC = Pallas-from-source"). Runs in
+interpret mode on the CPU mesh; on a TPU the same kernels compile to
+Mosaic."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+AXPY_SRC = """
+def axpy(alpha, x_ref, y_ref):
+    y_ref[...] = y_ref[...] + alpha * x_ref[...]
+
+def scale_rows(x_ref, out_ref):
+    i = pl.program_id(0)
+    out_ref[i, :] = x_ref[i, :] * (i + 1)
+"""
+
+
+def test_axpy_in_out_semantics():
+    mod = mx.rtc.PallasModule(AXPY_SRC)
+    k = mod.get_kernel("axpy",
+                       "float alpha, const float *x, float *y")
+    x = mx.nd.array(np.arange(6, dtype=np.float32).reshape(2, 3))
+    y = mx.nd.ones((2, 3))
+    k.launch((2.0, x, y), mx.cpu(), (1, 1, 1), (1, 1, 1))
+    np.testing.assert_allclose(
+        y.asnumpy(), 1.0 + 2.0 * np.arange(6).reshape(2, 3))
+    # x (const) untouched
+    np.testing.assert_allclose(x.asnumpy(),
+                               np.arange(6).reshape(2, 3))
+
+
+def test_grid_maps_to_pallas_grid():
+    mod = mx.rtc.PallasModule(AXPY_SRC)
+    k = mod.get_kernel("scale_rows", "const float *x, float *out")
+    x = mx.nd.ones((4, 5))
+    out = mx.nd.zeros((4, 5))
+    k.launch((x, out), mx.cpu(), (4, 1, 1), (1, 1, 1))
+    want = np.ones((4, 5)) * np.arange(1, 5)[:, None]
+    np.testing.assert_allclose(out.asnumpy(), want)
+
+
+def test_signature_grammar_matches_reference():
+    mod = mx.rtc.PallasModule(AXPY_SRC)
+    # reference grammar: names optional, const marks inputs
+    k = mod.get_kernel("axpy", "float, const float *, float *")
+    assert k._is_const == [False, True, False]
+    assert k._is_ndarray == [False, True, True]
+    with pytest.raises(ValueError):
+        mod.get_kernel("axpy", "const const *x")
+    with pytest.raises(TypeError):
+        mod.get_kernel("axpy", "quaternion *x")
+    with pytest.raises(mx.base.MXNetError):
+        mod.get_kernel("missing", "float *x")
+
+
+def test_launch_validation():
+    mod = mx.rtc.PallasModule(AXPY_SRC)
+    k = mod.get_kernel("axpy",
+                       "float alpha, const float *x, float *y")
+    x = mx.nd.ones((2,))
+    y = mx.nd.ones((2,))
+    with pytest.raises(mx.base.MXNetError, match="block_dims"):
+        k.launch((1.0, x, y), mx.cpu(), (1, 1, 1), (2, 1, 1))
+    with pytest.raises(mx.base.MXNetError, match="expects 3"):
+        k.launch((x, y), mx.cpu(), (1, 1, 1), (1, 1, 1))
+    # read-only signature (no writable array) is rejected up front
+    k2 = mod.get_kernel("axpy", "float a, const float *x, const float *y")
+    with pytest.raises(mx.base.MXNetError, match="no writable"):
+        k2.launch((1.0, x, y), mx.cpu(), (1, 1, 1), (1, 1, 1))
+
+
+def test_cudamodule_alias():
+    assert mx.rtc.CudaModule is mx.rtc.PallasModule
