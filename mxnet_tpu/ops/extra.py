@@ -576,10 +576,15 @@ def _group_adagrad_update(attrs, weight, grad, history):
     clip = attrs.get("clip_gradient")
     if clip is not None and clip > 0:
         g = jnp.clip(g, -float(clip), float(clip))
-    grp = jnp.mean(g * g, axis=tuple(range(1, g.ndim)), keepdims=True)
-    hist_new = history.astype(jnp.float32) + grp
+    # reference state shape is (rows,) (contrib/optimizer_op.cc
+    # GroupAdagrad Shape1(weight.shape[0])); a keepdims-shaped state
+    # from older checkpoints is accepted too
+    grp = jnp.mean(g * g, axis=tuple(range(1, g.ndim)))
+    h32 = history.astype(jnp.float32)
+    hist_new = h32 + grp.reshape(h32.shape)
+    bcast = hist_new.reshape((-1,) + (1,) * (g.ndim - 1))
     w_new = weight.astype(jnp.float32) - lr * g / (
-        jnp.sqrt(hist_new) + eps)
+        jnp.sqrt(bcast) + eps)
     return w_new.astype(weight.dtype), hist_new.astype(history.dtype)
 
 

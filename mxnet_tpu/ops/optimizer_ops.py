@@ -25,6 +25,23 @@ def _prep_grad(grad, attrs):
     return g
 
 
+def _prep_grad_wd(grad, weight, attrs):
+    """adam/rmsprop/ftml-family ordering (optimizer_op-inl.h:1153,
+    1546): fold wd into the gradient FIRST, then clip the sum — unlike
+    the sgd family, which clips the rescaled gradient alone."""
+    g = grad * float(attrs.get("rescale_grad", 1.0)) \
+        + float(attrs.get("wd", 0.0)) * weight
+    clip = float(attrs.get("clip_gradient", -1.0))
+    if clip > 0:
+        g = jnp.clip(g, -clip, clip)
+    return g
+
+
+def _clip_weights(w, attrs):
+    cw = float(attrs.get("clip_weights", -1.0))
+    return jnp.clip(w, -cw, cw) if cw > 0 else w
+
+
 _COMMON = {"lr": 0.01, "wd": 0.0, "rescale_grad": 1.0, "clip_gradient": -1.0,
            "lazy_update": True}
 
@@ -98,13 +115,11 @@ register("nag_mom_update", _nag_mom_update,
 
 
 def _adam_update(attrs, weight, grad, mean, var):
-    g = _prep_grad(grad, attrs)
+    g = _prep_grad_wd(grad, weight, attrs)
     lr = float(attrs["lr"])
-    wd = float(attrs.get("wd", 0.0))
     b1 = float(attrs.get("beta1", 0.9))
     b2 = float(attrs.get("beta2", 0.999))
     eps = float(attrs.get("epsilon", 1e-8))
-    g = g + wd * weight
     new_mean = b1 * mean + (1 - b1) * g
     new_var = b2 * var + (1 - b2) * jnp.square(g)
     new_w = weight - lr * new_mean / (jnp.sqrt(new_var) + eps)
@@ -118,39 +133,39 @@ register("adam_update", _adam_update,
 
 
 def _rmsprop_update(attrs, weight, grad, n):
-    g = _prep_grad(grad, attrs)
+    g = _prep_grad_wd(grad, weight, attrs)
     lr = float(attrs["lr"])
-    wd = float(attrs.get("wd", 0.0))
     rho = float(attrs.get("gamma1", 0.95))
     eps = float(attrs.get("epsilon", 1e-8))
-    g = g + wd * weight
     new_n = rho * n + (1 - rho) * jnp.square(g)
-    return weight - lr * g / jnp.sqrt(new_n + eps), new_n
+    new_w = weight - lr * g / jnp.sqrt(new_n + eps)
+    return _clip_weights(new_w, attrs), new_n
 
 
 register("rmsprop_update", _rmsprop_update,
          arg_names=("weight", "grad", "n"),
-         defaults=dict(_COMMON, gamma1=0.95, epsilon=1e-8),
+         defaults=dict(_COMMON, gamma1=0.95, epsilon=1e-8,
+                       clip_weights=-1.0),
          mutable_inputs=(2,))
 
 
 def _rmspropalex_update(attrs, weight, grad, n, g_acc, delta):
-    g = _prep_grad(grad, attrs)
+    g = _prep_grad_wd(grad, weight, attrs)
     lr = float(attrs["lr"])
-    wd = float(attrs.get("wd", 0.0))
     rho = float(attrs.get("gamma1", 0.95))
     mu = float(attrs.get("gamma2", 0.9))
     eps = float(attrs.get("epsilon", 1e-8))
-    g = g + wd * weight
     new_n = rho * n + (1 - rho) * jnp.square(g)
     new_g = rho * g_acc + (1 - rho) * g
     new_delta = mu * delta - lr * g / jnp.sqrt(new_n - jnp.square(new_g) + eps)
-    return weight + new_delta, new_n, new_g, new_delta
+    return (_clip_weights(weight + new_delta, attrs), new_n, new_g,
+            new_delta)
 
 
 register("rmspropalex_update", _rmspropalex_update,
          arg_names=("weight", "grad", "n", "g", "delta"),
-         defaults=dict(_COMMON, gamma1=0.95, gamma2=0.9, epsilon=1e-8),
+         defaults=dict(_COMMON, gamma1=0.95, gamma2=0.9, epsilon=1e-8,
+                       clip_weights=-1.0),
          mutable_inputs=(2, 3, 4))
 
 
@@ -218,14 +233,12 @@ register("signum_update", _signum_update, arg_names=("weight", "grad", "mom"),
 
 
 def _ftml_update(attrs, weight, grad, d, v, z):
-    g = _prep_grad(grad, attrs)
+    g = _prep_grad_wd(grad, weight, attrs)
     lr = float(attrs["lr"])
-    wd = float(attrs.get("wd", 0.0))
     b1 = float(attrs.get("beta1", 0.6))
     b2 = float(attrs.get("beta2", 0.999))
     eps = float(attrs.get("epsilon", 1e-8))
     t = int(attrs.get("t", 1))
-    g = g + wd * weight
     new_v = b2 * v + (1 - b2) * jnp.square(g)
     d_t = (1 - b1 ** t) / lr * (jnp.sqrt(new_v / (1 - b2 ** t)) + eps)
     sigma = d_t - b1 * d

@@ -330,30 +330,21 @@ ANALYTIC = {
         "exact minus_sign pin (test_head_op_gradients)",
 }
 
+# parameter-mutating optimizer kernels: each pinned EXACTLY against a
+# numpy transcription of the reference kernel, incl. wd and both
+# clip_gradient settings, in tests/test_optimizer_kernels.py
+for _n in ("sgd_update", "sgd_mom_update", "mp_sgd_update",
+           "mp_sgd_mom_update", "nag_mom_update", "adam_update",
+           "rmsprop_update", "rmspropalex_update", "ftrl_update",
+           "ftml_update", "signsgd_update", "signum_update",
+           "adagrad_update", "_sparse_adagrad_update",
+           "_contrib_group_adagrad_update", "_contrib_adamw_update",
+           "_contrib_mp_adamw_update", "multi_sgd_update",
+           "multi_sgd_mom_update", "multi_mp_sgd_update",
+           "multi_mp_sgd_mom_update"):
+    ANALYTIC[_n] = "exact reference-kernel pin (test_optimizer_kernels)"
+
 WAIVED = {
-    # parameter-mutating optimizer kernels: pinned against the
-    # reference's update math in test_operator.py optimizer tests
-    "sgd_update": "optimizer kernel (test_operator)",
-    "sgd_mom_update": "optimizer kernel (test_operator)",
-    "mp_sgd_update": "optimizer kernel (test_operator)",
-    "mp_sgd_mom_update": "optimizer kernel (test_operator)",
-    "multi_sgd_update": "optimizer kernel (test_operator)",
-    "multi_sgd_mom_update": "optimizer kernel (test_operator)",
-    "multi_mp_sgd_update": "optimizer kernel (test_operator)",
-    "multi_mp_sgd_mom_update": "optimizer kernel (test_operator)",
-    "adam_update": "optimizer kernel (test_operator)",
-    "nag_mom_update": "optimizer kernel (test_operator)",
-    "rmsprop_update": "optimizer kernel (test_operator)",
-    "rmspropalex_update": "optimizer kernel (test_operator)",
-    "ftml_update": "optimizer kernel (test_operator)",
-    "ftrl_update": "optimizer kernel (test_operator)",
-    "signsgd_update": "optimizer kernel (test_operator)",
-    "signum_update": "optimizer kernel (test_operator)",
-    "adagrad_update": "optimizer kernel (test_operator)",
-    "_sparse_adagrad_update": "optimizer kernel (test_sparse)",
-    "_contrib_group_adagrad_update": "optimizer kernel (test_operator)",
-    "_contrib_adamw_update": "optimizer kernel (test_operator)",
-    "_contrib_mp_adamw_update": "optimizer kernel (test_operator)",
     # stochastic samplers: no meaningful numeric gradient
     "_random_uniform": "sampler", "_random_normal": "sampler",
     "_random_gamma": "sampler", "_random_exponential": "sampler",
@@ -476,9 +467,10 @@ def test_every_op_swept_or_waived():
     assert not waived_unknown
     # the ANALYTIC category is honest only if every entry really has
     # its dedicated exact-gradient test
-    from test_head_op_gradients import ANALYTIC_COVERED
-    assert set(ANALYTIC) == set(ANALYTIC_COVERED), (
-        set(ANALYTIC) ^ set(ANALYTIC_COVERED))
+    from test_head_op_gradients import ANALYTIC_COVERED as _heads
+    from test_optimizer_kernels import ANALYTIC_COVERED as _optims
+    covered = set(_heads) | set(_optims)
+    assert set(ANALYTIC) == covered, (set(ANALYTIC) ^ covered)
     analytic_unknown = [n for n in ANALYTIC if find_op(n) is None]
     assert not analytic_unknown
 

@@ -1,0 +1,375 @@
+"""Exact pins for every optimizer update kernel against the reference
+formulas (src/operator/optimizer_op-inl.h; adamw in
+src/operator/contrib/adamw.cc). These ops mutate parameters rather
+than propagate cotangents, so the numeric-gradient sweep cannot apply;
+each is asserted EXACTLY against a numpy transcription of the
+reference kernel, with wd != 0 and both clip_gradient settings.
+
+Clip-placement contract (the round-5 parity fix): the sgd family clips
+the RESCALED GRADIENT ALONE, while adam/rmsprop/rmspropalex/ftml fold
+wd into the gradient FIRST and clip the sum.
+"""
+import numpy as np
+
+import mxnet_tpu as mx
+
+# consumed by tests/test_grad_sweep.py's accounting meta-test
+ANALYTIC_COVERED = (
+    "sgd_update", "sgd_mom_update", "mp_sgd_update",
+    "mp_sgd_mom_update", "nag_mom_update", "adam_update",
+    "rmsprop_update", "rmspropalex_update", "ftrl_update",
+    "ftml_update", "signsgd_update", "signum_update",
+    "adagrad_update", "_sparse_adagrad_update",
+    "_contrib_group_adagrad_update", "_contrib_adamw_update",
+    "_contrib_mp_adamw_update", "multi_sgd_update",
+    "multi_sgd_mom_update", "multi_mp_sgd_update",
+    "multi_mp_sgd_mom_update",
+)
+
+RNG = np.random.RandomState(31)
+LR, WD, MOM = 0.13, 0.07, 0.9
+RS = 1.7                                 # rescale_grad
+CLIPS = (-1.0, 0.4)                      # without / with clipping
+
+
+def _wgv(shape=(5,)):
+    w = RNG.uniform(-1, 1, shape).astype(np.float64)
+    g = RNG.uniform(-1, 1, shape).astype(np.float64)
+    return w, g
+
+
+def _clip(x, c):
+    return np.clip(x, -c, c) if c > 0 else x
+
+
+def _run(op, arrays, **attrs):
+    """Invoke the registered op on float32 copies; returns outputs and
+    the mutated state arrays."""
+    nds = [mx.nd.array(a.astype(np.float32)) for a in arrays]
+    from mxnet_tpu.ops.registry import get_op
+    from mxnet_tpu.ndarray.ndarray import invoke_nd
+    out = invoke_nd(get_op(op), nds, dict(attrs))
+    outs = out if isinstance(out, list) else [out]
+    return [o.asnumpy() for o in outs], [n.asnumpy() for n in nds]
+
+
+def _assert(a, b):
+    np.testing.assert_allclose(a, b, rtol=2e-5, atol=2e-6)
+
+
+def test_sgd_update():
+    for clip in CLIPS:
+        w, g = _wgv()
+        (out,), _ = _run("sgd_update", [w, g], lr=LR, wd=WD,
+                         rescale_grad=RS, clip_gradient=clip)
+        # SGDKernel: clip the rescaled grad alone, wd separate
+        _assert(out, w - LR * (_clip(RS * g, clip) + WD * w))
+
+
+def test_sgd_mom_update():
+    for clip in CLIPS:
+        w, g = _wgv()
+        mom = RNG.uniform(-1, 1, w.shape)
+        (out,), st = _run("sgd_mom_update", [w, g, mom], lr=LR, wd=WD,
+                          momentum=MOM, rescale_grad=RS,
+                          clip_gradient=clip)
+        want_mom = MOM * mom - LR * (_clip(RS * g, clip) + WD * w)
+        _assert(st[2], want_mom)
+        _assert(out, w + want_mom)
+
+
+def test_mp_sgd_updates():
+    w, g = _wgv()
+    w32 = w.copy()
+    (out,), st = _run("mp_sgd_update", [w, g, w32], lr=LR, wd=WD,
+                      rescale_grad=RS)
+    want = w - LR * (RS * g + WD * w)
+    _assert(st[2], want)
+    _assert(out, want)
+    mom = np.zeros_like(w)
+    (out2,), st2 = _run("mp_sgd_mom_update", [w, g, mom, w32], lr=LR,
+                        wd=WD, momentum=MOM, rescale_grad=RS)
+    want_mom = -LR * (RS * g + WD * w)
+    _assert(st2[2], want_mom)
+    _assert(out2, w + want_mom)
+
+
+def test_nag_mom_update():
+    """python/mxnet/optimizer NAG: state = mom*state + (g + wd*w);
+    w -= lr*(g + wd*w + mom*state)."""
+    for clip in CLIPS:
+        w, g = _wgv()
+        mom = RNG.uniform(-1, 1, w.shape)
+        (out,), st = _run("nag_mom_update", [w, g, mom], lr=LR, wd=WD,
+                          momentum=MOM, rescale_grad=RS,
+                          clip_gradient=clip)
+        gw = _clip(RS * g, clip) + WD * w
+        want_mom = MOM * mom + gw
+        _assert(st[2], want_mom)
+        _assert(out, w - LR * (gw + MOM * want_mom))
+
+
+def test_adam_update():
+    """optimizer_op-inl.h:1153: grad = rescale*g + wd*w THEN clip."""
+    b1, b2, eps = 0.9, 0.999, 1e-6
+    for clip in CLIPS:
+        w, g = _wgv()
+        mean = RNG.uniform(-0.5, 0.5, w.shape)
+        var = RNG.uniform(0.1, 0.5, w.shape)
+        (out,), st = _run("adam_update", [w, g, mean, var], lr=LR,
+                          wd=WD, beta1=b1, beta2=b2, epsilon=eps,
+                          rescale_grad=RS, clip_gradient=clip)
+        gw = _clip(RS * g + WD * w, clip)
+        want_mean = b1 * mean + (1 - b1) * gw
+        want_var = b2 * var + (1 - b2) * gw ** 2
+        _assert(st[2], want_mean)
+        _assert(st[3], want_var)
+        _assert(out, w - LR * want_mean / (np.sqrt(want_var) + eps))
+
+
+def test_rmsprop_update():
+    """optimizer_op-inl.h:1546: wd folded before clip; sqrt(n + eps);
+    optional clip_weights."""
+    gamma1, eps = 0.95, 1e-6
+    for clip in CLIPS:
+        w, g = _wgv()
+        n = RNG.uniform(0.1, 0.5, w.shape)
+        (out,), st = _run("rmsprop_update", [w, g, n], lr=LR, wd=WD,
+                          gamma1=gamma1, epsilon=eps, rescale_grad=RS,
+                          clip_gradient=clip)
+        gw = _clip(RS * g + WD * w, clip)
+        want_n = (1 - gamma1) * gw ** 2 + gamma1 * n
+        _assert(st[2], want_n)
+        _assert(out, w - LR * gw / np.sqrt(want_n + eps))
+    # clip_weights clamps the result
+    w, g = _wgv()
+    n = np.full(w.shape, 0.2)
+    (out,), _ = _run("rmsprop_update", [w * 10, g, n], lr=LR, wd=0.0,
+                     gamma1=gamma1, epsilon=eps, clip_weights=0.5)
+    assert np.all(np.abs(out) <= 0.5 + 1e-6)
+
+
+def test_rmspropalex_update():
+    gamma1, gamma2, eps = 0.95, 0.9, 1e-6
+    for clip in CLIPS:
+        w, g = _wgv()
+        n = RNG.uniform(0.3, 0.6, w.shape)
+        ga = RNG.uniform(-0.2, 0.2, w.shape)
+        delta = RNG.uniform(-0.1, 0.1, w.shape)
+        (out,), st = _run("rmspropalex_update", [w, g, n, ga, delta],
+                          lr=LR, wd=WD, gamma1=gamma1, gamma2=gamma2,
+                          epsilon=eps, rescale_grad=RS,
+                          clip_gradient=clip)
+        gw = _clip(RS * g + WD * w, clip)
+        want_n = (1 - gamma1) * gw ** 2 + gamma1 * n
+        want_g = (1 - gamma1) * gw + gamma1 * ga
+        want_d = gamma2 * delta - LR * gw / np.sqrt(
+            want_n - want_g ** 2 + eps)
+        _assert(st[2], want_n)
+        _assert(st[3], want_g)
+        _assert(st[4], want_d)
+        _assert(out, w + want_d)
+
+
+def test_ftrl_update():
+    """optimizer_op-inl.h:1641: z += g - (sqrt(n+g^2)-sqrt(n))*w/lr;
+    n += g^2; out = (sign(z)*l1 - z)/((beta+sqrt(n))/lr + wd)*(|z|>l1)."""
+    l1, beta = 0.1, 1.0
+    for clip in CLIPS:
+        w, g = _wgv()
+        z = RNG.uniform(-0.5, 0.5, w.shape)
+        n = RNG.uniform(0.1, 0.4, w.shape)
+        (out,), st = _run("ftrl_update", [w, g, z, n], lr=LR, wd=WD,
+                          lamda1=l1, beta=beta, rescale_grad=RS,
+                          clip_gradient=clip)
+        gw = _clip(RS * g, clip)
+        want_z = z + gw - (np.sqrt(n + gw ** 2) - np.sqrt(n)) * w / LR
+        want_n = n + gw ** 2
+        want = (np.sign(want_z) * l1 - want_z) / (
+            (beta + np.sqrt(want_n)) / LR + WD) \
+            * (np.abs(want_z) > l1)
+        _assert(st[2], want_z)
+        _assert(st[3], want_n)
+        _assert(out, want)
+
+
+def test_ftml_update():
+    """optimizer_op-inl.h:1048."""
+    b1, b2, eps, t = 0.6, 0.999, 1e-6, 3
+    for clip in CLIPS:
+        w, g = _wgv()
+        d = RNG.uniform(0.5, 1.5, w.shape)
+        v = RNG.uniform(0.1, 0.4, w.shape)
+        z = RNG.uniform(-0.5, 0.5, w.shape)
+        (out,), st = _run("ftml_update", [w, g, d, v, z], lr=LR, wd=WD,
+                          beta1=b1, beta2=b2, epsilon=eps, t=t,
+                          rescale_grad=RS, clip_gradient=clip)
+        gw = _clip(RS * g + WD * w, clip)
+        want_v = b2 * v + (1 - b2) * gw ** 2
+        d_t = (1 - b1 ** t) / LR * (
+            np.sqrt(want_v / (1 - b2 ** t)) + eps)
+        want_z = b1 * z + (1 - b1) * gw - (d_t - b1 * d) * w
+        _assert(st[3], want_v)
+        _assert(st[2], d_t)
+        _assert(st[4], want_z)
+        _assert(out, -want_z / d_t)
+
+
+def test_signsgd_update():
+    w, g = _wgv()
+    (out,), _ = _run("signsgd_update", [w, g], lr=LR, wd=WD,
+                     rescale_grad=RS)
+    # optimizer_op-inl.h:1820: (1 - lr*wd)*w - lr*sign(g)
+    _assert(out, (1 - LR * WD) * w - LR * np.sign(RS * g))
+
+
+def test_signum_update():
+    wd_lh = 0.02
+    for clip in CLIPS:
+        w, g = _wgv()
+        mom = RNG.uniform(-1, 1, w.shape)
+        (out,), st = _run("signum_update", [w, g, mom], lr=LR, wd=WD,
+                          momentum=MOM, wd_lh=wd_lh, rescale_grad=RS,
+                          clip_gradient=clip)
+        # optimizer_op-inl.h:1888
+        want_mom = MOM * mom - (1 - MOM) * WD * w \
+            - (1 - MOM) * _clip(RS * g, clip)
+        _assert(st[2], want_mom)
+        _assert(out, (1 - LR * wd_lh) * w + LR * np.sign(want_mom))
+
+
+def test_adagrad_update():
+    """optimizer_op-inl.h:1983 (the op requires wd == 0 in the
+    reference): state += g^2; out = w - lr*g/sqrt(state + eps)."""
+    eps = 1e-6
+    for clip in CLIPS:
+        w, g = _wgv()
+        h = RNG.uniform(0.1, 0.4, w.shape)
+        (out,), st = _run("adagrad_update", [w, g, h], lr=LR, wd=0.0,
+                          epsilon=eps, rescale_grad=RS,
+                          clip_gradient=clip)
+        gw = _clip(RS * g, clip)
+        want_h = h + gw ** 2
+        _assert(st[2], want_h)
+        _assert(out, w - LR * gw / np.sqrt(want_h + eps))
+
+
+def test_group_adagrad_update():
+    """contrib group-adagrad (row-wise accumulator)."""
+    w = RNG.uniform(-1, 1, (4, 3))
+    g = RNG.uniform(-1, 1, (4, 3))
+    h = RNG.uniform(0.1, 0.4, (4,))
+    eps = 1e-5
+    (out,), st = _run("_contrib_group_adagrad_update", [w, g, h],
+                      lr=LR, epsilon=eps, rescale_grad=RS)
+    gw = RS * g
+    want_h = h + np.mean(gw ** 2, axis=1)
+    _assert(st[2], want_h)
+    _assert(out, w - LR * gw / (np.sqrt(want_h) + eps)[:, None])
+
+
+def test_adamw_update():
+    """contrib/adamw.cc: decoupled wd — NOT folded into the gradient;
+    w -= eta*(lr*mean/(sqrt(var)+eps) + wd*w)."""
+    b1, b2, eps, eta = 0.9, 0.999, 1e-6, 0.8
+    for clip in CLIPS:
+        w, g = _wgv()
+        mean = RNG.uniform(-0.5, 0.5, w.shape)
+        var = RNG.uniform(0.1, 0.5, w.shape)
+        (out,), st = _run("_contrib_adamw_update", [w, g, mean, var],
+                          lr=LR, wd=WD, beta1=b1, beta2=b2,
+                          epsilon=eps, eta=eta, rescale_grad=RS,
+                          clip_gradient=clip)
+        gw = _clip(RS * g, clip)
+        want_mean = b1 * mean + (1 - b1) * gw
+        want_var = b2 * var + (1 - b2) * gw ** 2
+        _assert(st[2], want_mean)
+        _assert(st[3], want_var)
+        _assert(out, w - eta * (
+            LR * want_mean / (np.sqrt(want_var) + eps) + WD * w))
+
+
+def test_mp_adamw_update():
+    """contrib/adamw.cc multi-precision form: tensor rescale (the
+    loss-scale reciprocal) scales the grad, fp32 master takes the
+    decoupled-wd update, low-precision weight is its cast."""
+    b1, b2, eps, eta = 0.9, 0.999, 1e-6, 0.8
+    w, g = _wgv()
+    mean = RNG.uniform(-0.5, 0.5, w.shape)
+    var = RNG.uniform(0.1, 0.5, w.shape)
+    w32 = w.copy()
+    rescale = np.array([RS], np.float32)
+    (out,), st = _run("_contrib_mp_adamw_update",
+                      [w, g, mean, var, w32, rescale], lr=LR, wd=WD,
+                      beta1=b1, beta2=b2, epsilon=eps, eta=eta)
+    gw = RS * g
+    want_mean = b1 * mean + (1 - b1) * gw
+    want_var = b2 * var + (1 - b2) * gw ** 2
+    want = w - eta * (LR * want_mean / (np.sqrt(want_var) + eps)
+                      + WD * w)
+    _assert(st[2], want_mean)
+    _assert(st[3], want_var)
+    _assert(st[4], want)             # fp32 master
+    _assert(out, want)               # low-precision cast
+
+
+def test_multi_mp_sgd_updates():
+    """multi_mp_* variants keep an fp32 master per weight."""
+    shapes = [(3,), (2, 2)]
+    ws = [RNG.uniform(-1, 1, s) for s in shapes]
+    gs = [RNG.uniform(-1, 1, s) for s in shapes]
+    w32s = [w.copy() for w in ws]
+    lrs, wds = (0.1, 0.2), (0.0, 0.01)
+    flat = []
+    for w, g, w32 in zip(ws, gs, w32s):
+        flat.extend([w, g, w32])
+    outs, _ = _run("multi_mp_sgd_update", flat, num_weights=2,
+                   lrs=lrs, wds=wds, rescale_grad=RS)
+    assert len(outs) == 4            # (weight, master) pairs
+    for i, (w, g, lr, wd) in enumerate(zip(ws, gs, lrs, wds)):
+        want = w - lr * (RS * g + wd * w)
+        _assert(outs[2 * i], want)
+        _assert(outs[2 * i + 1], want)
+    moms = [np.zeros(s) for s in shapes]
+    flat = []
+    for w, g, m, w32 in zip(ws, gs, moms, w32s):
+        flat.extend([w, g, m, w32])
+    outs, _ = _run("multi_mp_sgd_mom_update", flat, num_weights=2,
+                   lrs=lrs, wds=wds, momentum=MOM, rescale_grad=RS)
+    assert len(outs) == 6            # (weight, mom, master) triples
+    for i, (w, g, lr, wd) in enumerate(zip(ws, gs, lrs, wds)):
+        want_mom = -lr * (RS * g + wd * w)
+        _assert(outs[3 * i + 1], want_mom)
+        _assert(outs[3 * i], w + want_mom)
+        _assert(outs[3 * i + 2], w + want_mom)
+
+
+def test_multi_sgd_updates():
+    """multi-tensor aggregation applies the scalar kernels per pair."""
+    shapes = [(3,), (2, 2), (4,)]
+    ws = [RNG.uniform(-1, 1, s) for s in shapes]
+    gs = [RNG.uniform(-1, 1, s) for s in shapes]
+    lrs = (0.1, 0.2, 0.3)
+    wds = (0.0, 0.01, 0.02)
+    flat = []
+    for w, g in zip(ws, gs):
+        flat.extend([w, g])
+    outs, _ = _run("multi_sgd_update", flat, num_weights=3, lrs=lrs,
+                   wds=wds, rescale_grad=RS)
+    for o, w, g, lr, wd in zip(outs, ws, gs, lrs, wds):
+        _assert(o, w - lr * (RS * g + wd * w))
+    # momentum variant
+    moms = [np.zeros(s) for s in shapes]
+    flat = []
+    for w, g, m in zip(ws, gs, moms):
+        flat.extend([w, g, m])
+    # the mom variant returns (weight, mom) pairs per weight (this
+    # framework's functional-state convention; the reference mutates
+    # mom in place instead) — values must match the reference kernel
+    outs, _ = _run("multi_sgd_mom_update", flat, num_weights=3,
+                   lrs=lrs, wds=wds, momentum=MOM, rescale_grad=RS)
+    assert len(outs) == 6
+    for i, (w, g, lr, wd) in enumerate(zip(ws, gs, lrs, wds)):
+        want_mom = -lr * (RS * g + wd * w)      # zero initial momentum
+        _assert(outs[2 * i + 1], want_mom)
+        _assert(outs[2 * i], w + want_mom)
