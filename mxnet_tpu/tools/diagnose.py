@@ -1,0 +1,110 @@
+"""Environment diagnosis (parity: tools/diagnose.py, minus the
+network-reachability section — this environment has zero egress, so
+the equivalent signal is backend reachability: a short-timeout
+subprocess probe of the accelerator, the same probe bench.py and the
+TPU test lane use).
+
+Run: ``python -m mxnet_tpu.tools.diagnose``.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import platform
+import subprocess
+import sys
+
+
+def diagnose_python():
+    print("----------Python Info----------")
+    print("Version      :", platform.python_version())
+    print("Compiler     :", platform.python_compiler())
+    print("Build        :", platform.python_build())
+    print("Arch         :", platform.architecture())
+
+
+def diagnose_os():
+    print("----------System Info----------")
+    print("Platform     :", platform.platform())
+    print("system       :", platform.system())
+    print("node         :", platform.node())
+    print("release      :", platform.release())
+    print("version      :", platform.version())
+
+
+def diagnose_hardware():
+    print("----------Hardware Info----------")
+    print("machine      :", platform.machine())
+    print("processor    :", platform.processor())
+    if sys.platform.startswith("linux"):
+        try:
+            out = subprocess.run(["lscpu"], capture_output=True,
+                                 text=True, timeout=10)
+            print(out.stdout.strip())
+        except Exception:
+            pass
+
+
+def diagnose_mxnet():
+    print("----------MXNet-TPU Info----------")
+    import mxnet_tpu as mx
+    from mxnet_tpu import runtime
+    print("Version      :", getattr(mx, "__version__", "dev"))
+    print("Directory    :", os.path.dirname(mx.__file__))
+    feats = runtime.Features() if hasattr(runtime, "Features") else None
+    if feats is not None:
+        enabled = [str(f) for f in getattr(feats, "enabled", lambda: [])()] \
+            if callable(getattr(feats, "enabled", None)) else []
+        if enabled:
+            print("Features     :", ", ".join(enabled))
+    import jax
+    import jaxlib
+    print("jax          :", jax.__version__)
+    print("jaxlib       :", jaxlib.__version__)
+    knobs = {k: v for k, v in os.environ.items()
+             if k.startswith(("MXNET_", "JAX_", "XLA_"))}
+    for k in sorted(knobs):
+        print("env %-24s: %s" % (k, knobs[k]))
+
+
+def diagnose_backend(timeout):
+    """Accelerator reachability (the zero-egress analogue of the
+    reference's URL tests): jax.devices() in a subprocess so a hung
+    backend cannot hang the diagnosis."""
+    print("----------Backend Reachability----------")
+    code = ("import jax; d = jax.devices(); "
+            "print([(x.platform, x.device_kind) for x in d])")
+    try:
+        out = subprocess.run([sys.executable, "-c", code],
+                             capture_output=True, text=True,
+                             timeout=timeout)
+        if out.returncode == 0:
+            print("devices      :", out.stdout.strip().splitlines()[-1])
+        else:
+            print("backend ERROR:", (out.stderr or "").strip()[-400:])
+    except subprocess.TimeoutExpired:
+        print("backend HUNG : jax.devices() did not answer within "
+              "%ds — accelerator attachment is broken" % timeout)
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(
+        description="Diagnose the current system.")
+    for choice in ("python", "os", "hardware", "mxnet", "backend"):
+        p.add_argument("--" + choice, default=1, type=int)
+    p.add_argument("--timeout", default=30, type=int)
+    args = p.parse_args(argv)
+    if args.python:
+        diagnose_python()
+    if args.os:
+        diagnose_os()
+    if args.hardware:
+        diagnose_hardware()
+    if args.mxnet:
+        diagnose_mxnet()
+    if args.backend:
+        diagnose_backend(args.timeout)
+
+
+if __name__ == "__main__":
+    main()
