@@ -74,6 +74,7 @@ from .kvstore import KVStore
 from . import io
 from . import recordio
 from . import rtc
+from . import deploy
 from . import callback
 from . import monitor
 from . import visualization

@@ -1,0 +1,145 @@
+"""Standalone deploy artifacts — the TPU-native ``c_predict_api``.
+
+Reference deploy story: ``HybridBlock.export`` emits symbol.json +
+params, which the standalone C predict ABI (src/c_api/c_predict_api.cc)
+or the single-file amalgamation build loads without the Python
+framework. The TPU-native equivalent is a serialized StableHLO
+program: ``export_compiled`` lowers the model's forward (params baked
+in as constants) through ``jax.export`` into ONE portable file that
+any JAX runtime can execute via ``load_compiled`` — no framework, no
+model code, no param files.
+
+    mx.deploy.export_compiled(net, "model.mxp",
+                              input_shapes={"data": (1, 3, 224, 224)})
+    pred = mx.deploy.load_compiled("model.mxp")
+    probs = pred(x)                      # numpy/jax array in, out
+"""
+from __future__ import annotations
+
+import json
+import struct
+
+import numpy as _np
+
+from .base import MXNetError
+
+__all__ = ["export_compiled", "load_compiled", "Predictor"]
+
+_MAGIC = b"MXTPUDEPLOY1"
+
+
+def _graph_fn(symbol, arg_params, aux_params, input_shapes, dtype):
+    import jax
+    import jax.numpy as jnp
+    from .cached_op import build_graph_callable
+
+    fn, arg_names, aux_names, _n_rng, n_out = \
+        build_graph_callable(symbol)
+    data_names = [n for n in arg_names if n not in arg_params]
+    missing = [n for n in data_names if n not in input_shapes]
+    if missing:
+        raise MXNetError(
+            "export_compiled: provide input_shapes for %s" % missing)
+    baked = {n: jnp.asarray(arg_params[n]._data
+                            if hasattr(arg_params[n], "_data")
+                            else arg_params[n])
+             for n in arg_names if n in arg_params}
+    baked_aux = {n: jnp.asarray(aux_params[n]._data
+                                if hasattr(aux_params[n], "_data")
+                                else aux_params[n])
+                 for n in aux_names}
+
+    def forward(*data):
+        feed = dict(zip(data_names, data))
+        vals = [feed[n] if n in feed else baked[n] for n in arg_names]
+        vals.extend(baked_aux[n] for n in aux_names)
+        outs = fn({"__train__": False}, *vals)[:n_out]
+        return outs[0] if n_out == 1 else tuple(outs)
+
+    specs = [jax.ShapeDtypeStruct(tuple(input_shapes[n]),
+                                  jnp.dtype(dtype))
+             for n in data_names]
+    return forward, specs, data_names
+
+
+def export_compiled(model, path, input_shapes, params=None,
+                    aux_params=None, dtype="float32"):
+    """Serialize ``model`` (a hybridized Gluon block, or a Symbol plus
+    ``params``/``aux_params`` dicts) into one portable StableHLO file.
+    Parameters are baked in as constants — the artifact is fully
+    self-contained, like the reference's amalgamation build."""
+    import jax
+    from jax import export as jexport
+    from . import symbol as sym_mod
+
+    if isinstance(model, sym_mod.Symbol):
+        symbol = model
+        arg_params = dict(params or {})
+        aux = dict(aux_params or {})
+    else:                                  # Gluon HybridBlock
+        if not getattr(model, "_cached_graph", None):
+            raise MXNetError(
+                "export_compiled: hybridize() the block and run one "
+                "forward before exporting")
+        symbol = model._cached_graph[1]
+        arg_names = set(symbol.list_arguments())
+        aux_names = set(symbol.list_auxiliary_states())
+        arg_params, aux = {}, {}
+        for name, p in model.collect_params().items():
+            if name in arg_names:
+                arg_params[name] = p.data()
+            elif name in aux_names:
+                aux[name] = p.data()
+
+    forward, specs, data_names = _graph_fn(symbol, arg_params, aux,
+                                           input_shapes, dtype)
+    exported = jexport.export(jax.jit(forward))(*specs)
+    blob = exported.serialize()
+    meta = {
+        "format": 1,
+        "inputs": [{"name": n, "shape": list(input_shapes[n]),
+                    "dtype": str(dtype)} for n in data_names],
+        "framework": "mxnet_tpu",
+    }
+    meta_bytes = json.dumps(meta).encode()
+    with open(path, "wb") as f:
+        f.write(_MAGIC)
+        f.write(struct.pack("<I", len(meta_bytes)))
+        f.write(meta_bytes)
+        f.write(blob)
+    return path
+
+
+class Predictor:
+    """Callable wrapper over a deserialized deploy artifact (the
+    c_predict_api MXPredCreate/MXPredForward role)."""
+
+    def __init__(self, exported, meta):
+        self._exported = exported
+        self.meta = meta
+
+    @property
+    def input_names(self):
+        return [i["name"] for i in self.meta["inputs"]]
+
+    def __call__(self, *args):
+        arrays = [a.asnumpy() if hasattr(a, "asnumpy")
+                  else _np.asarray(a) for a in args]
+        return self._exported.call(*arrays)
+
+    predict = __call__
+
+
+def load_compiled(path):
+    """Load an ``export_compiled`` artifact. Needs only jax — not the
+    framework's model code or parameter files."""
+    from jax import export as jexport
+    with open(path, "rb") as f:
+        magic = f.read(len(_MAGIC))
+        if magic != _MAGIC:
+            raise MXNetError("%s is not a mxnet_tpu deploy artifact"
+                             % path)
+        (mlen,) = struct.unpack("<I", f.read(4))
+        meta = json.loads(f.read(mlen).decode())
+        blob = f.read()
+    return Predictor(jexport.deserialize(blob), meta)

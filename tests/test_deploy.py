@@ -1,0 +1,74 @@
+"""Standalone StableHLO deploy artifacts (mx.deploy — the TPU-native
+c_predict_api / amalgamation deploy story; ref
+src/c_api/c_predict_api.cc)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+
+def _resnet18():
+    from mxnet_tpu.gluon.model_zoo import vision
+    net = vision.resnet18_v1(classes=10)
+    net.initialize(mx.init.Xavier())
+    net.hybridize()
+    x = mx.nd.random.uniform(0, 1, (2, 3, 32, 32))
+    y = net(x)
+    return net, x, y.asnumpy()
+
+
+def test_block_export_load_roundtrip(tmp_path):
+    net, x, y_ref = _resnet18()
+    path = str(tmp_path / "model.mxp")
+    mx.deploy.export_compiled(net, path,
+                              input_shapes={"data0": (2, 3, 32, 32)})
+    pred = mx.deploy.load_compiled(path)
+    assert pred.input_names == ["data0"]
+    out = pred(x.asnumpy())
+    np.testing.assert_allclose(np.asarray(out), y_ref, rtol=2e-4,
+                               atol=2e-5)
+
+
+def test_symbol_export_form(tmp_path):
+    d = mx.sym.var("data")
+    w = mx.sym.var("w")
+    b = mx.sym.var("b")
+    out = mx.sym.FullyConnected(d, w, b, num_hidden=4)
+    params = {"w": mx.nd.random.uniform(-1, 1, (4, 6)),
+              "b": mx.nd.zeros((4,))}
+    path = str(tmp_path / "fc.mxp")
+    mx.deploy.export_compiled(out, path, params=params,
+                              input_shapes={"data": (3, 6)})
+    pred = mx.deploy.load_compiled(path)
+    x = np.random.RandomState(0).randn(3, 6).astype(np.float32)
+    want = x @ params["w"].asnumpy().T + params["b"].asnumpy()
+    np.testing.assert_allclose(np.asarray(pred(x)), want, rtol=1e-5,
+                               atol=1e-6)
+
+
+def test_artifact_is_self_contained(tmp_path):
+    """Loading uses only jax.export.deserialize — no symbol JSON, no
+    param files, no registry."""
+    net, x, y_ref = _resnet18()
+    path = str(tmp_path / "model.mxp")
+    mx.deploy.export_compiled(net, path,
+                              input_shapes={"data0": (2, 3, 32, 32)})
+    from jax import export as jexport
+    import json, struct
+    with open(path, "rb") as f:
+        assert f.read(12) == b"MXTPUDEPLOY1"
+        (mlen,) = struct.unpack("<I", f.read(4))
+        meta = json.loads(f.read(mlen).decode())
+        blob = f.read()
+    exported = jexport.deserialize(blob)
+    out = exported.call(x.asnumpy())
+    np.testing.assert_allclose(np.asarray(out), y_ref, rtol=2e-4,
+                               atol=2e-5)
+    assert meta["inputs"][0]["shape"] == [2, 3, 32, 32]
+
+
+def test_bad_magic_rejected(tmp_path):
+    p = tmp_path / "junk.mxp"
+    p.write_bytes(b"not an artifact")
+    with pytest.raises(mx.base.MXNetError, match="deploy artifact"):
+        mx.deploy.load_compiled(str(p))
