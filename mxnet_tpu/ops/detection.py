@@ -190,7 +190,8 @@ def _nms_mask(boxes, scores, thresh, cls_id=None):
         return keep, None
 
     keep, _ = jax.lax.scan(body, jnp.ones((n,), bool), jnp.arange(n))
-    inv = jnp.zeros((n,), jnp.int32).at[order].set(jnp.arange(n))
+    inv = jnp.zeros((n,), jnp.int32).at[order].set(
+        jnp.arange(n, dtype=jnp.int32))
     return keep[inv]
 
 
