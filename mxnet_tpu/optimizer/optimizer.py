@@ -32,6 +32,13 @@ def register(klass):
     return klass
 
 
+def _is_low_precision(dtype):
+    """float16 OR bfloat16 (the MXU-native dtype) counts as low
+    precision for master-weight purposes; the reference only knew fp16
+    (optimizer.py multi_precision)."""
+    return str(dtype) in ("float16", "bfloat16")
+
+
 class Optimizer:
     """Base optimizer: per-index update counting, lr/wd multiplier
     tables, multi-precision plumbing (reference: optimizer.py:37)."""
@@ -70,7 +77,7 @@ class Optimizer:
         return None
 
     def create_state_multi_precision(self, index, weight):
-        if weight.dtype == numpy.float16:
+        if _is_low_precision(weight.dtype):
             if self.multi_precision:
                 master = weight.astype(numpy.float32)
                 return (master, self.create_state(index, master))
@@ -85,7 +92,7 @@ class Optimizer:
         raise NotImplementedError()
 
     def update_multi_precision(self, index, weight, grad, state):
-        if self.multi_precision and weight.dtype == numpy.float16:
+        if self.multi_precision and _is_low_precision(weight.dtype):
             master, inner = state
             self.update(index, master, grad.astype(numpy.float32), inner)
             weight[:] = master.astype(weight.dtype)
@@ -242,7 +249,7 @@ class SGD(Optimizer):
         return weight.zeros_like() if self.momentum != 0.0 else None
 
     def create_state_multi_precision(self, index, weight):
-        if self.multi_precision and weight.dtype == numpy.float16:
+        if self.multi_precision and _is_low_precision(weight.dtype):
             master = weight.astype(numpy.float32)
             return (self.create_state(index, master), master)
         return self.create_state(index, weight)
@@ -266,7 +273,7 @@ class SGD(Optimizer):
             invoke_nd("sgd_update", [weight, grad], kw, out=weight)
 
     def update_multi_precision(self, index, weight, grad, state):
-        if not (self.multi_precision and weight.dtype == numpy.float16):
+        if not (self.multi_precision and _is_low_precision(weight.dtype)):
             return self.update(index, weight, grad, state)
         _, _, kw = self._step_inputs(index)
         mom, master = state if isinstance(state, tuple) else (None, state)
