@@ -3,6 +3,9 @@ from . import quantization  # noqa: F401
 from . import text          # noqa: F401
 from . import svrg_optimization  # noqa: F401
 from . import onnx          # noqa: F401
+from . import io            # noqa: F401
+from . import autograd      # noqa: F401
+from . import tensorboard   # noqa: F401
 
 # legacy alias kept from earlier rounds
 onnx_export = onnx.export_model

@@ -1,0 +1,65 @@
+"""Contrib IO (parity: python/mxnet/contrib/io.py): wrap a Gluon
+DataLoader as a classic ``DataIter`` so the Module API can consume
+Gluon data pipelines."""
+from __future__ import annotations
+
+import numpy as _np
+
+from ..io.io import DataIter, DataDesc
+from .. import ndarray as nd
+
+__all__ = ["DataLoaderIter"]
+
+
+class DataLoaderIter(DataIter):
+    """Returns batches from a ``gluon.data.DataLoader`` through the
+    DataIter protocol (ref contrib/io.py:30). The last partial batch
+    is zero-padded to batch_size with ``pad`` reporting the filler
+    count, like the C-backed iterators."""
+
+    def __init__(self, loader, data_name="data",
+                 label_name="softmax_label", dtype="float32"):
+        self._loader = loader
+        self._iter = iter(loader)
+        data, label = next(self._iter)
+        batch_size = data.shape[0]
+        super().__init__(batch_size)
+        self.dtype = dtype
+        self.provide_data = [DataDesc(data_name, tuple(data.shape),
+                                      dtype)]
+        self.provide_label = [DataDesc(label_name, tuple(label.shape),
+                                       dtype)]
+        self._current_batch = None
+        self.reset()
+
+    def reset(self):
+        self._iter = iter(self._loader)
+
+    def iter_next(self):
+        try:
+            self._current_batch = next(self._iter)
+        except StopIteration:
+            self._current_batch = None
+        return self._current_batch is not None
+
+    def _padded(self, arr):
+        arr = arr.astype(self.dtype)
+        pad = self.getpad()
+        if pad:
+            full = nd.zeros((self.batch_size,) + tuple(arr.shape[1:]),
+                            dtype=self.dtype)
+            full[:arr.shape[0]] = arr
+            return [full]
+        return [arr]
+
+    def getdata(self):
+        return self._padded(self._current_batch[0])
+
+    def getlabel(self):
+        return self._padded(self._current_batch[1])
+
+    def getpad(self):
+        return self.batch_size - self._current_batch[0].shape[0]
+
+    def getindex(self):
+        return None

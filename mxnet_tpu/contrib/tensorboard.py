@@ -1,0 +1,50 @@
+"""TensorBoard metric logging callback (parity:
+python/mxnet/contrib/tensorboard.py). The writer backend is optional:
+mxboard / tensorboardX / torch.utils.tensorboard are tried in order;
+without any, construction raises ImportError with guidance."""
+from __future__ import annotations
+
+__all__ = ["LogMetricsCallback"]
+
+
+def _find_writer(logging_dir):
+    try:
+        from mxboard import SummaryWriter          # noqa: F401
+        return SummaryWriter(logging_dir)
+    except ImportError:
+        pass
+    try:
+        from tensorboardX import SummaryWriter    # noqa: F401
+        return SummaryWriter(logging_dir)
+    except ImportError:
+        pass
+    try:
+        from torch.utils.tensorboard import SummaryWriter
+        return SummaryWriter(logging_dir)
+    except ImportError:
+        raise ImportError(
+            "LogMetricsCallback needs a SummaryWriter backend: install "
+            "mxboard, tensorboardX, or torch")
+
+
+class LogMetricsCallback:
+    """Epoch/batch-end callback writing metric scalars to TensorBoard
+    event files (ref contrib/tensorboard.py:45)."""
+
+    def __init__(self, logging_dir, prefix=None):
+        self.prefix = prefix
+        self.summary_writer = _find_writer(logging_dir)
+        self._step = 0
+
+    def __call__(self, param):
+        if param.eval_metric is None:
+            return
+        step = getattr(param, "epoch", None)
+        if step is None:
+            step = self._step
+        self._step += 1
+        for name, value in param.eval_metric.get_name_value():
+            if self.prefix is not None:
+                name = "%s-%s" % (self.prefix, name)
+            self.summary_writer.add_scalar(name, value,
+                                           global_step=step)

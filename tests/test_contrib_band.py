@@ -1,0 +1,59 @@
+"""Contrib band: DataLoaderIter, legacy autograd, tensorboard gating
+(ref python/mxnet/contrib/{io,autograd,tensorboard}.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon
+
+
+def test_dataloader_iter_bridges_gluon_to_module():
+    x = np.random.RandomState(0).randn(70, 6).astype(np.float32)
+    y = (x.sum(axis=1) > 0).astype(np.float32)
+    ds = gluon.data.ArrayDataset(mx.nd.array(x), mx.nd.array(y))
+    loader = gluon.data.DataLoader(ds, batch_size=32)
+    it = mx.contrib.io.DataLoaderIter(loader)
+    assert it.provide_data[0].shape == (32, 6)
+    batches = list(it)
+    assert len(batches) == 3
+    assert batches[-1].pad == 32 - 70 % 32
+    assert batches[-1].data[0].shape == (32, 6)   # zero-padded
+    it.reset()
+    assert next(iter(it)).data[0].shape == (32, 6)
+
+
+def test_legacy_autograd_grad_and_loss():
+    from mxnet_tpu.contrib import autograd as old_ag
+    x = mx.nd.array([1.0, 2.0, 3.0])
+
+    def f(x):
+        return (x * x).sum()
+
+    grads, loss = old_ag.grad_and_loss(f)(x)
+    np.testing.assert_allclose(grads[0].asnumpy(), 2 * x.asnumpy(),
+                               rtol=1e-6)
+    np.testing.assert_allclose(float(loss.asnumpy()), 14.0, rtol=1e-6)
+    g_only = old_ag.grad(f)(x)
+    np.testing.assert_allclose(g_only[0].asnumpy(), 2 * x.asnumpy(),
+                               rtol=1e-6)
+
+
+def test_tensorboard_callback_gated():
+    try:
+        from torch.utils.tensorboard import SummaryWriter  # noqa
+        have_backend = True
+    except Exception:
+        have_backend = False
+    if not have_backend:
+        with pytest.raises(ImportError):
+            mx.contrib.tensorboard.LogMetricsCallback("/tmp/tb")
+    else:
+        import tempfile
+        with tempfile.TemporaryDirectory() as d:
+            cb = mx.contrib.tensorboard.LogMetricsCallback(d)
+            metric = mx.metric.create("acc")
+            metric.update([mx.nd.array([0., 1.])],
+                          [mx.nd.array([[0.9, 0.1], [0.2, 0.8]])])
+            from mxnet_tpu.model import BatchEndParam
+            cb(BatchEndParam(epoch=0, nbatch=0, eval_metric=metric,
+                             locals=None))
