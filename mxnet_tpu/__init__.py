@@ -75,6 +75,10 @@ from . import io
 from . import recordio
 from . import rtc
 from . import deploy
+from . import registry
+from . import log
+from . import libinfo
+from . import kvstore_server
 from . import callback
 from . import monitor
 from . import visualization

@@ -1,0 +1,44 @@
+"""KVStore server bootstrap (parity: python/mxnet/kvstore_server.py).
+
+The reference's ``dist_*`` kvstores run dedicated ps-lite server
+processes whose loop this module bootstraps when ``DMLC_ROLE=server``.
+The TPU-native ``tpu_sync`` design has NO server role: aggregation is
+an in-program psum collective over the worker mesh (SURVEY §5.8), so
+every process is a worker. This module keeps the API surface so
+reference launch scripts run unchanged — a "server" role degenerates
+to an immediate, logged no-op exit.
+"""
+from __future__ import annotations
+
+import logging
+import os
+
+__all__ = ["KVStoreServer"]
+
+
+class KVStoreServer:
+    """API-compatible server object (ref kvstore_server.py:28)."""
+
+    def __init__(self, kvstore):
+        self.kvstore = kvstore
+
+    def run(self):
+        """The reference blocks here serving push/pull requests; with
+        collective aggregation there is nothing to serve."""
+        logging.info(
+            "kvstore_server: tpu_sync aggregates via in-program "
+            "collectives; no server loop to run (role degenerates to "
+            "a no-op, workers carry the optimizer)")
+
+
+def _init_kvstore_server_module():
+    """Invoked at import when DMLC_ROLE=server (the reference wires
+    this into mxnet/__init__); logs and returns instead of blocking."""
+    role = os.environ.get("DMLC_ROLE", "")
+    if role == "server":
+        from . import kv
+        KVStoreServer(kv.create("tpu_sync")).run()
+
+
+if os.environ.get("DMLC_ROLE", "") == "server":   # pragma: no cover
+    _init_kvstore_server_module()

@@ -1,0 +1,29 @@
+"""Library/version info (parity: python/mxnet/libinfo.py).
+
+The reference locates ``libmxnet.so``; here the native component is
+the optional IO runtime (``native/build/libmxtpu_io.so``) and the
+compute "library" is XLA itself, so ``find_lib_path`` returns the
+paths of whichever native artifacts exist (possibly empty — the
+framework is fully functional without them)."""
+from __future__ import annotations
+
+import os
+
+__all__ = ["find_lib_path", "find_include_path", "__version__"]
+
+__version__ = "0.1.0"
+
+
+def find_lib_path():
+    """Paths of built native libraries (may be empty)."""
+    from .io.native import lib_path
+    p = lib_path()
+    return [p] if os.path.exists(p) else []
+
+
+def find_include_path():
+    """Native source directory (the C ABI lives in the .cc files; no
+    separate headers are installed)."""
+    here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    inc = os.path.join(here, "native")
+    return inc if os.path.isdir(inc) else ""

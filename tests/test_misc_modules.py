@@ -1,0 +1,65 @@
+"""Small parity modules: registry, log, libinfo, kvstore_server (ref
+python/mxnet/{registry,log,libinfo,kvstore_server}.py)."""
+import logging
+
+import pytest
+
+import mxnet_tpu as mx
+
+
+def test_registry_factory_roundtrip():
+    class Base:
+        def __init__(self, x=1):
+            self.x = x
+
+    reg = mx.registry.get_register_func(Base, "thing")
+    create = mx.registry.get_create_func(Base, "thing")
+
+    @reg
+    class Special(Base):
+        pass
+
+    inst = create("special", x=5)
+    assert isinstance(inst, Special) and inst.x == 5
+    # instance passthrough + JSON config form
+    assert create(inst) is inst
+    inst2 = create('{"name": "special", "x": 7}')
+    assert inst2.x == 7
+    assert "special" in mx.registry.get_registry(Base)
+    with pytest.raises(AssertionError):
+        create("unknown_thing")
+    # alias registrator
+    alias = mx.registry.get_alias_func(Base, "thing")
+
+    @alias("extra_name")
+    class Other(Base):
+        pass
+    assert isinstance(create("extra_name"), Other)
+    # duplicate registration warns
+    with pytest.warns(UserWarning):
+        reg(Special, "extra_name")
+
+
+def test_log_get_logger(tmp_path):
+    logfile = str(tmp_path / "out.log")
+    lg = mx.log.get_logger("mxtpu_test_logger", filename=logfile,
+                           level=mx.log.INFO)
+    lg.info("hello-parity")
+    for h in lg.handlers:
+        h.flush()
+    assert "hello-parity" in open(logfile).read()
+    assert mx.log.getLogger("mxtpu_test_logger") is \
+        logging.getLogger("mxtpu_test_logger")
+
+
+def test_libinfo_paths():
+    paths = mx.libinfo.find_lib_path()
+    assert isinstance(paths, list)
+    for p in paths:
+        assert p.endswith(".so")
+    assert mx.libinfo.__version__
+
+
+def test_kvstore_server_degenerates():
+    srv = mx.kvstore_server.KVStoreServer(mx.kv.create("local"))
+    srv.run()          # returns immediately: no server role on TPU
