@@ -1,0 +1,51 @@
+"""The deploy workflow end to end (the reference's
+HybridBlock.export -> c_predict_api story, TPU-native):
+
+1. train (briefly) / initialize a model-zoo network
+2. HybridBlock.export          -> symbol.json + .params (two-file pair)
+3. SymbolBlock.imports         -> reload without model code
+4. mx.deploy.export_compiled   -> ONE self-contained StableHLO file
+5. mx.deploy.load_compiled     -> predict with only jax installed
+
+    python examples/export_and_predict.py
+"""
+import tempfile
+import os
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon
+from mxnet_tpu.gluon.model_zoo import vision
+
+
+def main():
+    net = vision.resnet18_v1(classes=10)
+    net.initialize(mx.init.Xavier())
+    net.hybridize()
+    x = mx.nd.random.uniform(0, 1, (1, 3, 32, 32))
+    y_ref = net(x).asnumpy()
+
+    with tempfile.TemporaryDirectory() as d:
+        # two-file deploy pair
+        prefix = os.path.join(d, "resnet18")
+        net.export(prefix)
+        loaded = gluon.SymbolBlock.imports(
+            prefix + "-symbol.json", ["data0"], prefix + "-0000.params")
+        np.testing.assert_allclose(loaded(x).asnumpy(), y_ref,
+                                   rtol=1e-4, atol=1e-5)
+        print("SymbolBlock round-trip OK")
+
+        # single-file StableHLO artifact
+        artifact = os.path.join(d, "resnet18.mxp")
+        mx.deploy.export_compiled(net, artifact,
+                                  input_shapes={"data0": (1, 3, 32, 32)})
+        pred = mx.deploy.load_compiled(artifact)
+        np.testing.assert_allclose(np.asarray(pred(x)), y_ref,
+                                   rtol=1e-4, atol=1e-5)
+        print("StableHLO artifact OK (%d bytes)"
+              % os.path.getsize(artifact))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,87 @@
+"""CNN image classification with the Gluon API + Trainer (the
+reference's gluon example family). Synthetic CIFAR-shaped data —
+zero-egress — in bf16 with multi-precision SGD, the MXU-native
+training configuration.
+
+    python examples/train_gluon_cnn.py --epochs 3
+"""
+import argparse
+import time
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon, autograd as ag
+
+
+def build_net(classes=10):
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Conv2D(16, kernel_size=3, padding=1,
+                            activation="relu"))
+    net.add(gluon.nn.MaxPool2D(pool_size=2))
+    net.add(gluon.nn.Conv2D(32, kernel_size=3, padding=1,
+                            activation="relu"))
+    net.add(gluon.nn.GlobalAvgPool2D())
+    net.add(gluon.nn.Dense(classes))
+    return net
+
+
+def synthetic_cifar(n, rng):
+    protos = rng.normal(0, 1.5, (10, 3, 1, 1)).astype(np.float32)
+    y = rng.randint(0, 10, n)
+    x = protos[y] + rng.normal(0, 0.8, (n, 3, 32, 32)) \
+        .astype(np.float32)
+    return x.astype(np.float32), y.astype(np.float32)
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--batch-size", type=int, default=64)
+    p.add_argument("--epochs", type=int, default=3)
+    p.add_argument("--lr", type=float, default=0.05)
+    p.add_argument("--num-examples", type=int, default=2048)
+    p.add_argument("--dtype", type=str, default="bfloat16",
+                   choices=["float32", "bfloat16"])
+    args = p.parse_args()
+
+    rng = np.random.RandomState(0)
+    x, y = synthetic_cifar(args.num_examples, rng)
+    ds = gluon.data.ArrayDataset(mx.nd.array(x), mx.nd.array(y))
+    loader = gluon.data.DataLoader(ds, batch_size=args.batch_size,
+                                   shuffle=True)
+
+    net = build_net()
+    net.initialize(mx.init.Xavier())
+    if args.dtype != "float32":
+        net.cast(args.dtype)
+    net.hybridize()
+    trainer = gluon.Trainer(
+        net.collect_params(), "sgd",
+        {"learning_rate": args.lr, "momentum": 0.9,
+         "multi_precision": args.dtype != "float32"})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+
+    for epoch in range(args.epochs):
+        t0 = time.time()
+        total, seen = 0.0, 0
+        for xb, yb in loader:
+            xb = xb.astype(args.dtype)
+            with ag.record():
+                out = net(xb)
+                loss = loss_fn(out.astype("float32"), yb)
+            loss.backward()
+            trainer.step(xb.shape[0])
+            total += float(loss.sum().asnumpy())
+            seen += xb.shape[0]
+        print("epoch %d: loss %.4f  (%.1f img/s)"
+              % (epoch, total / seen, seen / (time.time() - t0)))
+
+    preds = net(mx.nd.array(x).astype(args.dtype)) \
+        .astype("float32").asnumpy().argmax(axis=1)
+    acc = float((preds == y).mean())
+    print("train accuracy: %.4f" % acc)
+    return acc
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,81 @@
+"""MNIST MLP via the symbolic Module API (the reference's canonical
+example/image-classification/train_mnist.py, zero-egress: synthetic
+MNIST-shaped data unless --mnist-dir points at the idx files).
+
+    python examples/train_mnist_module.py --num-epochs 5
+"""
+import argparse
+import logging
+
+import numpy as np
+
+import mxnet_tpu as mx
+
+
+def get_data(args):
+    if args.mnist_dir:
+        from mxnet_tpu.io import MNISTIter
+        train = MNISTIter(
+            image="%s/train-images-idx3-ubyte" % args.mnist_dir,
+            label="%s/train-labels-idx1-ubyte" % args.mnist_dir,
+            batch_size=args.batch_size, flat=True)
+        val = MNISTIter(
+            image="%s/t10k-images-idx3-ubyte" % args.mnist_dir,
+            label="%s/t10k-labels-idx1-ubyte" % args.mnist_dir,
+            batch_size=args.batch_size, flat=True)
+        return train, val
+    rng = np.random.RandomState(0)
+    protos = rng.normal(0, 2.5, (10, 784)).astype(np.float32)
+    y = rng.randint(0, 10, args.num_examples)
+    x = (protos[y] + rng.normal(0, 1.0, (args.num_examples, 784))) \
+        .astype(np.float32) / 3.0
+    split = args.num_examples * 4 // 5
+    train = mx.io.NDArrayIter(x[:split], y[:split].astype(np.float32),
+                              batch_size=args.batch_size, shuffle=True,
+                              label_name="softmax_label")
+    val = mx.io.NDArrayIter(x[split:], y[split:].astype(np.float32),
+                            batch_size=args.batch_size,
+                            label_name="softmax_label")
+    return train, val
+
+
+def get_symbol():
+    data = mx.sym.var("data")
+    net = mx.sym.FullyConnected(data, num_hidden=128, name="fc1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=64, name="fc2")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=10, name="fc3")
+    return mx.sym.SoftmaxOutput(net, name="softmax")
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--batch-size", type=int, default=64)
+    p.add_argument("--num-epochs", type=int, default=5)
+    p.add_argument("--lr", type=float, default=0.01)
+    p.add_argument("--num-examples", type=int, default=4000)
+    p.add_argument("--mnist-dir", type=str, default="",
+                   help="directory with the raw idx files (optional)")
+    p.add_argument("--model-prefix", type=str, default="")
+    args = p.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    train, val = get_data(args)
+    mod = mx.mod.Module(get_symbol(), context=mx.current_context())
+    cb = [mx.callback.Speedometer(args.batch_size, 20)]
+    epoch_cb = mx.callback.do_checkpoint(args.model_prefix) \
+        if args.model_prefix else None
+    mod.fit(train, eval_data=val, optimizer="sgd",
+            optimizer_params={"learning_rate": args.lr,
+                              "momentum": 0.9},
+            initializer=mx.init.Xavier(),
+            eval_metric="acc", num_epoch=args.num_epochs,
+            batch_end_callback=cb, epoch_end_callback=epoch_cb)
+    score = mod.score(val, "acc")
+    print("final validation accuracy: %.4f" % score[0][1])
+    return score[0][1]
+
+
+if __name__ == "__main__":
+    main()

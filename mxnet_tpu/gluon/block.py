@@ -433,8 +433,13 @@ class HybridBlock(Block):
     def _get_graph(self, *args):
         if not self._cached_graph:
             leaves, self._in_spec = _tree_flatten(list(args), "input")
-            placeholders = [sym_mod.var("data%d" % i)
-                            for i in range(len(leaves))]
+            # carry the traced input dtypes on the placeholders so
+            # shape/type inference sees them (strict-dtype ops like
+            # conv reject a float32 default against bf16-cast params)
+            placeholders = [
+                sym_mod.var("data%d" % i,
+                            dtype=getattr(leaf, "dtype", None))
+                for i, leaf in enumerate(leaves)]
             # args entered as a list, so the spec is always a list and
             # `structured` unpacks positionally
             structured = _tree_unflatten(list(placeholders), self._in_spec)

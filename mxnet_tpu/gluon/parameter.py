@@ -299,6 +299,9 @@ class Parameter:
 
     def cast(self, dtype):
         self._dtype = dtype
+        # the cached symbolic var carries the OLD dtype; a stale one
+        # breaks deferred shape inference (strict-dtype ops like conv)
+        self._var = None
         if self._data is None:
             return
         from .. import autograd
