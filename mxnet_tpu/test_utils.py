@@ -218,14 +218,17 @@ def check_symbolic_backward(sym, location, out_grads, expected, rtol=1e-5,
 
 def check_consistency(sym, ctx_list=None, scale=1.0, dtype=None,
                       arg_params=None, aux_params=None, tol=None,
-                      raise_on_err=True, **kwargs):
+                      raise_on_err=True, grad_req="write", **kwargs):
     """Cross-backend oracle (the reference's cpu-vs-gpu
     check_consistency, test_utils.py:1224): run the SAME graph
     symbolically (one compiled XLA program) on every context in
     ``ctx_list`` — e.g. ``[mx.cpu(), mx.tpu()]`` for the TPU test lane —
     plus eagerly (interpreted, per-op jit) on the first context, and
-    compare all outputs against the first context's."""
-    from .ndarray import array
+    compare all outputs against the first context's. With
+    ``grad_req='write'`` (the reference default) the BACKWARD runs on
+    every context too and every argument gradient is compared;
+    ``grad_req='null'`` restores forward-only checking."""
+    from .ndarray import array, zeros as nd_zeros, ones as nd_ones
     from . import autograd as ag
     ctx = ctx_list[0] if ctx_list else default_context()
     arg_names = sym.list_arguments()
@@ -239,41 +242,66 @@ def check_consistency(sym, ctx_list=None, scale=1.0, dtype=None,
     if aux_params is None:
         aux_params = {n: arg_params.pop(n) for n in aux_names
                       if n in arg_params}
+    with_grad = grad_req == "write"
 
     def _bind(c):
-        return sym.bind(c,
-                        {k: array(v, ctx=c) for k, v in arg_params.items()},
-                        aux_states={k: array(v, ctx=c)
-                                    for k, v in aux_params.items()}
-                        if aux_params else None)
+        grads = {k: nd_zeros(np.shape(v), ctx=c, dtype=str(
+            np.asarray(v).dtype)) for k, v in arg_params.items()} \
+            if with_grad else None
+        ex = sym.bind(
+            c, {k: array(v, ctx=c) for k, v in arg_params.items()},
+            args_grad=grads,
+            grad_req={k: grad_req for k in arg_params}
+            if with_grad else None,
+            aux_states={k: array(v, ctx=c)
+                        for k, v in aux_params.items()}
+            if aux_params else None)
+        return ex, grads
 
-    # symbolic path, per context
-    exe = _bind(ctx)
-    exe.forward(is_train=False)
-    sym_outs = [o.asnumpy() for o in exe.outputs]
+    def _run(c):
+        ex, grads = _bind(c)
+        outs = ex.forward(is_train=with_grad)
+        g = {}
+        if with_grad:
+            ex.backward([nd_ones(o.shape, ctx=c,
+                                 dtype=str(o.asnumpy().dtype))
+                         for o in outs])
+            g = {k: v.asnumpy() for k, v in grads.items()}
+        return [o.asnumpy() for o in outs], g
+
+    # symbolic path, per context — outputs AND gradients must agree
+    sym_outs, sym_grads = _run(ctx)
     for other in (ctx_list or [])[1:]:
-        exe_o = _bind(other)
-        exe_o.forward(is_train=False)
-        for ref_o, got_o in zip(sym_outs,
-                                [o.asnumpy() for o in exe_o.outputs]):
+        outs_o, grads_o = _run(other)
+        for ref_o, got_o in zip(sym_outs, outs_o):
             assert_almost_equal(ref_o, got_o, rtol=tol or 1e-4,
                                 atol=tol or 1e-4,
                                 names=(str(ctx), str(other)))
-    # eager path: interpret graph node by node via NDArray ops
+        for k in sym_grads:
+            assert_almost_equal(sym_grads[k], grads_o[k],
+                                rtol=tol or 1e-4, atol=tol or 1e-4,
+                                names=("grad(%s)@%s" % (k, ctx),
+                                       "grad(%s)@%s" % (k, other)))
+    # eager path: interpret graph node by node via NDArray ops, under
+    # the SAME mode as the symbolic leg (train when grads are checked —
+    # invoke_nd derives __train__ from the autograd mode)
     from .symbol.symbol import _topo
     env = {}
     all_params = dict(arg_params, **aux_params)
-    for node in sym._topo_nodes():
-        if node.is_variable():
-            env[(id(node), 0)] = array(all_params[node.name], ctx=ctx)
-        else:
-            from .ndarray.ndarray import invoke_nd
-            ins = [env[(id(s), i)] for (s, i) in node.inputs]
-            outs = invoke_nd(node.op, ins, dict(node.attrs))
-            if not isinstance(outs, list):
-                outs = [outs]
-            for i, o in enumerate(outs):
-                env[(id(node), i)] = o
+    mode = ag.train_mode() if with_grad else ag.predict_mode()
+    with mode:
+        for node in sym._topo_nodes():
+            if node.is_variable():
+                env[(id(node), 0)] = array(all_params[node.name],
+                                           ctx=ctx)
+            else:
+                from .ndarray.ndarray import invoke_nd
+                ins = [env[(id(s), i)] for (s, i) in node.inputs]
+                outs = invoke_nd(node.op, ins, dict(node.attrs))
+                if not isinstance(outs, list):
+                    outs = [outs]
+                for i, o in enumerate(outs):
+                    env[(id(node), i)] = o
     eager_outs = [env[(id(n), i)].asnumpy() for (n, i) in sym._outputs]
     tol = tol or 1e-4
     for s_o, e_o in zip(sym_outs, eager_outs):
