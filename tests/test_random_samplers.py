@@ -1,0 +1,120 @@
+"""Distribution pins for the waived stochastic samplers (the
+reference's tests/python/unittest/test_random.py pattern: moment checks
+per sampler + determinism under seeding). These ops have no numeric
+gradient; this file is their correctness oracle."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+N = 40000
+RTOL = 0.08
+
+
+def _draw(op, **attrs):
+    mx.random.seed(42)
+    fn = getattr(mx.nd, op)
+    return fn(shape=(N,), **attrs).asnumpy()
+
+
+def test_uniform_moments_and_bounds():
+    s = _draw("_random_uniform", low=-2.0, high=3.0)
+    assert s.min() >= -2.0 and s.max() < 3.0
+    np.testing.assert_allclose(s.mean(), 0.5, atol=0.05)
+    np.testing.assert_allclose(s.var(), 25.0 / 12, rtol=RTOL)
+
+
+def test_normal_moments():
+    s = _draw("_random_normal", loc=1.5, scale=2.0)
+    np.testing.assert_allclose(s.mean(), 1.5, atol=0.05)
+    np.testing.assert_allclose(s.std(), 2.0, rtol=RTOL)
+
+
+def test_gamma_moments():
+    s = _draw("_random_gamma", alpha=3.0, beta=2.0)
+    np.testing.assert_allclose(s.mean(), 6.0, rtol=RTOL)      # a*b
+    np.testing.assert_allclose(s.var(), 12.0, rtol=2 * RTOL)  # a*b^2
+    assert s.min() > 0
+
+
+def test_exponential_moments():
+    s = _draw("_random_exponential", lam=4.0)
+    np.testing.assert_allclose(s.mean(), 0.25, rtol=RTOL)
+    np.testing.assert_allclose(s.std(), 0.25, rtol=2 * RTOL)
+
+
+def test_poisson_moments():
+    s = _draw("_random_poisson", lam=7.0)
+    np.testing.assert_allclose(s.mean(), 7.0, rtol=RTOL)
+    np.testing.assert_allclose(s.var(), 7.0, rtol=2 * RTOL)
+    assert np.all(s == np.round(s))
+
+
+def test_randint_bounds_and_uniformity():
+    s = _draw("_random_randint", low=3, high=9)
+    assert s.min() == 3 and s.max() == 8
+    counts = np.bincount(s.astype(int))[3:9]
+    np.testing.assert_allclose(counts / N, 1 / 6, atol=0.02)
+
+
+def test_negative_binomial_moments():
+    k, p = 5.0, 0.4
+    s = _draw("_random_negative_binomial", k=k, p=p)
+    np.testing.assert_allclose(s.mean(), k * (1 - p) / p, rtol=RTOL)
+    np.testing.assert_allclose(s.var(), k * (1 - p) / p ** 2,
+                               rtol=2 * RTOL)
+
+
+def test_generalized_negative_binomial_moments():
+    mu, alpha = 4.0, 0.25
+    s = _draw("_random_generalized_negative_binomial", mu=mu,
+              alpha=alpha)
+    np.testing.assert_allclose(s.mean(), mu, rtol=RTOL)
+    np.testing.assert_allclose(s.var(), mu + alpha * mu ** 2,
+                               rtol=2 * RTOL)
+    # alpha=0 degenerates to Poisson
+    s0 = _draw("_random_generalized_negative_binomial", mu=mu,
+               alpha=0.0)
+    np.testing.assert_allclose(s0.var(), mu, rtol=2 * RTOL)
+
+
+def test_tensor_parameter_samplers_rowwise():
+    mx.random.seed(0)
+    lo = mx.nd.array(np.array([0.0, 5.0], np.float32))
+    hi = mx.nd.array(np.array([1.0, 9.0], np.float32))
+    s = mx.nd._sample_uniform(lo, hi, shape=(8000,)).asnumpy()
+    assert s.shape == (2, 8000)
+    np.testing.assert_allclose(s.mean(1), [0.5, 7.0], atol=0.08)
+    mu = mx.nd.array(np.array([-3.0, 2.0], np.float32))
+    sig = mx.nd.array(np.array([1.0, 0.5], np.float32))
+    n = mx.nd._sample_normal(mu, sig, shape=(8000,)).asnumpy()
+    np.testing.assert_allclose(n.mean(1), [-3.0, 2.0], atol=0.08)
+    np.testing.assert_allclose(n.std(1), [1.0, 0.5], rtol=RTOL)
+
+
+def test_shuffle_is_permutation():
+    mx.random.seed(3)
+    x = mx.nd.array(np.arange(24, dtype=np.float32).reshape(8, 3))
+    s = mx.nd._shuffle(x).asnumpy()
+    # rows permuted intact along axis 0
+    orig = x.asnumpy()
+    matched = set()
+    for row in s:
+        hits = np.where((orig == row).all(axis=1))[0]
+        assert hits.size >= 1
+        matched.add(int(hits[0]))
+    assert matched == set(range(8))
+    # 32 draws of an 8-row shuffle: fixed order would be a ~1e-7 fluke
+    draws = {tuple(mx.nd._shuffle(x).asnumpy()[:, 0].astype(int))
+             for _ in range(32)}
+    assert len(draws) > 1
+
+
+def test_seeding_determinism():
+    mx.random.seed(1234)
+    a = mx.nd._random_normal(loc=0.0, scale=1.0, shape=(64,)).asnumpy()
+    mx.random.seed(1234)
+    b = mx.nd._random_normal(loc=0.0, scale=1.0, shape=(64,)).asnumpy()
+    np.testing.assert_array_equal(a, b)
+    c = mx.nd._random_normal(loc=0.0, scale=1.0, shape=(64,)).asnumpy()
+    assert not np.array_equal(b, c)      # stream advances
