@@ -20,26 +20,22 @@ def _join_launcher_process_group():
     """Join the process group described by the launcher's DMLC_* env
     contract (tools/launch.py) BEFORE anything touches the jax backend
     — jax.distributed.initialize must run ahead of backend init, and
-    importing the package is the first thing every worker does."""
+    importing the package is the first thing every worker does. The
+    join itself (env parsing, coordinator retry) lives in
+    fault.join_process_group, shared with kvstore creation."""
     import os
     if int(os.environ.get("DMLC_NUM_WORKER", "1") or 1) <= 1 \
             or "DMLC_WORKER_ID" not in os.environ:
         return
-    import jax
-    try:
-        jax.distributed.initialize(
-            coordinator_address="%s:%s" % (
-                os.environ.get("DMLC_PS_ROOT_URI", "127.0.0.1"),
-                os.environ.get("DMLC_PS_ROOT_PORT", "9091")),
-            num_processes=int(os.environ["DMLC_NUM_WORKER"]),
-            process_id=int(os.environ["DMLC_WORKER_ID"]))
-    except RuntimeError:
-        pass                  # already in a group (manual initialize)
+    from . import fault
+    fault.join_process_group()
 
 
 _join_launcher_process_group()
 
 from .base import MXNetError
+from . import fault
+from .fault import CollectiveTimeoutError, InjectedFault
 from .context import Context, cpu, gpu, tpu, cpu_pinned, current_context, \
     num_gpus, num_tpus, gpu_memory_info
 from .name import NameManager

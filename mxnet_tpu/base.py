@@ -21,7 +21,19 @@ from typing import Any, Callable, Dict, Generic, Optional, TypeVar
 __all__ = [
     "MXNetError", "NotImplementedForSymbol", "get_env", "Registry",
     "string_types", "numeric_types", "integer_types", "classproperty",
+    "atomic_write_bytes",
 ]
+
+
+def atomic_write_bytes(fname, payload):
+    """write-then-rename: a preempted save leaves the old file intact,
+    never a truncated new one. The one shared copy of the discipline
+    (symbol JSON, optimizer states; nd.save keeps its own because
+    np.savez needs the open file object)."""
+    tmp = fname + ".tmp"
+    with open(tmp, "wb") as sink:
+        sink.write(payload)
+    os.replace(tmp, fname)
 
 string_types = (str,)
 numeric_types = (float, int)

@@ -94,7 +94,11 @@ def bulk(size):
 
 def wait_for_all():
     from .ndarray import waitall
-    waitall()
+    from . import fault
+    # faultable sync point: a planned hang here surfaces as a typed
+    # CollectiveTimeoutError after MXNET_KVSTORE_TIMEOUT instead of
+    # wedging the host thread (site "wait" in MXNET_FAULT_PLAN)
+    return fault.guard(waitall, "wait")
 
 
 @contextlib.contextmanager

@@ -1,0 +1,550 @@
+"""Fault-tolerance subsystem: deterministic fault injection, non-finite
+gradient guards, and retrying synchronization wrappers.
+
+The reference MXNet survived worker churn through ps-lite's server-side
+state (SURVEY §5.8); here resilience is host-side and testable:
+
+- **Fault injection** (:class:`FaultPlan`) — ``MXNET_FAULT_PLAN`` holds a
+  ``;``-separated list of ``site:step=N:action[:count=K]`` entries, e.g.
+  ``push:step=3:raise``, ``allreduce:step=7:hang``, ``grad:step=5:nan``.
+  Injection points (:func:`inject`) are threaded through kvstore
+  push/pull, the collective wrappers, ``engine.wait_for_all``, process
+  group init, and the optimizer updater (site ``grad``). A site's step
+  counter counts *visits* (for retried sites, attempts); an entry fires
+  on visits ``step .. step+count-1`` (``count=inf`` fires forever). With
+  ``MXNET_FAULT_PLAN`` unset every injection point is a no-op.
+
+- **Non-finite gradient guard** (:func:`filter_gradient`) — policies
+  ``skip_step`` (drop the update, count it in ``stats()``) and
+  ``scale_backoff`` (additionally halve a dynamic loss scale, regrow it
+  after ``MXNET_LOSS_SCALE_WINDOW`` clean steps). Selected with
+  ``MXNET_NONFINITE_GUARD``; a plan containing a ``grad`` site enables
+  ``skip_step`` automatically. Off (zero-cost) otherwise.
+
+- **Retries** (:func:`with_retries`) — exponential backoff + jitter
+  under a wall-clock deadline from ``MXNET_KVSTORE_TIMEOUT``; when the
+  deadline passes, a typed :class:`CollectiveTimeoutError` is raised
+  instead of hanging forever.
+
+State is process-global; :func:`reset` re-reads the environment (tests
+that monkeypatch ``MXNET_*`` vars must call it).
+"""
+from __future__ import annotations
+
+import logging
+import os
+import random
+import threading
+import time
+
+from .base import MXNetError, get_env
+
+__all__ = ["FaultPlan", "InjectedFault", "InjectedHang",
+           "CollectiveTimeoutError", "plan", "set_plan", "reset",
+           "active", "is_enabled", "inject", "with_retries", "guard",
+           "join_process_group", "filter_gradient", "guard_policy",
+           "loss_scale", "stats", "reset_stats"]
+
+_ACTIONS = ("raise", "hang", "nan", "inf")
+# the wired injection points; a typo'd site would otherwise make a
+# chaos run silently test nothing
+_SITES = ("push", "pull", "allreduce", "wait", "init", "grad")
+# corruption needs a value to corrupt — only the grad site carries one
+_VALUE_SITES = ("grad",)
+_GUARD_POLICIES = ("skip_step", "scale_backoff")
+
+_LOSS_SCALE_MAX = 2.0 ** 24
+
+
+class InjectedFault(MXNetError):
+    """A fault raised by a MXNET_FAULT_PLAN entry (action ``raise``)."""
+
+
+class InjectedHang(InjectedFault):
+    """A planned hang: the injection point blocked for
+    MXNET_FAULT_HANG_SECONDS and then surfaced as a timed-out op."""
+
+
+class CollectiveTimeoutError(MXNetError):
+    """A synchronization op (kvstore push/pull, collective, barrier,
+    process-group init) did not complete within MXNET_KVSTORE_TIMEOUT
+    despite retries."""
+
+
+class _PlanEntry:
+    __slots__ = ("site", "step", "action", "count")
+
+    def __init__(self, site, step, action, count):
+        self.site, self.step = site, step
+        self.action, self.count = action, count
+
+    def fires(self, visit):
+        return self.step <= visit < self.step + self.count
+
+    def __repr__(self):
+        spec = "%s:step=%d:%s" % (self.site, self.step, self.action)
+        if self.count != 1:
+            spec += ":count=%s" % ("inf" if self.count == float("inf")
+                                   else int(self.count))
+        return spec
+
+
+def _parse_entry(text):
+    parts = [p.strip() for p in text.split(":") if p.strip()]
+    if len(parts) < 2:
+        raise MXNetError(
+            "fault plan entry %r: want site:step=N:action[:count=K]"
+            % (text,))
+    site, step, count, action = parts[0], 1, 1, None
+    for tok in parts[1:]:
+        if tok.startswith("step="):
+            step = int(tok[len("step="):])
+        elif tok.startswith("count="):
+            val = tok[len("count="):]
+            count = float("inf") if val in ("inf", "-1") else int(val)
+        elif tok in _ACTIONS:
+            action = tok
+        else:
+            raise MXNetError(
+                "fault plan entry %r: unknown token %r (actions: %s)"
+                % (text, tok, "|".join(_ACTIONS)))
+    if action is None:
+        raise MXNetError("fault plan entry %r: no action given" % (text,))
+    if step < 1:
+        raise MXNetError("fault plan entry %r: step is 1-based" % (text,))
+    if site not in _SITES:
+        raise MXNetError(
+            "fault plan entry %r: unknown site %r (sites: %s)"
+            % (text, site, "|".join(_SITES)))
+    if action in ("nan", "inf") and site not in _VALUE_SITES:
+        raise MXNetError(
+            "fault plan entry %r: action %r only applies to value-"
+            "carrying sites (%s)" % (text, action, "|".join(_VALUE_SITES)))
+    return _PlanEntry(site, step, action, count)
+
+
+class FaultPlan:
+    """A parsed MXNET_FAULT_PLAN: entries plus per-site visit counters."""
+
+    def __init__(self, entries):
+        self.entries = list(entries)
+        self._visits = {}
+
+    @classmethod
+    def parse(cls, spec):
+        entries = [
+            _parse_entry(e)
+            for e in spec.replace(";", ",").split(",") if e.strip()]
+        return cls(entries)
+
+    def visit(self, site):
+        """Count one visit to ``site``; return the entry that fires on
+        this visit, or None."""
+        n = self._visits.get(site, 0) + 1
+        self._visits[site] = n
+        for entry in self.entries:
+            if entry.site == site and entry.fires(n):
+                return entry
+        return None
+
+    def has_site(self, site):
+        return any(e.site == site for e in self.entries)
+
+    def __repr__(self):
+        return "FaultPlan(%s)" % ";".join(repr(e) for e in self.entries)
+
+
+# ---------------------------------------------------------------------------
+# process-global state
+# ---------------------------------------------------------------------------
+
+_lock = threading.Lock()
+_plan: FaultPlan | None = None
+_plan_loaded = False
+_guard: str | None = None
+_guard_loaded = False
+_loss_scale_val: float | None = None
+_good_steps = 0
+_jitter_rng = random.Random(0)
+
+
+def _fresh_stats():
+    return {"skipped_steps": 0, "retries": 0, "timeouts": 0,
+            "injected": {}, "resumed_from_epoch": None}
+
+
+_stats = _fresh_stats()
+
+
+def plan():
+    """The active FaultPlan, parsed once from MXNET_FAULT_PLAN (None
+    when unset/empty)."""
+    global _plan, _plan_loaded
+    if not _plan_loaded:
+        with _lock:
+            if not _plan_loaded:
+                spec = os.environ.get("MXNET_FAULT_PLAN", "")
+                _plan = FaultPlan.parse(spec) if spec.strip() else None
+                if _plan is not None and not _plan.entries:
+                    _plan = None
+                _plan_loaded = True
+    return _plan
+
+
+def _reset_guard_state_locked():
+    """Clear guard runtime state (loss scale, regrow window, step
+    tracking); caller holds _lock."""
+    global _guard, _guard_loaded, _loss_scale_val, _good_steps
+    global _seen_indices, _step_clean
+    _guard, _guard_loaded = None, False
+    _loss_scale_val, _good_steps = None, 0
+    _seen_indices, _step_clean = set(), True
+
+
+def set_plan(spec):
+    """Install a plan programmatically (a spec string, a FaultPlan, or
+    None); resets counters, guard resolution, and guard runtime state
+    (loss scale, step tracking) so consecutive experiments start
+    clean."""
+    global _plan, _plan_loaded
+    with _lock:
+        if spec is None or isinstance(spec, FaultPlan):
+            _plan = spec
+        else:
+            _plan = FaultPlan.parse(spec)
+            if not _plan.entries:
+                _plan = None
+        _plan_loaded = True
+        _reset_guard_state_locked()
+    reset_stats()
+
+
+def reset():
+    """Forget cached plan/guard/scale state and re-read the environment
+    on next use. Tests that monkeypatch MXNET_* vars call this."""
+    global _plan, _plan_loaded, _retry_cfg
+    with _lock:
+        _plan, _plan_loaded = None, False
+        _retry_cfg = None
+        _reset_guard_state_locked()
+    reset_stats()
+
+
+def reset_stats():
+    global _stats
+    with _lock:
+        _stats = _fresh_stats()
+
+
+def active():
+    """True when a fault plan is installed."""
+    return plan() is not None
+
+
+def guard_policy():
+    """The resolved non-finite-guard policy: MXNET_NONFINITE_GUARD when
+    set (``off`` disables), else ``skip_step`` when the active plan has
+    a ``grad`` site, else None."""
+    global _guard, _guard_loaded
+    if not _guard_loaded:
+        env = os.environ.get("MXNET_NONFINITE_GUARD", "").strip()
+        if env and env != "off":
+            if env not in _GUARD_POLICIES:
+                raise MXNetError(
+                    "MXNET_NONFINITE_GUARD=%r (want %s|off)"
+                    % (env, "|".join(_GUARD_POLICIES)))
+            resolved = env
+        elif env == "off":
+            resolved = None
+        else:
+            p = plan()
+            resolved = "skip_step" if p is not None and p.has_site("grad") \
+                else None
+        with _lock:
+            _guard, _guard_loaded = resolved, True
+    return _guard
+
+
+def is_enabled():
+    """Cheap hot-path check: any resilience feature (plan or guard) on?"""
+    return active() or guard_policy() is not None
+
+
+# ---------------------------------------------------------------------------
+# injection
+# ---------------------------------------------------------------------------
+
+def _hang_seconds():
+    return get_env("MXNET_FAULT_HANG_SECONDS", 0.05, float)
+
+
+def _corrupt(value, kind):
+    """A poisoned COPY of ``value`` — the caller's buffer is never
+    touched, so an injected fault on an accumulating (grad_req='add')
+    gradient clears with the next backward like a real transient."""
+    import jax.numpy as jnp
+    bad = float("nan") if kind == "nan" else float("inf")
+    if hasattr(value, "copy") and hasattr(value, "asnumpy"):
+        out = value.copy()                    # deep (sparse parts too)
+        target = getattr(out, "_sp_data", out)
+        target._set_data(jnp.full_like(target._data, bad))
+        return out
+    return jnp.full_like(value, bad)
+
+
+def inject(site, value=None):
+    """One injection point. Counts a visit to ``site``; when a plan
+    entry fires: ``raise``→InjectedFault, ``hang``→bounded sleep then
+    InjectedHang, ``nan``/``inf``→return a corrupted copy of ``value``.
+    Returns ``value`` (possibly corrupted) otherwise. No-op without an
+    active plan."""
+    p = plan()
+    if p is None:
+        return value
+    with _lock:
+        entry = p.visit(site)
+        if entry is not None:
+            _stats["injected"][site] = _stats["injected"].get(site, 0) + 1
+    if entry is None:
+        return value
+    if entry.action == "raise":
+        raise InjectedFault("planned fault at site %r (%r)" % (site, entry))
+    if entry.action == "hang":
+        time.sleep(_hang_seconds())
+        raise InjectedHang(
+            "planned hang at site %r (%r): blocked %.3fs"
+            % (site, entry, _hang_seconds()))
+    if value is not None:
+        return _corrupt(value, entry.action)
+    return value
+
+
+# ---------------------------------------------------------------------------
+# retries
+# ---------------------------------------------------------------------------
+
+_retry_cfg = None
+
+
+def _retry_config():
+    """(timeout, backoff, max_backoff) from the environment, parsed
+    once — with_retries sits on the per-key dist push path, so the env
+    must not be re-read per call. reset() re-reads."""
+    global _retry_cfg
+    if _retry_cfg is None:
+        _retry_cfg = (
+            get_env("MXNET_KVSTORE_TIMEOUT", 60.0, float),
+            get_env("MXNET_KVSTORE_RETRY_BACKOFF", 0.05, float),
+            get_env("MXNET_KVSTORE_RETRY_MAX_BACKOFF", 2.0, float))
+    return _retry_cfg
+
+
+def with_retries(fn, timeout=None, backoff=None, max_backoff=None,
+                 retry_on=None, site=None):
+    """Run ``fn()`` with exponential backoff + jitter under a wall-clock
+    deadline; raise :class:`CollectiveTimeoutError` (chaining the last
+    error) once the deadline passes.
+
+    The deadline is enforced BETWEEN attempts: a planned ``hang`` is
+    bounded (it sleeps MXNET_FAULT_HANG_SECONDS then raises), but an op
+    genuinely wedged inside the runtime cannot be preempted from this
+    thread — pair with an external watchdog for that class of failure.
+
+    - ``timeout``: seconds; default MXNET_KVSTORE_TIMEOUT (60).
+    - ``backoff``: first retry delay; default
+      MXNET_KVSTORE_RETRY_BACKOFF (0.05), doubling per attempt up to
+      ``max_backoff`` (MXNET_KVSTORE_RETRY_MAX_BACKOFF, 2.0).
+    - ``retry_on``: exception classes worth retrying; defaults to
+      injected faults plus transient transport errors
+      (ConnectionError/TimeoutError/OSError).
+    - ``site``: optional injection site visited before each attempt, so
+      planned faults exercise the retry path itself.
+    """
+    env_timeout, env_backoff, env_max_backoff = _retry_config()
+    if timeout is None:
+        timeout = env_timeout
+    if backoff is None:
+        backoff = env_backoff
+    if max_backoff is None:
+        max_backoff = env_max_backoff
+    if retry_on is None:
+        retry_on = (InjectedFault, ConnectionError, TimeoutError, OSError)
+    deadline = time.monotonic() + timeout
+    attempt = 0
+    while True:
+        try:
+            if site is not None:
+                inject(site)
+            return fn()
+        except CollectiveTimeoutError:
+            raise
+        except retry_on as exc:
+            now = time.monotonic()
+            if now >= deadline:
+                with _lock:
+                    _stats["timeouts"] += 1
+                raise CollectiveTimeoutError(
+                    "%s did not complete within %.3fs (%d attempt(s); "
+                    "last error %s: %s)"
+                    % (site or getattr(fn, "__name__", "op"), timeout,
+                       attempt + 1, type(exc).__name__, exc)) from exc
+            # jitter BEFORE the deadline clamp so the sleep can never
+            # overshoot the promised wall-clock bound
+            delay = min(backoff * (2.0 ** attempt), max_backoff)
+            delay *= 1.0 + 0.1 * _jitter_rng.random()
+            delay = min(delay, max(deadline - now, 0.0))
+            with _lock:
+                _stats["retries"] += 1
+            time.sleep(delay)
+            attempt += 1
+
+
+def guard(fn, site):
+    """The shared fast-path gate for sync points: ``with_retries`` when
+    a fault plan is active, a plain direct call otherwise — so inactive
+    runs pay neither injection accounting nor deadline bookkeeping."""
+    if active():
+        return with_retries(fn, site=site)
+    return fn()
+
+
+def join_process_group():
+    """Join the process group described by the launcher's DMLC_* env
+    contract (tools/launch.py; ref dmlc tracker env in
+    python/mxnet/kvstore_server.py), retrying transient coordinator
+    races under the kvstore deadline. No-op without a contract; an
+    already-joined process surfaces as RuntimeError and is left alone.
+    Shared by package import (pre-backend-init) and kvstore creation."""
+    import os
+    n = int(os.environ.get("DMLC_NUM_WORKER", "1") or 1)
+    if n <= 1 or "DMLC_WORKER_ID" not in os.environ:
+        return
+    import jax
+    try:
+        with_retries(
+            lambda: jax.distributed.initialize(
+                coordinator_address="%s:%s" % (
+                    os.environ.get("DMLC_PS_ROOT_URI", "127.0.0.1"),
+                    os.environ.get("DMLC_PS_ROOT_PORT", "9091")),
+                num_processes=n,
+                process_id=int(os.environ["DMLC_WORKER_ID"])),
+            retry_on=(ConnectionError, OSError, InjectedFault),
+            site="init")
+    except RuntimeError:
+        pass          # already initialized
+
+
+# ---------------------------------------------------------------------------
+# non-finite gradient guard
+# ---------------------------------------------------------------------------
+
+def _all_finite(grad):
+    import jax.numpy as jnp
+    x = getattr(grad, "_sp_data", None)
+    if x is None:
+        x = grad
+    data = x._data if hasattr(x, "_data") else x
+    return bool(jnp.isfinite(data).all())
+
+
+def loss_scale():
+    """Current dynamic loss scale (scale_backoff policy); 1.0 when that
+    policy is off. The training loop multiplies the loss by this before
+    backward; gluon ``Trainer.step`` divides it back out of the update."""
+    global _loss_scale_val
+    if guard_policy() != "scale_backoff":
+        return 1.0
+    if _loss_scale_val is None:
+        _loss_scale_val = get_env("MXNET_LOSS_SCALE", 2.0 ** 15, float)
+    return _loss_scale_val
+
+
+def _backoff_scale():
+    global _loss_scale_val, _good_steps
+    prev = loss_scale()
+    _loss_scale_val = max(prev * 0.5, 1.0)
+    _good_steps = 0
+    return prev, _loss_scale_val
+
+
+# The updater runs once per parameter index per optimizer step; the
+# guard's accounting is per STEP (one halving / one skipped_steps count
+# no matter how many of the step's gradients overflowed). A repeating
+# index marks the next step's first update.
+_seen_indices: set = set()
+_step_clean = True
+
+
+def _close_step():
+    """End-of-step accounting: a fully clean step advances the regrow
+    window (scale_backoff); a bad step already halved on its first
+    non-finite gradient."""
+    global _loss_scale_val, _good_steps
+    if guard_policy() != "scale_backoff" or not _step_clean:
+        return
+    _good_steps += 1
+    window = get_env("MXNET_LOSS_SCALE_WINDOW", 2000, int)
+    if _good_steps >= window:
+        _loss_scale_val = min(loss_scale() * 2.0, _LOSS_SCALE_MAX)
+        _good_steps = 0
+
+
+def _note_step_boundary(index):
+    global _seen_indices, _step_clean
+    if index in _seen_indices:
+        _close_step()
+        _seen_indices = set()
+        _step_clean = True
+    _seen_indices.add(index)
+
+
+def filter_gradient(index, grad):
+    """The optimizer-updater guard: apply any planned ``grad`` fault,
+    then test finiteness under the active policy. Returns
+    ``(grad, skip)``; ``skip=True`` means drop this parameter's update.
+    stats()['skipped_steps'] and the scale_backoff halving advance once
+    per optimizer step, however many of its gradients overflowed."""
+    grad = inject("grad", value=grad)
+    policy = guard_policy()
+    if policy is None:
+        return grad, False
+    _note_step_boundary(index)
+    if _all_finite(grad):
+        return grad, False
+    global _step_clean
+    first_bad = _step_clean
+    _step_clean = False
+    if first_bad:
+        with _lock:
+            _stats["skipped_steps"] += 1
+        if policy == "scale_backoff":
+            prev, cur = _backoff_scale()
+            logging.warning(
+                "fault: non-finite gradient for index %s — skipping "
+                "update, loss scale %g -> %g", index, prev, cur)
+        else:
+            logging.warning(
+                "fault: non-finite gradient for index %s — skipping "
+                "update (policy=skip_step)", index)
+    return grad, True
+
+
+# ---------------------------------------------------------------------------
+# stats
+# ---------------------------------------------------------------------------
+
+def note_resume(epoch):
+    with _lock:
+        _stats["resumed_from_epoch"] = epoch
+
+
+def stats():
+    """Queryable resilience counters: skipped_steps, retries, timeouts,
+    per-site injected counts, resumed_from_epoch, loss_scale,
+    guard_policy."""
+    with _lock:
+        out = dict(_stats)
+        out["injected"] = dict(_stats["injected"])
+    out["loss_scale"] = loss_scale()
+    out["guard_policy"] = guard_policy()
+    return out

@@ -8,6 +8,13 @@ Own structure: the parameter roster is validated once into an indexed
 list; kvstore resolution lives in a single ``_resolve_kvstore`` step;
 the update loop separates its skip conditions from the sparse-grad
 fast path.
+
+Fault tolerance: every update funnels through the shared ``Updater``,
+so the non-finite gradient guard and planned ``grad`` faults
+(``mxnet_tpu.fault``) apply here exactly as in Module; dist pushes in
+``allreduce_grads`` inherit the kvstore's retry/timeout guarding, and
+``step`` unscales by the dynamic loss scale under the scale_backoff
+policy.
 """
 from __future__ import annotations
 
@@ -142,10 +149,23 @@ class Trainer:
                 if not self._update_on_kvstore:
                     self._kvstore.pull(i, param.grad())
 
+    def _step_rescale(self, batch_size):
+        """1/batch_size rescale, additionally unscaling by the dynamic
+        loss scale when the scale_backoff guard is active (the user
+        multiplies the loss by ``fault.loss_scale()`` before backward;
+        the updater sees unit-scale gradients and the guard's NaN/Inf
+        skip + backoff handles overflowed steps). Straight 1/batch when
+        the guard is off."""
+        from .. import fault
+        scale = self._scale / batch_size
+        if fault.guard_policy() == 'scale_backoff':
+            scale /= fault.loss_scale()
+        self._sync_rescale(scale)
+
     def step(self, batch_size, ignore_stale_grad=False):
         """allreduce + update, rescaled by batch size
         (reference: trainer.py:302)."""
-        self._sync_rescale(self._scale / batch_size)
+        self._step_rescale(batch_size)
         if not self._kv_initialized:
             self._init_kvstore()
         if self._kvstore is not None:
@@ -162,7 +182,7 @@ class Trainer:
                 'update() when parameters are updated on kvstore is '
                 'not supported. Try setting `update_on_kvstore` to '
                 'False when creating trainer.')
-        self._sync_rescale(self._scale / batch_size)
+        self._step_rescale(batch_size)
         self._apply_updates(ignore_stale_grad)
 
     def _sync_rescale(self, scale):
@@ -243,8 +263,9 @@ class Trainer:
             self._kvstore.save_optimizer_states(fname,
                                                 dump_optimizer=True)
             return
-        with open(fname, 'wb') as sink:
-            sink.write(self._updaters[0].get_states(dump_optimizer=True))
+        from ..base import atomic_write_bytes
+        atomic_write_bytes(
+            fname, self._updaters[0].get_states(dump_optimizer=True))
 
     def load_states(self, fname):
         if not self._kv_initialized:

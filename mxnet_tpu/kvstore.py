@@ -12,15 +12,31 @@ Types (factory semantics mirror kvstore.cc:40 substring matching):
   ICI mesh via jax.distributed rank/size when launched multi-process,
   replacing the ps-lite ZPush/ZPull path wholesale.
 - ``dist_async`` — accepted; degrades to sync (documented divergence,
-  SURVEY §2.2 Async SGD row).
+  SURVEY §2.2 Async SGD row), announced by a one-time warning.
 
 ``update_on_kvstore`` semantics, optimizer/updater hosting, row_sparse
 pull, and gradient-compression API parity are kept.
+
+Fault tolerance (see README "Fault tolerance" + ``mxnet_tpu.fault``):
+dist-type push/pull run under ``fault.with_retries`` — transient
+transport errors and planned faults (``MXNET_FAULT_PLAN`` sites
+``push``/``pull``/``allreduce``/``init``) are retried with exponential
+backoff, and a persistently failing op raises
+``CollectiveTimeoutError`` after ``MXNET_KVSTORE_TIMEOUT`` instead of
+erroring out on the first attempt. Caveat: retrying a CROSS-PROCESS
+collective is only coordinated when the fault is symmetric (a planned
+fault fires on every worker running the same plan; real one-sided
+transport errors need the symmetric retry barrier a later elastic PR
+adds) — the proven lanes are the single-process degenerate case and
+planned-fault chaos runs.
 """
 from __future__ import annotations
 
+import functools
+import logging
 import pickle
 
+from . import fault
 from .base import MXNetError
 from . import optimizer as opt
 from .ndarray import NDArray
@@ -71,32 +87,35 @@ class _TwoBitCompressor:
 
 
 def _ensure_process_group():
-    """Join the process group described by the launcher's DMLC_* env
-    contract (tools/launch.py; ref dmlc tracker env in
-    python/mxnet/kvstore_server.py). A dist kvstore created in a worker
-    spawned by ``python -m mxnet_tpu.tools.launch -n N ...`` calls
-    ``jax.distributed.initialize`` against the shared coordinator; a
-    process already in a group (manual initialize, TPU pod runtime) or
-    with no contract in the env is left untouched."""
+    """A dist kvstore created in a worker spawned by ``python -m
+    mxnet_tpu.tools.launch -n N ...`` joins the DMLC_* process group
+    (fault.join_process_group — retrying, shared with package import);
+    a process already in a group (manual initialize, TPU pod runtime)
+    or with no contract in the env is left untouched."""
     import jax
     try:
         if jax.process_count() > 1:
             return
     except Exception:
         pass
-    import os
-    n = int(os.environ.get("DMLC_NUM_WORKER", "1") or 1)
-    if n <= 1 or "DMLC_WORKER_ID" not in os.environ:
-        return
-    uri = os.environ.get("DMLC_PS_ROOT_URI", "127.0.0.1")
-    port = os.environ.get("DMLC_PS_ROOT_PORT", "9091")
-    try:
-        jax.distributed.initialize(
-            coordinator_address="%s:%s" % (uri, port),
-            num_processes=n,
-            process_id=int(os.environ["DMLC_WORKER_ID"]))
-    except RuntimeError:
-        pass          # already initialized
+    fault.join_process_group()
+
+
+_DIST_ASYNC_WARNED = False
+
+
+def _warn_dist_async_once():
+    """dist_async degrades to synchronous updates on this backend (the
+    documented divergence, SURVEY §2.2 Async SGD row) — say so once
+    instead of silently changing semantics."""
+    global _DIST_ASYNC_WARNED
+    if not _DIST_ASYNC_WARNED:
+        _DIST_ASYNC_WARNED = True
+        logging.warning(
+            "kvstore 'dist_async' degrades to synchronous updates on "
+            "this backend (documented divergence, SURVEY §2.2 Async SGD "
+            "row): pushes are psum-reduced across workers like "
+            "'tpu_sync', with the same retry/timeout guarding.")
 
 
 class KVStore:
@@ -111,6 +130,8 @@ class KVStore:
         self._compression_params = None
         self._is_dist = ("dist" in kv_type) or ("tpu" in kv_type)
         if self._is_dist:
+            if "async" in kv_type:
+                _warn_dist_async_once()
             _ensure_process_group()
 
     # -- identity --------------------------------------------------------
@@ -142,6 +163,16 @@ class KVStore:
                 v = v[0]
             self._data[k] = v.copy()
 
+    def _guarded(self, fn, site):
+        """Run one sync phase under fault.with_retries on dist stores
+        (and whenever a fault plan is active); the local fast path
+        stays a direct call. Callers keep state mutation OUT of the
+        retried region — the injection point fires at the top of each
+        attempt, and only communication re-runs on failure."""
+        if self._is_dist:
+            return fault.with_retries(fn, site=site)
+        return fault.guard(fn, site)
+
     def push(self, key, value, priority=0):
         """Aggregate value(s) into the store.
 
@@ -151,29 +182,39 @@ class KVStore:
         """
         keys, vals = _ctype_key_value(key, value)
         for k, v in zip(keys, vals):
-            if isinstance(v, (list, tuple)):
-                # CommDevice semantics (comm.h:451): gather the
-                # per-device copies onto the first device's placement,
-                # then tree-sum there (XLA fuses the adds).
-                vs = [v[0]] + [self._like(x, v[0]) for x in v[1:]]
-                agg = self._tree_sum(vs)
-            else:
-                agg = v
-            comp = getattr(self, "_compression", None)
-            if comp is not None:
-                from .ndarray.sparse import BaseSparseNDArray
-                if not isinstance(agg, BaseSparseNDArray):
-                    agg = comp.compress(k, agg)
-            agg = self._global_reduce(agg)
-            if self._optimizer is not None:
-                self._ensure_updater()
-            if self._updater is not None:
-                self._align_placement(agg, self._data[k])
-                self._updater(self._key_index(k), agg, self._data[k])
-            else:
-                # KVStoreLocal without updater: merged value replaces the
-                # stored one (kvstore_local.h PushImpl assign semantics)
-                self._data[k] = agg.copy()
+            self._push_one(k, v)
+
+    def _push_one(self, k, v):
+        # local phase — aggregation and compression mutate worker-local
+        # state (compression residual), so they run exactly once
+        if isinstance(v, (list, tuple)):
+            # CommDevice semantics (comm.h:451): gather the
+            # per-device copies onto the first device's placement,
+            # then tree-sum there (XLA fuses the adds).
+            vs = [v[0]] + [self._like(x, v[0]) for x in v[1:]]
+            agg = self._tree_sum(vs)
+        else:
+            agg = v
+        comp = getattr(self, "_compression", None)
+        if comp is not None:
+            from .ndarray.sparse import BaseSparseNDArray
+            if not isinstance(agg, BaseSparseNDArray):
+                agg = comp.compress(k, agg)
+        # communication phase — the only retried region; re-running the
+        # reduce is free of side effects on this worker
+        agg = self._guarded(functools.partial(self._global_reduce, agg),
+                            site="push")
+        # apply phase — runs at most once per push, so a retried
+        # transport failure can never double-apply an optimizer update
+        if self._optimizer is not None:
+            self._ensure_updater()
+        if self._updater is not None:
+            self._align_placement(agg, self._data[k])
+            self._updater(self._key_index(k), agg, self._data[k])
+        else:
+            # KVStoreLocal without updater: merged value replaces the
+            # stored one (kvstore_local.h PushImpl assign semantics)
+            self._data[k] = agg.copy()
 
     @staticmethod
     def _tree_sum(vals):
@@ -313,26 +354,31 @@ class KVStore:
             arr.shape, ctx=arr._ctx)
 
     def pull(self, key, out=None, priority=0, ignore_sparse=True):
-        from .ndarray.sparse import BaseSparseNDArray
         keys, outs = _ctype_key_value(key, out)
         for k, o in zip(keys, outs):
-            if k not in self._data:
-                raise MXNetError("kvstore: key %s not initialized" % str(k))
-            v = self._data[k]
-            if isinstance(v, BaseSparseNDArray):
-                if ignore_sparse:
-                    continue  # reference pull skips sparse values
-                tgts = o if isinstance(o, (list, tuple)) else [o]
-                for tgt in tgts:
-                    v.copyto(tgt)
-                continue
-            if isinstance(o, (list, tuple)):
-                # Broadcast: each destination keeps its own placement
-                # (comm.h Broadcast copies back out to every device).
-                for oo in o:
-                    oo._set_data(self._like(v, oo)._data)
-            else:
-                o._set_data(self._like(v, o)._data)
+            self._guarded(
+                functools.partial(self._pull_one, k, o, ignore_sparse),
+                site="pull")
+
+    def _pull_one(self, k, o, ignore_sparse):
+        from .ndarray.sparse import BaseSparseNDArray
+        if k not in self._data:
+            raise MXNetError("kvstore: key %s not initialized" % str(k))
+        v = self._data[k]
+        if isinstance(v, BaseSparseNDArray):
+            if ignore_sparse:
+                return  # reference pull skips sparse values
+            tgts = o if isinstance(o, (list, tuple)) else [o]
+            for tgt in tgts:
+                v.copyto(tgt)
+            return
+        if isinstance(o, (list, tuple)):
+            # Broadcast: each destination keeps its own placement
+            # (comm.h Broadcast copies back out to every device).
+            for oo in o:
+                oo._set_data(self._like(v, oo)._data)
+        else:
+            o._set_data(self._like(v, o)._data)
 
     def pushpull(self, key, value, out=None, priority=0):
         self.push(key, value, priority)
@@ -441,8 +487,8 @@ class KVStore:
     def save_optimizer_states(self, fname, dump_optimizer=False):
         assert self._updater is not None, "Cannot save states for " \
             "distributed training without updater"
-        with open(fname, 'wb') as fout:
-            fout.write(self._updater.get_states(dump_optimizer))
+        from .base import atomic_write_bytes
+        atomic_write_bytes(fname, self._updater.get_states(dump_optimizer))
 
     def load_optimizer_states(self, fname):
         assert self._updater is not None, "Cannot load states for " \

@@ -1,6 +1,15 @@
 """Model helpers: checkpoints + kvstore wiring (parity:
-python/mxnet/model.py)."""
+python/mxnet/model.py).
+
+Checkpoint writes are atomic (write to ``*.tmp``, ``os.replace``) so a
+preempted save never leaves a truncated param/symbol file behind, and
+``load_latest_valid_checkpoint`` gives ``fit(resume_from_checkpoint=..)``
+its scan-and-validate resume point (see README "Fault tolerance")."""
 from __future__ import annotations
+
+import logging
+import os
+import re
 
 from collections import namedtuple
 
@@ -9,7 +18,7 @@ from . import ndarray as nd
 from . import symbol as sym
 
 __all__ = ["BatchEndParam", "save_checkpoint", "load_checkpoint",
-           "load_params"]
+           "load_params", "load_latest_valid_checkpoint"]
 
 BatchEndParam = namedtuple('BatchEndParams',
                            ['epoch', 'nbatch', 'eval_metric', 'locals'])
@@ -97,7 +106,8 @@ def _update_params(param_arrays, grad_arrays, updater, num_device,
 def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params,
                     remove_amp_cast=True):
     """Write prefix-symbol.json + prefix-%04d.params
-    (reference: model.py:394)."""
+    (reference: model.py:394). Both writes are atomic (Symbol.save and
+    nd.save are write-then-rename underneath)."""
     if symbol is not None:
         symbol.save('%s-symbol.json' % prefix)
     save_dict = {('arg:%s' % k): v for k, v in arg_params.items()}
@@ -124,3 +134,33 @@ def load_checkpoint(prefix, epoch):
     symbol = sym.load('%s-symbol.json' % prefix)
     arg_params, aux_params = load_params(prefix, epoch)
     return (symbol, arg_params, aux_params)
+
+
+def list_checkpoint_epochs(prefix):
+    """Epochs with a ``prefix-%04d.params`` file on disk, ascending.
+    (\\d+, not \\d{4}: '%04d' grows past four digits at epoch 10000.)"""
+    directory = os.path.dirname(prefix) or '.'
+    base = os.path.basename(prefix)
+    pat = re.compile(re.escape(base) + r'-(\d+)\.params$')
+    if not os.path.isdir(directory):
+        return []
+    epochs = {int(m.group(1)) for f in os.listdir(directory)
+              for m in [pat.match(f)] if m}
+    return sorted(epochs)
+
+
+def load_latest_valid_checkpoint(prefix):
+    """Newest checkpoint under ``prefix`` that loads cleanly, as
+    ``(epoch, arg_params, aux_params)``; corrupt or partial param files
+    (a preempted non-atomic writer, a torn copy) are skipped with a
+    warning and the scan falls back to the next older epoch. Returns
+    None when nothing usable exists."""
+    for epoch in reversed(list_checkpoint_epochs(prefix)):
+        try:
+            arg_params, aux_params = load_params(prefix, epoch)
+            return (epoch, arg_params, aux_params)
+        except Exception as exc:
+            logging.warning(
+                'skipping corrupt/partial checkpoint %s-%04d.params '
+                '(%s: %s)', prefix, epoch, type(exc).__name__, exc)
+    return None

@@ -140,6 +140,55 @@ class BaseModule:
             return output_list2
         return output_list
 
+    def _resume_point(self, resume_from_checkpoint, checkpoint_prefix):
+        """Resolve fit's auto-resume request: scan the checkpoint prefix
+        for the newest epoch whose params load cleanly (corrupt/partial
+        files are skipped with a warning) and return
+        (next_epoch, arg_params, aux_params), or None when nothing
+        usable exists."""
+        from ..model import load_latest_valid_checkpoint
+        from .. import fault
+        prefix = resume_from_checkpoint \
+            if isinstance(resume_from_checkpoint, str) else checkpoint_prefix
+        if not prefix:
+            raise ValueError(
+                'resume_from_checkpoint needs a prefix: pass '
+                'checkpoint_prefix=... or resume_from_checkpoint="<prefix>"')
+        found = load_latest_valid_checkpoint(prefix)
+        if found is None:
+            self.logger.info(
+                'fit: no usable checkpoint under %s; starting fresh',
+                prefix)
+            return None
+        epoch, args, auxs = found
+        self._stage_resume_opt_states('%s-%04d.states' % (prefix, epoch))
+        fault.note_resume(epoch)
+        self.logger.info(
+            'fit: resuming from checkpoint %s-%04d.params at epoch %d',
+            prefix, epoch, epoch + 1)
+        return (epoch + 1, args, auxs)
+
+    def _stage_resume_opt_states(self, states_file):
+        """Stage the matching optimizer-state file for init_optimizer's
+        preload hook (momentum/moments continue instead of silently
+        resetting); a missing or corrupt file downgrades to a
+        params-only resume with a warning."""
+        import os
+        import pickle
+        if not hasattr(self, '_preload_opt_states') \
+                or not os.path.isfile(states_file):
+            return
+        try:
+            with open(states_file, 'rb') as src:
+                pickle.loads(src.read())      # validate before staging
+        except Exception as exc:
+            self.logger.warning(
+                'fit: optimizer states %s are corrupt (%s: %s); '
+                'resuming with params only', states_file,
+                type(exc).__name__, exc)
+            return
+        self._preload_opt_states = states_file
+
     def fit(self, train_data, eval_data=None, eval_metric='acc',
             epoch_end_callback=None, batch_end_callback=None,
             kvstore='local', optimizer='sgd',
@@ -148,9 +197,32 @@ class BaseModule:
             initializer=Uniform(0.01), arg_params=None, aux_params=None,
             allow_missing=False, force_rebind=False, force_init=False,
             begin_epoch=0, num_epoch=None, validation_metric=None,
-            monitor=None, sparse_row_id_fn=None):
-        """The canonical training loop (reference: base_module.py:409)."""
+            monitor=None, sparse_row_id_fn=None, checkpoint_prefix=None,
+            resume_from_checkpoint=False, checkpoint_period=1):
+        """The canonical training loop (reference: base_module.py:409).
+
+        Fault tolerance extensions (see README "Fault tolerance"):
+        ``checkpoint_prefix`` saves an atomic epoch-granularity
+        checkpoint every ``checkpoint_period`` epochs, and
+        ``resume_from_checkpoint=True`` (or an explicit prefix string)
+        scans that prefix for the latest epoch whose params validate,
+        loads them, and continues from the following epoch — corrupt or
+        truncated files are skipped with a warning. Non-finite-gradient
+        skip counts accumulate in ``mxnet_tpu.fault.stats()``.
+        """
+        from .. import fault
         assert num_epoch is not None, 'please specify number of epochs'
+        # stats are process-global and cumulative: report only THIS
+        # fit's guard skips at the end
+        skipped_at_entry = fault.stats()['skipped_steps'] \
+            if fault.is_enabled() else 0
+        if resume_from_checkpoint:
+            resumed = self._resume_point(resume_from_checkpoint,
+                                         checkpoint_prefix)
+            if resumed is not None:
+                resume_epoch, arg_params, aux_params = resumed
+                begin_epoch = max(begin_epoch, resume_epoch)
+                force_init = True
         self.bind(data_shapes=train_data.provide_data,
                   label_shapes=train_data.provide_label,
                   for_training=True, force_rebind=force_rebind)
@@ -210,6 +282,15 @@ class BaseModule:
 
             arg_params, aux_params = self.get_params()
             self.set_params(arg_params, aux_params)
+            if checkpoint_prefix is not None and \
+                    (epoch + 1) % max(checkpoint_period, 1) == 0:
+                from ..model import save_checkpoint as _save_ckpt
+                _save_ckpt(checkpoint_prefix, epoch, self.symbol,
+                           arg_params, aux_params)
+                if getattr(self, 'optimizer_initialized', False) and \
+                        hasattr(self, 'save_optimizer_states'):
+                    self.save_optimizer_states(
+                        '%s-%04d.states' % (checkpoint_prefix, epoch))
             if epoch_end_callback is not None:
                 for callback in _as_list(epoch_end_callback):
                     callback(epoch, self.symbol, arg_params, aux_params)
@@ -223,6 +304,13 @@ class BaseModule:
                     self.logger.info('Epoch[%d] Validation-%s=%f', epoch,
                                      name, val)
             train_data.reset()
+
+        if fault.is_enabled():
+            skipped = fault.stats()['skipped_steps'] - skipped_at_entry
+            if skipped:
+                self.logger.warning(
+                    'fit: %d optimizer step(s) skipped by the '
+                    'non-finite gradient guard (fault.stats())', skipped)
 
     # -- symbol / params -------------------------------------------------
     @property

@@ -87,6 +87,9 @@ class Module(BaseModule):
 
     def save_checkpoint(self, prefix, epoch, save_optimizer_states=False,
                         remove_amp_cast=True):
+        # every piece is written atomically (tmp + os.replace in
+        # Symbol.save / nd.save / base.atomic_write_bytes) so a
+        # preempted save never strands a truncated file
         self._symbol.save('%s-symbol.json' % prefix)
         param_file = '%s-%04d.params' % (prefix, epoch)
         self.save_params(param_file)
@@ -431,8 +434,8 @@ class Module(BaseModule):
         if self._update_on_kvstore:
             self._kvstore.save_optimizer_states(fname)
             return
-        with open(fname, 'wb') as sink:
-            sink.write(self._updater.get_states())
+        from ..base import atomic_write_bytes
+        atomic_write_bytes(fname, self._updater.get_states())
 
     def load_optimizer_states(self, fname):
         assert self.optimizer_initialized

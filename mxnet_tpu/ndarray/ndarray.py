@@ -898,14 +898,13 @@ def save(fname, data):
     else:
         raise ValueError("data needs to either be a NDArray, dict of (str, "
                          "NDArray) pairs or a list of NDarrays.")
-    _np.savez(_ensure_npz(fname), **arrays)
+    # write-then-rename: a preempted save can never leave a truncated
+    # file at fname (the file object keeps numpy from appending .npz)
     import os
-    if not fname.endswith(".npz") and os.path.exists(fname + ".npz"):
-        os.replace(fname + ".npz", fname)
-
-
-def _ensure_npz(fname):
-    return fname if fname.endswith(".npz") else fname
+    tmp = fname + ".tmp"
+    with open(tmp, "wb") as sink:
+        _np.savez(sink, **arrays)
+    os.replace(tmp, fname)
 
 
 def _unflatten(loaded):

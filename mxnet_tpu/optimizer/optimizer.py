@@ -624,7 +624,15 @@ def create(name, **kwargs):
 
 class Updater:
     """KVStore-side state bookkeeping around one Optimizer
-    (reference: optimizer.py:1608)."""
+    (reference: optimizer.py:1608).
+
+    Every update funnels through ``__call__`` — Module, gluon Trainer
+    and kvstore-hosted optimizers alike — so this is where the
+    fault-tolerance layer sits: planned ``grad`` faults are injected
+    and the non-finite gradient guard (skip_step / scale_backoff,
+    ``mxnet_tpu.fault``) drops poisoned updates before they can reach
+    the weights. Zero-cost straight-through path when no plan or guard
+    policy is active."""
 
     def __init__(self, optimizer):
         self.optimizer = optimizer
@@ -633,6 +641,11 @@ class Updater:
         self.aggregate_updates = optimizer.aggregate_num > 0
 
     def __call__(self, index, grad, weight):
+        from .. import fault
+        if fault.is_enabled():
+            grad, skip = fault.filter_gradient(index, grad)
+            if skip:
+                return
         if index not in self.states:
             self.states[index] = \
                 self.optimizer.create_state_multi_precision(index, weight)

@@ -37,9 +37,14 @@ def _shard_map():
 
 def all_reduce(x, mesh, axis="dp", op="sum"):
     """Sum the shards of ``x`` along a mesh axis; result is the reduced
-    (replicated) value — CommDevice::Reduce / ncclReduce role."""
+    (replicated) value — CommDevice::Reduce / ncclReduce role.
+
+    When a fault plan is active (site ``allreduce``) the eager call runs
+    under ``fault.with_retries``: planned/transient failures back off
+    and retry, an unrecoverable hang raises CollectiveTimeoutError."""
     import jax
     from jax.sharding import PartitionSpec as P
+    from .. import fault
 
     def f(v):
         if op == "sum":
@@ -50,8 +55,11 @@ def all_reduce(x, mesh, axis="dp", op="sum"):
             return jax.lax.pmean(v, axis)
         raise ValueError(op)
 
-    return _shard_map()(f, mesh=mesh, in_specs=(P(axis),),
-                        out_specs=P())(x)
+    def run():
+        return _shard_map()(f, mesh=mesh, in_specs=(P(axis),),
+                            out_specs=P())(x)
+
+    return fault.guard(run, "allreduce")
 
 
 def all_gather(x, mesh, axis="dp", tiled=True):

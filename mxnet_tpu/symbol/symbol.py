@@ -401,8 +401,8 @@ class Symbol:
         }, indent=2)
 
     def save(self, fname):
-        with open(fname, "w") as f:
-            f.write(self.tojson())
+        from ..base import atomic_write_bytes
+        atomic_write_bytes(fname, self.tojson().encode("utf-8"))
 
     # -- composition helpers --------------------------------------------
     def _binary(self, other, op, scalar_op, reverse=False):

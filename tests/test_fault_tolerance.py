@@ -1,0 +1,381 @@
+"""Fault-tolerance subsystem (mxnet_tpu.fault).
+
+Deterministic fault injection (MXNET_FAULT_PLAN), the non-finite
+gradient guard, retrying sync wrappers with CollectiveTimeoutError, and
+checkpoint auto-resume — all on the CPU mesh, deterministic seeds, and
+every sleep bounded well under 0.05s (tiny backoff/timeout budgets).
+"""
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import fault
+from mxnet_tpu.model import (list_checkpoint_epochs,
+                             load_latest_valid_checkpoint)
+
+SHAPE = (4, 5)
+
+# small budgets: retries sleep 0.01-0.04s, deadlines expire in ~0.1s
+FAST_RETRY_ENV = {"MXNET_KVSTORE_TIMEOUT": "0.15",
+                  "MXNET_KVSTORE_RETRY_BACKOFF": "0.01",
+                  "MXNET_KVSTORE_RETRY_MAX_BACKOFF": "0.04",
+                  "MXNET_FAULT_HANG_SECONDS": "0.02"}
+
+
+@pytest.fixture(autouse=True)
+def _clean_fault_state(monkeypatch):
+    for k, v in FAST_RETRY_ENV.items():
+        monkeypatch.setenv(k, v)
+    monkeypatch.delenv("MXNET_FAULT_PLAN", raising=False)
+    monkeypatch.delenv("MXNET_NONFINITE_GUARD", raising=False)
+    fault.reset()
+    yield
+    fault.reset()
+
+
+# ---------------------------------------------------------------------------
+# plan parsing
+# ---------------------------------------------------------------------------
+
+def test_plan_parsing_and_env():
+    p = fault.FaultPlan.parse(
+        "push:step=3:raise;allreduce:step=7:hang;grad:step=5:nan;"
+        "pull:step=2:raise:count=inf")
+    assert len(p.entries) == 4
+    e = p.entries[0]
+    assert (e.site, e.step, e.action, e.count) == ("push", 3, "raise", 1)
+    assert p.entries[3].count == float("inf")
+    assert p.has_site("grad") and not p.has_site("wait")
+    # entry fires exactly on its step window
+    assert p.entries[0].fires(3) and not p.entries[0].fires(4)
+    assert p.entries[3].fires(2) and p.entries[3].fires(9999)
+
+
+def test_plan_from_env(monkeypatch):
+    monkeypatch.setenv("MXNET_FAULT_PLAN", "push:step=1:raise")
+    fault.reset()
+    assert fault.active()
+    assert fault.plan().has_site("push")
+    # a grad site auto-enables the skip_step guard; none here
+    assert fault.guard_policy() is None
+
+
+def test_malformed_plan_rejected():
+    with pytest.raises(mx.MXNetError):
+        fault.FaultPlan.parse("push:step=1:explode")
+    with pytest.raises(mx.MXNetError):
+        fault.FaultPlan.parse("justasite")
+    # a typo'd site would silently test nothing; corruption actions
+    # only make sense on the value-carrying grad site
+    with pytest.raises(mx.MXNetError):
+        fault.FaultPlan.parse("alreduce:step=1:raise")
+    with pytest.raises(mx.MXNetError):
+        fault.FaultPlan.parse("push:step=1:nan")
+
+
+def test_inactive_plan_is_straight_through():
+    assert not fault.is_enabled()
+    g = mx.nd.ones((3,))
+    assert fault.inject("push") is None
+    out, skip = fault.filter_gradient(0, g)
+    assert out is g and not skip
+    assert fault.stats()["injected"] == {}
+
+
+# ---------------------------------------------------------------------------
+# retries
+# ---------------------------------------------------------------------------
+
+def test_injected_push_failure_retried_to_success():
+    fault.set_plan("push:step=1:raise")
+    kv = mx.kv.create("local")
+    kv.init(3, mx.nd.zeros(SHAPE))
+    kv.push(3, mx.nd.ones(SHAPE) * 4)
+    out = mx.nd.zeros(SHAPE)
+    kv.pull(3, out=out)
+    np.testing.assert_array_equal(out.asnumpy(), np.ones(SHAPE) * 4)
+    s = fault.stats()
+    assert s["injected"]["push"] == 1
+    assert s["retries"] >= 1
+    assert s["timeouts"] == 0
+
+
+def test_exhausted_retries_raise_collective_timeout():
+    fault.set_plan("push:step=1:raise:count=inf")
+    kv = mx.kv.create("local")
+    kv.init(3, mx.nd.zeros(SHAPE))
+    with pytest.raises(mx.CollectiveTimeoutError):
+        kv.push(3, mx.nd.ones(SHAPE))
+    assert fault.stats()["timeouts"] == 1
+
+
+def test_unrecoverable_hang_raises_instead_of_blocking():
+    fault.set_plan("wait:step=1:hang:count=inf")
+    with pytest.raises(mx.CollectiveTimeoutError):
+        mx.engine.wait_for_all()
+
+
+def test_wait_for_all_recovers_from_single_hang():
+    fault.set_plan("wait:step=1:hang")
+    mx.engine.wait_for_all()        # hang once, retry succeeds
+    assert fault.stats()["injected"]["wait"] == 1
+
+
+def test_with_retries_preserves_return_value():
+    fault.set_plan("init:step=1:raise")
+    assert fault.with_retries(lambda: 42, site="init") == 42
+
+
+def test_collectives_all_reduce_retried():
+    import jax
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 devices")
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from mxnet_tpu import parallel as par
+    mesh = par.local_mesh("dp")
+    x = jnp.arange(16, dtype=jnp.float32)
+    xs = jax.device_put(x, NamedSharding(mesh, P("dp")))
+    fault.set_plan("allreduce:step=1:raise")
+    out = par.all_reduce(xs, mesh, "dp")
+    expected = np.arange(16, dtype=np.float32).reshape(8, 2).sum(axis=0)
+    np.testing.assert_allclose(np.asarray(out)[:2], expected)
+    assert fault.stats()["injected"]["allreduce"] == 1
+
+
+# ---------------------------------------------------------------------------
+# non-finite gradient guard
+# ---------------------------------------------------------------------------
+
+def _updater(lr=0.1):
+    opt = mx.optimizer.create("sgd", learning_rate=lr)
+    return mx.optimizer.get_updater(opt)
+
+
+def test_nan_gradient_skipped_and_counted():
+    fault.set_plan("grad:step=1:nan")
+    assert fault.guard_policy() == "skip_step"   # grad site auto-enables
+    upd = _updater()
+    w = mx.nd.ones((3,))
+    upd(0, mx.nd.ones((3,)), w)                  # poisoned -> skipped
+    np.testing.assert_array_equal(w.asnumpy(), np.ones(3))
+    assert fault.stats()["skipped_steps"] == 1
+    upd(0, mx.nd.ones((3,)), w)                  # clean -> applied
+    np.testing.assert_allclose(w.asnumpy(), np.ones(3) * 0.9, rtol=1e-6)
+    assert fault.stats()["skipped_steps"] == 1
+
+
+def test_guard_catches_organic_nan(monkeypatch):
+    monkeypatch.setenv("MXNET_NONFINITE_GUARD", "skip_step")
+    fault.reset()
+    upd = _updater()
+    w = mx.nd.ones((3,))
+    bad = mx.nd.array(np.array([1.0, np.inf, 1.0], dtype=np.float32))
+    upd(0, bad, w)
+    np.testing.assert_array_equal(w.asnumpy(), np.ones(3))
+    assert fault.stats()["skipped_steps"] == 1
+
+
+def test_scale_backoff_accounts_per_step_not_per_gradient(monkeypatch):
+    """A step where EVERY parameter gradient overflowed halves the
+    scale once (not 2^n_params times); the regrow window counts clean
+    steps, not clean gradients."""
+    monkeypatch.setenv("MXNET_NONFINITE_GUARD", "scale_backoff")
+    monkeypatch.setenv("MXNET_LOSS_SCALE", "1024")
+    monkeypatch.setenv("MXNET_LOSS_SCALE_WINDOW", "2")
+    fault.reset()
+    upd = _updater()
+    ws = [mx.nd.ones((3,)) for _ in range(4)]
+    nan_g = mx.nd.array(np.full(3, np.nan, dtype=np.float32))
+    for i in range(4):                       # step 1: all grads bad
+        upd(i, nan_g, ws[i])
+    assert fault.loss_scale() == 512.0       # ONE halving
+    assert fault.stats()["skipped_steps"] == 1
+    for _ in range(2):                       # steps 2-3: clean
+        for i in range(4):
+            upd(i, mx.nd.ones((3,)), ws[i])
+    upd(0, mx.nd.ones((3,)), ws[0])          # step 4 opens, closes 3
+    assert fault.loss_scale() == 1024.0      # window=2 clean steps grew
+
+
+def test_scale_backoff_halves_loss_scale(monkeypatch):
+    monkeypatch.setenv("MXNET_NONFINITE_GUARD", "scale_backoff")
+    monkeypatch.setenv("MXNET_LOSS_SCALE", "1024")
+    fault.reset()
+    fault.set_plan("grad:step=1:nan")
+    assert fault.loss_scale() == 1024.0
+    upd = _updater()
+    w = mx.nd.ones((3,))
+    upd(0, mx.nd.ones((3,)), w)
+    assert fault.stats()["skipped_steps"] == 1
+    assert fault.loss_scale() == 512.0
+    np.testing.assert_array_equal(w.asnumpy(), np.ones(3))
+
+
+# ---------------------------------------------------------------------------
+# fit survives a planned NaN, final metric within tolerance
+# ---------------------------------------------------------------------------
+
+def _mlp_sym():
+    data = mx.sym.var("data")
+    h = mx.sym.FullyConnected(data, num_hidden=32, name="fc1")
+    h = mx.sym.Activation(h, act_type="relu", name="relu1")
+    h = mx.sym.FullyConnected(h, num_hidden=10, name="fc2")
+    return mx.sym.SoftmaxOutput(h, mx.sym.var("softmax_label"),
+                                name="softmax")
+
+
+def _toy_data(n=256, dim=32, seed=5):
+    rng = np.random.RandomState(seed)
+    centers = rng.normal(0, 1.5, (10, dim))
+    y = rng.randint(0, 10, n)
+    x = (centers[y] + rng.normal(0, 0.4, (n, dim))).astype(np.float32)
+    return x, y.astype(np.float32)
+
+
+def _fit_once(num_epoch=3, **fit_kwargs):
+    x, y = _toy_data()
+    it = mx.io.NDArrayIter(x, y, batch_size=64,
+                           label_name="softmax_label")
+    mx.random.seed(7)
+    np.random.seed(7)
+    mod = mx.module.Module(_mlp_sym())
+    mod.fit(it, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.5, "momentum": 0.9},
+            num_epoch=num_epoch, initializer=mx.init.Xavier(),
+            **fit_kwargs)
+    return mod, mod.score(it, "acc")[0][1]
+
+
+def test_fit_completes_past_planned_nan_gradient():
+    _, acc_clean = _fit_once()
+    fault.set_plan("grad:step=10:nan")
+    _, acc_faulted = _fit_once()
+    assert fault.stats()["skipped_steps"] == 1
+    assert acc_faulted > 0.8
+    assert abs(acc_clean - acc_faulted) < 0.08, (acc_clean, acc_faulted)
+
+
+# ---------------------------------------------------------------------------
+# atomic checkpoints + auto-resume
+# ---------------------------------------------------------------------------
+
+def test_nd_save_is_atomic(tmp_path):
+    fname = str(tmp_path / "x.params")
+    mx.nd.save(fname, {"arg:w": mx.nd.ones((2, 2))})
+    assert os.path.exists(fname)
+    assert not os.path.exists(fname + ".tmp")
+    loaded = mx.nd.load(fname)
+    np.testing.assert_array_equal(loaded["arg:w"].asnumpy(),
+                                  np.ones((2, 2)))
+
+
+def test_save_checkpoint_leaves_no_tmp_files(tmp_path):
+    x, y = _toy_data(n=64)
+    it = mx.io.NDArrayIter(x, y, batch_size=32,
+                           label_name="softmax_label")
+    mod = mx.module.Module(_mlp_sym())
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params()
+    prefix = str(tmp_path / "mlp")
+    mod.save_checkpoint(prefix, 3)
+    files = sorted(os.listdir(tmp_path))
+    assert "mlp-0003.params" in files and "mlp-symbol.json" in files
+    assert not any(f.endswith(".tmp") for f in files)
+    mx.module.Module.load(prefix, 3)
+
+
+def test_load_latest_valid_checkpoint_skips_corrupt(tmp_path):
+    prefix = str(tmp_path / "ck")
+    sym = _mlp_sym()
+    args = {"fc1_weight": mx.nd.ones((2, 2))}
+    mx.model.save_checkpoint(prefix, 0, sym, args, {})
+    mx.model.save_checkpoint(prefix, 1, sym, args, {})
+    assert list_checkpoint_epochs(prefix) == [0, 1]
+    # truncate the newest file mid-write (a preemption with a
+    # non-atomic writer would strand exactly this)
+    with open(prefix + "-0001.params", "wb") as f:
+        f.write(b"PK\x03\x04 torn")
+    epoch, loaded_args, _ = load_latest_valid_checkpoint(prefix)
+    assert epoch == 0
+    np.testing.assert_array_equal(loaded_args["fc1_weight"].asnumpy(),
+                                  np.ones((2, 2)))
+    assert load_latest_valid_checkpoint(str(tmp_path / "nothing")) is None
+
+
+def test_fit_resumes_past_corrupt_epoch_file(tmp_path):
+    prefix = str(tmp_path / "resume")
+    _fit_once(num_epoch=2, checkpoint_prefix=prefix)
+    assert list_checkpoint_epochs(prefix) == [0, 1]
+    # optimizer states ride along with every epoch checkpoint
+    assert os.path.exists(prefix + "-0000.states")
+    with open(prefix + "-0001.params", "wb") as f:
+        f.write(b"\x00garbage")                  # corrupt newest epoch
+    with open(prefix + "-0000.states", "wb") as f:
+        f.write(b"torn")    # corrupt states: params-only resume + warn
+    _, acc = _fit_once(num_epoch=4, checkpoint_prefix=prefix,
+                       resume_from_checkpoint=True)
+    # resumed from epoch 0 (1 was corrupt), trained epochs 1..3
+    assert fault.stats()["resumed_from_epoch"] == 0
+    assert list_checkpoint_epochs(prefix) == [0, 1, 2, 3]
+    found = load_latest_valid_checkpoint(prefix)
+    assert found is not None and found[0] == 3
+    assert acc > 0.8
+
+
+def test_fit_resume_restores_optimizer_states(tmp_path):
+    prefix = str(tmp_path / "momresume")
+    mod, _ = _fit_once(num_epoch=2, checkpoint_prefix=prefix)
+    mod2, _ = _fit_once(num_epoch=3, checkpoint_prefix=prefix,
+                        resume_from_checkpoint=True)
+    # the resumed module restored epoch 1's staged states through
+    # Updater.set_states (which marks restored indices unsynced) rather
+    # than recreating fresh zero momentum buffers
+    upd = mod2._updater if mod2._updater is not None \
+        else mod2._kvstore._updater
+    assert upd.states, "no optimizer state restored"
+    assert any(v is False for v in upd.states_synced.values()), \
+        "states were recreated, not restored"
+
+
+def test_fit_resume_without_prefix_raises():
+    x, y = _toy_data(n=64)
+    it = mx.io.NDArrayIter(x, y, batch_size=32,
+                           label_name="softmax_label")
+    mod = mx.module.Module(_mlp_sym())
+    with pytest.raises(ValueError):
+        mod.fit(it, num_epoch=1, resume_from_checkpoint=True)
+
+
+# ---------------------------------------------------------------------------
+# dist_async degradation is announced once
+# ---------------------------------------------------------------------------
+
+def test_dist_async_warns_once(caplog):
+    import logging
+    from mxnet_tpu import kvstore as kvs
+    kvs._DIST_ASYNC_WARNED = False
+    with caplog.at_level(logging.WARNING):
+        mx.kv.create("dist_async")
+        mx.kv.create("dist_async")
+    hits = [r for r in caplog.records
+            if "dist_async" in r.getMessage()]
+    assert len(hits) == 1
+    assert "degrades to synchronous" in hits[0].getMessage()
+
+
+# ---------------------------------------------------------------------------
+# slow smoke: scratch/faultcheck.py end-to-end
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_faultcheck_smoke():
+    import importlib.util
+    path = os.path.join(os.path.dirname(__file__), os.pardir, "scratch",
+                        "faultcheck.py")
+    spec = importlib.util.spec_from_file_location("faultcheck", path)
+    faultcheck = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(faultcheck)
+    faultcheck.main()

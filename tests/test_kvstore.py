@@ -89,3 +89,45 @@ def test_list_key_push_pull():
     for o in outs:
         np.testing.assert_allclose(o.asnumpy(),
                                    np.ones(SHAPE) * 2 * N_DEV, rtol=1e-6)
+
+
+def _tpu_sync_roundtrip(values):
+    """push the same per-device value lists through a fresh tpu_sync
+    store (single-process: the psum degenerates to identity) and pull
+    the result back."""
+    kv = mx.kv.create("tpu_sync")
+    kv.init(3, mx.nd.zeros(SHAPE))
+    for step_vals in values:
+        kv.push(3, step_vals)
+    out = mx.nd.zeros(SHAPE)
+    kv.pull(3, out=out)
+    return out.asnumpy()
+
+
+def test_tpu_sync_retry_path_byte_identical():
+    """The fault/retry guard around tpu_sync push/pull (single-process
+    psum degenerate case) is byte-identical to the unguarded run when no
+    fault is planned, and still exact when a planned push failure is
+    retried to success."""
+    from mxnet_tpu import fault
+
+    values = [[mx.nd.ones(SHAPE, ctx=mx.cpu(i)) * (i + 1)
+               for i in range(N_DEV)] for _ in range(2)]
+    fault.reset()
+    assert not fault.is_enabled()
+    baseline = _tpu_sync_roundtrip(values)
+    np.testing.assert_array_equal(
+        baseline, np.ones(SHAPE) * sum(range(1, N_DEV + 1)))
+
+    # same pushes with no plan again: bytes must match exactly
+    np.testing.assert_array_equal(_tpu_sync_roundtrip(values), baseline)
+
+    # a planned push failure is retried to success with identical bytes
+    fault.set_plan("push:step=1:raise")
+    try:
+        np.testing.assert_array_equal(_tpu_sync_roundtrip(values),
+                                      baseline)
+        stats = fault.stats()
+        assert stats["injected"]["push"] == 1 and stats["retries"] >= 1
+    finally:
+        fault.reset()
