@@ -340,6 +340,129 @@ def _bench_allreduce_bandwidth():
     return (n_workers + 1) * nbytes * iters / dt / 1e9   # GB/s
 
 
+def _mlp_sym():
+    import mxnet_tpu as mx
+    data = mx.sym.var("data")
+    x = mx.sym.FullyConnected(data, num_hidden=256, name="fc1")
+    x = mx.sym.Activation(x, act_type="relu", name="relu1")
+    x = mx.sym.FullyConnected(x, num_hidden=10, name="fc2")
+    return mx.sym.SoftmaxOutput(x, mx.sym.var("softmax_label"),
+                                name="softmax")
+
+
+def _convnet_sym():
+    import mxnet_tpu as mx
+    data = mx.sym.var("data")
+    x = mx.sym.Convolution(data, kernel=(3, 3), num_filter=8,
+                           name="conv1")
+    x = mx.sym.Activation(x, act_type="relu", name="relu1")
+    x = mx.sym.Pooling(x, pool_type="max", kernel=(2, 2), stride=(2, 2),
+                       name="pool1")
+    x = mx.sym.Flatten(x, name="flat")
+    x = mx.sym.FullyConnected(x, num_hidden=10, name="fc1")
+    return mx.sym.SoftmaxOutput(x, mx.sym.var("softmax_label"),
+                                name="softmax")
+
+
+def _bench_fused_step_case(build_sym, data_shape, steps=60, warmup=5,
+                           rounds=3):
+    """steps/sec for the eager vs fused train step on one net: the
+    fused executor (fused_step.py) runs forward+backward+optimizer as
+    ONE donated XLA dispatch per step, vs the eager loop's fused
+    fwd+bwd dispatch plus ~2·P per-parameter update launches. Timed
+    rounds are INTERLEAVED (eager, fused, eager, fused, ...) and the
+    best round per mode is reported, so host-load noise hits both
+    modes symmetrically."""
+    import numpy as np_
+    import mxnet_tpu as mx
+
+    def sync(mod):
+        mod._exec.arg_dict[mod._param_names[0]]._data.block_until_ready()
+
+    rng = np_.random.RandomState(0)
+    batch = mx.io.DataBatch(
+        data=[mx.nd.array(
+            rng.uniform(0, 1, data_shape).astype(np_.float32))],
+        label=[mx.nd.array(
+            rng.randint(0, 10, (data_shape[0],)).astype(np_.float32))])
+
+    prior = os.environ.get("MXNET_FUSED_STEP")
+    try:
+        mods = {}
+        for mode in ("eager", "fused"):
+            os.environ["MXNET_FUSED_STEP"] = \
+                "1" if mode == "fused" else "0"
+            mod = mx.module.Module(build_sym(),
+                                   context=mx.current_context())
+            mod.bind(data_shapes=[("data", data_shape)],
+                     label_shapes=[("softmax_label", (data_shape[0],))])
+            mod.init_params(initializer=mx.init.Xavier())
+            mod.init_optimizer(
+                optimizer="sgd",
+                optimizer_params={"learning_rate": 0.05,
+                                  "momentum": 0.9})
+            for _ in range(warmup):
+                mod.forward_backward(batch)
+                mod.update()
+            sync(mod)
+            mods[mode] = mod
+
+        best = {"eager": 0.0, "fused": 0.0}
+        for _ in range(rounds):
+            for mode in ("eager", "fused"):
+                os.environ["MXNET_FUSED_STEP"] = \
+                    "1" if mode == "fused" else "0"
+                mod = mods[mode]
+                t0 = time.perf_counter()
+                for _ in range(steps):
+                    mod.forward_backward(batch)
+                    mod.update()
+                sync(mod)
+                dt = time.perf_counter() - t0
+                best[mode] = max(best[mode], steps / dt)
+
+        fused = mods["fused"]._fused
+        assert fused, "fused path did not run"
+        return {
+            "eager_steps_per_sec": round(best["eager"], 2),
+            "fused_steps_per_sec": round(best["fused"], 2),
+            "fused_dispatches_per_step":
+                fused.dispatch_count // (warmup + rounds * steps),
+            "fused_traces": fused._trace_count,
+            "params": len(mods["fused"]._param_names),
+            "speedup": round(best["fused"] / best["eager"], 3),
+        }
+    finally:
+        if prior is None:
+            os.environ.pop("MXNET_FUSED_STEP", None)
+        else:
+            os.environ["MXNET_FUSED_STEP"] = prior
+
+
+def _fused_step_record():
+    """The fused-train-step benchmark record (BENCH_r06.json): MLP +
+    small conv net, eager vs fused steps/sec, per-step dispatch count.
+    CPU-friendly — runs wherever the tier-1 suite runs."""
+    import jax
+    record = {"metric": "fused_step_steps_per_sec", "unit": "steps/s",
+              "dtype": "float32", "optimizer": "sgd_momentum",
+              "platform": jax.default_backend(), "cases": {}}
+    errors = {}
+    try:
+        record["cases"]["mlp"] = _bench_fused_step_case(
+            _mlp_sym, (64, 784))
+    except Exception as exc:                     # noqa: BLE001
+        errors["mlp"] = _err_str(exc)
+    try:
+        record["cases"]["convnet"] = _bench_fused_step_case(
+            _convnet_sym, (32, 1, 28, 28))
+    except Exception as exc:                     # noqa: BLE001
+        errors["convnet"] = _err_str(exc)
+    if errors:
+        record["errors"] = errors
+    return record
+
+
 def _err_str(exc):
     return "%s: %s" % (type(exc).__name__, str(exc)[:400])
 
@@ -426,6 +549,14 @@ def main():
                 "(trajectory-parity checked vs eager Executor+Updater)")
 
     try:
+        fused_rec = _fused_step_record()
+        record["fused_step"] = fused_rec["cases"]
+        if "errors" in fused_rec:
+            errors["fused_step"] = fused_rec["errors"]
+    except Exception as exc:                     # noqa: BLE001
+        errors["fused_step"] = _err_str(exc)
+
+    try:
         allreduce_gbps = _bench_allreduce_bandwidth()
         bound = _HBM_GBPS.get(kind, 819.0)
         record["kvstore_pushpull_gbps"] = round(allreduce_gbps, 1)
@@ -443,4 +574,9 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    if "--fused-step" in sys.argv:
+        # CPU-friendly standalone mode: only the fused-train-step
+        # benchmark, one JSON line (the BENCH_r06 artifact)
+        print(json.dumps(_fused_step_record()))
+    else:
+        main()

@@ -571,6 +571,18 @@ class Executor:
         if self._monitor_callback is not None:
             self._run_monitor()
 
+    def fused_plan(self):
+        """The pieces the fused train-step executor (fused_step.py)
+        composes into ITS OWN jit: the raw (unjitted) train-mode
+        fwd+bwd program, the grad-carrying arg positions, and the
+        traced output structs (for the default all-ones cotangents).
+        Raises on a multi-device bind — raw tracing is unsupported
+        there and the caller falls back to the eager path."""
+        fn = self._get_fn("fwdbwd", True, raw=True)
+        args = tuple(a._data for a in self.arg_arrays)
+        aux = tuple(a._data for a in self.aux_arrays)
+        return fn, list(self._grad_positions), self._out_structs(args, aux)
+
     def _out_structs(self, args, aux):
         import jax
         key = ("ostruct", tuple((a.shape, str(a.dtype)) for a in args))

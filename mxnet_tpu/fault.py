@@ -43,7 +43,8 @@ __all__ = ["FaultPlan", "InjectedFault", "InjectedHang",
            "CollectiveTimeoutError", "plan", "set_plan", "reset",
            "active", "is_enabled", "inject", "with_retries", "guard",
            "join_process_group", "filter_gradient", "guard_policy",
-           "loss_scale", "stats", "reset_stats"]
+           "loss_scale", "stats", "reset_stats", "grad_poison",
+           "fused_step_guard"]
 
 _ACTIONS = ("raise", "hang", "nan", "inf")
 # the wired injection points; a typo'd site would otherwise make a
@@ -292,21 +293,19 @@ def _corrupt(value, kind):
     return jnp.full_like(value, bad)
 
 
-def inject(site, value=None):
-    """One injection point. Counts a visit to ``site``; when a plan
-    entry fires: ``raise``→InjectedFault, ``hang``→bounded sleep then
-    InjectedHang, ``nan``/``inf``→return a corrupted copy of ``value``.
-    Returns ``value`` (possibly corrupted) otherwise. No-op without an
-    active plan."""
+def _visit_site(site):
+    """Count one visit to ``site``; return the corruption entry firing
+    on this visit (stats-accounted) or None. ``raise``/``hang`` entries
+    fire here as exceptions."""
     p = plan()
     if p is None:
-        return value
+        return None
     with _lock:
         entry = p.visit(site)
         if entry is not None:
             _stats["injected"][site] = _stats["injected"].get(site, 0) + 1
     if entry is None:
-        return value
+        return None
     if entry.action == "raise":
         raise InjectedFault("planned fault at site %r (%r)" % (site, entry))
     if entry.action == "hang":
@@ -314,9 +313,33 @@ def inject(site, value=None):
         raise InjectedHang(
             "planned hang at site %r (%r): blocked %.3fs"
             % (site, entry, _hang_seconds()))
-    if value is not None:
+    return entry
+
+
+def inject(site, value=None):
+    """One injection point. Counts a visit to ``site``; when a plan
+    entry fires: ``raise``→InjectedFault, ``hang``→bounded sleep then
+    InjectedHang, ``nan``/``inf``→return a corrupted copy of ``value``.
+    Returns ``value`` (possibly corrupted) otherwise. No-op without an
+    active plan."""
+    entry = _visit_site(site)
+    if entry is not None and value is not None:
         return _corrupt(value, entry.action)
     return value
+
+
+def grad_poison():
+    """Fused-step injection hook for the ``grad`` site: counts ONE
+    visit (the fused executor calls it once per parameter per step,
+    matching the eager updater's visit order) and returns the poison
+    scalar the compiled step splices over that parameter's gradient —
+    0.0 when nothing fires, nan/inf when a corruption entry does.
+    ``raise``/``hang`` actions fire here, host-side, exactly like the
+    eager path."""
+    entry = _visit_site("grad")
+    if entry is None:
+        return 0.0
+    return float("nan") if entry.action == "nan" else float("inf")
 
 
 # ---------------------------------------------------------------------------
@@ -527,6 +550,38 @@ def filter_gradient(index, grad):
                 "fault: non-finite gradient for index %s — skipping "
                 "update (policy=skip_step)", index)
     return grad, True
+
+
+def fused_step_guard(all_finite):
+    """Per-step guard accounting for the compiled fused step. The skip
+    itself happened INSIDE the program (a ``jnp.where`` kept the old
+    weight and state for every non-finite gradient); this mirrors
+    :func:`filter_gradient`'s host bookkeeping — one skipped_steps
+    count / one scale halving per bad step, regrow-window advance per
+    clean step. No-op when no guard policy is active."""
+    global _step_clean
+    policy = guard_policy()
+    if policy is None:
+        return
+    if all_finite:
+        _step_clean = True
+        _close_step()
+        return
+    # mark the step dirty so interleaved eager bookkeeping
+    # (_note_step_boundary -> _close_step) cannot count this overflowed
+    # step toward the scale-regrow window
+    _step_clean = False
+    with _lock:
+        _stats["skipped_steps"] += 1
+    if policy == "scale_backoff":
+        prev, cur = _backoff_scale()
+        logging.warning(
+            "fault: non-finite gradient inside fused step — update "
+            "dropped in-program, loss scale %g -> %g", prev, cur)
+    else:
+        logging.warning(
+            "fault: non-finite gradient inside fused step — update "
+            "dropped in-program (policy=skip_step)")
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,417 @@
+"""Fused train-step executor: one donated XLA dispatch per step.
+
+The executor already lowers forward+vjp to a single compiled program
+(executor.py), but the optimizer update ran host-side as a per-parameter
+eager loop — every step paid 1 fused dispatch plus ~2·P tiny XLA
+launches, P host→device round-trips, and P non-donated weight buffers.
+This module closes that gap the way MXNet's fused/multi-tensor
+optimizer kernels (src/operator/optimizer_op.cc) and
+``update_on_kvstore`` did on GPU: the whole step — forward, backward,
+and the update rule for *every* parameter and optimizer state — is one
+``jax.jit`` program with ``donate_argnums`` on weights and optimizer
+state, so XLA reuses the parameter buffers in place.
+
+Two entry points share one core:
+
+- :class:`FusedStepExecutor` (Module path): composes the executor's raw
+  fwd+vjp plan with each parameter's :meth:`Optimizer.fused_step_fn`.
+  ``Module.backward`` defers, ``Module.update`` runs the whole step as
+  ONE dispatch.
+- :class:`FusedUpdater` (gluon Trainer path): backward already ran under
+  autograd, so only the all-parameter update fuses — still one dispatch
+  instead of ~2·P.
+
+Per-step scalars (LR schedule value, wd, rescale/loss-scale, Adam's
+bias-corrected lr) enter as *traced inputs* packed into two f32 vectors,
+so schedule ticks and dynamic loss-scale changes never retrigger a
+compile. The compile cache is keyed on (shapes, dtypes, train-mode,
+guard state, optimizer statics); hit/miss counts are exported through
+``profiler.counters()``.
+
+Fault tolerance stays inside the compiled step: planned ``grad``-site
+faults are spliced in as per-parameter poison scalars
+(``fault.grad_poison``), and the non-finite guard's skip is a
+``jnp.where`` that keeps the old weight/state — host accounting
+(skipped_steps, scale backoff) reads the program's finite mask
+(``fault.fused_step_guard``).
+
+Fallback matrix (→ eager loop, counted in
+``profiler.counters()['fused_step_fallbacks']``): ``MXNET_FUSED_STEP=0``,
+sparse (row_sparse) gradients, kvstore-hosted or dist updates,
+multi-precision low-dtype weights, optimizers without a
+``fused_step_fn``, monitors/``inputs_need_grad``/``grad_req='add'`` on
+the Module path, and multi-device (mesh) binds.
+
+Donation caveat: after a fused step the OLD parameter buffers are
+donated to XLA. NDArray handles tracked by the executor/trainer are
+re-pointed at the new buffers, but any alias made of the raw buffer
+beforehand (``detach()``, a stashed ``._data``) is stale and raises on
+use. Copies (``.copy()``, ``asnumpy()``) are unaffected.
+"""
+from __future__ import annotations
+
+import os
+
+import numpy as _np
+
+from .base import MXNetError
+
+__all__ = ["fused_step_enabled", "FusedStepExecutor", "FusedUpdater"]
+
+
+def fused_step_enabled():
+    """The MXNET_FUSED_STEP gate — default ON; ``0``/``false``/``off``
+    disable (re-read each step so benchmarks can toggle it)."""
+    return os.environ.get("MXNET_FUSED_STEP", "1").strip().lower() \
+        not in ("0", "false", "off")
+
+
+def _count(name, delta=1):
+    from . import profiler
+    profiler.increment_counter(name, delta)
+
+
+def _flat_state_handles(state):
+    """Flatten one parameter's optimizer state into a list of NDArray
+    handles (state layouts are None, one NDArray, or a tuple of them).
+    Returns None when a leaf is not an NDArray — that layout has no
+    compiled path and the caller falls back to the eager loop."""
+    from .ndarray import NDArray
+    if state is None:
+        return []
+    if isinstance(state, NDArray):
+        return [state]
+    if isinstance(state, (tuple, list)):
+        out = []
+        for s in state:
+            sub = _flat_state_handles(s)
+            if sub is None:
+                return None
+            out.extend(sub)
+        return out
+    return None
+
+
+def _sig(arrays):
+    return tuple((tuple(a.shape), str(a.dtype)) for a in arrays)
+
+
+class _FusedCore:
+    """Shared machinery of both fused paths: per-parameter step-fn
+    roster, state flattening against the SHARED Updater (so optimizer
+    state checkpoints stay interchangeable with the eager path),
+    per-step scalar packing, the traced update composition with the
+    in-program fault guard, and host-side guard accounting."""
+
+    def __init__(self, optimizer, updater):
+        self._opt = optimizer
+        self._updater = updater
+        self._cache = {}
+        self._zeros = None       # cached all-clear poison vector
+        self._trace_count = 0    # distinct program traces (test hook)
+        self.dispatch_count = 0  # compiled-step executions
+
+    # -- rosters ----------------------------------------------------------
+    def step_fns(self, indices, weights_nd):
+        """One pure update fn per parameter, or None when any parameter
+        has no compiled path (→ eager fallback)."""
+        fns = []
+        for i, w in zip(indices, weights_nd):
+            fn = self._opt.fused_step_fn(i, w)
+            if fn is None:
+                return None
+            fns.append(fn)
+        return fns
+
+    def _states_for(self, indices, weights_nd):
+        """Per-index optimizer states from the shared Updater (created
+        on first use exactly like the eager path), flattened to NDArray
+        handles plus a per-param count. (None, None) when a layout is
+        not fusable."""
+        handles, counts = [], []
+        for i, w in zip(indices, weights_nd):
+            if i not in self._updater.states:
+                self._updater.states[i] = \
+                    self._opt.create_state_multi_precision(i, w)
+                self._updater.states_synced[i] = True
+            flat = _flat_state_handles(self._updater.states[i])
+            if flat is None:
+                return None, None
+            handles.extend(flat)
+            counts.append(len(flat))
+        return handles, tuple(counts)
+
+    # -- per-step traced scalars -----------------------------------------
+    def _scalars(self, indices):
+        """The per-step scalar block as ONE host f32 vector
+        ``[lr_0..lr_n-1, wd_0..wd_n-1, rescale]`` — handed to the
+        compiled call as a plain numpy array so pjit's own argument
+        path does the single transfer (an explicit jnp.asarray per
+        scalar group cost ~1ms/step host-side). LR schedules, per-param
+        multipliers, and loss-scale-driven rescale changes tick per
+        step WITHOUT recompiling. Advances the optimizer's update
+        counters exactly like the eager ``_step_inputs``."""
+        n = len(indices)
+        block = _np.empty((2 * n + 1,), _np.float32)
+        for k, i in enumerate(indices):
+            lr, wd = self._opt.fused_step_scalars(i)
+            block[k] = lr
+            block[n + k] = wd
+        block[2 * n] = self._opt.rescale_grad
+        return block
+
+    def _poisons(self, indices):
+        """Planned grad-site faults for this step as a poison vector
+        (nan/inf fire inside the program; raise/hang fire here, host-
+        side, exactly like the eager updater). None when the plan has
+        no grad site."""
+        from . import fault
+        p = fault.plan()
+        if p is None or not p.has_site("grad"):
+            return None
+        return _np.asarray([fault.grad_poison() for _ in indices],
+                           _np.float32)
+
+    def _zero_poisons(self, n):
+        """Cached all-clear poison vector (the common, no-plan case) —
+        the traced program ignores it, but it must exist as an input."""
+        z = self._zeros
+        if z is None or z.shape[0] != n:
+            z = _np.zeros((n,), _np.float32)
+            self._zeros = z
+        return z
+
+    def _guard_active(self):
+        from . import fault
+        return fault.guard_policy() is not None
+
+    # -- traced composition ----------------------------------------------
+    def _make_apply(self, step_fns, state_counts, guard, inject):
+        """The traceable all-parameter update: splice in poison, test
+        finiteness, run each param's step fn, and (under the guard)
+        keep the old weight/state via jnp.where for non-finite grads —
+        the compiled-step equivalent of filter_gradient's skip."""
+        import jax.numpy as jnp
+        n = len(step_fns)
+
+        def apply(grads, weights, states, scalars, poisons):
+            # scalars = [lr_0..lr_n-1, wd_0..wd_n-1, rescale]
+            rescale = scalars[2 * n]
+            new_ws, new_sts, oks = [], [], []
+            si = 0
+            for i, fn in enumerate(step_fns):
+                g, w = grads[i], weights[i]
+                st = tuple(states[si:si + state_counts[i]])
+                si += state_counts[i]
+                if inject:
+                    g = jnp.where(jnp.isfinite(poisons[i]), g,
+                                  jnp.full_like(g, poisons[i]
+                                                .astype(g.dtype)))
+                if guard:
+                    ok = jnp.isfinite(g).all()
+                # cast the traced scalars to the grad dtype: the eager
+                # ops see python floats, which JAX weak-types (f64 →
+                # weak f32 → operand dtype) — an uncast strong-f32
+                # scalar would PROMOTE low-precision weights to f32
+                nw, nst = fn(g, w, st, scalars[i].astype(g.dtype),
+                             scalars[n + i].astype(g.dtype),
+                             rescale.astype(g.dtype))
+                if guard:
+                    nw = jnp.where(ok, nw, w)
+                    nst = tuple(jnp.where(ok, new_s, old_s)
+                                for new_s, old_s in zip(nst, st))
+                    oks.append(ok)
+                new_ws.append(nw)
+                new_sts.extend(nst)
+            mask = jnp.stack(oks) if oks else \
+                jnp.ones((n,), jnp.bool_)
+            return tuple(new_ws), tuple(new_sts), mask
+        return apply
+
+    # -- host-side guard accounting --------------------------------------
+    def _post_step(self, indices, mask, guard):
+        """When the guard is on, read the program's finite mask (the
+        only host sync the fused step performs, and only in guarded
+        runs): roll back update counts for skipped params (the eager
+        path never advanced them) and run the per-step bookkeeping."""
+        if not guard:
+            return
+        from . import fault
+        finite = _np.asarray(mask)
+        for i, ok in zip(indices, finite):
+            if not ok:
+                self._opt.fused_rollback_count(i)
+        fault.fused_step_guard(bool(finite.all()))
+
+
+class FusedStepExecutor(_FusedCore):
+    """Module-path fused step: the bound executor's fwd+vjp plan and
+    every parameter's update rule in ONE jitted program with weights
+    and optimizer state donated. ``Module.update`` drives it."""
+
+    def __init__(self, executor, optimizer, updater, param_names):
+        super().__init__(optimizer, updater)
+        self._ex = executor
+        self._param_names = list(param_names)
+        gpos = list(executor._grad_positions)
+        names = [executor.arg_names[p] for p in gpos]
+        # the fused roster is the grad-carrying subset of the params —
+        # frozen params (fixed_param_names -> grad_req 'null') simply
+        # ride along as non-donated constants, exactly as the eager
+        # loop skips their None grads. Optimizer indices stay the full-
+        # roster positions so states/lr-mult tables match the eager
+        # Updater's keying.
+        pos = {n: i for i, n in enumerate(self._param_names)}
+        if any(n not in pos for n in names):
+            raise MXNetError(
+                "fused step: grad-carrying args %s are not all "
+                "parameters %s" % (names, self._param_names))
+        self._gpos = gpos
+        in_g = set(gpos)
+        self._other_pos = [i for i in range(len(executor.arg_names))
+                           if i not in in_g]
+        self._indices = [pos[n] for n in names]
+
+    def step(self):
+        """Run one train step — forward + backward + every optimizer
+        update — as a single compiled dispatch; write outputs, aux,
+        new weights, and new optimizer states back into the executor
+        and shared-updater handles."""
+        ex = self._ex
+        weights_nd = [ex.arg_arrays[p] for p in self._gpos]
+        fns = self.step_fns(self._indices, weights_nd)
+        if fns is None:
+            raise MXNetError("fused step: optimizer has no compiled "
+                             "update path")
+        handles, counts = self._states_for(self._indices, weights_nd)
+        if handles is None:
+            raise MXNetError("fused step: optimizer state layout has "
+                             "no compiled path")
+        weights = tuple(w._data for w in weights_nd)
+        states = tuple(h._data for h in handles)
+        others = tuple(ex.arg_arrays[p]._data for p in self._other_pos)
+        aux = tuple(a._data for a in ex.aux_arrays)
+        rngs = ex._rngs()
+        poisons = self._poisons(self._indices)
+        guard = self._guard_active()
+        inject = poisons is not None
+        scalars = self._scalars(self._indices)
+        fn = self._compiled(weights, states, others, aux, counts, fns,
+                            guard, inject)
+        if poisons is None:
+            poisons = self._zero_poisons(len(fns))
+        outs, new_aux, new_ws, new_sts, mask = fn(
+            weights, states, others, aux, rngs, scalars, poisons)
+        self.dispatch_count += 1
+        _count("fused_step_dispatches")
+        ex._store_outputs(outs)
+        ex._store_aux(new_aux)
+        for p, w in zip(self._gpos, new_ws):
+            ex.arg_arrays[p]._set_data(w)
+        for h, s in zip(handles, new_sts):
+            h._set_data(s)
+        self._post_step(self._indices, mask, guard)
+        return ex.outputs
+
+    def _compiled(self, weights, states, others, aux, counts, fns,
+                  guard, inject):
+        key = (_sig(weights), _sig(states), _sig(others), _sig(aux),
+               counts, guard, inject, self._opt.fused_static_key())
+        cached = self._cache.get(key)
+        if cached is not None:
+            _count("fused_step_cache_hits")
+            return cached
+        _count("fused_step_cache_misses")
+        import jax
+        import jax.numpy as jnp
+        fwdbwd, gpos, out_structs = self._ex.fused_plan()
+        apply_fn = self._make_apply(fns, counts, guard, inject)
+        n_args = len(self._ex.arg_names)
+        other_pos = list(self._other_pos)
+        ostructs = [(tuple(s.shape), s.dtype) for s in out_structs]
+
+        def program(weights, states, others, aux_vals, rng_keys,
+                    scalars, poisons):
+            self._trace_count += 1
+            full = [None] * n_args
+            for p, w in zip(gpos, weights):
+                full[p] = w
+            for p, o in zip(other_pos, others):
+                full[p] = o
+            ogs = tuple(jnp.ones(s, d) for s, d in ostructs)
+            outs, new_aux, grads = fwdbwd(tuple(full), aux_vals,
+                                          rng_keys, ogs)
+            new_ws, new_sts, mask = apply_fn(grads, weights, states,
+                                             scalars, poisons)
+            return outs, new_aux, new_ws, new_sts, mask
+
+        from .engine import compiler_options
+        fn = jax.jit(program, donate_argnums=(0, 1),
+                     compiler_options=compiler_options(self._ex._ctx))
+        self._cache[key] = fn
+        return fn
+
+
+class FusedUpdater(_FusedCore):
+    """Gluon-Trainer-path fused update: autograd already produced the
+    gradients, so the fused program is the all-parameter optimizer
+    update — one donated dispatch instead of ~2·P eager launches."""
+
+    def update(self, items):
+        """``items``: ordered ``[(index, weight_nd, grad_nd)]`` for the
+        parameters being updated this step. Returns True when the fused
+        program ran; False (nothing modified) → caller falls back to
+        the eager per-parameter loop."""
+        indices = [i for i, _, _ in items]
+        weights_nd = [w for _, w, _ in items]
+        fns = self.step_fns(indices, weights_nd)
+        if fns is None:
+            _count("fused_step_fallbacks")
+            return False
+        handles, counts = self._states_for(indices, weights_nd)
+        if handles is None:
+            _count("fused_step_fallbacks")
+            return False
+        weights = tuple(w._data for w in weights_nd)
+        grads = tuple(g._data for _, _, g in items)
+        states = tuple(h._data for h in handles)
+        poisons = self._poisons(indices)
+        guard = self._guard_active()
+        inject = poisons is not None
+        scalars = self._scalars(indices)
+        fn = self._compiled(grads, weights, states, counts, fns, guard,
+                            inject, tuple(indices))
+        if poisons is None:
+            poisons = self._zero_poisons(len(fns))
+        new_ws, new_sts, mask = fn(grads, weights, states, scalars,
+                                   poisons)
+        self.dispatch_count += 1
+        _count("fused_step_dispatches")
+        for w_nd, w in zip(weights_nd, new_ws):
+            w_nd._set_data(w)
+        for h, s in zip(handles, new_sts):
+            h._set_data(s)
+        self._post_step(indices, mask, guard)
+        return True
+
+    def _compiled(self, grads, weights, states, counts, fns, guard,
+                  inject, idx_key):
+        key = (_sig(grads), _sig(weights), _sig(states), counts, guard,
+               inject, idx_key, self._opt.fused_static_key())
+        cached = self._cache.get(key)
+        if cached is not None:
+            _count("fused_step_cache_hits")
+            return cached
+        _count("fused_step_cache_misses")
+        import jax
+        apply_fn = self._make_apply(fns, counts, guard, inject)
+
+        def program(grads, weights, states, scalars, poisons):
+            self._trace_count += 1
+            return apply_fn(grads, weights, states, scalars, poisons)
+
+        from .engine import compiler_options
+        fn = jax.jit(program, donate_argnums=(1, 2),
+                     compiler_options=compiler_options())
+        self._cache[key] = fn
+        return fn

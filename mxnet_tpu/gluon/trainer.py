@@ -56,6 +56,7 @@ class Trainer:
         opts = dict(optimizer_params or {})
         self._scale = float(opts.get('rescale_grad', 1.0))
         self._contexts = self._shared_contexts()
+        self._fused_updater = None
         self._setup_optimizer(optimizer, opts)
         self._kvstore_params = {'kvstore': kvstore,
                                 'update_on_kvstore': update_on_kvstore}
@@ -223,9 +224,26 @@ class Trainer:
         return RowSparseNDArray(grad.take(rows_nd), rows_nd, grad.shape,
                                 ctx=grad.context)
 
+    def _get_fused(self):
+        """The fused all-parameter update program (fused_step.py): one
+        donated XLA dispatch per step instead of ~2·P eager launches.
+        None when MXNET_FUSED_STEP=0; the FusedUpdater itself reports
+        False (→ eager loop) for optimizers without a compiled path."""
+        from ..fused_step import FusedUpdater, fused_step_enabled
+        if not fused_step_enabled():
+            return None
+        fused = self._fused_updater
+        if fused is not None and fused._opt is self._optimizer and \
+                fused._updater is self._updaters[0]:
+            return fused
+        self._fused_updater = FusedUpdater(self._optimizer,
+                                           self._updaters[0])
+        return self._fused_updater
+
     def _apply_updates(self, ignore_stale_grad=False):
         updater = self._updaters[0]
         hosted = self._kvstore is not None and self._update_on_kvstore
+        work, sparse = [], False
         for i, param in enumerate(self._params):
             if param.grad_req == 'null' or param._data is None:
                 continue
@@ -235,10 +253,25 @@ class Trainer:
                 continue
             if hosted:
                 continue        # kvstore ran the update in allreduce
-            grad = param.grad()
-            if param._grad_stype == 'row_sparse':
-                grad = self._to_row_sparse(param, grad)
-            updater(i, grad, param.data())
+            work.append((i, param))
+            sparse = sparse or param._grad_stype == 'row_sparse'
+        fused_done = False
+        if work and not sparse:
+            fused = self._get_fused()
+            if fused is not None:
+                fused_done = fused.update(
+                    [(i, p.data(), p.grad()) for i, p in work])
+        elif work and sparse:
+            from ..fused_step import fused_step_enabled
+            if fused_step_enabled():
+                from .. import profiler
+                profiler.increment_counter("fused_step_fallbacks")
+        for i, param in work:
+            if not fused_done:
+                grad = param.grad()
+                if param._grad_stype == 'row_sparse':
+                    grad = self._to_row_sparse(param, grad)
+                updater(i, grad, param.data())
             param._data._fresh_grad = False
         # drop row-id stashes on EVERY param (also frozen/stale-skipped
         # ones) so forwards from this step never leak into the next

@@ -33,11 +33,13 @@ class KVStoreServer:
 
 def _init_kvstore_server_module():
     """Invoked at import when DMLC_ROLE=server (the reference wires
-    this into mxnet/__init__); logs and returns instead of blocking."""
+    this into mxnet/__init__); logs and returns instead of blocking.
+    This runs mid-package-init, so the package-level ``kv`` alias does
+    not exist yet — import the kvstore factory directly."""
     role = os.environ.get("DMLC_ROLE", "")
     if role == "server":
-        from . import kv
-        KVStoreServer(kv.create("tpu_sync")).run()
+        from .kvstore import create
+        KVStoreServer(create("tpu_sync")).run()
 
 
 if os.environ.get("DMLC_ROLE", "") == "server":   # pragma: no cover

@@ -73,6 +73,8 @@ class Module(BaseModule):
         self._preload_opt_states = None
         self._grad_req = None
         self._exec = None
+        self._fused = None            # FusedStepExecutor | False | None
+        self._pending_step = False
 
     # -- checkpointing -----------------------------------------------------
     @staticmethod
@@ -223,6 +225,8 @@ class Module(BaseModule):
              shared_module=None, grad_req='write'):
         if force_rebind:
             self._exec = None
+            self._fused = None
+            self._pending_step = False
             self.binded = False
         if self.binded:
             self.logger.warning('Already binded, ignoring bind()')
@@ -348,6 +352,7 @@ class Module(BaseModule):
                 update_on_kvstore=update_on_kvstore)
         if not update_on_kvstore:
             self._updater = opt.get_updater(optimizer)
+        self._fused = None
         self.optimizer_initialized = True
 
         if self._preload_opt_states is not None:
@@ -357,6 +362,13 @@ class Module(BaseModule):
     # -- computation -------------------------------------------------------
     def forward(self, data_batch, is_train=None):
         assert self.binded and self.params_initialized
+        if self._pending_step:
+            # a deferred fused step is outstanding and this forward is
+            # about to overwrite its staged inputs: materialize the
+            # eager fwd+bwd now so a later update() sees the gradients
+            # of the batch that backward() was called on
+            self._exec.forward_backward(is_train=True)
+            self._pending_step = False
         if is_train is None:
             is_train = self.for_training
         feed = dict(zip(self._data_names, data_batch.data))
@@ -375,15 +387,91 @@ class Module(BaseModule):
             self._pending_forward = False
 
     def backward(self, out_grads=None):
+        """Deferred under the fused step (MXNET_FUSED_STEP=1): the
+        gradients are consumed INSIDE update()'s compiled program and
+        never materialize in ``_exec.grad_dict`` — public observers
+        (``get_outputs``, a later ``forward``) transparently fall back
+        to the eager program for the step, but reaching into the
+        private ``_exec.grad_dict`` between backward() and update()
+        reads the previous buffers. Set MXNET_FUSED_STEP=0 for
+        grad-inspection workflows (see README 'Fused train step')."""
         assert self.binded and self.params_initialized
+        if out_grads is None and self._fused_eligible():
+            # defer: update() runs forward+backward+optimizer as ONE
+            # donated XLA dispatch (fused_step.py). Anything that
+            # observes state before update() — get_outputs — falls back
+            # to the eager program for that step.
+            self._pending_step = True
+            self._params_dirty = True
+            return
         self._exec.forward_backward(out_grads=out_grads, is_train=True)
         self._pending_forward = False
+        self._pending_step = False
         self._params_dirty = True
+
+    def _fused_eligible(self):
+        """Quick per-step test for the one-dispatch fused train step
+        (the full fallback matrix is documented in fused_step.py and
+        README 'Fused train step')."""
+        if not self.optimizer_initialized or self._updater is None:
+            return False
+        if self._kvstore is not None or self._update_on_kvstore:
+            return False
+        if self.inputs_need_grad or self._fused is False:
+            return False
+        ex = self._exec
+        if ex is None or ex._mesh is not None or ex._grouped is not None \
+                or ex._monitor_callback is not None:
+            return False
+        if any(ex._grad_req.get(n) == 'add' for n in ex.arg_names):
+            return False
+        from ..fused_step import fused_step_enabled
+        return fused_step_enabled()
+
+    def _get_fused(self):
+        """Build (or reuse) the FusedStepExecutor for the current
+        executor/optimizer pair; None (cached as False) when the
+        optimizer or its state layout has no compiled path."""
+        from ..fused_step import FusedStepExecutor
+        fused = self._fused
+        if fused is not None and fused is not False \
+                and fused._ex is self._exec \
+                and fused._opt is self._optimizer \
+                and fused._updater is self._updater:
+            return fused
+        try:
+            fused = FusedStepExecutor(self._exec, self._optimizer,
+                                      self._updater, self._param_names)
+            weights = [self._exec.arg_dict[self._param_names[i]]
+                       for i in fused._indices]
+            ok = fused.step_fns(fused._indices, weights) is not None \
+                and fused._states_for(fused._indices,
+                                      weights)[0] is not None
+        except MXNetError:
+            ok = False
+        if not ok:
+            from .. import profiler
+            profiler.increment_counter("fused_step_fallbacks")
+            self._fused = False
+            return None
+        self._fused = fused
+        return fused
 
     def update(self):
         assert self.binded and self.params_initialized \
             and self.optimizer_initialized
         self._params_dirty = True
+        if self._pending_step:
+            self._pending_step = False
+            fused = self._get_fused() if self._fused_eligible() else None
+            if fused is not None:
+                fused.step()
+                self._pending_forward = False
+                return
+            # no compiled path after all: run the eager forward+backward
+            # now, then fall through to the eager update loop
+            self._exec.forward_backward(is_train=True)
+            self._pending_forward = False
         weights = [self._exec.arg_dict[n] for n in self._param_names]
         grads = [self._exec.grad_dict.get(n) for n in self._param_names]
         if self._update_on_kvstore:
@@ -396,7 +484,14 @@ class Module(BaseModule):
 
     def get_outputs(self, merge_multi_context=True):
         assert self.binded and self.params_initialized
-        if getattr(self, "_pending_forward", False):
+        if self._pending_step:
+            # observed between backward() and update(): materialize the
+            # eager program for this step (grads land in grad_dict; the
+            # coming update() takes the eager loop)
+            self._exec.forward_backward(is_train=True)
+            self._pending_step = False
+            self._pending_forward = False
+        elif getattr(self, "_pending_forward", False):
             self._exec.forward(is_train=True)
             self._pending_forward = False
         return self._exec.outputs
@@ -455,6 +550,8 @@ class Module(BaseModule):
         feed.update((l.name, l.shape)
                     for l in (self._label_shapes or []))
         self._exec = self._exec.reshape(**feed)
+        self._fused = None
+        self._pending_step = False
 
     def prepare(self, data_batch, sparse_row_id_fn=None):
         pass

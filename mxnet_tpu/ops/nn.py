@@ -556,6 +556,7 @@ def _softmax_output(attrs, data, label):
     preserve_shape = bool(attrs.get("preserve_shape", False))
     normalization = attrs.get("normalization", "null")
     smooth_alpha = float(attrs.get("smooth_alpha", 0.0))
+    use_out_grad = bool(attrs.get("out_grad", False))
 
     @jax.custom_vjp
     def f(d, l):
@@ -573,13 +574,18 @@ def _softmax_output(attrs, data, label):
         return _so_fwd(d), (d, l)
 
     def f_bwd(res, g):
-        del g  # loss layer: implicit CE gradient, head grad ignored
+        # loss layer: implicit CE gradient; the head cotangent is
+        # ignored UNLESS out_grad=True, which multiplies it in
+        # element-wise (softmax_output-inl.h:227 out_grad path)
         d, l = res
         p = _so_fwd(d)
         if tuple(l.shape) == tuple(d.shape):
             # probability labels (softmax_output-inl.h:160): plain
             # (out - label) * grad_scale, no normalization
-            return ((p - l) * grad_scale, jnp.zeros_like(l))
+            dgrad = (p - l) * grad_scale
+            if use_out_grad:
+                dgrad = dgrad * g
+            return (dgrad, jnp.zeros_like(l))
         axis = 1 if multi_output else (d.ndim - 1)
         nclass = d.shape[axis]
         li = l.astype(jnp.int32)
@@ -606,7 +612,10 @@ def _softmax_output(attrs, data, label):
             grad = grad / jnp.maximum(jnp.sum(valid), 1.0)
         elif spatial != 1:
             grad = grad / spatial
-        return (grad * grad_scale, jnp.zeros_like(l))
+        grad = grad * grad_scale
+        if use_out_grad:
+            grad = grad * g
+        return (grad, jnp.zeros_like(l))
 
     f.defvjp(f_fwd, f_bwd)
     return f(data, label)

@@ -182,6 +182,47 @@ class Optimizer:
             grad = grad.clip(-self.clip_gradient, self.clip_gradient)
         return grad
 
+    # -- fused-step protocol (fused_step.py) ------------------------------
+    def fused_step_fn(self, index, weight):
+        """Pure functional update rule for the fused train step:
+        ``fn(grad, weight, states, lr, wd, rescale) ->
+        (new_weight, new_states)`` over raw jax arrays, where ``states``
+        is the flat tuple of this index's state arrays and lr/wd/rescale
+        arrive as traced scalars. Returns None when this optimizer (or
+        this configuration — e.g. multi-precision low-dtype weights)
+        has no compiled path; the executor then falls back to the
+        eager loop. Implementations must mirror the registered eager
+        update ops operation-for-operation so fused and eager steps are
+        bit-identical."""
+        return None
+
+    def fused_step_scalars(self, index):
+        """Host-side per-step ``(lr, wd)`` for one parameter — advances
+        the update counters exactly like the eager ``_step_inputs``.
+        Subclasses fold per-step corrections (Adam's bias correction)
+        into the returned lr so the compiled program needs no step
+        counter input."""
+        self._update_count(index)
+        return self._get_lr(index), self._get_wd(index)
+
+    def fused_rollback_count(self, index):
+        """Undo one ``fused_step_scalars`` count advance: the in-program
+        guard skipped this parameter's update, and the eager path only
+        counts applied updates."""
+        c = self._index_update_count.get(index)
+        if c is None:
+            return
+        self._index_update_count[index] = c - 1
+        self.num_update = max([self.begin_num_update]
+                              + list(self._index_update_count.values()))
+
+    def fused_static_key(self):
+        """Static hyperparameters baked into a compiled fused step —
+        part of the compile-cache key, so mutating them mid-run
+        compiles a fresh program instead of silently reusing stale
+        constants."""
+        return (type(self).__name__, self.clip_gradient)
+
     def __getstate__(self):
         return self.__dict__.copy()
 
@@ -284,6 +325,27 @@ class SGD(Optimizer):
             invoke_nd("mp_sgd_update", [weight, grad, master], kw,
                       out=weight)
 
+    def fused_step_fn(self, index, weight):
+        """Mirrors ops/optimizer_ops.py sgd_update / sgd_mom_update."""
+        if self.multi_precision and _is_low_precision(weight.dtype):
+            return None
+        import jax.numpy as jnp
+        mu, clip = self.momentum, self.clip_gradient
+
+        def fn(grad, weight, states, lr, wd, rescale):
+            g = grad * rescale
+            if clip is not None and clip > 0:
+                g = jnp.clip(g, -clip, clip)
+            if mu == 0.0:
+                return weight - lr * (g + wd * weight), ()
+            (mom,) = states
+            new_mom = mu * mom - lr * (g + wd * weight)
+            return weight + new_mom, (new_mom,)
+        return fn
+
+    def fused_static_key(self):
+        return (type(self).__name__, self.clip_gradient, self.momentum)
+
 
 @register
 class Signum(Optimizer):
@@ -376,6 +438,37 @@ class Adam(Optimizer):
             grad = rsp.tostype("default")
         invoke_nd("adam_update", [weight, grad, mean, var], kw, out=weight)
 
+    def fused_step_fn(self, index, weight):
+        """Mirrors ops/optimizer_ops.py adam_update (wd folded into the
+        gradient BEFORE the clip); ``lr`` arrives bias-corrected from
+        :meth:`fused_step_scalars`."""
+        if self.multi_precision and _is_low_precision(weight.dtype):
+            return None
+        import jax.numpy as jnp
+        b1, b2, eps = self.beta1, self.beta2, self.epsilon
+        clip = self.clip_gradient
+
+        def fn(grad, weight, states, lr, wd, rescale):
+            g = grad * rescale + wd * weight
+            if clip is not None and clip > 0:
+                g = jnp.clip(g, -clip, clip)
+            mean, var = states
+            new_mean = b1 * mean + (1 - b1) * g
+            new_var = b2 * var + (1 - b2) * jnp.square(g)
+            new_w = weight - lr * new_mean / (jnp.sqrt(new_var) + eps)
+            return new_w, (new_mean, new_var)
+        return fn
+
+    def fused_step_scalars(self, index):
+        lr, wd = super().fused_step_scalars(index)
+        t = self._index_update_count[index]
+        lr = lr * math.sqrt(1. - self.beta2 ** t) / (1. - self.beta1 ** t)
+        return lr, wd
+
+    def fused_static_key(self):
+        return (type(self).__name__, self.clip_gradient, self.beta1,
+                self.beta2, self.epsilon)
+
 
 @register
 class AdaGrad(Optimizer):
@@ -397,6 +490,28 @@ class AdaGrad(Optimizer):
             return _lazy_row_update("adagrad_update", weight, rsp,
                                     [state], kw)
         invoke_nd("adagrad_update", [weight, grad, state], kw, out=weight)
+
+    def fused_step_fn(self, index, weight):
+        """Mirrors ops/optimizer_ops.py adagrad_update."""
+        if self.multi_precision and _is_low_precision(weight.dtype):
+            return None
+        import jax.numpy as jnp
+        eps, clip = self.float_stable_eps, self.clip_gradient
+
+        def fn(grad, weight, states, lr, wd, rescale):
+            g = grad * rescale
+            if clip is not None and clip > 0:
+                g = jnp.clip(g, -clip, clip)
+            (history,) = states
+            new_h = history + jnp.square(g)
+            new_w = weight - lr * (g / jnp.sqrt(new_h + eps)
+                                   + wd * weight)
+            return new_w, (new_h,)
+        return fn
+
+    def fused_static_key(self):
+        return (type(self).__name__, self.clip_gradient,
+                self.float_stable_eps)
 
 
 @register
@@ -430,6 +545,44 @@ class RMSProp(Optimizer):
                       out=weight)
         if self.clip_weights:
             weight[:] = weight.clip(-self.clip_weights, self.clip_weights)
+
+    def fused_step_fn(self, index, weight):
+        """Mirrors ops/optimizer_ops.py rmsprop_update /
+        rmspropalex_update (wd folded pre-clip), plus the host-side
+        clip_weights pass."""
+        if self.multi_precision and _is_low_precision(weight.dtype):
+            return None
+        import jax.numpy as jnp
+        rho, mu, eps = self.gamma1, self.gamma2, self.epsilon
+        clip, cw = self.clip_gradient, self.clip_weights
+        centered = self.centered
+
+        def fn(grad, weight, states, lr, wd, rescale):
+            g = grad * rescale + wd * weight
+            if clip is not None and clip > 0:
+                g = jnp.clip(g, -clip, clip)
+            if not centered:
+                (n,) = states
+                new_n = rho * n + (1 - rho) * jnp.square(g)
+                new_w = weight - lr * g / jnp.sqrt(new_n + eps)
+                new_states = (new_n,)
+            else:
+                n, g_acc, delta = states
+                new_n = rho * n + (1 - rho) * jnp.square(g)
+                new_g = rho * g_acc + (1 - rho) * g
+                new_delta = mu * delta - lr * g / jnp.sqrt(
+                    new_n - jnp.square(new_g) + eps)
+                new_w = weight + new_delta
+                new_states = (new_n, new_g, new_delta)
+            if cw:
+                new_w = jnp.clip(new_w, -cw, cw)
+            return new_w, new_states
+        return fn
+
+    def fused_static_key(self):
+        return (type(self).__name__, self.clip_gradient, self.gamma1,
+                self.gamma2, self.epsilon, self.centered,
+                self.clip_weights)
 
 
 @register

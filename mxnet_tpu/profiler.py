@@ -20,7 +20,8 @@ import time
 __all__ = ["set_config", "profiler_set_config", "set_state",
            "profiler_set_state", "dump", "dumps", "pause", "resume",
            "Task", "Frame", "Event", "Counter", "Marker", "record",
-           "aggregate_stats"]
+           "aggregate_stats", "increment_counter", "counters",
+           "reset_counters"]
 
 _state = {
     "running": False,
@@ -28,6 +29,7 @@ _state = {
     "events": [],
     "jax_trace_dir": None,
     "aggregate": {},
+    "counters": {},
 }
 _lock = threading.Lock()
 _t0 = time.time()
@@ -134,6 +136,30 @@ def dump(finished=True, profile_process='worker'):
 
 def aggregate_stats():
     return dict(_state["aggregate"])
+
+
+def increment_counter(name, delta=1):
+    """Named monotonic counters (fused-step compile-cache hits/misses,
+    dispatch and fallback counts, ...). Always accumulated — queryable
+    via :func:`counters` — and additionally emitted as chrome-tracing
+    counter events while the profiler is running."""
+    with _lock:
+        value = _state["counters"].get(name, 0) + delta
+        _state["counters"][name] = value
+    if _state["running"]:
+        _emit(name, "counter", "C", args={"value": value})
+    return value
+
+
+def counters():
+    """Snapshot of the named counters."""
+    with _lock:
+        return dict(_state["counters"])
+
+
+def reset_counters():
+    with _lock:
+        _state["counters"] = {}
 
 
 class _Scoped:

@@ -30,6 +30,12 @@ def make_stub(op):
             # reference's generated signatures (e.g. F.clip(x, 0, 6))
             free = [k for k in op.defaults
                     if k not in kwargs and not k.startswith("__")]
+            if len(pos_attrs) > len(free):
+                raise TypeError(
+                    "%s: %d trailing positional attribute(s) %r but only "
+                    "%d free keyword parameter(s) %r remain"
+                    % (op.name, len(pos_attrs), tuple(pos_attrs),
+                       len(free), tuple(free)))
             for k, v in zip(free, pos_attrs):
                 kwargs[k] = v
         named = {k: kwargs.pop(k) for k in list(kwargs)

@@ -49,17 +49,22 @@ def measure(shapes, kv_type="local", num_workers=2, num_batches=5,
     for b in range(num_batches):
         t0 = time.time()
         errors = 0
+        pending = []
         for i, s in enumerate(shapes):
             vals = [mx.nd.ones(s) * (w + 1)
                     for w in range(num_workers)]
             outs = [mx.nd.zeros(s) for _ in range(num_workers)]
             kv.push(i, vals)
             kv.pull(i, out=outs)
+            pending.extend(outs)
             if test_results and optimizer is None:
                 want = sum(w + 1 for w in range(num_workers))
                 if not np.allclose(outs[0].asnumpy(), want):
                     errors += 1
-        for o in outs:
+        # wait on EVERY key's outputs before the end timestamp (waiting
+        # only on the last shape lets earlier keys still be in flight,
+        # overstating GB/s — and NameErrors on an empty shape list)
+        for o in pending:
             o.wait_to_read()
         dt = time.time() - t0
         gbps = 2 * total_bytes * num_workers / dt / 1e9

@@ -1,0 +1,329 @@
+"""Fused train-step executor (mxnet_tpu/fused_step.py).
+
+Contracts under test:
+- bit-exact parity of the fused (one donated XLA dispatch) step with
+  the eager per-parameter loop — params AND optimizer state — over 5
+  steps for SGD-momentum, Adam, AdaGrad, and RMSProp;
+- compile-cache reuse: the step program traces exactly ONCE across
+  steps, with hit/miss counters exported via profiler.counters();
+- the non-finite gradient guard (skip_step) firing INSIDE the compiled
+  step (jnp.where keeps weights/state), with per-step stats accounting;
+- automatic eager fallback: sparse (row_sparse) gradients, non-fusable
+  optimizers, and MXNET_FUSED_STEP=0.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, fault, gluon, profiler
+from mxnet_tpu.fused_step import _flat_state_handles
+
+
+@pytest.fixture(autouse=True)
+def _clean_fault():
+    yield
+    fault.reset()
+
+
+def _mlp_sym():
+    data = mx.sym.var("data")
+    x = mx.sym.FullyConnected(data, num_hidden=16, name="fc1")
+    x = mx.sym.Activation(x, act_type="relu", name="relu1")
+    x = mx.sym.FullyConnected(x, num_hidden=4, name="fc2")
+    return mx.sym.SoftmaxOutput(x, mx.sym.var("softmax_label"),
+                                name="softmax")
+
+
+def _fixed_batch(seed=3):
+    rng = np.random.RandomState(seed)
+    x = rng.uniform(0, 1, (8, 10)).astype(np.float32)
+    y = rng.randint(0, 4, (8,)).astype(np.float32)
+    return mx.io.DataBatch(data=[mx.nd.array(x)], label=[mx.nd.array(y)])
+
+
+def _make_module(optimizer, opt_params, fused, monkeypatch, seed=11):
+    monkeypatch.setenv("MXNET_FUSED_STEP", "1" if fused else "0")
+    rng = np.random.RandomState(seed)
+    mod = mx.module.Module(_mlp_sym(), context=mx.cpu())
+    mod.bind(data_shapes=[("data", (8, 10))],
+             label_shapes=[("softmax_label", (8,))])
+    mod.init_params(initializer=mx.init.Xavier())
+    args, _ = mod.get_params()
+    arg_params = {k: mx.nd.array(
+        rng.uniform(-0.1, 0.1, v.shape).astype(np.float32))
+        for k, v in sorted(args.items())}
+    mod.set_params(arg_params, {})
+    mod.init_optimizer(optimizer=optimizer, optimizer_params=opt_params)
+    return mod
+
+
+def _run_steps(mod, steps, batch):
+    for _ in range(steps):
+        mod.forward_backward(batch)
+        mod.update()
+    args, _ = mod.get_params()
+    states = {}
+    for i in sorted(mod._updater.states):
+        flat = _flat_state_handles(mod._updater.states[i])
+        states[i] = [h.asnumpy().copy() for h in flat]
+    return {k: v.asnumpy().copy() for k, v in args.items()}, states
+
+
+OPTIMIZERS = [
+    ("sgd", {"learning_rate": 0.05, "momentum": 0.9}),
+    ("adam", {"learning_rate": 0.01}),
+    ("adagrad", {"learning_rate": 0.05}),
+    ("rmsprop", {"learning_rate": 0.01}),
+]
+
+
+@pytest.mark.parametrize("opt,params", OPTIMIZERS,
+                         ids=[o for o, _ in OPTIMIZERS])
+def test_fused_bitexact_parity(opt, params, monkeypatch):
+    """Fused step == eager step exactly (rtol=0, atol=0), params and
+    optimizer state, over 5 steps."""
+    batch = _fixed_batch()
+    args_e, states_e = _run_steps(
+        _make_module(opt, params, False, monkeypatch), 5, batch)
+    mod_f = _make_module(opt, params, True, monkeypatch)
+    args_f, states_f = _run_steps(mod_f, 5, batch)
+    assert mod_f._fused is not None and mod_f._fused is not False
+    assert mod_f._fused.dispatch_count == 5
+    for k in args_e:
+        np.testing.assert_array_equal(args_e[k], args_f[k],
+                                      err_msg="param %s" % k)
+    assert sorted(states_e) == sorted(states_f)
+    for i in states_e:
+        assert len(states_e[i]) == len(states_f[i])
+        for a, b in zip(states_e[i], states_f[i]):
+            np.testing.assert_array_equal(a, b,
+                                          err_msg="state idx %d" % i)
+
+
+def test_fused_compile_cache_single_trace(monkeypatch):
+    """Across 5 same-shape steps the program traces exactly once; the
+    subsequent steps are cache hits, visible in profiler.counters()."""
+    before = profiler.counters()
+    mod = _make_module("sgd", {"learning_rate": 0.05, "momentum": 0.9},
+                       True, monkeypatch)
+    _run_steps(mod, 5, _fixed_batch())
+    fused = mod._fused
+    assert fused.dispatch_count == 5
+    assert fused._trace_count == 1
+    after = profiler.counters()
+
+    def delta(name):
+        return after.get(name, 0) - before.get(name, 0)
+    assert delta("fused_step_cache_misses") == 1
+    assert delta("fused_step_cache_hits") == 4
+    assert delta("fused_step_dispatches") == 5
+
+
+def test_fused_guard_skip_step_in_program(monkeypatch):
+    """A planned grad-site nan poisons step 2 INSIDE the compiled step:
+    weights and optimizer state hold (jnp.where skip), stats count one
+    skipped step, and training resumes bit-exact on step 3."""
+    mod = _make_module("sgd", {"learning_rate": 0.05, "momentum": 0.9},
+                       True, monkeypatch)
+    n_params = len(mod._param_names)
+    # grad-site visits go per parameter: step 2 spans visits P+1..2P
+    fault.set_plan("grad:step=%d:nan:count=%d" % (n_params + 1, n_params))
+    batch = _fixed_batch()
+    snaps = []
+    for _ in range(3):
+        mod.forward_backward(batch)
+        mod.update()
+        args, _ = mod.get_params()
+        snaps.append({k: v.asnumpy().copy() for k, v in args.items()})
+    assert mod._fused.dispatch_count == 3
+    for k in snaps[0]:
+        np.testing.assert_array_equal(snaps[0][k], snaps[1][k],
+                                      err_msg="step 2 not skipped (%s)"
+                                      % k)
+    assert any(not np.array_equal(snaps[1][k], snaps[2][k])
+               for k in snaps[1]), "step 3 did not resume updating"
+    st = fault.stats()
+    assert st["skipped_steps"] == 1
+    assert st["injected"]["grad"] == n_params
+
+
+def test_fused_guard_matches_eager_guard(monkeypatch):
+    """Same fault plan, fused vs eager: identical end-state (the
+    in-program where-skip reproduces filter_gradient exactly)."""
+    batch = _fixed_batch()
+    spec = "grad:step=2:nan"     # one poisoned param inside step 1
+    results = []
+    for fused in (False, True):
+        mod = _make_module("sgd", {"learning_rate": 0.05,
+                                   "momentum": 0.9}, fused, monkeypatch)
+        fault.set_plan(spec)
+        results.append(_run_steps(mod, 3, batch))
+        assert fault.stats()["skipped_steps"] == 1
+        fault.reset()
+    (args_e, states_e), (args_f, states_f) = results
+    for k in args_e:
+        np.testing.assert_array_equal(args_e[k], args_f[k],
+                                      err_msg="param %s" % k)
+    for i in states_e:
+        for a, b in zip(states_e[i], states_f[i]):
+            np.testing.assert_array_equal(a, b)
+
+
+def test_fused_disabled_by_env(monkeypatch):
+    mod = _make_module("sgd", {"learning_rate": 0.05}, False,
+                       monkeypatch)
+    _run_steps(mod, 2, _fixed_batch())
+    assert mod._fused is None
+
+
+def test_fused_fallback_nonfusable_optimizer(monkeypatch):
+    """Optimizers without a fused_step_fn (adadelta) take the eager
+    loop — training still works, fallback counted."""
+    before = profiler.counters().get("fused_step_fallbacks", 0)
+    mod = _make_module("adadelta", {}, True, monkeypatch)
+    args0, _ = _run_steps(mod, 0, _fixed_batch())
+    args2, _ = _run_steps(mod, 2, _fixed_batch())
+    assert mod._fused is False       # checked once, cached as no-path
+    assert profiler.counters().get("fused_step_fallbacks", 0) \
+        == before + 1
+    assert any(not np.array_equal(args0[k], args2[k]) for k in args0)
+
+
+def test_trainer_fused_matches_eager(monkeypatch):
+    """Gluon Trainer path: the fused all-parameter update program is
+    bit-exact with the eager per-parameter updater loop."""
+    rng = np.random.RandomState(5)
+    x = mx.nd.array(rng.uniform(-1, 1, (5, 6)).astype(np.float32))
+
+    def run(fused):
+        monkeypatch.setenv("MXNET_FUSED_STEP", "1" if fused else "0")
+        net = gluon.nn.Dense(4, in_units=6)
+        net.initialize(mx.init.Xavier())
+        params = net.collect_params()
+        for i, p in enumerate(params.values()):
+            p.set_data(mx.nd.array(
+                np.random.RandomState(20 + i).uniform(
+                    -0.2, 0.2, p.shape).astype(np.float32)))
+        trainer = gluon.Trainer(params, 'adam',
+                                {'learning_rate': 0.01})
+        for _ in range(5):
+            with autograd.record():
+                out = net(x)
+                loss = (out * out).sum()
+            loss.backward()
+            trainer.step(5)
+        # positional keys: gluon block name counters differ per run
+        return ([p.data().asnumpy().copy()
+                 for p in params.values()], trainer)
+
+    eager, _ = run(False)
+    fused, trainer = run(True)
+    assert trainer._fused_updater is not None
+    assert trainer._fused_updater.dispatch_count == 5
+    assert trainer._fused_updater._trace_count == 1
+    for i, (a, b) in enumerate(zip(eager, fused)):
+        np.testing.assert_array_equal(a, b, err_msg="param %d" % i)
+
+
+def test_trainer_sparse_grad_falls_back(monkeypatch):
+    """row_sparse gradients have no compiled path: the trainer takes
+    the eager lazy-row update and counts a fallback — no fused
+    dispatch happens."""
+    monkeypatch.setenv("MXNET_FUSED_STEP", "1")
+    before = profiler.counters().get("fused_step_fallbacks", 0)
+    p = gluon.Parameter('w', shape=(6, 4), grad_stype='row_sparse')
+    p.initialize(init=mx.init.One(), ctx=mx.cpu())
+    trainer = gluon.Trainer([p], 'sgd', {'learning_rate': 0.5})
+    with autograd.record():
+        loss = (p.data() * 2.0).sum()
+    loss.backward()
+    trainer.step(1)
+    assert profiler.counters().get("fused_step_fallbacks", 0) \
+        == before + 1
+    assert trainer._fused_updater is None or \
+        trainer._fused_updater.dispatch_count == 0
+    # dense-equivalent SGD result: w -= lr * grad (grad == 2, every row
+    # touched, rescaled by 1/batch=1)
+    np.testing.assert_allclose(p.data().asnumpy(),
+                               np.ones((6, 4), np.float32) - 0.5 * 2.0,
+                               rtol=1e-6)
+
+
+def test_fused_with_frozen_params(monkeypatch):
+    """fixed_param_names: the fused step covers the grad-carrying
+    subset (frozen params ride along un-donated and untouched) and
+    stays bit-exact with the eager loop — the fine-tuning case."""
+    batch = _fixed_batch()
+    results = []
+    for fused in (False, True):
+        monkeypatch.setenv("MXNET_FUSED_STEP", "1" if fused else "0")
+        rng = np.random.RandomState(11)
+        mod = mx.module.Module(
+            _mlp_sym(), context=mx.cpu(),
+            fixed_param_names=["fc1_weight", "fc1_bias"])
+        mod.bind(data_shapes=[("data", (8, 10))],
+                 label_shapes=[("softmax_label", (8,))])
+        mod.init_params(initializer=mx.init.Xavier())
+        args, _ = mod.get_params()
+        mod.set_params({k: mx.nd.array(
+            rng.uniform(-0.1, 0.1, v.shape).astype(np.float32))
+            for k, v in sorted(args.items())}, {})
+        mod.init_optimizer(optimizer="sgd",
+                           optimizer_params={"learning_rate": 0.05,
+                                             "momentum": 0.9})
+        results.append(_run_steps(mod, 3, batch))
+        if fused:
+            assert mod._fused is not None and mod._fused is not False
+            assert mod._fused.dispatch_count == 3
+    (args_e, _), (args_f, _) = results
+    for k in args_e:
+        np.testing.assert_array_equal(args_e[k], args_f[k],
+                                      err_msg="param %s" % k)
+    # frozen params really frozen
+    init = np.random.RandomState(11)
+    w0 = init.uniform(-0.1, 0.1, args_f["fc1_bias"].shape)
+    np.testing.assert_array_equal(
+        args_f["fc1_bias"], w0.astype(np.float32))
+
+
+def test_fused_observer_materializes_eager(monkeypatch):
+    """get_outputs() between backward() and update() (outside the fit
+    loop order) falls back to the eager program for that step and stays
+    numerically identical."""
+    batch = _fixed_batch()
+    mod_e = _make_module("sgd", {"learning_rate": 0.05}, False,
+                         monkeypatch)
+    mod_f = _make_module("sgd", {"learning_rate": 0.05}, True,
+                         monkeypatch)
+    for mod in (mod_e, mod_f):
+        mod.forward(batch, is_train=True)
+        mod.backward()
+        outs = mod.get_outputs()          # observer before update()
+        assert outs[0].shape == (8, 4)
+        mod.update()
+    args_e, _ = mod_e.get_params()
+    args_f, _ = mod_f.get_params()
+    for k in args_e:
+        np.testing.assert_array_equal(args_e[k].asnumpy(),
+                                      args_f[k].asnumpy())
+
+
+def test_fused_fit_loop(monkeypatch):
+    """Module.fit drives the fused executor end-to-end (forward,
+    metric, update) and learns."""
+    monkeypatch.setenv("MXNET_FUSED_STEP", "1")
+    rng = np.random.RandomState(9)
+    n = 64
+    x = rng.uniform(0, 1, (n, 10)).astype(np.float32)
+    w = rng.uniform(-1, 1, (10, 4)).astype(np.float32)
+    y = np.argmax(x @ w, axis=1).astype(np.float32)
+    it = mx.io.NDArrayIter(x, y, batch_size=8,
+                           label_name="softmax_label")
+    mod = mx.module.Module(_mlp_sym(), context=mx.cpu())
+    mod.fit(it, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.3, "momentum": 0.9},
+            num_epoch=5)
+    assert mod._fused is not None and mod._fused is not False
+    assert mod._fused.dispatch_count == 5 * (n // 8)
+    score = mod.score(it, "acc")
+    assert score[0][1] > 0.5
