@@ -463,6 +463,101 @@ def _fused_step_record():
     return record
 
 
+def _bench_telemetry_overhead(steps=80, warmup=5, rounds=3):
+    """MLP train-step time with telemetry OFF (the default env — hooks
+    must be one module lookup + None check) vs ON (active run, fit-style
+    step records + spans, JSONL sink). Rounds are interleaved
+    (off, on, off, on, ...) and the best round per mode is reported so
+    host-load noise hits both modes symmetrically. The acceptance bar
+    is the OFF path: < 2% overhead vs the parent commit's step time
+    (compare telemetry_off_steps_per_sec with BENCH_r06's mlp case)."""
+    import tempfile
+
+    import numpy as np_
+    import mxnet_tpu as mx
+    from mxnet_tpu import telemetry
+
+    def sync(mod):
+        mod._exec.arg_dict[mod._param_names[0]]._data.block_until_ready()
+
+    rng = np_.random.RandomState(0)
+    data_shape = (64, 784)
+    batch = mx.io.DataBatch(
+        data=[mx.nd.array(
+            rng.uniform(0, 1, data_shape).astype(np_.float32))],
+        label=[mx.nd.array(
+            rng.randint(0, 10, (data_shape[0],)).astype(np_.float32))])
+
+    mod = mx.module.Module(_mlp_sym(), context=mx.current_context())
+    mod.bind(data_shapes=[("data", data_shape)],
+             label_shapes=[("softmax_label", (data_shape[0],))])
+    mod.init_params(initializer=mx.init.Xavier())
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.05,
+                                         "momentum": 0.9})
+    for _ in range(warmup):
+        mod.forward_backward(batch)
+        mod.update()
+    sync(mod)
+
+    sink = os.path.join(tempfile.gettempdir(),
+                        "bench_telemetry_%d.jsonl" % os.getpid())
+
+    def run_round(mode):
+        if mode == "on":
+            telemetry.start(filename=sink,
+                            meta={"case": "telemetry_overhead"})
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            if mode == "on":
+                telemetry.step_begin()
+                with telemetry.span("compute"):
+                    mod.forward_backward(batch)
+                with telemetry.span("optimizer"):
+                    mod.update()
+                telemetry.step_end(samples=data_shape[0])
+            else:
+                mod.forward_backward(batch)
+                mod.update()
+        sync(mod)
+        dt = time.perf_counter() - t0
+        if mode == "on":
+            telemetry.stop()
+        return steps / dt
+
+    telemetry.reset()
+    best = {"off": 0.0, "on": 0.0}
+    for _ in range(rounds):
+        for mode in ("off", "on"):
+            best[mode] = max(best[mode], run_round(mode))
+    try:
+        os.remove(sink)
+    except OSError:
+        pass
+    return {
+        "telemetry_off_steps_per_sec": round(best["off"], 2),
+        "telemetry_on_steps_per_sec": round(best["on"], 2),
+        "on_overhead_pct": round(
+            100.0 * (best["off"] / best["on"] - 1.0), 2),
+        "steps": steps,
+        "batch": data_shape[0],
+    }
+
+
+def _telemetry_record():
+    """The telemetry-overhead benchmark record (BENCH_r07.json).
+    CPU-friendly — runs wherever the tier-1 suite runs."""
+    import jax
+    record = {"metric": "telemetry_overhead", "unit": "steps/s",
+              "dtype": "float32", "optimizer": "sgd_momentum",
+              "platform": jax.default_backend(), "cases": {}}
+    try:
+        record["cases"]["mlp"] = _bench_telemetry_overhead()
+    except Exception as exc:                     # noqa: BLE001
+        record["errors"] = {"mlp": _err_str(exc)}
+    return record
+
+
 def _err_str(exc):
     return "%s: %s" % (type(exc).__name__, str(exc)[:400])
 
@@ -578,5 +673,9 @@ if __name__ == "__main__":
         # CPU-friendly standalone mode: only the fused-train-step
         # benchmark, one JSON line (the BENCH_r06 artifact)
         print(json.dumps(_fused_step_record()))
+    elif "--telemetry-overhead" in sys.argv:
+        # CPU-friendly standalone mode: telemetry-off vs telemetry-on
+        # MLP train-step time, one JSON line (the BENCH_r07 artifact)
+        print(json.dumps(_telemetry_record()))
     else:
         main()

@@ -80,6 +80,7 @@ from . import monitor
 from . import visualization
 from . import visualization as viz
 from . import profiler
+from . import telemetry
 from . import model
 from . import rnn
 from . import storage

@@ -42,7 +42,13 @@ def log_train_metric(period, auto_reset=False):
 
 
 class Speedometer:
-    """Throughput logger (reference: callback.py:129)."""
+    """Throughput logger (reference: callback.py:129).
+
+    With a telemetry run active (``mxnet_tpu.telemetry``), the speed
+    comes from the run's own step records — the same ring buffer that
+    feeds ``telemetry.report()`` — instead of a private wall clock, so
+    the logged samples/sec and the run summary can never disagree. The
+    private clock remains the fallback for loops without telemetry."""
 
     def __init__(self, batch_size, frequent=50, auto_reset=True):
         self.batch_size = batch_size
@@ -52,6 +58,18 @@ class Speedometer:
         self.last_count = 0
         self.auto_reset = auto_reset
 
+    def _speed(self):
+        from . import telemetry
+        speed = telemetry.recent_rate(self.frequent) \
+            if telemetry.enabled() else None
+        if speed is not None:
+            return speed
+        try:
+            return self.frequent * self.batch_size / \
+                (time.time() - self.tic)
+        except ZeroDivisionError:
+            return float('inf')
+
     def __call__(self, param):
         count = param.nbatch
         if self.last_count > count:
@@ -60,11 +78,7 @@ class Speedometer:
 
         if self.init:
             if count % self.frequent == 0:
-                try:
-                    speed = self.frequent * self.batch_size / \
-                        (time.time() - self.tic)
-                except ZeroDivisionError:
-                    speed = float('inf')
+                speed = self._speed()
                 if param.eval_metric is not None:
                     name_value = param.eval_metric.get_name_value()
                     if self.auto_reset:

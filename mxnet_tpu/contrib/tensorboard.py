@@ -29,22 +29,42 @@ def _find_writer(logging_dir):
 
 class LogMetricsCallback:
     """Epoch/batch-end callback writing metric scalars to TensorBoard
-    event files (ref contrib/tensorboard.py:45)."""
+    event files (ref contrib/tensorboard.py:45).
 
-    def __init__(self, logging_dir, prefix=None):
+    ``log_telemetry=True`` additionally exports the active telemetry
+    run's step-time p50, samples/sec, and goodput (the same numbers
+    ``telemetry.report()`` returns) as ``telemetry/*`` scalars."""
+
+    def __init__(self, logging_dir, prefix=None, log_telemetry=False):
         self.prefix = prefix
+        self.log_telemetry = log_telemetry
         self.summary_writer = _find_writer(logging_dir)
         self._step = 0
 
     def __call__(self, param):
-        if param.eval_metric is None:
+        if param.eval_metric is None and not self.log_telemetry:
             return
         step = getattr(param, "epoch", None)
         if step is None:
             step = self._step
         self._step += 1
-        for name, value in param.eval_metric.get_name_value():
-            if self.prefix is not None:
-                name = "%s-%s" % (self.prefix, name)
-            self.summary_writer.add_scalar(name, value,
-                                           global_step=step)
+        if param.eval_metric is not None:
+            for name, value in param.eval_metric.get_name_value():
+                if self.prefix is not None:
+                    name = "%s-%s" % (self.prefix, name)
+                self.summary_writer.add_scalar(name, value,
+                                               global_step=step)
+        if self.log_telemetry:
+            self._write_telemetry(step)
+
+    def _write_telemetry(self, step):
+        # quick_stats, not report(): this runs per batch-end and must
+        # not pay for comms/memory copies it doesn't chart
+        from .. import telemetry
+        stats = telemetry.quick_stats() if telemetry.enabled() else None
+        if not stats or not stats.get("steps"):
+            return
+        for key in ("samples_per_sec", "goodput", "step_time_ms_p50"):
+            if stats.get(key) is not None:
+                self.summary_writer.add_scalar(
+                    "telemetry/" + key, stats[key], global_step=step)

@@ -28,6 +28,12 @@ state (SURVEY §5.8); here resilience is host-side and testable:
 
 State is process-global; :func:`reset` re-reads the environment (tests
 that monkeypatch ``MXNET_*`` vars must call it).
+
+Telemetry unification: the exact branch points that advance
+``stats()``'s skipped_steps/retries/timeouts also call
+``telemetry.note(...)`` (lazy import, cold paths only), so an active
+telemetry run's goodput accounting reconciles with :func:`stats` by
+construction (README "Observability").
 """
 from __future__ import annotations
 
@@ -406,6 +412,8 @@ def with_retries(fn, timeout=None, backoff=None, max_backoff=None,
             if now >= deadline:
                 with _lock:
                     _stats["timeouts"] += 1
+                from . import telemetry
+                telemetry.note("timeouts")
                 raise CollectiveTimeoutError(
                     "%s did not complete within %.3fs (%d attempt(s); "
                     "last error %s: %s)"
@@ -418,6 +426,8 @@ def with_retries(fn, timeout=None, backoff=None, max_backoff=None,
             delay = min(delay, max(deadline - now, 0.0))
             with _lock:
                 _stats["retries"] += 1
+            from . import telemetry
+            telemetry.note("retries")
             time.sleep(delay)
             attempt += 1
 
@@ -540,6 +550,8 @@ def filter_gradient(index, grad):
     if first_bad:
         with _lock:
             _stats["skipped_steps"] += 1
+        from . import telemetry
+        telemetry.note("skipped_steps")
         if policy == "scale_backoff":
             prev, cur = _backoff_scale()
             logging.warning(
@@ -573,6 +585,8 @@ def fused_step_guard(all_finite):
     _step_clean = False
     with _lock:
         _stats["skipped_steps"] += 1
+    from . import telemetry
+    telemetry.note("skipped_steps")
     if policy == "scale_backoff":
         prev, cur = _backoff_scale()
         logging.warning(

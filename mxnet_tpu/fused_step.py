@@ -300,8 +300,12 @@ class FusedStepExecutor(_FusedCore):
                             guard, inject)
         if poisons is None:
             poisons = self._zero_poisons(len(fns))
-        outs, new_aux, new_ws, new_sts, mask = fn(
-            weights, states, others, aux, rngs, scalars, poisons)
+        from . import telemetry
+        # this is THE "optimizer" span of a fused-mode Module step —
+        # module.update()'s fused branch opens none of its own
+        with telemetry.span("optimizer"):
+            outs, new_aux, new_ws, new_sts, mask = fn(
+                weights, states, others, aux, rngs, scalars, poisons)
         self.dispatch_count += 1
         _count("fused_step_dispatches")
         ex._store_outputs(outs)
@@ -383,8 +387,10 @@ class FusedUpdater(_FusedCore):
                             inject, tuple(indices))
         if poisons is None:
             poisons = self._zero_poisons(len(fns))
-        new_ws, new_sts, mask = fn(grads, weights, states, scalars,
-                                   poisons)
+        from . import telemetry
+        with telemetry.span("optimizer"):
+            new_ws, new_sts, mask = fn(grads, weights, states, scalars,
+                                       poisons)
         self.dispatch_count += 1
         _count("fused_step_dispatches")
         for w_nd, w in zip(weights_nd, new_ws):

@@ -165,17 +165,29 @@ class Trainer:
 
     def step(self, batch_size, ignore_stale_grad=False):
         """allreduce + update, rescaled by batch size
-        (reference: trainer.py:302)."""
+        (reference: trainer.py:302).
+
+        Telemetry: each call is one step boundary (tick mode — the
+        step spans from the previous ``step``), with the cross-worker
+        reduce under the ``sync`` phase and the parameter update under
+        ``optimizer`` (README "Observability")."""
+        from .. import telemetry
+        telemetry.maybe_start(meta={"source": "gluon.Trainer"})
         self._step_rescale(batch_size)
         if not self._kv_initialized:
             self._init_kvstore()
         if self._kvstore is not None:
-            self.allreduce_grads()
-        self._apply_updates(ignore_stale_grad)
+            with telemetry.span("sync"):
+                self.allreduce_grads()
+        with telemetry.span("optimizer"):
+            self._apply_updates(ignore_stale_grad)
+        telemetry.step_tick(samples=batch_size)
 
     def update(self, batch_size, ignore_stale_grad=False):
         """Update only — the caller already ran allreduce_grads
         (reference: trainer.py:363)."""
+        from .. import telemetry
+        telemetry.maybe_start(meta={"source": "gluon.Trainer"})
         if not self._kv_initialized:
             self._init_kvstore()
         if self._kvstore and self._update_on_kvstore:
@@ -184,7 +196,9 @@ class Trainer:
                 'not supported. Try setting `update_on_kvstore` to '
                 'False when creating trainer.')
         self._step_rescale(batch_size)
-        self._apply_updates(ignore_stale_grad)
+        with telemetry.span("optimizer"):
+            self._apply_updates(ignore_stale_grad)
+        telemetry.step_tick(samples=batch_size)
 
     def _sync_rescale(self, scale):
         if self._optimizer.rescale_grad != scale:

@@ -21,10 +21,17 @@ import numpy as np
 from ..base import MXNetError
 from ..ndarray import NDArray, array as nd_array
 from ..context import cpu
-
 __all__ = ["DataDesc", "DataBatch", "DataIter", "ResizeIter",
            "PrefetchingIter", "NDArrayIter", "MNISTIter", "CSVIter",
            "LibSVMIter"]
+
+
+def _data_wait_span():
+    """Telemetry data-wait phase for iterator fetches. Same-phase
+    nesting is counted once, so `fit`'s own outer data_wait span and
+    these inner ones never double count (README "Observability")."""
+    from .. import telemetry
+    return telemetry.span("data_wait")
 
 
 class DataDesc(namedtuple("DataDesc", ["name", "shape"])):
@@ -87,9 +94,12 @@ class DataIter:
         pass
 
     def next(self):
-        if self.iter_next():
-            return DataBatch(data=self.getdata(), label=self.getlabel(),
-                             pad=self.getpad(), index=self.getindex())
+        with _data_wait_span():
+            if self.iter_next():
+                return DataBatch(data=self.getdata(),
+                                 label=self.getlabel(),
+                                 pad=self.getpad(),
+                                 index=self.getindex())
         raise StopIteration
 
     def __next__(self):
@@ -231,7 +241,10 @@ class PrefetchingIter(DataIter):
         self._stop.set()
 
     def next(self):
-        batches = self._queue.get()
+        # the queue get IS the consumer-visible data wait: the worker
+        # thread's decode time only matters when the queue runs dry
+        with _data_wait_span():
+            batches = self._queue.get()
         if batches is None:
             raise StopIteration
         if self.n_iter == 1:
@@ -324,8 +337,9 @@ class NDArrayIter(DataIter):
     def next(self):
         if not self.iter_next():
             raise StopIteration
-        return DataBatch(data=self.getdata(), label=self.getlabel(),
-                         pad=self.getpad(), index=None)
+        with _data_wait_span():
+            return DataBatch(data=self.getdata(), label=self.getlabel(),
+                             pad=self.getpad(), index=None)
 
     def _getdata(self, data_source):
         end = min(self.cursor + self.batch_size, self.num_data)

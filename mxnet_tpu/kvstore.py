@@ -29,6 +29,10 @@ fault fires on every worker running the same plan; real one-sided
 transport errors need the symmetric retry barrier a later elastic PR
 adds) — the proven lanes are the single-process degenerate case and
 planned-fault chaos runs.
+
+Observability: with a telemetry run active (``mxnet_tpu.telemetry``),
+every push/pull is accounted per key — bytes moved and caller-observed
+latency (retry backoff included) — under comm kinds ``push``/``pull``.
 """
 from __future__ import annotations
 
@@ -37,6 +41,7 @@ import logging
 import pickle
 
 from . import fault
+from . import telemetry
 from .base import MXNetError
 from . import optimizer as opt
 from .ndarray import NDArray
@@ -201,9 +206,11 @@ class KVStore:
             if not isinstance(agg, BaseSparseNDArray):
                 agg = comp.compress(k, agg)
         # communication phase — the only retried region; re-running the
-        # reduce is free of side effects on this worker
-        agg = self._guarded(functools.partial(self._global_reduce, agg),
-                            site="push")
+        # reduce is free of side effects on this worker. The telemetry
+        # latency is caller-observed: retry backoff counts.
+        with telemetry.comm_span("push", k, agg):
+            agg = self._guarded(
+                functools.partial(self._global_reduce, agg), site="push")
         # apply phase — runs at most once per push, so a retried
         # transport failure can never double-apply an optimizer update
         if self._optimizer is not None:
@@ -356,9 +363,11 @@ class KVStore:
     def pull(self, key, out=None, priority=0, ignore_sparse=True):
         keys, outs = _ctype_key_value(key, out)
         for k, o in zip(keys, outs):
-            self._guarded(
-                functools.partial(self._pull_one, k, o, ignore_sparse),
-                site="pull")
+            with telemetry.comm_span("pull", k, self._data.get(k)):
+                self._guarded(
+                    functools.partial(self._pull_one, k, o,
+                                      ignore_sparse),
+                    site="pull")
 
     def _pull_one(self, k, o, ignore_sparse):
         from .ndarray.sparse import BaseSparseNDArray

@@ -209,108 +209,149 @@ class BaseModule:
         loads them, and continues from the following epoch — corrupt or
         truncated files are skipped with a warning. Non-finite-gradient
         skip counts accumulate in ``mxnet_tpu.fault.stats()``.
+
+        Observability (see README "Observability"): with telemetry
+        enabled (``MXNET_TELEMETRY``/``MXNET_TELEMETRY_FILE`` or an
+        explicit ``telemetry.start()``), every batch becomes one step
+        record with a data_wait/compute/optimizer phase timeline,
+        epoch-end checkpoint/eval phases are timed, and the run's
+        goodput reconciles with ``fault.stats()``.
         """
-        from .. import fault
+        from .. import fault, telemetry
         assert num_epoch is not None, 'please specify number of epochs'
+        owns_telemetry = telemetry.maybe_start(
+            meta={"source": "Module.fit", "begin_epoch": begin_epoch,
+                  "num_epoch": num_epoch})
         # stats are process-global and cumulative: report only THIS
         # fit's guard skips at the end
         skipped_at_entry = fault.stats()['skipped_steps'] \
             if fault.is_enabled() else 0
-        if resume_from_checkpoint:
-            resumed = self._resume_point(resume_from_checkpoint,
-                                         checkpoint_prefix)
-            if resumed is not None:
-                resume_epoch, arg_params, aux_params = resumed
-                begin_epoch = max(begin_epoch, resume_epoch)
-                force_init = True
-        self.bind(data_shapes=train_data.provide_data,
-                  label_shapes=train_data.provide_label,
-                  for_training=True, force_rebind=force_rebind)
-        if monitor is not None:
-            self.install_monitor(monitor)
-        self.init_params(initializer=initializer, arg_params=arg_params,
-                         aux_params=aux_params, allow_missing=allow_missing,
-                         force_init=force_init)
-        self.init_optimizer(kvstore=kvstore, optimizer=optimizer,
-                            optimizer_params=optimizer_params)
-        if validation_metric is None:
-            validation_metric = eval_metric
-        if not isinstance(eval_metric, _metric.EvalMetric):
-            eval_metric = _metric.create(eval_metric)
+        batch_samples = getattr(train_data, 'batch_size', None) or None
+        # the finally must cover everything after maybe_start: a setup
+        # error (bad optimizer name, bind shape mismatch) would
+        # otherwise leak the run this fit owns
+        try:
+            if resume_from_checkpoint:
+                resumed = self._resume_point(resume_from_checkpoint,
+                                             checkpoint_prefix)
+                if resumed is not None:
+                    resume_epoch, arg_params, aux_params = resumed
+                    begin_epoch = max(begin_epoch, resume_epoch)
+                    force_init = True
+            self.bind(data_shapes=train_data.provide_data,
+                      label_shapes=train_data.provide_label,
+                      for_training=True, force_rebind=force_rebind)
+            if monitor is not None:
+                self.install_monitor(monitor)
+            self.init_params(initializer=initializer,
+                             arg_params=arg_params,
+                             aux_params=aux_params,
+                             allow_missing=allow_missing,
+                             force_init=force_init)
+            self.init_optimizer(kvstore=kvstore, optimizer=optimizer,
+                                optimizer_params=optimizer_params)
+            if validation_metric is None:
+                validation_metric = eval_metric
+            if not isinstance(eval_metric, _metric.EvalMetric):
+                eval_metric = _metric.create(eval_metric)
 
-        for epoch in range(begin_epoch, num_epoch):
-            tic = time.time()
-            eval_metric.reset()
-            nbatch = 0
-            data_iter = iter(train_data)
-            end_of_batch = False
-            next_data_batch = next(data_iter)
-            while not end_of_batch:
-                data_batch = next_data_batch
-                if monitor is not None:
-                    monitor.tic()
-                self.forward_backward(data_batch)
-                self.update()
-                if isinstance(data_batch, list):
-                    self.update_metric(eval_metric,
-                                       [db.label for db in data_batch],
-                                       pre_sliced=True)
-                else:
-                    self.update_metric(eval_metric, data_batch.label)
-                try:
+            for epoch in range(begin_epoch, num_epoch):
+                tic = time.time()
+                eval_metric.reset()
+                nbatch = 0
+                data_iter = iter(train_data)
+                end_of_batch = False
+                with telemetry.span("data_wait"):
                     next_data_batch = next(data_iter)
-                    self.prepare(next_data_batch,
-                                 sparse_row_id_fn=sparse_row_id_fn)
-                except StopIteration:
-                    end_of_batch = True
-                if monitor is not None:
-                    monitor.toc_print()
-                if end_of_batch:
-                    eval_name_vals = eval_metric.get_name_value()
-                if batch_end_callback is not None:
-                    batch_end_params = BatchEndParam(
-                        epoch=epoch, nbatch=nbatch,
-                        eval_metric=eval_metric, locals=locals())
-                    for callback in _as_list(batch_end_callback):
-                        callback(batch_end_params)
-                nbatch += 1
+                while not end_of_batch:
+                    data_batch = next_data_batch
+                    telemetry.step_begin()
+                    if monitor is not None:
+                        monitor.tic()
+                    with telemetry.span("compute"):
+                        self.forward_backward(data_batch)
+                    # update() spans itself: "optimizer" for the
+                    # eager/fused update, "sync" for the kvstore
+                    # push/pull path — fit must not blanket both under
+                    # one phase
+                    self.update()
+                    if isinstance(data_batch, list):
+                        self.update_metric(eval_metric,
+                                           [db.label
+                                            for db in data_batch],
+                                           pre_sliced=True)
+                    else:
+                        self.update_metric(eval_metric, data_batch.label)
+                    try:
+                        with telemetry.span("data_wait"):
+                            next_data_batch = next(data_iter)
+                        self.prepare(next_data_batch,
+                                     sparse_row_id_fn=sparse_row_id_fn)
+                    except StopIteration:
+                        end_of_batch = True
+                    if monitor is not None:
+                        monitor.toc_print()
+                    if end_of_batch:
+                        eval_name_vals = eval_metric.get_name_value()
+                    # close the step BEFORE the callbacks so the
+                    # Speedometer reads a ring that includes this batch
+                    telemetry.step_end(samples=batch_samples)
+                    if batch_end_callback is not None:
+                        batch_end_params = BatchEndParam(
+                            epoch=epoch, nbatch=nbatch,
+                            eval_metric=eval_metric, locals=locals())
+                        for callback in _as_list(batch_end_callback):
+                            callback(batch_end_params)
+                    nbatch += 1
 
-            for name, val in eval_name_vals:
-                self.logger.info('Epoch[%d] Train-%s=%f', epoch, name, val)
-            toc = time.time()
-            self.logger.info('Epoch[%d] Time cost=%.3f', epoch, (toc - tic))
+                for name, val in eval_name_vals:
+                    self.logger.info('Epoch[%d] Train-%s=%f', epoch, name,
+                                     val)
+                toc = time.time()
+                self.logger.info('Epoch[%d] Time cost=%.3f', epoch,
+                                 (toc - tic))
 
-            arg_params, aux_params = self.get_params()
-            self.set_params(arg_params, aux_params)
-            if checkpoint_prefix is not None and \
-                    (epoch + 1) % max(checkpoint_period, 1) == 0:
-                from ..model import save_checkpoint as _save_ckpt
-                _save_ckpt(checkpoint_prefix, epoch, self.symbol,
-                           arg_params, aux_params)
-                if getattr(self, 'optimizer_initialized', False) and \
-                        hasattr(self, 'save_optimizer_states'):
-                    self.save_optimizer_states(
-                        '%s-%04d.states' % (checkpoint_prefix, epoch))
-            if epoch_end_callback is not None:
-                for callback in _as_list(epoch_end_callback):
-                    callback(epoch, self.symbol, arg_params, aux_params)
+                arg_params, aux_params = self.get_params()
+                self.set_params(arg_params, aux_params)
+                if checkpoint_prefix is not None and \
+                        (epoch + 1) % max(checkpoint_period, 1) == 0:
+                    with telemetry.span("checkpoint"):
+                        from ..model import save_checkpoint as _save_ckpt
+                        _save_ckpt(checkpoint_prefix, epoch, self.symbol,
+                                   arg_params, aux_params)
+                        if getattr(self, 'optimizer_initialized',
+                                   False) and \
+                                hasattr(self, 'save_optimizer_states'):
+                            self.save_optimizer_states(
+                                '%s-%04d.states' % (checkpoint_prefix,
+                                                    epoch))
+                if epoch_end_callback is not None:
+                    for callback in _as_list(epoch_end_callback):
+                        callback(epoch, self.symbol, arg_params,
+                                 aux_params)
 
-            if eval_data is not None:
-                res = self.score(eval_data, validation_metric,
-                                 score_end_callback=eval_end_callback,
-                                 batch_end_callback=eval_batch_end_callback,
-                                 epoch=epoch)
-                for name, val in res:
-                    self.logger.info('Epoch[%d] Validation-%s=%f', epoch,
-                                     name, val)
-            train_data.reset()
+                if eval_data is not None:
+                    with telemetry.span("eval"):
+                        res = self.score(
+                            eval_data, validation_metric,
+                            score_end_callback=eval_end_callback,
+                            batch_end_callback=eval_batch_end_callback,
+                            epoch=epoch)
+                    for name, val in res:
+                        self.logger.info('Epoch[%d] Validation-%s=%f',
+                                         epoch, name, val)
+                train_data.reset()
 
-        if fault.is_enabled():
-            skipped = fault.stats()['skipped_steps'] - skipped_at_entry
-            if skipped:
-                self.logger.warning(
-                    'fit: %d optimizer step(s) skipped by the '
-                    'non-finite gradient guard (fault.stats())', skipped)
+            if fault.is_enabled():
+                skipped = fault.stats()['skipped_steps'] - skipped_at_entry
+                if skipped:
+                    self.logger.warning(
+                        'fit: %d optimizer step(s) skipped by the '
+                        'non-finite gradient guard (fault.stats())',
+                        skipped)
+        finally:
+            if owns_telemetry:
+                telemetry.stop()
 
     # -- symbol / params -------------------------------------------------
     @property

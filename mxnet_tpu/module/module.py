@@ -92,14 +92,17 @@ class Module(BaseModule):
         # every piece is written atomically (tmp + os.replace in
         # Symbol.save / nd.save / base.atomic_write_bytes) so a
         # preempted save never strands a truncated file
-        self._symbol.save('%s-symbol.json' % prefix)
-        param_file = '%s-%04d.params' % (prefix, epoch)
-        self.save_params(param_file)
-        logging.info('Saved checkpoint to \"%s\"', param_file)
-        if save_optimizer_states:
-            state_file = '%s-%04d.states' % (prefix, epoch)
-            self.save_optimizer_states(state_file)
-            logging.info('Saved optimizer state to \"%s\"', state_file)
+        from .. import telemetry
+        with telemetry.span("checkpoint"):
+            self._symbol.save('%s-symbol.json' % prefix)
+            param_file = '%s-%04d.params' % (prefix, epoch)
+            self.save_params(param_file)
+            logging.info('Saved checkpoint to \"%s\"', param_file)
+            if save_optimizer_states:
+                state_file = '%s-%04d.states' % (prefix, epoch)
+                self.save_optimizer_states(state_file)
+                logging.info('Saved optimizer state to \"%s\"',
+                             state_file)
 
     # -- properties --------------------------------------------------------
     data_names = property(lambda self: self._data_names)
@@ -460,27 +463,37 @@ class Module(BaseModule):
     def update(self):
         assert self.binded and self.params_initialized \
             and self.optimizer_initialized
+        from .. import telemetry
         self._params_dirty = True
         if self._pending_step:
             self._pending_step = False
-            fused = self._get_fused() if self._fused_eligible() else None
+            fused = self._get_fused() if self._fused_eligible() \
+                else None
             if fused is not None:
-                fused.step()
+                fused.step()          # spans "optimizer" internally
                 self._pending_forward = False
                 return
-            # no compiled path after all: run the eager forward+backward
-            # now, then fall through to the eager update loop
-            self._exec.forward_backward(is_train=True)
+            # no compiled path after all: run the eager
+            # forward+backward now, then fall through to the eager
+            # update loop
+            with telemetry.span("compute"):
+                self._exec.forward_backward(is_train=True)
             self._pending_forward = False
         weights = [self._exec.arg_dict[n] for n in self._param_names]
-        grads = [self._exec.grad_dict.get(n) for n in self._param_names]
+        grads = [self._exec.grad_dict.get(n)
+                 for n in self._param_names]
         if self._update_on_kvstore:
-            _update_params_on_kvstore(weights, grads, self._kvstore,
-                                      self._param_names)
+            # push/pull IS the cross-worker reduce — "sync", not
+            # "optimizer" (the hosted updater runs inside the push;
+            # per-key time/bytes land in the comms table either way)
+            with telemetry.span("sync"):
+                _update_params_on_kvstore(weights, grads, self._kvstore,
+                                          self._param_names)
         else:
-            _update_params(weights, grads, updater=self._updater,
-                           num_device=1, kvstore=self._kvstore,
-                           param_names=self._param_names)
+            with telemetry.span("optimizer"):
+                _update_params(weights, grads, updater=self._updater,
+                               num_device=1, kvstore=self._kvstore,
+                               param_names=self._param_names)
 
     def get_outputs(self, merge_multi_context=True):
         assert self.binded and self.params_initialized

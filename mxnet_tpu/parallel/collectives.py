@@ -5,6 +5,10 @@ The TPU-native replacement for the reference's communication backends
 ps-lite push/pull all collapse into XLA collectives over ICI/DCN. These
 wrappers exist for the eager KVStore path and for shard_map kernels;
 inside pjit programs, sharding annotations let XLA insert them.
+
+Observability: with a telemetry run active (``mxnet_tpu.telemetry``),
+each eager collective is accounted — input bytes and caller-observed
+latency — under comm kind ``collective`` keyed by the primitive name.
 """
 from __future__ import annotations
 
@@ -59,7 +63,9 @@ def all_reduce(x, mesh, axis="dp", op="sum"):
         return _shard_map()(f, mesh=mesh, in_specs=(P(axis),),
                             out_specs=P())(x)
 
-    return fault.guard(run, "allreduce")
+    from .. import telemetry
+    with telemetry.comm_span("collective", "all_reduce", x):
+        return fault.guard(run, "allreduce")
 
 
 def all_gather(x, mesh, axis="dp", tiled=True):
@@ -69,8 +75,10 @@ def all_gather(x, mesh, axis="dp", tiled=True):
     def f(v):
         return jax.lax.all_gather(v, axis, tiled=tiled)
 
-    return _shard_map()(f, mesh=mesh, in_specs=(P(axis),),
-                        out_specs=P())(x)
+    from .. import telemetry
+    with telemetry.comm_span("collective", "all_gather", x):
+        return _shard_map()(f, mesh=mesh, in_specs=(P(axis),),
+                            out_specs=P())(x)
 
 
 def reduce_scatter(x, mesh, axis="dp"):
@@ -80,8 +88,10 @@ def reduce_scatter(x, mesh, axis="dp"):
     def f(v):
         return jax.lax.psum_scatter(v, axis, tiled=True)
 
-    return _shard_map()(f, mesh=mesh, in_specs=(P(),),
-                        out_specs=P(axis))(x)
+    from .. import telemetry
+    with telemetry.comm_span("collective", "reduce_scatter", x):
+        return _shard_map()(f, mesh=mesh, in_specs=(P(),),
+                            out_specs=P(axis))(x)
 
 
 def ppermute(x, mesh, axis, perm):
@@ -91,8 +101,10 @@ def ppermute(x, mesh, axis, perm):
     def f(v):
         return jax.lax.ppermute(v, axis, perm)
 
-    return _shard_map()(f, mesh=mesh, in_specs=(P(axis),),
-                        out_specs=P(axis))(x)
+    from .. import telemetry
+    with telemetry.comm_span("collective", "ppermute", x):
+        return _shard_map()(f, mesh=mesh, in_specs=(P(axis),),
+                            out_specs=P(axis))(x)
 
 
 def broadcast(x, mesh, axis="dp", root=0):
@@ -105,8 +117,10 @@ def broadcast(x, mesh, axis="dp", root=0):
         v = jnp.where(idx == root, v, jnp.zeros_like(v))
         return jax.lax.psum(v, axis)
 
-    return _shard_map()(f, mesh=mesh, in_specs=(P(axis),),
-                        out_specs=P(axis))(x)
+    from .. import telemetry
+    with telemetry.comm_span("collective", "broadcast", x):
+        return _shard_map()(f, mesh=mesh, in_specs=(P(axis),),
+                            out_specs=P(axis))(x)
 
 
 def psum_eager(arrays):
@@ -121,9 +135,11 @@ def psum_eager(arrays):
 
 def barrier(name="barrier"):
     import jax
+    from .. import telemetry
     try:
         if jax.process_count() > 1:
             from jax.experimental import multihost_utils
-            multihost_utils.sync_global_devices(name)
+            with telemetry.comm_span("collective", "barrier"):
+                multihost_utils.sync_global_devices(name)
     except Exception:
         pass

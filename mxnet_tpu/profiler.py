@@ -55,6 +55,8 @@ profiler_set_config = set_config
 def set_state(state='stop', profile_process='worker'):
     """'run' | 'stop' (reference: profiler.py set_state)."""
     if state == 'run':
+        global _MAX_EVENTS
+        _MAX_EVENTS = None            # re-read the env cap at run start
         _state["running"] = True
         if _state["jax_trace_dir"]:
             try:
@@ -83,7 +85,27 @@ def resume(profile_process='worker'):
     _state["running"] = True
 
 
+_MAX_EVENTS = None
+
+
+def _max_events():
+    """MXNET_PROFILER_MAX_EVENTS, read once and cached — _emit sits on
+    the tracing hot path. set_state('run') re-reads."""
+    global _MAX_EVENTS
+    if _MAX_EVENTS is None:
+        from .base import get_env
+        _MAX_EVENTS = get_env("MXNET_PROFILER_MAX_EVENTS", 1000000, int)
+    return _MAX_EVENTS
+
+
 def _emit(name, cat, ph, ts=None, args=None, dur=None):
+    """Append one trace event — only while the profiler is running
+    (a stopped profiler must not accumulate host events forever), and
+    only up to MXNET_PROFILER_MAX_EVENTS; overflow increments the
+    ``profiler_events_dropped`` counter instead of growing without
+    bound."""
+    if not _state["running"]:
+        return
     ev = {"name": name, "cat": cat, "ph": ph,
           "ts": ts if ts is not None else _now_us(),
           "pid": os.getpid(), "tid": threading.get_ident()}
@@ -92,6 +114,11 @@ def _emit(name, cat, ph, ts=None, args=None, dur=None):
     if dur is not None:
         ev["dur"] = dur
     with _lock:
+        if len(_state["events"]) >= _max_events():
+            # direct dict bump: increment_counter would re-enter _lock
+            _state["counters"]["profiler_events_dropped"] = \
+                _state["counters"].get("profiler_events_dropped", 0) + 1
+            return
         _state["events"].append(ev)
 
 
@@ -107,31 +134,51 @@ def _aggregate(name, dur_us):
 
 
 def dumps(reset=False, format='table', sort_by='total', ascending=False):
-    """Aggregate stats table (reference: MXAggregateProfileStatsPrint)."""
+    """Aggregate stats table (reference: MXAggregateProfileStatsPrint,
+    which sorts by avg by default). ``sort_by`` is one of
+    total|avg|count|min|max — an unknown key raises instead of
+    silently sorting everything as 0."""
+    valid = ("total", "avg", "count", "min", "max")
+    if sort_by not in valid:
+        raise ValueError("dumps: sort_by=%r (want %s)"
+                         % (sort_by, "|".join(valid)))
+
+    def _key(kv):
+        a = kv[1]
+        if sort_by == "avg":
+            return a["total"] / max(a["count"], 1)
+        return a[sort_by]
+
     with _lock:
-        rows = sorted(_state["aggregate"].items(),
-                      key=lambda kv: kv[1].get(sort_by, 0),
+        rows = sorted(_state["aggregate"].items(), key=_key,
                       reverse=not ascending)
-        out = ["%-40s %8s %12s %12s %12s" % ("Name", "Count",
-                                             "Total(us)", "Min(us)",
-                                             "Max(us)")]
+        out = ["%-40s %8s %12s %12s %12s %12s"
+               % ("Name", "Count", "Total(us)", "Avg(us)", "Min(us)",
+                  "Max(us)")]
         for name, a in rows:
-            out.append("%-40s %8d %12.1f %12.1f %12.1f"
-                       % (name, a["count"], a["total"], a["min"], a["max"]))
+            out.append("%-40s %8d %12.1f %12.1f %12.1f %12.1f"
+                       % (name, a["count"], a["total"],
+                          a["total"] / max(a["count"], 1), a["min"],
+                          a["max"]))
         if reset:
             _state["aggregate"] = {}
     return "\n".join(out)
 
 
 def dump(finished=True, profile_process='worker'):
-    """Write chrome://tracing JSON (reference: DumpProfile)."""
+    """Write chrome://tracing JSON (reference: DumpProfile). The write
+    is atomic (tmp + os.replace, the checkpoint-write contract) so a
+    crash mid-dump never leaves a truncated trace."""
     with _lock:
         events = list(_state["events"])
         if finished:
             _state["events"] = []
-    with open(_state["filename"], "w") as f:
+    fname = _state["filename"]
+    tmp = fname + ".tmp"
+    with open(tmp, "w") as f:
         json.dump({"traceEvents": events, "displayTimeUnit": "ms"}, f)
-    return _state["filename"]
+    os.replace(tmp, fname)
+    return fname
 
 
 def aggregate_stats():
@@ -211,19 +258,30 @@ class Marker:
 
 
 class Counter:
+    """Trace counter. Value updates run under the module lock so
+    concurrent increments never lose counts (the lock is released
+    before the event emit, which takes it again)."""
+
     def __init__(self, name, domain=None, value=0):
         self.name = name
         self._v = value
 
     def set_value(self, value):
-        self._v = value
+        with _lock:
+            self._v = value
+        _emit(self.name, "counter", "C", args={"value": value})
+
+    def _shift(self, delta):
+        with _lock:
+            self._v += delta
+            value = self._v
         _emit(self.name, "counter", "C", args={"value": value})
 
     def increment(self, delta=1):
-        self.set_value(self._v + delta)
+        self._shift(delta)
 
     def decrement(self, delta=1):
-        self.set_value(self._v - delta)
+        self._shift(-delta)
 
     __iadd__ = lambda self, d: (self.increment(d), self)[1]
     __isub__ = lambda self, d: (self.decrement(d), self)[1]

@@ -1,0 +1,772 @@
+"""Unified training-run telemetry: step timeline, goodput, memory,
+comms — one schema, one sink (SURVEY §5.1 as a subsystem).
+
+The reference framework's observability was scattered across
+DumpProfile's chrome-tracing artifact, MXAggregateProfileStatsPrint
+tables, and the Monitor/Speedometer training taps; this reproduction
+additionally grew `fault.stats()` and the `fused_step_*` counters with
+no shared notion of a *training run*. This module unifies them:
+
+- **Per-step timeline** — :func:`span` phases (``data_wait``,
+  ``compute``, ``optimizer``, ``sync``, ``checkpoint``, ``eval``)
+  accumulate into the open step record and layer onto the existing
+  profiler (aggregate table always; a chrome-tracing ``X`` event while
+  the profiler is running). Phases are exclusive: under nesting the
+  OUTERMOST span owns the wall time (an inner ``data_wait`` in
+  ``PrefetchingIter`` under ``fit``'s own never double counts, and an
+  eval-loop fetch is ``eval`` time, not a second copy under
+  ``data_wait``), so phase totals can never sum past the wall clock —
+  and only spans on the accounting thread (the one driving steps)
+  count at all, so a prefetch worker's background decode time is never
+  misreported as a consumer stall. Note the fused train step
+  (MXNET_FUSED_STEP=1) defers the
+  forward+backward into ``Module.update``'s single dispatch, so its
+  wall time lands in the ``optimizer`` phase and ``compute`` reads ~0.
+- **Throughput & goodput** — steps land in a ring buffer
+  (``MXNET_TELEMETRY_RING``, default 1024) for p50/p90/p99 step-time
+  percentiles; productive vs. skipped/retried accounting is unified
+  with ``fault.stats()`` (fault.py calls :func:`note` at the exact
+  branch points that advance its own counters) and the ``fused_step_*``
+  profiler counters, all reconciled in :func:`report`.
+- **Device memory watermarks** — ``jax.local_devices()[i]
+  .memory_stats()`` sampled every ``MXNET_TELEMETRY_MEM_INTERVAL``
+  steps (default 10; 0 disables), gracefully no-op on backends without
+  it, with an optional host live-buffer fallback
+  (``MXNET_TELEMETRY_LIVE_BUFFERS``, default on).
+- **Comms accounting** — bytes and call latency per key for kvstore
+  push/pull and per collective in ``parallel/collectives.py``, via
+  :func:`comm_span`.
+
+Everything flows to a structured JSONL sink (``MXNET_TELEMETRY_FILE``)
+and to the :func:`report` summary dict; ``python -m
+mxnet_tpu.tools.diagnose <file>.jsonl`` renders the sink back into
+human tables. The sink is created atomically (``<file>.tmp`` +
+``os.replace``) and later flushes append only the records accrued
+since the previous flush (flushed records leave host memory, so a
+week-long run stays O(ring + accumulators), not O(steps)); a crash can
+strand at most one trailing partial line, which the diagnose reader
+skips — never a truncated earlier record.
+
+Always cheap when off: with no active run every hook is one module
+lookup + None check and :func:`span`/:func:`comm_span` return a shared
+no-op context manager. A run starts explicitly (:func:`start`) or from
+the environment (``MXNET_TELEMETRY=1`` or ``MXNET_TELEMETRY_FILE``
+set) on the next ``Module.fit`` / gluon ``Trainer.step``
+(:func:`maybe_start`).
+
+JSONL record types: ``run_start`` (meta), ``step`` (seq, dur_ms,
+phases_ms, samples, skipped, retries), ``memory`` (per-device bytes),
+``summary`` (the :func:`report` dict, written at :func:`stop`).
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from collections import deque
+
+from .base import get_env
+
+__all__ = ["PHASES", "enabled", "start", "stop", "reset", "maybe_start",
+           "step_begin", "step_end", "step_tick", "span", "comm",
+           "comm_span", "note", "recent_rate", "sample_memory", "flush",
+           "report", "quick_stats", "percentile"]
+
+PHASES = ("data_wait", "compute", "optimizer", "sync", "checkpoint",
+          "eval")
+
+_lock = threading.Lock()
+_run = None          # the active _Run
+_last_run = None     # most recently stopped run (report() after fit)
+_env_cfg = None      # cached (enabled, filename) from the environment
+
+
+class _Run:
+    """One training run's accumulators. All mutation under the module
+    lock; reads for report() snapshot under the same lock."""
+
+    def __init__(self, filename, run_id, meta):
+        self.run_id = run_id or "run-%d-%d" % (os.getpid(),
+                                               int(time.time()))
+        self.filename = filename
+        self.t0_wall = time.time()
+        self.records = [{"type": "run_start", "run_id": self.run_id,
+                         "time": self.t0_wall, "pid": os.getpid(),
+                         "meta": dict(meta or {})}]
+        self.ring = deque(
+            maxlen=max(1, get_env("MXNET_TELEMETRY_RING", 1024, int)))
+        self.steps = 0
+        self.samples = 0
+        self.total_step_s = 0.0
+        self.phase_totals = {}       # phase -> seconds (whole run)
+        self.open_phases = set()     # same-phase reentrancy guard
+        self.pending_phases = {}     # phase -> seconds since boundary
+        self.comms = {}              # (kind, key) -> calls/bytes/time_ms
+        self.fault_counters = {"skipped_steps": 0, "retries": 0,
+                               "timeouts": 0}
+        self.extra_counters = {}     # free-form note() names
+        self.mem_watermarks = {}     # device -> peak/last bytes
+        self.fault_base = None       # fault.stats() at start
+        self.counters_base = {}      # profiler.counters() at start
+        self._step_t0 = None         # perf_counter at step_begin
+        self._last_boundary = None   # perf_counter at last step end
+        # spans only count on the accounting thread (the one driving
+        # steps): a prefetch worker's decode time is not a consumer
+        # stall, and a run-global phase guard must not let a background
+        # thread suppress the training thread's real span
+        self._thread = threading.get_ident()
+        self._step_fault_base = dict(self.fault_counters)
+        self._steps_since_flush = 0
+        self._steps_since_mem = 0
+        self._mem_interval = get_env("MXNET_TELEMETRY_MEM_INTERVAL",
+                                     10, int)
+        self._flush_steps = max(
+            1, get_env("MXNET_TELEMETRY_FLUSH_STEPS", 50, int))
+        self._sink_created = False
+        self._flush_lock = threading.Lock()   # serializes sink writers
+        # sink-less runs cap the in-memory record list; flushed records
+        # of sink-backed runs leave memory at each flush
+        self._max_records = max(
+            1, get_env("MXNET_TELEMETRY_MAX_RECORDS", 100000, int))
+        self.records_dropped = 0
+
+
+class _NullSpan:
+    """Shared no-op context manager — the whole cost of a span when
+    telemetry is off."""
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        return False
+
+
+_NULL = _NullSpan()
+
+
+# ---------------------------------------------------------------------------
+# run lifecycle
+# ---------------------------------------------------------------------------
+
+def enabled():
+    """True while a run is active."""
+    return _run is not None
+
+
+def _env():
+    """(enabled, filename) from MXNET_TELEMETRY / MXNET_TELEMETRY_FILE,
+    parsed once; reset() re-reads."""
+    global _env_cfg
+    if _env_cfg is None:
+        on = os.environ.get("MXNET_TELEMETRY", "").strip().lower() \
+            in ("1", "true", "on", "yes")
+        fname = os.environ.get("MXNET_TELEMETRY_FILE", "").strip() or None
+        _env_cfg = (on or fname is not None, fname)
+    return _env_cfg
+
+
+def start(filename=None, run_id=None, meta=None):
+    """Begin a telemetry run. ``filename`` (or MXNET_TELEMETRY_FILE)
+    names the JSONL sink; None keeps the run in memory only. Returns
+    the run_id. A second start() while a run is active is a no-op
+    returning the active run's id. An atexit stop() is registered so a
+    run whose loop has no natural end (a bare gluon loop that never
+    calls stop()) still gets its final flush + summary record."""
+    global _run, _atexit_registered
+    # baselines first, outside the lock (fault/profiler take their own
+    # locks; a loser's snapshot is simply discarded below)
+    from . import fault, profiler
+    fault_base = fault.stats()
+    counters_base = profiler.counters()
+    with _lock:
+        if _run is not None:
+            return _run.run_id     # racer lost: report the winner's id
+        if filename is None:
+            filename = _env()[1]
+        run = _Run(_per_worker_filename(filename), run_id, meta)
+        run.fault_base = fault_base
+        run.counters_base = counters_base
+        _run = run
+    if not _atexit_registered:
+        _atexit_registered = True
+        import atexit
+        atexit.register(stop)      # no-op when already stopped
+    return run.run_id
+
+
+def _per_worker_filename(filename):
+    """In a launcher-spawned multi-worker job (the DMLC_* env
+    contract) every worker would otherwise race on ONE sink path —
+    concurrent creates clobber each other and interleaved appends
+    merge two runs. Give each non-zero worker its own file."""
+    if not filename:
+        return filename
+    worker = os.environ.get("DMLC_WORKER_ID")
+    if not worker or worker == "0" or \
+            os.environ.get("DMLC_NUM_WORKER", "1") in ("", "1"):
+        return filename
+    base, ext = os.path.splitext(filename)
+    return "%s.worker%s%s" % (base, worker, ext)
+
+
+_atexit_registered = False
+
+
+def maybe_start(meta=None):
+    """Training-loop entry hook: start a run when the environment asks
+    for one (MXNET_TELEMETRY=1 or MXNET_TELEMETRY_FILE set) and none is
+    active. Returns True only when THIS call started the run — the
+    caller then owns stop() (loops with no natural end rely on the
+    atexit stop that start() registers)."""
+    if _run is not None:
+        return False
+    on, fname = _env()
+    if not on:
+        return False
+    start(filename=fname, meta=meta)
+    return True
+
+
+def stop():
+    """End the run: close any open step, append the ``summary`` record,
+    flush the JSONL sink, and keep the run readable via report().
+    Returns the summary dict (None when no run was active)."""
+    global _run, _last_run
+    run = _run
+    if run is None:
+        return None
+    now = time.perf_counter()
+    with _lock:
+        if run._step_t0 is not None:
+            _close_step_locked(run, now, None)
+    # a final sample guarantees every run carries memory watermarks,
+    # even short ones that never hit the periodic interval
+    _sample_memory(run)
+    summary = report()
+    with _lock:
+        run.records.append(dict(summary, type="summary"))
+        _last_run = run
+        _run = None
+    _flush_run(run)
+    return summary
+
+
+def reset():
+    """Forget the active and last runs and the cached env config.
+    Tests that monkeypatch MXNET_TELEMETRY* call this."""
+    global _run, _last_run, _env_cfg
+    with _lock:
+        _run = None
+        _last_run = None
+        _env_cfg = None
+
+
+# ---------------------------------------------------------------------------
+# steps
+# ---------------------------------------------------------------------------
+
+def _close_step_locked(run, now, samples):
+    """Finalize one step record; caller holds the lock. In tick mode
+    (no step_begin) the step spans from the previous boundary — the
+    first tick only sets the baseline."""
+    t0 = run._step_t0
+    if t0 is None:
+        if run._last_boundary is None:
+            run._last_boundary = now
+            run.pending_phases = {}
+            run._step_fault_base = dict(run.fault_counters)
+            return None
+        t0 = run._last_boundary
+    dur = max(now - t0, 0.0)
+    run._step_t0 = None
+    run._last_boundary = now
+    run.steps += 1
+    run.total_step_s += dur
+    rec = {"type": "step", "seq": run.steps,
+           "t": round(time.time() - run.t0_wall, 6),
+           "dur_ms": round(dur * 1e3, 6)}
+    if run.pending_phases:
+        rec["phases_ms"] = {k: round(v * 1e3, 6)
+                            for k, v in run.pending_phases.items()}
+    if samples:
+        rec["samples"] = int(samples)
+        run.samples += int(samples)
+    skipped = run.fault_counters["skipped_steps"] \
+        - run._step_fault_base["skipped_steps"]
+    retries = run.fault_counters["retries"] \
+        - run._step_fault_base["retries"]
+    if skipped:
+        rec["skipped"] = skipped
+    if retries:
+        rec["retries"] = retries
+    run.pending_phases = {}
+    run._step_fault_base = dict(run.fault_counters)
+    run.ring.append(rec)
+    run.records.append(rec)
+    if not run.filename and len(run.records) > run._max_records:
+        # memory-only run: bound the record list (the ring and the
+        # accumulators keep the summary exact; only raw records drop).
+        # Drop a 10% block, not one element — a per-step front-shift
+        # of a 100k list under the lock would cost O(cap) every step
+        drop = max(len(run.records) - run._max_records,
+                   run._max_records // 10)
+        drop = min(drop, len(run.records) - 1)   # keep run_start
+        del run.records[1:1 + drop]
+        run.records_dropped += drop
+    run._steps_since_flush += 1
+    run._steps_since_mem += 1
+    return rec
+
+
+def step_begin():
+    """Open a step (closing any still-open one). The fit loop calls
+    this at the top of each batch."""
+    run = _run
+    if run is None:
+        return
+    now = time.perf_counter()
+    with _lock:
+        if run._step_t0 is not None:
+            _close_step_locked(run, now, None)
+        run._step_t0 = now
+        run._thread = threading.get_ident()
+        run.pending_phases = {}
+        run._step_fault_base = dict(run.fault_counters)
+
+
+def step_end(samples=None):
+    """Close the open step, or — with no step_begin (gluon Trainer
+    tick mode) — record a step spanning from the previous boundary.
+    Returns the step record (None when telemetry is off or this tick
+    only set the baseline)."""
+    run = _run
+    if run is None:
+        return None
+    now = time.perf_counter()
+    with _lock:
+        run._thread = threading.get_ident()   # tick mode: the ticking
+        rec = _close_step_locked(run, now, samples)   # thread accounts
+    _after_step(run)
+    return rec
+
+
+# gluon Trainer's per-step boundary: identical semantics, honest name
+step_tick = step_end
+
+
+def _after_step(run):
+    """Post-boundary work that must not hold the lock: periodic memory
+    sampling and JSONL flush."""
+    if run._mem_interval > 0 and run._steps_since_mem >= run._mem_interval:
+        run._steps_since_mem = 0
+        _sample_memory(run)
+    if run.filename and run._steps_since_flush >= run._flush_steps:
+        run._steps_since_flush = 0
+        _flush_run(run)
+
+
+# ---------------------------------------------------------------------------
+# spans
+# ---------------------------------------------------------------------------
+
+class _Span:
+    __slots__ = ("run", "phase", "t0", "active")
+
+    def __init__(self, run, phase):
+        self.run = run
+        self.phase = phase
+
+    def __enter__(self):
+        run = self.run
+        with _lock:
+            if threading.get_ident() != run._thread:
+                # off the accounting thread (a prefetch worker):
+                # background work is not a step stall — no-op
+                self.active = False
+            elif run.open_phases:
+                # phases are EXCLUSIVE: the outermost span owns the
+                # wall time (an eval-loop data fetch is eval time, not
+                # a second copy under data_wait), so phase totals can
+                # never sum past the run's wall clock
+                self.active = False
+            else:
+                run.open_phases.add(self.phase)
+                self.active = True
+        self.t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *a):
+        if not self.active:
+            return False
+        dur = time.perf_counter() - self.t0
+        run = self.run
+        with _lock:
+            run.open_phases.discard(self.phase)
+            run.pending_phases[self.phase] = \
+                run.pending_phases.get(self.phase, 0.0) + dur
+            run.phase_totals[self.phase] = \
+                run.phase_totals.get(self.phase, 0.0) + dur
+        # layer onto the existing profiler: always in the aggregate
+        # table, and as a trace event while the profiler runs
+        from . import profiler
+        dur_us = dur * 1e6
+        profiler._aggregate("telemetry.%s" % self.phase, dur_us)
+        if profiler._state["running"]:
+            profiler._emit("telemetry.%s" % self.phase, "telemetry", "X",
+                           ts=profiler._now_us() - int(dur_us),
+                           dur=int(dur_us))
+        return False
+
+
+def span(phase):
+    """A context manager timing one phase of the current step. No-op
+    singleton when telemetry is off. Phases are exclusive — under
+    nesting, only the outermost span counts — and only the accounting
+    thread's spans count at all."""
+    run = _run
+    if run is None:
+        return _NULL
+    return _Span(run, phase)
+
+
+# ---------------------------------------------------------------------------
+# comms
+# ---------------------------------------------------------------------------
+
+def _nbytes(value):
+    """Best-effort payload size of an NDArray / jax array / sparse
+    NDArray / list of them."""
+    if value is None:
+        return 0
+    if isinstance(value, (list, tuple)):
+        return sum(_nbytes(v) for v in value)
+    sp = getattr(value, "_sp_data", None)
+    if sp is not None:
+        return _nbytes(sp) + _nbytes(getattr(value, "_sp_indices", None))
+    data = getattr(value, "_data", value)
+    nb = getattr(data, "nbytes", None)
+    if nb is not None:
+        try:
+            return int(nb)
+        except (TypeError, ValueError):
+            return 0
+    return 0
+
+
+def comm(kind, key, nbytes=0, seconds=0.0):
+    """Account one communication call: bytes + latency per (kind, key).
+    kind is ``push``/``pull``/``collective``; key is the kvstore key or
+    the collective's name."""
+    run = _run
+    if run is None:
+        return
+    k = (str(kind), str(key))
+    with _lock:
+        c = run.comms.get(k)
+        if c is None:
+            c = run.comms[k] = {"calls": 0, "bytes": 0, "time_ms": 0.0}
+        c["calls"] += 1
+        c["bytes"] += int(nbytes)
+        c["time_ms"] += seconds * 1e3
+
+
+class _CommSpan:
+    __slots__ = ("kind", "key", "nbytes", "t0")
+
+    def __init__(self, kind, key, nbytes):
+        self.kind = kind
+        self.key = key
+        self.nbytes = nbytes
+
+    def __enter__(self):
+        self.t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *a):
+        comm(self.kind, self.key, self.nbytes,
+             time.perf_counter() - self.t0)
+        return False
+
+
+def comm_span(kind, key, value=None):
+    """Time one communication call and account ``value``'s bytes under
+    (kind, key). The latency includes any fault-retry backoff — it is
+    the caller-observed call latency."""
+    if _run is None:
+        return _NULL
+    return _CommSpan(kind, key, _nbytes(value))
+
+
+# ---------------------------------------------------------------------------
+# fault/goodput unification
+# ---------------------------------------------------------------------------
+
+def note(name, delta=1):
+    """Count one resilience/bookkeeping event against the run.
+    fault.py calls this at the exact branch points that advance its own
+    stats() (skipped_steps, retries, timeouts), which is what lets
+    report() reconcile with fault.stats() per step."""
+    run = _run
+    if run is None:
+        return
+    with _lock:
+        if name in run.fault_counters:
+            run.fault_counters[name] += delta
+        else:
+            run.extra_counters[name] = \
+                run.extra_counters.get(name, 0) + delta
+
+
+# ---------------------------------------------------------------------------
+# memory
+# ---------------------------------------------------------------------------
+
+def sample_memory():
+    """Sample per-device memory now (also runs automatically every
+    MXNET_TELEMETRY_MEM_INTERVAL steps and at stop())."""
+    run = _run
+    if run is None:
+        return
+    _sample_memory(run)
+
+
+def _sample_memory(run):
+    try:
+        import jax
+        devices = jax.local_devices()
+    except Exception:
+        return
+    got_device_stats = False
+    for d in devices:
+        stats = None
+        try:
+            fn = getattr(d, "memory_stats", None)
+            stats = fn() if fn is not None else None
+        except Exception:
+            stats = None
+        if not stats:
+            continue
+        got_device_stats = True
+        in_use = int(stats.get("bytes_in_use", 0) or 0)
+        peak = int(stats.get("peak_bytes_in_use", in_use) or in_use)
+        _record_memory(run, str(d), in_use, peak)
+    if not got_device_stats and \
+            get_env("MXNET_TELEMETRY_LIVE_BUFFERS", 1, int):
+        # backends without memory_stats (CPU): total live device
+        # buffer bytes is the closest available watermark signal
+        try:
+            import jax
+            total = sum(int(getattr(a, "nbytes", 0) or 0)
+                        for a in jax.live_arrays())
+        except Exception:
+            return
+        _record_memory(run, "host_live_buffers", total, total)
+
+
+def _record_memory(run, device, in_use, peak):
+    rec = {"type": "memory", "device": device, "seq": run.steps,
+           "bytes_in_use": in_use, "peak_bytes_in_use": peak}
+    with _lock:
+        wm = run.mem_watermarks.get(device)
+        if wm is None:
+            wm = run.mem_watermarks[device] = {
+                "peak_bytes_in_use": 0, "last_bytes_in_use": 0,
+                "samples": 0}
+        wm["peak_bytes_in_use"] = max(wm["peak_bytes_in_use"], peak,
+                                      in_use)
+        wm["last_bytes_in_use"] = in_use
+        wm["samples"] += 1
+        run.records.append(rec)
+
+
+# ---------------------------------------------------------------------------
+# queries
+# ---------------------------------------------------------------------------
+
+def recent_rate(n=None):
+    """samples/sec over the last ``n`` ring-buffer steps that carry a
+    sample count (None when unavailable) — the Speedometer's clock."""
+    run = _run or _last_run
+    if run is None:
+        return None
+    with _lock:
+        steps = list(run.ring)
+    if n:
+        steps = steps[-int(n):]
+    pairs = [(s["samples"], s["dur_ms"]) for s in steps
+             if s.get("samples") and s.get("dur_ms")]
+    if not pairs:
+        return None
+    total_s = sum(d for _, d in pairs) / 1e3
+    if total_s <= 0:
+        return float("inf")
+    return sum(s for s, _ in pairs) / total_s
+
+
+def quick_stats():
+    """Per-callback subset of :func:`report` — steps, goodput,
+    samples/sec, step-time p50 — without the comms/memory copies or
+    the fault/profiler snapshots, cheap enough for a batch-end
+    callback. None when no run exists."""
+    run = _run or _last_run
+    if run is None:
+        return None
+    with _lock:
+        steps = run.steps
+        skipped = run.fault_counters["skipped_steps"]
+        samples = run.samples
+        total_s = run.total_step_s
+        durs = [r["dur_ms"] for r in run.ring]
+    return {
+        "steps": steps,
+        "goodput": ((steps - skipped) / steps) if steps else None,
+        "samples_per_sec": (samples / total_s)
+        if (samples and total_s > 0) else None,
+        "step_time_ms_p50": percentile(durs, 50) if durs else None,
+    }
+
+
+def percentile(values, q):
+    """Linear-interpolated percentile (numpy's default method) of an
+    iterable; None on empty input. q in [0, 100]."""
+    vals = sorted(values)
+    if not vals:
+        return None
+    if len(vals) == 1:
+        return float(vals[0])
+    pos = (len(vals) - 1) * (q / 100.0)
+    lo = int(pos)
+    hi = min(lo + 1, len(vals) - 1)
+    frac = pos - lo
+    return float(vals[lo] * (1.0 - frac) + vals[hi] * frac)
+
+
+def report():
+    """The run summary: step-time percentiles (over the ring buffer),
+    goodput, phase totals, memory watermarks, per-key comms, the
+    fused_step_* counter deltas, and the fault.stats() delta since the
+    run started — ``skipped_steps``/``retried`` here reconcile exactly
+    with it. Works on the active run, or the last stopped one."""
+    run = _run or _last_run
+    if run is None:
+        return None
+    with _lock:
+        ring = list(run.ring)
+        out = {
+            "run_id": run.run_id,
+            "steps": run.steps,
+            "samples": run.samples,
+            "skipped_steps": run.fault_counters["skipped_steps"],
+            "retried": run.fault_counters["retries"],
+            "timeouts": run.fault_counters["timeouts"],
+            "phases_ms": {k: round(v * 1e3, 3)
+                          for k, v in run.phase_totals.items()},
+            "memory": {d: dict(w)
+                       for d, w in run.mem_watermarks.items()},
+            "comms": {"%s:%s" % k: dict(c)
+                      for k, c in sorted(run.comms.items())},
+        }
+        if run.extra_counters:
+            out["events"] = dict(run.extra_counters)
+        if run.records_dropped:
+            out["records_dropped"] = run.records_dropped
+        total_s = run.total_step_s
+        fault_base = run.fault_base
+        counters_base = run.counters_base
+    out["productive_steps"] = out["steps"] - out["skipped_steps"]
+    out["goodput"] = (out["productive_steps"] / out["steps"]) \
+        if out["steps"] else None
+    out["samples_per_sec"] = (out["samples"] / total_s) \
+        if (out["samples"] and total_s > 0) else None
+    durs = [s["dur_ms"] for s in ring]
+    if durs:
+        out["step_time_ms"] = {
+            "count": len(durs),
+            "mean": sum(durs) / len(durs),
+            "p50": percentile(durs, 50),
+            "p90": percentile(durs, 90),
+            "p99": percentile(durs, 99),
+            "max": max(durs),
+        }
+    from . import fault, profiler
+    if fault_base is not None:
+        fs = fault.stats()
+        out["fault"] = {k: fs[k] - fault_base.get(k, 0)
+                        for k in ("skipped_steps", "retries", "timeouts")}
+    ctr = profiler.counters()
+    fused = {k: v - counters_base.get(k, 0) for k, v in ctr.items()
+             if k.startswith("fused_step")}
+    if fused:
+        out["counters"] = fused
+    return out
+
+
+# ---------------------------------------------------------------------------
+# sink
+# ---------------------------------------------------------------------------
+
+def flush():
+    """Write the run's pending records to the JSONL sink now (atomic
+    create on the first flush, whole-line appends after — see the
+    module docstring). Returns the filename, or None without a
+    sink/run."""
+    run = _run or _last_run
+    if run is None:
+        return None
+    return _flush_run(run)
+
+
+def _flush_run(run):
+    """Create the sink atomically on first flush; later flushes append
+    only the records accrued since (snapshot-and-clear is one locked
+    step, so a record is either in memory or on disk, never both) —
+    flush cost and resident memory stay O(new records), not O(run).
+    The whole flush runs under the run's flush lock so two concurrent
+    flushers (training thread + an explicit flush()/stop()) serialize
+    instead of the creator's os.replace clobbering the appender's
+    lines. Lock order: _flush_lock before _lock, never the reverse."""
+    with run._flush_lock:
+        with _lock:
+            fname = run.filename
+            if not fname:
+                return None
+            lines = [json.dumps(r) for r in run.records]
+            run.records = []
+            first = not run._sink_created
+            run._sink_created = True
+        try:
+            if first and not os.path.exists(fname):
+                # pid-unique tmp: two processes pointed at one path
+                # must not scribble over each other's staging file
+                tmp = "%s.%d.tmp" % (fname, os.getpid())
+                with open(tmp, "w") as sink:
+                    for line in lines:
+                        sink.write(line)
+                        sink.write("\n")
+                os.replace(tmp, fname)
+            elif lines:
+                # either a later flush of this run, or the sink holds
+                # an earlier run (two fits in one process reusing
+                # MXNET_TELEMETRY_FILE): append instead of destroying
+                # it — the diagnose reader renders the file's LAST run
+                with open(fname, "a") as sink:
+                    for line in lines:
+                        sink.write(line)
+                        sink.write("\n")
+        except OSError as exc:
+            # an observability layer enabled from the environment must
+            # never kill the job it observes: disable the sink for the
+            # rest of the run (ring + accumulators keep report()
+            # working)
+            with _lock:
+                run.filename = None
+            import warnings
+            warnings.warn(
+                "telemetry: cannot write sink %s (%s: %s); sink "
+                "disabled for the rest of this run"
+                % (fname, type(exc).__name__, exc))
+            return None
+    return fname

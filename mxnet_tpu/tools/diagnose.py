@@ -5,10 +5,18 @@ subprocess probe of the accelerator, the same probe bench.py and the
 TPU test lane use).
 
 Run: ``python -m mxnet_tpu.tools.diagnose``.
+
+Telemetry mode: ``python -m mxnet_tpu.tools.diagnose <run>.jsonl``
+reads a ``mxnet_tpu.telemetry`` JSONL sink back into human tables —
+step-time percentiles, per-phase breakdown, goodput (productive vs.
+skipped/retried, unified with ``fault.stats()``), memory watermarks,
+and per-key comms bytes/latency. This supersedes scraping the same
+facts out of log lines with ``tools/parse_log.py``.
 """
 from __future__ import annotations
 
 import argparse
+import json
 import os
 import platform
 import subprocess
@@ -87,13 +95,153 @@ def diagnose_backend(timeout):
               "%ds — accelerator attachment is broken" % timeout)
 
 
+# ---------------------------------------------------------------------------
+# telemetry JSONL mode
+# ---------------------------------------------------------------------------
+
+def read_telemetry(path):
+    """Parse a mxnet_tpu.telemetry JSONL sink. Unparseable lines are
+    skipped (a crash can strand at most one trailing partial line).
+    A sink holding several runs (consecutive fits appending to the
+    same MXNET_TELEMETRY_FILE) yields the LAST run."""
+    out = {"run": None, "steps": [], "memory": [], "summary": None}
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            kind = rec.get("type")
+            if kind == "run_start":
+                out = {"run": rec, "steps": [], "memory": [],
+                       "summary": None}
+            elif kind == "step":
+                out["steps"].append(rec)
+            elif kind == "memory":
+                out["memory"].append(rec)
+            elif kind == "summary":
+                out["summary"] = rec
+    return out
+
+
+def _fmt_bytes(n):
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if abs(n) < 1024.0 or unit == "GiB":
+            return "%.1f %s" % (n, unit)
+        n /= 1024.0
+
+
+def format_telemetry(tel):
+    """Render the parsed telemetry run as the human tables (step-time
+    percentiles over ALL step records in the file, phases, goodput,
+    memory watermarks, per-key comms)."""
+    from ..telemetry import percentile
+    run = tel.get("run") or {}
+    summary = tel.get("summary") or {}
+    steps = tel.get("steps") or []
+    lines = ["----------Telemetry Run----------",
+             "run_id       : %s" % (run.get("run_id") or
+                                    summary.get("run_id") or "?")]
+    if run.get("meta"):
+        lines.append("meta         : %s" % json.dumps(run["meta"]))
+
+    lines.append("----------Step time----------")
+    durs = [s["dur_ms"] for s in steps if s.get("dur_ms") is not None]
+    if durs:
+        lines.append("steps        : %d" % len(durs))
+        lines.append("mean(ms)     : %.3f" % (sum(durs) / len(durs)))
+        for q in (50, 90, 99):
+            lines.append("p%-2d(ms)      : %.3f" % (q,
+                                                    percentile(durs, q)))
+        lines.append("max(ms)      : %.3f" % max(durs))
+    else:
+        lines.append("no step records")
+
+    # the summary's totals are whole-run truth (they include phases
+    # that run BETWEEN steps — epoch-end checkpoint/eval); summing the
+    # step records is the fallback for a run that died before stop()
+    totals = dict(summary.get("phases_ms") or {})
+    if not totals:
+        for s in steps:
+            for phase, ms in (s.get("phases_ms") or {}).items():
+                totals[phase] = totals.get(phase, 0.0) + ms
+    if totals:
+        lines.append("----------Phases----------")
+        whole = sum(totals.values()) or 1.0
+        for phase in sorted(totals, key=totals.get, reverse=True):
+            lines.append("%-12s : %12.3f ms  (%5.1f%%)"
+                         % (phase, totals[phase],
+                            100.0 * totals[phase] / whole))
+
+    lines.append("----------Goodput----------")
+    skipped = sum(s.get("skipped", 0) for s in steps)
+    retried = sum(s.get("retries", 0) for s in steps)
+    samples = sum(s.get("samples", 0) for s in steps)
+    n = len(steps)
+    productive = n - skipped
+    lines.append("steps        : %d (productive %d, skipped %d, "
+                 "retried ops %d)" % (n, productive, skipped, retried))
+    if n:
+        lines.append("goodput      : %.1f%%" % (100.0 * productive / n))
+    if samples and durs:
+        lines.append("samples/sec  : %.2f"
+                     % (samples / (sum(durs) / 1e3)))
+    if summary.get("fault"):
+        lines.append("fault.stats  : %s" % json.dumps(summary["fault"]))
+
+    lines.append("----------Memory----------")
+    watermarks = {}
+    for m in tel.get("memory") or []:
+        dev = m.get("device", "?")
+        peak = max(int(m.get("peak_bytes_in_use", 0) or 0),
+                   int(m.get("bytes_in_use", 0) or 0))
+        watermarks[dev] = max(watermarks.get(dev, 0), peak)
+    if not watermarks and summary.get("memory"):
+        watermarks = {d: w.get("peak_bytes_in_use", 0)
+                      for d, w in summary["memory"].items()}
+    if watermarks:
+        for dev in sorted(watermarks):
+            lines.append("%-24s peak %s"
+                         % (dev, _fmt_bytes(watermarks[dev])))
+    else:
+        lines.append("no memory samples (backend without memory_stats)")
+
+    lines.append("----------Comms----------")
+    comms = summary.get("comms") or {}
+    if comms:
+        lines.append("%-24s %8s %12s %12s" % ("kind:key", "calls",
+                                              "bytes", "time(ms)"))
+        for key in sorted(comms):
+            c = comms[key]
+            lines.append("%-24s %8d %12d %12.3f"
+                         % (key, c.get("calls", 0), c.get("bytes", 0),
+                            c.get("time_ms", 0.0)))
+    else:
+        lines.append("no comms records (run had no kvstore/collectives "
+                     "or no summary record)")
+    return "\n".join(lines)
+
+
 def main(argv=None):
     p = argparse.ArgumentParser(
-        description="Diagnose the current system.")
+        description="Diagnose the current system, or render a "
+                    "telemetry JSONL run.")
+    p.add_argument("telemetry", nargs="?", default=None,
+                   help="path to a mxnet_tpu.telemetry JSONL sink; "
+                        "when given, render its tables and exit")
     for choice in ("python", "os", "hardware", "mxnet", "backend"):
         p.add_argument("--" + choice, default=1, type=int)
     p.add_argument("--timeout", default=30, type=int)
     args = p.parse_args(argv)
+    if args.telemetry:
+        if not os.path.isfile(args.telemetry):
+            p.error("telemetry sink %r not found (expected a "
+                    "mxnet_tpu.telemetry JSONL file)" % args.telemetry)
+        print(format_telemetry(read_telemetry(args.telemetry)))
+        return
     if args.python:
         diagnose_python()
     if args.os:

@@ -10,17 +10,23 @@ import argparse
 import re
 
 
+# value pattern: plain/negative decimals AND scientific notation —
+# `([.\d]+)` silently truncated `1e-07` to `1` and dropped the sign of
+# negative metrics (perplexity deltas)
+_NUM = r"(-?[\d.]+(?:[eE][+-]?\d+)?)"
+
+
 def parse(lines, metric_names=("accuracy",)):
     """Returns {epoch: {"train-<m>": v, "val-<m>": v, "time": v}}."""
     pats = []
     for m in metric_names:
         pats.append(("train-" + m, re.compile(
-            r".*Epoch\[(\d+)\] Train-" + re.escape(m) + r".*=([.\d]+)")))
+            r".*Epoch\[(\d+)\] Train-" + re.escape(m) + r".*=" + _NUM)))
         pats.append(("val-" + m, re.compile(
             r".*Epoch\[(\d+)\] Validation-" + re.escape(m)
-            + r".*=([.\d]+)")))
+            + r".*=" + _NUM)))
     pats.append(("time", re.compile(
-        r".*Epoch\[(\d+)\] Time.*=([.\d]+)")))
+        r".*Epoch\[(\d+)\] Time.*=" + _NUM)))
     table = {}
     for line in lines:
         for name, pat in pats:

@@ -1,0 +1,662 @@
+"""Unified telemetry layer (mxnet_tpu.telemetry): span nesting,
+percentile math, goodput reconciliation with injected MXNET_FAULT_PLAN
+faults, JSONL round-trip through tools.diagnose, and the
+always-cheap-when-off path — plus the profiler satellites (gated and
+bounded event emission, Avg column/sort, thread-safe Counter, atomic
+dump) and first-ever unit tests for Speedometer/ProgressBar/Monitor.
+"""
+import json
+import logging
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import fault, profiler, telemetry
+from mxnet_tpu.model import BatchEndParam
+
+
+@pytest.fixture(autouse=True)
+def _clean_state(monkeypatch):
+    for var in ("MXNET_TELEMETRY", "MXNET_TELEMETRY_FILE",
+                "MXNET_TELEMETRY_RING", "MXNET_TELEMETRY_MEM_INTERVAL",
+                "MXNET_FAULT_PLAN", "MXNET_NONFINITE_GUARD",
+                "MXNET_PROFILER_MAX_EVENTS"):
+        monkeypatch.delenv(var, raising=False)
+    monkeypatch.setenv("MXNET_KVSTORE_TIMEOUT", "0.5")
+    monkeypatch.setenv("MXNET_KVSTORE_RETRY_BACKOFF", "0.01")
+    telemetry.reset()
+    fault.reset()
+    yield
+    telemetry.reset()
+    fault.reset()
+
+
+def _mlp_sym():
+    data = mx.sym.var("data")
+    x = mx.sym.FullyConnected(data, num_hidden=8, name="fc1")
+    x = mx.sym.Activation(x, act_type="relu")
+    x = mx.sym.FullyConnected(x, num_hidden=3, name="fc2")
+    return mx.sym.SoftmaxOutput(x, mx.sym.var("softmax_label"),
+                                name="softmax")
+
+
+def _train_iter(n=24, batch=8):
+    rng = np.random.RandomState(7)
+    X = rng.uniform(size=(n, 6)).astype(np.float32)
+    Y = rng.randint(0, 3, (n,)).astype(np.float32)
+    return mx.io.NDArrayIter(X, Y, batch_size=batch)
+
+
+# ---------------------------------------------------------------------------
+# off-by-default: the overhead path
+# ---------------------------------------------------------------------------
+
+def test_off_by_default_every_hook_noops():
+    assert not telemetry.enabled()
+    assert telemetry.maybe_start() is False
+    # the span factory returns the SHARED no-op singleton — no
+    # allocation, no lock, no record
+    assert telemetry.span("compute") is telemetry._NULL
+    assert telemetry.comm_span("push", 0) is telemetry._NULL
+    assert telemetry.step_end() is None
+    telemetry.step_begin()
+    telemetry.note("skipped_steps")
+    telemetry.comm("push", 0, 128, 0.001)
+    telemetry.sample_memory()
+    assert telemetry.report() is None
+    assert telemetry.flush() is None
+
+
+def test_off_train_step_leaves_no_trace(tmp_path):
+    """A train loop with telemetry off must leave zero telemetry
+    records and zero profiler events (gated emission, satellite 1)."""
+    events_before = len(profiler._state["events"])
+    mod = mx.module.Module(_mlp_sym(), context=mx.cpu())
+    mod.fit(_train_iter(), num_epoch=1, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.05})
+    assert telemetry.report() is None
+    assert len(profiler._state["events"]) == events_before
+
+
+# ---------------------------------------------------------------------------
+# spans and steps
+# ---------------------------------------------------------------------------
+
+def test_span_nesting_outermost_owns_the_time():
+    """Phases are exclusive: nested spans (same OR different phase)
+    are owned by the outermost one, so phase totals can never sum past
+    the wall clock — an eval-loop data fetch is eval time, not a
+    second copy under data_wait."""
+    telemetry.start(run_id="nest")
+    telemetry.step_begin()
+    with telemetry.span("compute"):
+        time.sleep(0.02)
+        with telemetry.span("compute"):       # same-phase: no-op
+            time.sleep(0.02)
+        with telemetry.span("data_wait"):     # nested cross-phase:
+            time.sleep(0.01)                  # also owned by compute
+    with telemetry.span("optimizer"):         # sibling: counted
+        time.sleep(0.01)
+    rec = telemetry.step_end(samples=4)
+    phases = rec["phases_ms"]
+    # outer compute covers all three sleeps (~50ms); double counting
+    # would add another 30ms across phases
+    assert 40.0 <= phases["compute"] < 70.0, phases
+    assert "data_wait" not in phases
+    assert phases["optimizer"] >= 8.0
+    assert sum(phases.values()) <= rec["dur_ms"]
+    rep = telemetry.stop()
+    assert rep["steps"] == 1 and rep["samples"] == 4
+    # report rounds phase totals to 3 decimals
+    assert rep["phases_ms"]["compute"] == pytest.approx(
+        phases["compute"], abs=1e-3)
+
+
+def test_spans_off_the_accounting_thread_are_ignored():
+    """A prefetch worker's background decode must not be charged to the
+    consumer's step as a data stall, nor hold the same-phase guard
+    against the consumer's real wait."""
+    telemetry.start(run_id="threads")
+    telemetry.step_begin()
+    worker_done = threading.Event()
+
+    def worker():
+        with telemetry.span("data_wait"):
+            time.sleep(0.03)
+        worker_done.set()
+
+    t = threading.Thread(target=worker)
+    t.start()
+    time.sleep(0.005)          # overlap: worker holds the phase open...
+    with telemetry.span("data_wait"):     # ...but the consumer still
+        time.sleep(0.01)                  # records its own wait
+    worker_done.wait()
+    t.join()
+    rec = telemetry.step_end(samples=1)
+    phases = rec["phases_ms"]
+    # only the consumer's ~10ms wait counts — the worker's 30ms is out
+    assert 8.0 <= phases["data_wait"] < 25.0, phases
+    assert phases["data_wait"] <= rec["dur_ms"]
+    telemetry.stop()
+
+
+def test_prefetching_iter_worker_does_not_pollute_steps():
+    from mxnet_tpu.io import NDArrayIter, PrefetchingIter
+    X = np.zeros((32, 4), np.float32)
+    Y = np.zeros((32,), np.float32)
+    telemetry.start(run_id="prefetch")
+    it = PrefetchingIter(NDArrayIter(X, Y, batch_size=8))
+    for _ in it:
+        telemetry.step_begin()
+        telemetry.step_end(samples=8)
+    rep = telemetry.stop()
+    # phases never exceed the recorded step time
+    run = telemetry._last_run
+    for rec in run.records:
+        if rec["type"] == "step" and rec.get("phases_ms"):
+            assert sum(rec["phases_ms"].values()) <= rec["dur_ms"] * 1.5
+    assert rep["steps"] == 4
+
+
+def test_fit_setup_error_still_stops_owned_run(tmp_path, monkeypatch):
+    """A bind/optimizer setup error inside fit must not leak the
+    env-started run it owns."""
+    sink = str(tmp_path / "leak.jsonl")
+    monkeypatch.setenv("MXNET_TELEMETRY_FILE", sink)
+    telemetry.reset()
+    mod = mx.module.Module(_mlp_sym(), context=mx.cpu())
+    with pytest.raises(Exception):
+        mod.fit(_train_iter(), num_epoch=1,
+                optimizer="no_such_optimizer")
+    assert not telemetry.enabled()      # run stopped, not leaked
+    kinds = [json.loads(line)["type"] for line in open(sink)]
+    assert kinds[-1] == "summary"
+
+
+def test_step_tick_mode_first_tick_sets_baseline():
+    telemetry.start(run_id="tick")
+    assert telemetry.step_tick(samples=8) is None     # baseline only
+    time.sleep(0.01)
+    rec = telemetry.step_tick(samples=8)
+    assert rec is not None and rec["dur_ms"] >= 8.0
+    rep = telemetry.stop()
+    assert rep["steps"] == 1 and rep["samples"] == 8
+
+
+def test_ring_buffer_bounds_percentile_window(monkeypatch):
+    monkeypatch.setenv("MXNET_TELEMETRY_RING", "8")
+    telemetry.start(run_id="ring")
+    for _ in range(20):
+        telemetry.step_begin()
+        telemetry.step_end(samples=1)
+    rep = telemetry.stop()
+    assert rep["steps"] == 20
+    assert rep["step_time_ms"]["count"] == 8   # ring kept only the tail
+    # ...but the JSONL record stream keeps every step
+    run = telemetry._last_run
+    assert sum(1 for r in run.records if r["type"] == "step") == 20
+
+
+def test_percentile_math():
+    vals = list(range(1, 101))
+    assert telemetry.percentile(vals, 0) == 1.0
+    assert telemetry.percentile(vals, 100) == 100.0
+    assert telemetry.percentile(vals, 50) == pytest.approx(50.5)
+    assert telemetry.percentile(vals, 90) == pytest.approx(90.1)
+    assert telemetry.percentile(vals, 99) == pytest.approx(99.01)
+    assert telemetry.percentile([3.0], 99) == 3.0
+    assert telemetry.percentile([], 50) is None
+    # order-insensitive
+    assert telemetry.percentile([5, 1, 3], 50) == 3.0
+
+
+def test_comm_accounting_bytes_and_latency():
+    telemetry.start(run_id="comm")
+    arr = mx.nd.zeros((16, 4))
+    with telemetry.comm_span("push", "w0", arr):
+        time.sleep(0.005)
+    with telemetry.comm_span("push", "w0", [arr, arr]):
+        pass
+    rep = telemetry.stop()
+    c = rep["comms"]["push:w0"]
+    assert c["calls"] == 2
+    assert c["bytes"] == 3 * 16 * 4 * 4        # one + two fp32 arrays
+    assert c["time_ms"] >= 4.0
+
+
+# ---------------------------------------------------------------------------
+# the acceptance scenario: faulted Module.fit, reconciliation, diagnose
+# ---------------------------------------------------------------------------
+
+def test_faulted_fit_reconciles_and_diagnose_renders(tmp_path,
+                                                     monkeypatch):
+    """grad-NaN + push failure via MXNET_FAULT_PLAN: telemetry.report()
+    must reconcile exactly with fault.stats(), and tools.diagnose must
+    render percentiles, goodput, memory, and per-key comms from the
+    same JSONL run."""
+    monkeypatch.setenv("MXNET_FAULT_PLAN",
+                       "grad:step=2:nan,push:step=1:raise")
+    monkeypatch.setenv("MXNET_TELEMETRY_MEM_INTERVAL", "1")
+    fault.reset()
+    sink = str(tmp_path / "run.jsonl")
+    telemetry.start(filename=sink, meta={"case": "faulted_fit"})
+
+    mod = mx.module.Module(_mlp_sym(), context=mx.cpu())
+    # a real KVStore instance so pushes flow through the push site
+    # (comms accounting + the planned push fault's retry)
+    kv = mx.kvstore.create("local")
+    mod.fit(_train_iter(), num_epoch=2, kvstore=kv, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.05})
+
+    rep = telemetry.stop()
+    fs = fault.stats()
+    # exact reconciliation with fault.stats()
+    assert rep["skipped_steps"] == fs["skipped_steps"] == 1
+    assert rep["retried"] == fs["retries"] == 1
+    assert rep["fault"] == {"skipped_steps": 1, "retries": 1,
+                            "timeouts": 0}
+    assert rep["steps"] == 6                       # 2 epochs x 3 batches
+    assert rep["productive_steps"] == 5
+    assert rep["goodput"] == pytest.approx(5.0 / 6.0)
+    assert rep["samples"] == 6 * 8
+    # update_on_kvstore: the per-key push/pull (the reduce + hosted
+    # updater) is the "sync" phase, not "optimizer"
+    for phase in ("compute", "sync", "data_wait"):
+        assert rep["phases_ms"].get(phase, 0) > 0, rep["phases_ms"]
+    # per-key comms for every parameter, bytes > 0
+    push_keys = [k for k in rep["comms"] if k.startswith("push:")]
+    assert sorted(push_keys) == ["push:fc1_bias", "push:fc1_weight",
+                                 "push:fc2_bias", "push:fc2_weight"]
+    assert all(rep["comms"][k]["bytes"] > 0 for k in push_keys)
+    # the per-step records tag the faulted steps (read from the sink —
+    # flushed records leave memory)
+    steps = [json.loads(line) for line in open(sink)]
+    steps = [r for r in steps if r["type"] == "step"]
+    assert sum(s.get("skipped", 0) for s in steps) == 1
+    assert sum(s.get("retries", 0) for s in steps) == 1
+    # memory watermarks present (device memory_stats or the live-buffer
+    # fallback on CPU)
+    assert rep["memory"], rep
+
+    # --- JSONL round trip through tools.diagnose -----------------------
+    from mxnet_tpu.tools.diagnose import read_telemetry, format_telemetry
+    tel = read_telemetry(sink)
+    assert tel["run"]["meta"] == {"case": "faulted_fit"}
+    assert len(tel["steps"]) == 6
+    assert tel["summary"]["skipped_steps"] == 1
+    text = format_telemetry(tel)
+    assert "p50(ms)" in text and "p99(ms)" in text
+    assert "goodput      : 83.3%" in text
+    assert "push:fc1_weight" in text
+    assert "skipped 1" in text and "retried ops 1" in text
+    assert "peak" in text                          # memory table
+
+    # the CLI path renders the same thing
+    from mxnet_tpu.tools import diagnose as diag_mod
+    import io as _io
+    import contextlib
+    buf = _io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        diag_mod.main([sink])
+    assert "Telemetry Run" in buf.getvalue()
+
+
+def test_incremental_flush_appends_once(tmp_path):
+    """Mid-run flushes append only the new records; nothing is written
+    twice and flushed records leave host memory."""
+    sink = str(tmp_path / "inc.jsonl")
+    telemetry.start(filename=sink, run_id="inc")
+    for _ in range(3):
+        telemetry.step_begin()
+        telemetry.step_end(samples=1)
+    telemetry.flush()
+    assert telemetry._run.records == []        # flushed out of memory
+    for _ in range(2):
+        telemetry.step_begin()
+        telemetry.step_end(samples=1)
+    telemetry.stop()
+    recs = [json.loads(line) for line in open(sink)]
+    kinds = [r["type"] for r in recs]
+    assert kinds[0] == "run_start" and kinds[-1] == "summary"
+    assert kinds.count("step") == 5
+    assert [r["seq"] for r in recs if r["type"] == "step"] == \
+        [1, 2, 3, 4, 5]
+
+
+def test_memory_only_run_bounds_records(monkeypatch):
+    monkeypatch.setenv("MXNET_TELEMETRY_MAX_RECORDS", "6")
+    telemetry.start(run_id="cap")               # no sink
+    for _ in range(10):
+        telemetry.step_begin()
+        telemetry.step_end(samples=1)
+    rep = telemetry.stop()
+    assert rep["steps"] == 10                   # accumulators exact
+    assert rep["records_dropped"] > 0
+    run = telemetry._last_run
+    assert run.records[0]["type"] == "run_start"
+
+
+def test_start_registers_atexit_stop(tmp_path, monkeypatch):
+    """A loop that never calls stop() (bare gluon training) must still
+    get its final flush via the atexit handler — for env-configured
+    AND explicitly start()-ed runs."""
+    import atexit
+    sink = str(tmp_path / "atexit.jsonl")
+    telemetry._atexit_registered = False
+    registered = []
+    monkeypatch.setattr(atexit, "register",
+                        lambda fn: registered.append(fn))
+    telemetry.start(filename=sink)              # explicit start
+    assert registered == [telemetry.stop]
+    telemetry.step_begin()
+    telemetry.step_end(samples=2)
+    registered[0]()                             # what interp exit runs
+    assert not telemetry.enabled()
+    kinds = [json.loads(line)["type"] for line in open(sink)]
+    assert kinds[-1] == "summary" and "step" in kinds
+
+
+def test_unwritable_sink_degrades_instead_of_crashing(tmp_path):
+    """A bad MXNET_TELEMETRY_FILE path must never kill the training
+    job: the flush disables the sink with a warning and report() keeps
+    working from the in-memory accumulators."""
+    telemetry.start(filename=str(tmp_path / "no_such_dir" / "x.jsonl"))
+    telemetry.step_begin()
+    telemetry.step_end(samples=4)
+    with pytest.warns(UserWarning, match="sink disabled"):
+        assert telemetry.flush() is None
+    telemetry.step_begin()
+    telemetry.step_end(samples=4)
+    rep = telemetry.stop()                      # no raise from finally
+    assert rep["steps"] == 2 and rep["samples"] == 8
+
+
+def test_trainer_update_path_autostarts(tmp_path, monkeypatch):
+    """The manual gluon path (allreduce_grads + update, never step)
+    must auto-start env-configured telemetry like step() does."""
+    from mxnet_tpu.gluon import nn
+    sink = str(tmp_path / "upd.jsonl")
+    monkeypatch.setenv("MXNET_TELEMETRY_FILE", sink)
+    telemetry.reset()
+    net = nn.Dense(4, in_units=6)
+    net.initialize(mx.init.Xavier())
+    trainer = mx.gluon.Trainer(net.collect_params(), "sgd",
+                               {"learning_rate": 0.1})
+    x = mx.nd.array(np.random.uniform(size=(8, 6)).astype(np.float32))
+    for _ in range(3):
+        with mx.autograd.record():
+            loss = net(x).sum()
+        loss.backward()
+        trainer.allreduce_grads()
+        trainer.update(8)
+    assert telemetry.enabled()
+    rep = telemetry.stop()
+    assert rep["steps"] == 2                    # first tick = baseline
+
+
+def test_multi_worker_sink_gets_per_worker_file(tmp_path, monkeypatch):
+    """Launcher-spawned workers sharing one MXNET_TELEMETRY_FILE must
+    not clobber each other's sink."""
+    monkeypatch.setenv("DMLC_NUM_WORKER", "2")
+    monkeypatch.setenv("DMLC_WORKER_ID", "1")
+    sink = str(tmp_path / "run.jsonl")
+    telemetry.start(filename=sink)
+    assert telemetry._run.filename == str(tmp_path /
+                                          "run.worker1.jsonl")
+    telemetry.stop()
+    assert os.path.exists(str(tmp_path / "run.worker1.jsonl"))
+    assert not os.path.exists(sink)
+
+
+def test_diagnose_missing_sink_friendly_error(capsys):
+    from mxnet_tpu.tools import diagnose as diag_mod
+    with pytest.raises(SystemExit) as exc:
+        diag_mod.main(["/no/such/file.jsonl"])
+    assert exc.value.code == 2
+    assert "not found" in capsys.readouterr().err
+
+
+def test_env_autostart_fit_owns_run(tmp_path, monkeypatch):
+    sink = str(tmp_path / "auto.jsonl")
+    monkeypatch.setenv("MXNET_TELEMETRY_FILE", sink)
+    telemetry.reset()                       # re-read the env config
+    mod = mx.module.Module(_mlp_sym(), context=mx.cpu())
+    mod.fit(_train_iter(), num_epoch=1, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.05})
+    # fit started the run from the env and stopped it on exit
+    assert not telemetry.enabled()
+    rep = telemetry.report()                # readable after stop
+    assert rep is not None and rep["steps"] == 3
+    lines = [json.loads(line) for line in open(sink)]
+    kinds = [r["type"] for r in lines]
+    assert kinds[0] == "run_start" and kinds[-1] == "summary"
+    assert kinds.count("step") == 3
+    assert not os.path.exists(sink + ".tmp")   # replace-atomic flush
+
+    # a second fit reusing the sink APPENDS its run — the first run's
+    # records survive and diagnose renders the last run
+    telemetry.reset()
+    mod2 = mx.module.Module(_mlp_sym(), context=mx.cpu())
+    mod2.fit(_train_iter(), num_epoch=2, optimizer="sgd",
+             optimizer_params={"learning_rate": 0.05})
+    kinds = [json.loads(line)["type"] for line in open(sink)]
+    assert kinds.count("run_start") == 2
+    assert kinds.count("step") == 3 + 6
+    from mxnet_tpu.tools.diagnose import read_telemetry
+    tel = read_telemetry(sink)
+    assert len(tel["steps"]) == 6           # the LAST run only
+    assert tel["summary"]["steps"] == 6
+
+
+def test_eval_fetches_not_double_counted():
+    """fit's score() loop fetches eval batches under the open ``eval``
+    span; the io iterators' nested data_wait spans must not copy the
+    same seconds into a second phase."""
+    telemetry.start(run_id="eval")
+    with telemetry.span("eval"):
+        for _ in _train_iter():       # DataIter.next spans data_wait
+            pass
+    rep = telemetry.stop()
+    assert rep["phases_ms"].get("eval", 0) > 0
+    assert "data_wait" not in rep["phases_ms"]
+
+
+def test_gluon_trainer_tick_and_phases():
+    from mxnet_tpu.gluon import nn
+    telemetry.start(run_id="gluon")
+    net = nn.Dense(4, in_units=6)
+    net.initialize(mx.init.Xavier())
+    trainer = mx.gluon.Trainer(net.collect_params(), "sgd",
+                               {"learning_rate": 0.1})
+    x = mx.nd.array(np.random.uniform(size=(8, 6)).astype(np.float32))
+    for _ in range(3):
+        with mx.autograd.record():
+            loss = net(x).sum()
+        loss.backward()
+        trainer.step(8)
+    rep = telemetry.stop()
+    # first step() set the tick baseline; the next two are records
+    assert rep["steps"] == 2
+    assert rep["samples"] == 16
+    assert rep["phases_ms"].get("optimizer", 0) > 0
+
+
+# ---------------------------------------------------------------------------
+# Speedometer / ProgressBar / Monitor
+# ---------------------------------------------------------------------------
+
+def _param(epoch, nbatch, metric=None):
+    return BatchEndParam(epoch=epoch, nbatch=nbatch, eval_metric=metric,
+                         locals=None)
+
+
+def test_speedometer_feeds_from_telemetry(caplog):
+    from mxnet_tpu.callback import Speedometer
+    telemetry.start(run_id="speed")
+    # two fabricated 10ms steps of 32 samples -> ~3200 samples/sec
+    for _ in range(2):
+        telemetry.step_begin()
+        time.sleep(0.01)
+        telemetry.step_end(samples=32)
+    spd = Speedometer(batch_size=32, frequent=2, auto_reset=False)
+    with caplog.at_level(logging.INFO):
+        spd(_param(0, 1))                   # arms self.init
+        spd(_param(0, 2))                   # logs at the frequent mark
+    msgs = [r.getMessage() for r in caplog.records
+            if "samples/sec" in r.getMessage()]
+    assert msgs, caplog.text
+    speed = float(msgs[-1].split("Speed:")[1].split("samples")[0])
+    expected = telemetry.recent_rate(2)
+    assert speed == pytest.approx(expected, rel=0.01)
+    assert speed < 32000                    # a wall-clock glitch would
+    telemetry.stop()                        # read orders of magnitude off
+
+
+def test_speedometer_fallback_clock_without_telemetry(caplog):
+    from mxnet_tpu.callback import Speedometer
+    assert not telemetry.enabled()
+    spd = Speedometer(batch_size=16, frequent=1, auto_reset=False)
+    with caplog.at_level(logging.INFO):
+        spd(_param(0, 1))
+        time.sleep(0.01)
+        spd(_param(0, 2))
+    assert any("samples/sec" in r.getMessage() for r in caplog.records)
+
+
+def test_speedometer_epoch_reset_rearms():
+    from mxnet_tpu.callback import Speedometer
+    spd = Speedometer(batch_size=4, frequent=10)
+    spd(_param(0, 50))
+    assert spd.init
+    spd(_param(1, 0))                       # nbatch went backwards
+    assert spd.last_count == 0
+
+
+def test_progressbar_renders(caplog):
+    from mxnet_tpu.callback import ProgressBar
+    bar = ProgressBar(total=10, length=20)
+    with caplog.at_level(logging.INFO):
+        bar(_param(0, 5))
+    msg = [r.getMessage() for r in caplog.records][-1]
+    assert "=" * 10 in msg and "-" * 10 in msg and "50" in msg
+
+
+def test_monitor_toc_collects_param_stats():
+    from mxnet_tpu.monitor import Monitor
+    mod = mx.module.Module(_mlp_sym(), context=mx.cpu())
+    mod.bind(data_shapes=[("data", (8, 6))],
+             label_shapes=[("softmax_label", (8,))])
+    mod.init_params(initializer=mx.init.Xavier())
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.05})
+    mon = Monitor(interval=1, pattern="fc.*")
+    mod.install_monitor(mon)
+    batch = mx.io.DataBatch(
+        data=[mx.nd.array(np.random.uniform(size=(8, 6))
+                          .astype(np.float32))],
+        label=[mx.nd.array(np.zeros((8,), np.float32))])
+    mon.tic()
+    mod.forward_backward(batch)
+    mod.update()
+    res = mon.toc()
+    names = {name for _, name, _ in res}
+    assert {"fc1_weight", "fc1_bias", "fc2_weight",
+            "fc2_bias"} <= names
+    for _, _, value in res:
+        assert isinstance(value, str) and value.strip()
+    assert mon.toc() == []                  # queue drained, deactivated
+
+
+# ---------------------------------------------------------------------------
+# profiler satellites
+# ---------------------------------------------------------------------------
+
+def _drain_profiler():
+    with profiler._lock:
+        profiler._state["events"] = []
+
+
+def test_profiler_emission_gated_on_running():
+    _drain_profiler()
+    assert not profiler._state["running"]
+    profiler.Marker("m").mark()
+    profiler.Counter("c").set_value(3)
+    with profiler.Task("t"):
+        pass
+    assert profiler._state["events"] == []   # stopped: nothing emitted
+    profiler.set_state("run")
+    try:
+        profiler.Marker("m").mark()
+        with profiler.Task("t"):
+            pass
+        assert len(profiler._state["events"]) == 2
+    finally:
+        profiler.set_state("stop")
+        _drain_profiler()
+
+
+def test_profiler_event_buffer_bounded(monkeypatch):
+    _drain_profiler()
+    monkeypatch.setenv("MXNET_PROFILER_MAX_EVENTS", "5")
+    profiler.reset_counters()
+    profiler.set_state("run")
+    try:
+        marker = profiler.Marker("spam")
+        for _ in range(12):
+            marker.mark()
+        assert len(profiler._state["events"]) == 5
+        assert profiler.counters()["profiler_events_dropped"] == 7
+    finally:
+        profiler.set_state("stop")
+        _drain_profiler()
+        profiler.reset_counters()
+
+
+def test_dumps_avg_column_and_sort():
+    with profiler._lock:
+        profiler._state["aggregate"] = {}
+    profiler._aggregate("many_small", 10.0)
+    profiler._aggregate("many_small", 20.0)     # avg 15
+    profiler._aggregate("one_big", 100.0)       # avg 100
+    table = profiler.dumps(sort_by="avg")
+    lines = table.splitlines()
+    assert "Avg(us)" in lines[0]
+    assert lines[1].startswith("one_big")       # highest avg first
+    assert "15.0" in lines[2]                   # the Avg cell
+    # unknown sort keys raise instead of silently sorting as 0
+    with pytest.raises(ValueError):
+        profiler.dumps(sort_by="bogus")
+    profiler.dumps(reset=True)
+
+
+def test_profiler_counter_thread_safe():
+    counter = profiler.Counter("race")
+    threads = [threading.Thread(
+        target=lambda: [counter.increment() for _ in range(500)])
+        for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert counter._v == 8 * 500
+
+
+def test_profiler_dump_atomic(tmp_path, monkeypatch):
+    _drain_profiler()
+    fname = str(tmp_path / "trace.json")
+    profiler.set_config(filename=fname)
+    profiler.set_state("run")
+    try:
+        profiler.Marker("m").mark()
+    finally:
+        profiler.set_state("stop")
+    out = profiler.dump()
+    assert out == fname
+    assert not os.path.exists(fname + ".tmp")
+    trace = json.load(open(fname))
+    assert len(trace["traceEvents"]) == 1
+    _drain_profiler()

@@ -47,3 +47,22 @@ def test_parse_log():
     assert t[1]["train-accuracy"] == 0.85
     txt = format_table(t)
     assert "epoch" in txt and "0.85" in txt and "-" in txt
+
+
+def test_parse_log_scientific_and_negative_values():
+    """The old ([.\\d]+) value pattern silently truncated `1e-07` to 1.0
+    and dropped the sign of negative metrics."""
+    from mxnet_tpu.tools.parse_log import parse
+    lines = [
+        "INFO Epoch[0] Train-cross-entropy=1e-07",
+        "INFO Epoch[0] Validation-cross-entropy=2.5e-03",
+        "INFO Epoch[1] Train-cross-entropy=-0.125",
+        "INFO Epoch[1] Validation-cross-entropy=1.5E+02",
+        "INFO Epoch[1] Time cost=3.25",
+    ]
+    t = parse(lines, metric_names=("cross-entropy",))
+    assert t[0]["train-cross-entropy"] == 1e-07
+    assert t[0]["val-cross-entropy"] == 2.5e-03
+    assert t[1]["train-cross-entropy"] == -0.125
+    assert t[1]["val-cross-entropy"] == 150.0
+    assert t[1]["time"] == 3.25
