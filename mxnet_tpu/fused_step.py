@@ -46,7 +46,11 @@ Donation caveat: after a fused step the OLD parameter buffers are
 donated to XLA. NDArray handles tracked by the executor/trainer are
 re-pointed at the new buffers, but any alias made of the raw buffer
 beforehand (``detach()``, a stashed ``._data``) is stale and raises on
-use. Copies (``.copy()``, ``asnumpy()``) are unaffected.
+use. Copies (``.copy()``, ``asnumpy()``) are unaffected. Batch inputs
+are NOT donated — they ride in the non-donated ``others`` block — so
+the async input pipeline's device-prefetched batches
+(``io/pipeline.py``), each a fresh ``device_put`` result, hand off
+into the traced inputs safely.
 """
 from __future__ import annotations
 

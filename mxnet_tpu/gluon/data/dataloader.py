@@ -30,15 +30,40 @@ def default_batchify_fn(data):
     return nd.array(data, dtype=data.dtype)
 
 
+class _GeneratorSource:
+    """Adapts a plain python generator to the DataIter surface the
+    async pipeline drives (``next``/``reset``); the loader's decode
+    pool already sits behind the generator, so the pipeline only adds
+    the device-prefetch stage."""
+
+    batch_size = 0
+
+    def __init__(self, gen):
+        self._gen = gen
+
+    def next(self):
+        return next(self._gen)
+
+    def reset(self):
+        pass
+
+
 class DataLoader:
     """Mini-batch loader over a Dataset (reference: dataloader.py:441)."""
 
     def __init__(self, dataset, batch_size=None, shuffle=False,
                  sampler=None, last_batch=None, batch_sampler=None,
                  batchify_fn=None, num_workers=0, pin_memory=False,
-                 pin_device_id=0, prefetch=None, thread_pool=True):
+                 pin_device_id=0, prefetch=None, thread_pool=True,
+                 device_prefetch=None):
         self._dataset = dataset
         self._pin_memory = pin_memory
+        # device_prefetch: True → the current context's device; or an
+        # explicit jax.Device / Sharding / (name, array)->target
+        # callable. Batches are committed there by a background placer
+        # thread (io/pipeline.py) so the gluon train loop receives
+        # device-resident arrays — H2D overlaps the previous step.
+        self._device_prefetch = device_prefetch
 
         if batch_sampler is None:
             if batch_size is None:
@@ -71,7 +96,7 @@ class DataLoader:
     def _make_batch(self, batch_indices):
         return self._batchify_fn([self._dataset[i] for i in batch_indices])
 
-    def __iter__(self):
+    def _iter_batches(self):
         if self._num_workers == 0:
             for batch in self._batch_sampler:
                 yield self._make_batch(batch)
@@ -91,6 +116,35 @@ class DataLoader:
                 except StopIteration:
                     pass
                 yield fut.result()
+
+    def _resolve_placement(self):
+        target = self._device_prefetch
+        if target is True:
+            from ...context import current_context
+            return current_context().jax_device()
+        return target
+
+    def __iter__(self):
+        gen = self._iter_batches()
+        placement = self._resolve_placement()
+        if not placement:
+            yield from gen
+            return
+        from ...io.pipeline import AsyncInputPipeline
+        # floor of 1: the ready queue cannot be unbounded-empty, but an
+        # explicit prefetch=0 request is not silently promoted past it
+        depth = max(1, self._prefetch)
+        pipe = AsyncInputPipeline(_GeneratorSource(gen), num_workers=1,
+                                  prefetch_depth=depth,
+                                  placement=placement)
+        try:
+            while True:
+                try:
+                    yield pipe.next()
+                except StopIteration:
+                    return
+        finally:
+            pipe.close()
 
     def __len__(self):
         return len(self._batch_sampler)

@@ -2,3 +2,6 @@
 from .io import (DataDesc, DataBatch, DataIter, ResizeIter, PrefetchingIter,
                  NDArrayIter, MNISTIter, CSVIter, LibSVMIter)
 from .image_record import ImageRecordIter, ImageDetRecordIter
+from .pipeline import (AsyncInputPipeline, data_workers, pipeline_enabled,
+                       placement_for_module, make_sharded_pipeline,
+                       place_batch)

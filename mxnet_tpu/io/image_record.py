@@ -164,19 +164,21 @@ class ImageRecordIter(DataIter):
             label = np.zeros((self._label_width,), np.float32)
         return chw, label[:self._label_width]
 
-    def _load_batch(self, keys):
-        payloads = []
-        for k in keys:
-            raw = self._read_raw(k)
-            if raw is None:
-                return None
-            payloads.append(raw)
-        return self._assemble(payloads)
+    def _draw(self, n):
+        """The batch's augmentation randomness — drawn SERIALLY (from
+        ``next_raw`` on the pipeline's scheduler thread, or inline on
+        the eager path) so pooled decode is bit-identical to eager for
+        the same seed, in the same batch order."""
+        mirrors = self._rng.rand(n) < 0.5 \
+            if self._rand_mirror else [False] * n
+        crops = self._rng.rand(n, 2)
+        return mirrors, crops
 
     def _assemble(self, payloads):
-        mirrors = self._rng.rand(len(payloads)) < 0.5 \
-            if self._rand_mirror else [False] * len(payloads)
-        crops = self._rng.rand(len(payloads), 2)
+        mirrors, crops = self._draw(len(payloads))
+        return self._assemble_drawn(payloads, mirrors, crops)
+
+    def _assemble_drawn(self, payloads, mirrors, crops):
         futures = [self._pool.submit(self._prepare, p, m, cp)
                    for p, m, cp in zip(payloads, mirrors, crops)]
         images, labels = zip(*[f.result() for f in futures])
@@ -187,14 +189,9 @@ class ImageRecordIter(DataIter):
             lab = lab[:, 0]
         return DataBatch([data], [nd_array(lab)], pad=0)
 
-    # -- DataIter protocol ------------------------------------------------
-    def reset(self):
-        self._order = self._epoch_keys()
-        self._cursor = 0
-        if self._keys is None:
-            self._rec.reset()
-
-    def next(self):
+    def _next_payloads(self):
+        """Serialized record IO for one batch: raw (still-encoded)
+        payloads + the pad count, or StopIteration at epoch end."""
         bs = self.batch_size
         if self._order is not None:
             if self._cursor >= len(self._order):
@@ -208,11 +205,13 @@ class ImageRecordIter(DataIter):
                 # the pad count so score()/metrics can mask
                 keys = keys + [self._order[i % len(self._order)]
                                for i in range(pad)]
-            batch = self._load_batch(keys)
-            if batch is None:
-                raise StopIteration
-            batch.pad = pad
-            return batch
+            payloads = []
+            for k in keys:
+                raw = self._read_raw(k)
+                if raw is None:
+                    raise StopIteration
+                payloads.append(raw)
+            return payloads, pad
         # sequential scan: read up to bs records, pad from this batch
         payloads = []
         for _ in range(bs):
@@ -226,9 +225,32 @@ class ImageRecordIter(DataIter):
         if pad:
             reps = [payloads[i % len(payloads)] for i in range(pad)]
             payloads = payloads + reps
-        batch = self._assemble(payloads)
+        return payloads, pad
+
+    # -- DataIter protocol ------------------------------------------------
+    def reset(self):
+        self._order = self._epoch_keys()
+        self._cursor = 0
+        if self._keys is None:
+            self._rec.reset()
+
+    # split protocol (io/pipeline.py): record IO + rng draws serialize
+    # in next_raw; the expensive JPEG decode/augment parallelizes in
+    # decode_raw across the pipeline's workers (each of which may also
+    # fan single images out to this iterator's own thread pool)
+    def next_raw(self):
+        payloads, pad = self._next_payloads()
+        mirrors, crops = self._draw(len(payloads))
+        return payloads, mirrors, crops, pad
+
+    def decode_raw(self, raw):
+        payloads, mirrors, crops, pad = raw
+        batch = self._assemble_drawn(payloads, mirrors, crops)
         batch.pad = pad
         return batch
+
+    def next(self):
+        return self.decode_raw(self.next_raw())
 
     def close(self):
         """Shut the decode pool and the record reader down."""

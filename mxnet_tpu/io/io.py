@@ -11,9 +11,7 @@ from __future__ import annotations
 
 import gzip
 import os
-import queue
 import struct
-import threading
 from collections import namedtuple, OrderedDict
 
 import numpy as np
@@ -82,7 +80,16 @@ class DataBatch:
 
 
 class DataIter:
-    """Base iterator (reference: io/io.py:211)."""
+    """Base iterator (reference: io/io.py:211).
+
+    Iterators that want multi-worker decode under the async input
+    pipeline (``io/pipeline.py``) additionally implement the *split
+    protocol*: ``next_raw()`` — the cheap, serialized part (record IO,
+    cursor math, randomness draws) returning an opaque work item — and
+    ``decode_raw(raw)`` — the expensive, thread-safe part returning the
+    finished :class:`DataBatch`. ``next()`` must stay equivalent to
+    ``decode_raw(next_raw())`` so the pooled path is bit-identical to
+    the eager one."""
 
     def __init__(self, batch_size=0):
         self.batch_size = batch_size
@@ -171,12 +178,50 @@ class ResizeIter(DataIter):
         return self.current_batch.pad
 
 
+class _CombinedSource(DataIter):
+    """The multi-iterator merge the old prefetch worker performed
+    inline: one ``next()`` pulls a batch from EVERY child and
+    concatenates data/label rosters (first exhausted child ends the
+    epoch, as before)."""
+
+    def __init__(self, iters):
+        super().__init__(getattr(iters[0], "batch_size", 0) or 0)
+        self.iters = iters
+
+    def next(self):
+        batches = [i.next() for i in self.iters]
+        return DataBatch(
+            data=sum([b.data for b in batches], []),
+            label=sum([(b.label or []) for b in batches], []),
+            pad=max(b.pad or 0 for b in batches))
+
+    def reset(self):
+        for i in self.iters:
+            i.reset()
+
+    @property
+    def provide_data(self):
+        return sum([i.provide_data for i in self.iters], [])
+
+    @property
+    def provide_label(self):
+        return sum([i.provide_label for i in self.iters], [])
+
+
 class PrefetchingIter(DataIter):
-    """Background-thread prefetcher (the dmlc::ThreadedIter /
-    PrefetcherIter role, reference: io/io.py:355 + iter_prefetcher.h)."""
+    """Background prefetcher (the dmlc::ThreadedIter / PrefetcherIter
+    role, reference: io/io.py:355 + iter_prefetcher.h) — now a thin
+    wrapper over the staged :class:`~mxnet_tpu.io.pipeline.
+    AsyncInputPipeline`: a multi-worker decode pool
+    (``MXNET_DATA_WORKERS``; order-preserving) replaces the old single
+    worker loop, an optional ``placement`` (device / Sharding /
+    per-array callable) moves batches onto the consumer's device ahead
+    of time, ``reset()`` honors the configured ``prefetch_depth``, and
+    shutdown is drain-then-join with stop-aware puts — no leaked or
+    wedged threads."""
 
     def __init__(self, iters, rename_data=None, rename_label=None,
-                 prefetch_depth=2):
+                 prefetch_depth=2, num_workers=None, placement=None):
         super().__init__()
         if not isinstance(iters, list):
             iters = [iters]
@@ -186,10 +231,12 @@ class PrefetchingIter(DataIter):
         self.rename_data = rename_data
         self.rename_label = rename_label
         self.batch_size = self.provide_data[0][1][0]
-        self._queue = queue.Queue(maxsize=prefetch_depth)
-        self._stop = threading.Event()
-        self._thread = None
-        self._start()
+        self.prefetch_depth = max(1, int(prefetch_depth))
+        from .pipeline import AsyncInputPipeline
+        source = iters[0] if self.n_iter == 1 else _CombinedSource(iters)
+        self._pipeline = AsyncInputPipeline(
+            source, num_workers=num_workers,
+            prefetch_depth=self.prefetch_depth, placement=placement)
 
     @property
     def provide_data(self):
@@ -209,57 +256,65 @@ class PrefetchingIter(DataIter):
                      for x in i.provide_label]
                     for r, i in zip(self.rename_label, self.iters)], [])
 
-    def _worker(self):
-        while not self._stop.is_set():
-            try:
-                batches = [i.next() for i in self.iters]
-            except StopIteration:
-                self._queue.put(None)
-                return
-            self._queue.put(batches)
-
-    def _start(self):
-        self._thread = threading.Thread(target=self._worker, daemon=True)
-        self._thread.start()
+    def set_placement(self, placement):
+        """Adopt a device/sharding target for batches placed from now
+        on (fit calls this when it knows the bound executor's
+        placement); in-flight host batches still work — the executor
+        transfers them itself like before."""
+        self._pipeline.set_placement(placement)
 
     def reset(self):
-        self._stop.set()
-        try:
-            while True:
-                self._queue.get_nowait()
-        except queue.Empty:
-            pass
-        if self._thread is not None:
-            self._thread.join(timeout=5)
-        for i in self.iters:
-            i.reset()
-        self._stop = threading.Event()
-        self._queue = queue.Queue(maxsize=2)
-        self._start()
+        # delegates: stop + drain + join, reset children, restart with
+        # the CONFIGURED depth (the old code rebuilt maxsize=2 here)
+        self._pipeline.reset()
+
+    def close(self):
+        pipeline = getattr(self, "_pipeline", None)
+        if pipeline is not None:
+            pipeline.close()
 
     def __del__(self):
-        self._stop.set()
+        try:
+            self.close()
+        except Exception:       # interpreter teardown
+            pass
 
     def next(self):
-        # the queue get IS the consumer-visible data wait: the worker
-        # thread's decode time only matters when the queue runs dry
-        with _data_wait_span():
-            batches = self._queue.get()
-        if batches is None:
-            raise StopIteration
-        if self.n_iter == 1:
-            return batches[0]
-        return DataBatch(
-            data=sum([b.data for b in batches], []),
-            label=sum([(b.label or []) for b in batches], []),
-            pad=max(b.pad or 0 for b in batches))
+        # the pipeline's queue get is the consumer-visible data wait —
+        # and it opens a data_wait span only when the queue runs dry
+        return self._pipeline.next()
 
+    # the iter_next/getdata protocol delegates to the pipeline's
+    # cached-batch implementation
     def iter_next(self):
+        return self._pipeline.iter_next()
+
+    def getdata(self):
+        return self._pipeline.getdata()
+
+    def getlabel(self):
+        return self._pipeline.getlabel()
+
+    def getpad(self):
+        return self._pipeline.getpad()
+
+    def getindex(self):
+        return self._pipeline.getindex()
+
+
+def _as_host_view(v):
+    """A host numpy view of one source array WITHOUT copying when the
+    buffer already lives in host memory: plain numpy passes through
+    ``np.asarray`` (no copy), and an NDArray on a host backend is
+    exported zero-copy through DLPack (read-only — the iterator only
+    ever gathers from it). Device-resident NDArrays (or anything DLPack
+    refuses) fall back to the old ``asnumpy()`` copy."""
+    if isinstance(v, NDArray):
         try:
-            self._cached = self.next()
-            return True
-        except StopIteration:
-            return False
+            return np.from_dlpack(v._data)
+        except Exception:
+            return v.asnumpy()
+    return np.asarray(v)
 
 
 def _init_data(data, allow_empty, default_name):
@@ -286,8 +341,7 @@ def _init_data(data, allow_empty, default_name):
             raise TypeError("Invalid type '%s' for %s, should be NDArray or "
                             "numpy.ndarray" % (type(v), k))
     return list(OrderedDict(
-        [(k, v.asnumpy() if isinstance(v, NDArray) else np.asarray(v))
-         for k, v in data.items()]).items())
+        [(k, _as_host_view(v)) for k, v in data.items()]).items())
 
 
 class NDArrayIter(DataIter):
@@ -335,15 +389,30 @@ class NDArrayIter(DataIter):
         return self.cursor < self.num_data
 
     def next(self):
+        with _data_wait_span():
+            return self.decode_raw(self.next_raw())
+
+    # -- split protocol (async pipeline, io/pipeline.py) -----------------
+    def next_raw(self):
+        """Serialized half: advance the cursor (cheap index math) and
+        hand the gather position to a decode worker."""
         if not self.iter_next():
             raise StopIteration
-        with _data_wait_span():
-            return DataBatch(data=self.getdata(), label=self.getlabel(),
-                             pad=self.getpad(), index=None)
+        return (self.cursor, self._pad_at(self.cursor))
 
-    def _getdata(self, data_source):
-        end = min(self.cursor + self.batch_size, self.num_data)
-        s = slice(self.cursor, end)
+    def decode_raw(self, raw):
+        """Parallel half: gather + stack the batch at an explicit
+        cursor — pure reads of the shared source arrays and the
+        epoch-stable shuffle order, safe across decode workers."""
+        cursor, pad = raw
+        return DataBatch(data=self._getdata(self.data, cursor),
+                         label=self._getdata(self.label, cursor),
+                         pad=pad, index=None)
+
+    def _getdata(self, data_source, cursor=None):
+        cursor = self.cursor if cursor is None else cursor
+        end = min(cursor + self.batch_size, self.num_data)
+        s = slice(cursor, end)
         out = []
         for _, src in data_source:
             chunk = src[self.idx[s]]
@@ -361,11 +430,14 @@ class NDArrayIter(DataIter):
     def getlabel(self):
         return self._getdata(self.label)
 
-    def getpad(self):
+    def _pad_at(self, cursor):
         if self.last_batch_handle == 'pad' and \
-                self.cursor + self.batch_size > self.num_data:
-            return self.cursor + self.batch_size - self.num_data
+                cursor + self.batch_size > self.num_data:
+            return cursor + self.batch_size - self.num_data
         return 0
+
+    def getpad(self):
+        return self._pad_at(self.cursor)
 
 
 def _read_idx_file(path):

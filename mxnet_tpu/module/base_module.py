@@ -216,6 +216,14 @@ class BaseModule:
         record with a data_wait/compute/optimizer phase timeline,
         epoch-end checkpoint/eval phases are timed, and the run's
         goodput reconciles with ``fault.stats()``.
+
+        Input pipeline (see README "Input pipeline"): unless
+        ``MXNET_DATA_PIPELINE=0``, ``train_data`` is consumed through
+        the staged async pipeline (``io/pipeline.py``) — a
+        ``MXNET_DATA_WORKERS``-wide decode pool plus device prefetch
+        against this module's bound device/mesh sharding — so decode
+        and the H2D transfer overlap each step's compute and
+        ``data_wait`` measures only true queue-dry stalls.
         """
         from .. import fault, telemetry
         assert num_epoch is not None, 'please specify number of epochs'
@@ -230,6 +238,7 @@ class BaseModule:
         # the finally must cover everything after maybe_start: a setup
         # error (bad optimizer name, bind shape mismatch) would
         # otherwise leak the run this fit owns
+        owned_pipeline = None
         try:
             if resume_from_checkpoint:
                 resumed = self._resume_point(resume_from_checkpoint,
@@ -254,12 +263,13 @@ class BaseModule:
                 validation_metric = eval_metric
             if not isinstance(eval_metric, _metric.EvalMetric):
                 eval_metric = _metric.create(eval_metric)
+            fit_data, owned_pipeline = self._wrap_train_data(train_data)
 
             for epoch in range(begin_epoch, num_epoch):
                 tic = time.time()
                 eval_metric.reset()
                 nbatch = 0
-                data_iter = iter(train_data)
+                data_iter = iter(fit_data)
                 end_of_batch = False
                 with telemetry.span("data_wait"):
                     next_data_batch = next(data_iter)
@@ -340,7 +350,7 @@ class BaseModule:
                     for name, val in res:
                         self.logger.info('Epoch[%d] Validation-%s=%f',
                                          epoch, name, val)
-                train_data.reset()
+                fit_data.reset()
 
             if fault.is_enabled():
                 skipped = fault.stats()['skipped_steps'] - skipped_at_entry
@@ -350,8 +360,35 @@ class BaseModule:
                         'non-finite gradient guard (fault.stats())',
                         skipped)
         finally:
+            if owned_pipeline is not None:
+                owned_pipeline.close()
             if owns_telemetry:
                 telemetry.stop()
+
+    def _wrap_train_data(self, train_data):
+        """Consume fit's train_data through the staged async input
+        pipeline (io/pipeline.py): multi-worker decode + batches
+        device-placed against this module's bound executor before the
+        consuming step begins. Returns ``(iterator, owned_pipeline)``
+        — the pipeline is closed in fit's ``finally`` when this wrap
+        created it. Already-async iterators just adopt the module's
+        placement; non-DataIter sources and ``MXNET_DATA_PIPELINE=0``
+        pass through untouched."""
+        from ..io.io import DataIter, PrefetchingIter
+        from ..io.pipeline import (AsyncInputPipeline, pipeline_enabled,
+                                   placement_for_module)
+        if not pipeline_enabled():
+            return train_data, None
+        if isinstance(train_data, (AsyncInputPipeline, PrefetchingIter)):
+            placement = placement_for_module(self)
+            if placement is not None:
+                train_data.set_placement(placement)
+            return train_data, None
+        if not isinstance(train_data, DataIter):
+            return train_data, None
+        pipeline = AsyncInputPipeline(
+            train_data, placement=placement_for_module(self))
+        return pipeline, pipeline
 
     # -- symbol / params -------------------------------------------------
     @property

@@ -12,7 +12,32 @@ from __future__ import annotations
 
 from typing import Any, Callable, Dict, Optional
 
-__all__ = ["make_data_parallel_step", "shard_params", "DistributedTrainer"]
+__all__ = ["make_data_parallel_step", "shard_params", "DistributedTrainer",
+           "sharded_input_pipeline"]
+
+
+def sharded_input_pipeline(source, mesh, prefetch_depth=2,
+                           num_workers=None):
+    """An async input pipeline (io/pipeline.py) whose batches arrive
+    already sharded for a data-parallel step on ``mesh``: batch-dim
+    arrays split over ``dp``, the rest replicated — the exact placement
+    :class:`DistributedTrainer`/``make_data_parallel_step`` consume, so
+    their own ``device_put`` degenerates to a no-op and the per-device
+    H2D scatter overlaps the previous step's compute."""
+    from ..io.pipeline import make_sharded_pipeline
+    return make_sharded_pipeline(source, mesh,
+                                 prefetch_depth=prefetch_depth,
+                                 num_workers=num_workers)
+
+
+def _put_unless_placed(value, sharding):
+    """device_put unless the array already carries the wanted sharding
+    (the input pipeline's prefetch stage commits batches ahead of
+    time — re-putting would serialize the transfer we just hid)."""
+    import jax
+    if getattr(value, "sharding", None) == sharding:
+        return value
+    return jax.device_put(value, sharding)
 
 
 def shard_params(params: Dict[str, Any], mesh, rules=None):
@@ -143,8 +168,8 @@ class DistributedTrainer:
                       for n in arg_names if n in self._params}
         aux_vals = {n: jax.device_put(self._params[n].data()._data, repl)
                     for n in aux_names if n in self._params}
-        data_v = jax.device_put(data._data, self._batch_sharding)
-        label_v = jax.device_put(label._data, self._batch_sharding)
+        data_v = _put_unless_placed(data._data, self._batch_sharding)
+        label_v = _put_unless_placed(label._data, self._batch_sharding)
         loss, new_params, new_aux = self._step_fn(
             param_vals, aux_vals, data_v, label_v, _random.new_key())
         for n, v in new_params.items():

@@ -70,8 +70,8 @@ from .base import get_env
 
 __all__ = ["PHASES", "enabled", "start", "stop", "reset", "maybe_start",
            "step_begin", "step_end", "step_tick", "span", "comm",
-           "comm_span", "note", "recent_rate", "sample_memory", "flush",
-           "report", "quick_stats", "percentile"]
+           "comm_span", "h2d", "note", "recent_rate", "sample_memory",
+           "flush", "report", "quick_stats", "percentile"]
 
 PHASES = ("data_wait", "compute", "optimizer", "sync", "checkpoint",
           "eval")
@@ -498,6 +498,22 @@ def comm_span(kind, key, value=None):
     if _run is None:
         return _NULL
     return _CommSpan(kind, key, _nbytes(value))
+
+
+def h2d(key, nbytes=0, seconds=0.0):
+    """Account one host→device batch transfer performed by the input
+    pipeline's device-prefetch stage (``io/pipeline.py``). Lands in
+    the run's comms table under the ``h2d`` kind — per-key bytes and
+    transfer latency — and in the process-global profiler counters
+    (``h2d_calls``/``h2d_bytes``), so ``tools.diagnose`` can show how
+    much transfer ran off the step critical path. The transfer happens
+    on the prefetch thread, which is exactly why this is a counter and
+    not a :func:`span`: off-accounting-thread spans are (rightly)
+    ignored, but overlapped copy volume still needs a ledger."""
+    from . import profiler
+    profiler.increment_counter("h2d_calls")
+    profiler.increment_counter("h2d_bytes", int(nbytes))
+    comm("h2d", key, nbytes, seconds)
 
 
 # ---------------------------------------------------------------------------
