@@ -340,6 +340,13 @@ def _bench_allreduce_bandwidth():
     return (n_workers + 1) * nbytes * iters / dt / 1e9   # GB/s
 
 
+def _sync_module(mod):
+    """Completion barrier for framework-path benches: fetch-free sync
+    on a parameter buffer (shared by every train-step bench so the
+    sync mechanism can never diverge between them)."""
+    mod._exec.arg_dict[mod._param_names[0]]._data.block_until_ready()
+
+
 def _mlp_sym():
     import mxnet_tpu as mx
     data = mx.sym.var("data")
@@ -376,9 +383,6 @@ def _bench_fused_step_case(build_sym, data_shape, steps=60, warmup=5,
     import numpy as np_
     import mxnet_tpu as mx
 
-    def sync(mod):
-        mod._exec.arg_dict[mod._param_names[0]]._data.block_until_ready()
-
     rng = np_.random.RandomState(0)
     batch = mx.io.DataBatch(
         data=[mx.nd.array(
@@ -404,7 +408,7 @@ def _bench_fused_step_case(build_sym, data_shape, steps=60, warmup=5,
             for _ in range(warmup):
                 mod.forward_backward(batch)
                 mod.update()
-            sync(mod)
+            _sync_module(mod)
             mods[mode] = mod
 
         best = {"eager": 0.0, "fused": 0.0}
@@ -417,7 +421,7 @@ def _bench_fused_step_case(build_sym, data_shape, steps=60, warmup=5,
                 for _ in range(steps):
                     mod.forward_backward(batch)
                     mod.update()
-                sync(mod)
+                _sync_module(mod)
                 dt = time.perf_counter() - t0
                 best[mode] = max(best[mode], steps / dt)
 
@@ -477,9 +481,6 @@ def _bench_telemetry_overhead(steps=80, warmup=5, rounds=3):
     import mxnet_tpu as mx
     from mxnet_tpu import telemetry
 
-    def sync(mod):
-        mod._exec.arg_dict[mod._param_names[0]]._data.block_until_ready()
-
     rng = np_.random.RandomState(0)
     data_shape = (64, 784)
     batch = mx.io.DataBatch(
@@ -498,7 +499,7 @@ def _bench_telemetry_overhead(steps=80, warmup=5, rounds=3):
     for _ in range(warmup):
         mod.forward_backward(batch)
         mod.update()
-    sync(mod)
+    _sync_module(mod)
 
     sink = os.path.join(tempfile.gettempdir(),
                         "bench_telemetry_%d.jsonl" % os.getpid())
@@ -519,7 +520,7 @@ def _bench_telemetry_overhead(steps=80, warmup=5, rounds=3):
             else:
                 mod.forward_backward(batch)
                 mod.update()
-        sync(mod)
+        _sync_module(mod)
         dt = time.perf_counter() - t0
         if mode == "on":
             telemetry.stop()
@@ -553,6 +554,105 @@ def _telemetry_record():
               "platform": jax.default_backend(), "cases": {}}
     try:
         record["cases"]["mlp"] = _bench_telemetry_overhead()
+    except Exception as exc:                     # noqa: BLE001
+        record["errors"] = {"mlp": _err_str(exc)}
+    return record
+
+
+def _bench_compile_watch_overhead(steps=80, warmup=5, rounds=3):
+    """Fused-MLP train-step time with the compile watch OFF (the
+    default env — a watched call is one module-global None check before
+    the plain jit) vs ON (staged compiles + per-dispatch flops/bytes
+    accrual + per-step utilization records into a telemetry run with a
+    JSONL sink). Rounds are interleaved so host-load noise hits both
+    modes symmetrically; each round re-warms after the mode switch so
+    one-time staged compiles never pollute steady-state timing. The
+    acceptance bar is the OFF path: within noise of the parent
+    commit's fused MLP step time (BENCH_r07/r08 era)."""
+    import tempfile
+
+    import numpy as np_
+    import mxnet_tpu as mx
+    from mxnet_tpu import compile_watch, telemetry
+
+    rng = np_.random.RandomState(0)
+    data_shape = (64, 784)
+    batch = mx.io.DataBatch(
+        data=[mx.nd.array(
+            rng.uniform(0, 1, data_shape).astype(np_.float32))],
+        label=[mx.nd.array(
+            rng.randint(0, 10, (data_shape[0],)).astype(np_.float32))])
+
+    mod = mx.module.Module(_mlp_sym(), context=mx.current_context())
+    mod.bind(data_shapes=[("data", data_shape)],
+             label_shapes=[("softmax_label", (data_shape[0],))])
+    mod.init_params(initializer=mx.init.Xavier())
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.05,
+                                         "momentum": 0.9})
+    for _ in range(warmup):
+        mod.forward_backward(batch)
+        mod.update()
+    _sync_module(mod)
+
+    sink = os.path.join(tempfile.gettempdir(),
+                        "bench_compile_watch_%d.jsonl" % os.getpid())
+
+    def run_round(mode):
+        if mode == "on":
+            compile_watch.enable()
+            telemetry.start(filename=sink,
+                            meta={"case": "compile_watch_overhead"})
+        for _ in range(warmup):      # absorb staged compiles/mode flip
+            mod.forward_backward(batch)
+            mod.update()
+        _sync_module(mod)
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            if mode == "on":
+                telemetry.step_begin()
+                mod.forward_backward(batch)
+                mod.update()
+                telemetry.step_end(samples=data_shape[0])
+            else:
+                mod.forward_backward(batch)
+                mod.update()
+        _sync_module(mod)
+        dt = time.perf_counter() - t0
+        if mode == "on":
+            telemetry.stop()
+            compile_watch.disable()
+        return steps / dt
+
+    telemetry.reset()
+    compile_watch.disable()
+    best = {"off": 0.0, "on": 0.0}
+    for _ in range(rounds):
+        for mode in ("off", "on"):
+            best[mode] = max(best[mode], run_round(mode))
+    try:
+        os.remove(sink)
+    except OSError:
+        pass
+    return {
+        "compile_watch_off_steps_per_sec": round(best["off"], 2),
+        "compile_watch_on_steps_per_sec": round(best["on"], 2),
+        "on_overhead_pct": round(
+            100.0 * (best["off"] / best["on"] - 1.0), 2),
+        "steps": steps,
+        "batch": data_shape[0],
+    }
+
+
+def _compile_watch_record():
+    """The compile-watch-overhead benchmark record (BENCH_r09.json).
+    CPU-friendly — runs wherever the tier-1 suite runs."""
+    import jax
+    record = {"metric": "compile_watch_overhead", "unit": "steps/s",
+              "dtype": "float32", "optimizer": "sgd_momentum",
+              "platform": jax.default_backend(), "cases": {}}
+    try:
+        record["cases"]["mlp"] = _bench_compile_watch_overhead()
     except Exception as exc:                     # noqa: BLE001
         record["errors"] = {"mlp": _err_str(exc)}
     return record
@@ -640,9 +740,6 @@ def _bench_input_pipeline_case(build_sym, data_shape, io_wait_ms=35.0,
         max(2, min(4, os.cpu_count() or 2)))
     n_batches = steps + 2
 
-    def sync(mod):
-        mod._exec.arg_dict[mod._param_names[0]]._data.block_until_ready()
-
     mod = mx.module.Module(build_sym(), context=mx.current_context())
     mod.bind(data_shapes=[("data", data_shape)],
              label_shapes=[("softmax_label", (data_shape[0],))])
@@ -655,7 +752,7 @@ def _bench_input_pipeline_case(build_sym, data_shape, io_wait_ms=35.0,
     for batch in warm_src:
         mod.forward_backward(batch)
         mod.update()
-    sync(mod)
+    _sync_module(mod)
 
     dev = mx.current_context().jax_device()
     sources = {m: _DecodeBoundIter(data_shape, n_batches,
@@ -686,7 +783,7 @@ def _bench_input_pipeline_case(build_sym, data_shape, io_wait_ms=35.0,
                 mod.forward_backward(batch)
             mod.update()
             telemetry.step_end(samples=data_shape[0])
-        sync(mod)
+        _sync_module(mod)
         dt = time.perf_counter() - t0
         rep = telemetry.stop()
         telemetry.reset()
@@ -874,5 +971,9 @@ if __name__ == "__main__":
         # pooled+device-prefetch input path on a decode-bound loop,
         # one JSON line (the BENCH_r08 artifact)
         print(json.dumps(_input_pipeline_record()))
+    elif "--compile-watch-overhead" in sys.argv:
+        # CPU-friendly standalone mode: compile-watch-off vs -on fused
+        # MLP train-step time, one JSON line (the BENCH_r09 artifact)
+        print(json.dumps(_compile_watch_record()))
     else:
         main()

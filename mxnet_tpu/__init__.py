@@ -81,6 +81,7 @@ from . import visualization
 from . import visualization as viz
 from . import profiler
 from . import telemetry
+from . import compile_watch
 from . import model
 from . import rnn
 from . import storage

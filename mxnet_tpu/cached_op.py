@@ -4,7 +4,11 @@ Parity target: src/imperative/cached_op.{h,cc} (the Gluon hybridize
 backend). TPU-native design: the whole traced Symbol becomes ONE
 synthetic OpDef whose forward replays the graph as a pure JAX function.
 - eager call        → one jitted XLA executable (static_alloc/bulking
-  equivalents come free from XLA buffer assignment + fusion)
+  equivalents come free from XLA buffer assignment + fusion); the
+  compile rides the per-op jit cache, so with the compile watch on
+  (``mxnet_tpu.compile_watch``) every CachedOp compile is captured
+  under site ``op:_cachedopN.<head>`` with per-argument recompile
+  diffs and storm tracking
 - under autograd    → one tape node; backward compiles forward+vjp of
   the entire subgraph (CachedOp::Backward's cached grad graph role)
 - train/eval        → two jit specializations via the __train__ attr
@@ -125,8 +129,14 @@ class CachedOp:
         self.aux_names = aux_names
         self.num_inputs = len(arg_names) + len(aux_names)
         mutable = tuple(range(len(arg_names), self.num_inputs))
+        # name the synthetic op after the graph's head so compile-watch
+        # records and debug strings identify WHICH hybridized block
+        # recompiled, not just "_cachedop3"
+        outs = sym.list_outputs()
+        head = "".join(c if c.isalnum() or c == "_" else "_"
+                       for c in (outs[0] if outs else "graph"))[:40]
         self._op = OpDef(
-            "_cachedop%d" % next(_counter), fn,
+            "_cachedop%d.%s" % (next(_counter), head), fn,
             arg_names=arg_names + aux_names,
             defaults={"__train__": False},
             num_outputs=n_out,

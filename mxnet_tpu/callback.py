@@ -48,7 +48,11 @@ class Speedometer:
     comes from the run's own step records — the same ring buffer that
     feeds ``telemetry.report()`` — instead of a private wall clock, so
     the logged samples/sec and the run summary can never disagree. The
-    private clock remains the fallback for loops without telemetry."""
+    private clock remains the fallback for loops without telemetry.
+
+    With the compile watch active (``mxnet_tpu.compile_watch``) and
+    utilization measured, the log line additionally carries the mean
+    MFU over the window; with the watch off the output is unchanged."""
 
     def __init__(self, batch_size, frequent=50, auto_reset=True):
         self.batch_size = batch_size
@@ -70,6 +74,15 @@ class Speedometer:
         except ZeroDivisionError:
             return float('inf')
 
+    def _mfu(self):
+        """Mean MFU over the logging window when the compile watch has
+        utilization records for this run; None (no output change)
+        otherwise."""
+        from . import compile_watch
+        if not compile_watch.enabled():
+            return None
+        return compile_watch.recent_mfu(self.frequent)
+
     def __call__(self, param):
         count = param.nbatch
         if self.last_count > count:
@@ -79,18 +92,23 @@ class Speedometer:
         if self.init:
             if count % self.frequent == 0:
                 speed = self._speed()
+                mfu = self._mfu()
+                mfu_part = () if mfu is None else (100.0 * mfu,)
+                mfu_fmt = "" if mfu is None else "\tMFU: %.2f%%"
                 if param.eval_metric is not None:
                     name_value = param.eval_metric.get_name_value()
                     if self.auto_reset:
                         param.eval_metric.reset()
                     msg = 'Epoch[%d] Batch [%d-%d]\tSpeed: %.2f samples/sec'
+                    msg += mfu_fmt
                     msg += '\t%s=%f' * len(name_value)
                     logging.info(msg, param.epoch, count - self.frequent,
-                                 count, speed,
+                                 count, speed, *mfu_part,
                                  *sum(name_value, ()))
                 else:
                     logging.info("Iter[%d] Batch [%d]\tSpeed: %.2f "
-                                 "samples/sec", param.epoch, count, speed)
+                                 "samples/sec" + mfu_fmt, param.epoch,
+                                 count, speed, *mfu_part)
                 self.tic = time.time()
         else:
             self.init = True

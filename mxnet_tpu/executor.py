@@ -358,9 +358,11 @@ class Executor:
         fn = self._fns.get(key)
         if fn is not None:
             return fn
+        from . import compile_watch
         from .engine import compiler_options
         copts = compiler_options(self._ctx)
         run = self._make_graph_fn(is_train)
+        site = "executor:%s:%s" % (kind, "train" if is_train else "eval")
         rep = None
         if self._mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec as P
@@ -371,10 +373,13 @@ class Executor:
             elif rep is not None:
                 # outputs auto-sharded; updated aux replicated so eager
                 # math on them never mixes device sets
-                fn = jax.jit(run, out_shardings=(None, rep),
-                             compiler_options=copts)
+                fn = compile_watch.jit(
+                    run, site, describe=self._cw_describe,
+                    out_shardings=(None, rep), compiler_options=copts)
             else:
-                fn = jax.jit(run, compiler_options=copts)
+                fn = compile_watch.jit(run, site,
+                                       describe=self._cw_describe,
+                                       compiler_options=copts)
         else:
             gpos = self._grad_positions
 
@@ -394,12 +399,34 @@ class Executor:
                 fn = fwdbwd
             elif rep is not None:
                 # grads replicated = the in-program allreduce
-                fn = jax.jit(fwdbwd, out_shardings=(None, rep, rep),
-                             compiler_options=copts)
+                fn = compile_watch.jit(
+                    fwdbwd, site, describe=self._cw_describe,
+                    out_shardings=(None, rep, rep),
+                    compiler_options=copts)
             else:
-                fn = jax.jit(fwdbwd, compiler_options=copts)
+                fn = compile_watch.jit(fwdbwd, site,
+                                       describe=self._cw_describe,
+                                       compiler_options=copts)
         self._fns[key] = fn
         return fn
+
+    def _cw_describe(self, arg_vals, aux_vals, rng_keys, out_grads=None):
+        """compile_watch describe hook: name the compiled program's
+        argument leaves with the symbol's own arg/aux names, so a
+        recompile-cause diff says "data: f32[32,784] -> f32[48,784]"
+        instead of a positional index."""
+        from .compile_watch import describe_arrays
+        d = describe_arrays(self.arg_names, arg_vals)
+        d.update(describe_arrays(["aux:%s" % n for n in self.aux_names],
+                                 aux_vals))
+        if rng_keys:
+            d.update(describe_arrays(
+                ["rng%d" % i for i in range(len(rng_keys))], rng_keys))
+        if out_grads is not None:
+            d.update(describe_arrays(
+                ["out_grad:%s" % n for n in self.output_names],
+                out_grads))
+        return d
 
     # -- execution -------------------------------------------------------
     def _dp_shardings(self):

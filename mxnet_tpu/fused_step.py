@@ -330,7 +330,6 @@ class FusedStepExecutor(_FusedCore):
             _count("fused_step_cache_hits")
             return cached
         _count("fused_step_cache_misses")
-        import jax
         import jax.numpy as jnp
         fwdbwd, gpos, out_structs = self._ex.fused_plan()
         apply_fn = self._make_apply(fns, counts, guard, inject)
@@ -353,9 +352,32 @@ class FusedStepExecutor(_FusedCore):
                                              scalars, poisons)
             return outs, new_aux, new_ws, new_sts, mask
 
+        arg_names = self._ex.arg_names
+        aux_names = self._ex.aux_names
+
+        def describe(weights, states, others, aux_vals, rng_keys,
+                     scalars, poisons):
+            from .compile_watch import describe_arrays
+            d = describe_arrays([arg_names[p] for p in gpos], weights)
+            d.update(describe_arrays(
+                ["state%d" % i for i in range(len(states))], states))
+            d.update(describe_arrays(
+                [arg_names[p] for p in other_pos], others))
+            d.update(describe_arrays(
+                ["aux:%s" % n for n in aux_names], aux_vals))
+            d.update(describe_arrays(
+                ["scalars", "poisons"], [scalars, poisons]))
+            return d
+
+        from . import compile_watch
         from .engine import compiler_options
-        fn = jax.jit(program, donate_argnums=(0, 1),
-                     compiler_options=compiler_options(self._ex._ctx))
+        fn = compile_watch.jit(
+            program, "fused_step:module", describe=describe,
+            counter="fused_step_compile_ms",
+            statics=(counts, guard, inject,
+                     self._opt.fused_static_key()),
+            donate_argnums=(0, 1),
+            compiler_options=compiler_options(self._ex._ctx))
         self._cache[key] = fn
         return fn
 
@@ -413,15 +435,32 @@ class FusedUpdater(_FusedCore):
             _count("fused_step_cache_hits")
             return cached
         _count("fused_step_cache_misses")
-        import jax
         apply_fn = self._make_apply(fns, counts, guard, inject)
 
         def program(grads, weights, states, scalars, poisons):
             self._trace_count += 1
             return apply_fn(grads, weights, states, scalars, poisons)
 
+        def describe(grads, weights, states, scalars, poisons):
+            from .compile_watch import describe_arrays
+            d = describe_arrays(
+                ["grad:param%d" % i for i in idx_key], grads)
+            d.update(describe_arrays(
+                ["param%d" % i for i in idx_key], weights))
+            d.update(describe_arrays(
+                ["state%d" % i for i in range(len(states))], states))
+            d.update(describe_arrays(
+                ["scalars", "poisons"], [scalars, poisons]))
+            return d
+
+        from . import compile_watch
         from .engine import compiler_options
-        fn = jax.jit(program, donate_argnums=(1, 2),
-                     compiler_options=compiler_options())
+        fn = compile_watch.jit(
+            program, "fused_step:trainer", describe=describe,
+            counter="fused_step_compile_ms",
+            statics=(counts, guard, inject, idx_key,
+                     self._opt.fused_static_key()),
+            donate_argnums=(1, 2),
+            compiler_options=compiler_options())
         self._cache[key] = fn
         return fn

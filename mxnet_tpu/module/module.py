@@ -75,6 +75,7 @@ class Module(BaseModule):
         self._exec = None
         self._fused = None            # FusedStepExecutor | False | None
         self._pending_step = False
+        self._noted_monitor_eager = False   # one-time telemetry note
 
     # -- checkpointing -----------------------------------------------------
     @staticmethod
@@ -423,8 +424,18 @@ class Module(BaseModule):
         if self.inputs_need_grad or self._fused is False:
             return False
         ex = self._exec
-        if ex is None or ex._mesh is not None or ex._grouped is not None \
-                or ex._monitor_callback is not None:
+        if ex is None or ex._mesh is not None or ex._grouped is not None:
+            return False
+        if ex._monitor_callback is not None:
+            # an installed Monitor silently forces the fused step back
+            # to eager (fallback matrix): tell the telemetry run ONCE,
+            # so diagnose can answer "why was this run eager"
+            from .. import telemetry
+            from ..fused_step import fused_step_enabled
+            if telemetry.enabled() and fused_step_enabled() \
+                    and not self._noted_monitor_eager:
+                self._noted_monitor_eager = True
+                telemetry.note("fused_step_eager_monitor")
             return False
         if any(ex._grad_req.get(n) == 'add' for n in ex.arg_names):
             return False

@@ -231,17 +231,31 @@ _jit_lock = threading.Lock()
 
 
 def _get_jitted(op: OpDef, nattrs: Dict[str, Any], n_inputs: int):
-    import jax
     key = (op.name, attr_key(nattrs), n_inputs, op.needs_rng)
     fn = _jit_cache.get(key)
     if fn is None:
+        from .. import compile_watch
+        arg_names = list(op.arg_names) if op.arg_names else None
         if op.needs_rng:
             def raw(rng, *arrays):
                 return op.forward(nattrs, *arrays, rng=rng)
+            names = ["rng"] + (arg_names or [])
         else:
             def raw(*arrays):
                 return op.forward(nattrs, *arrays)
-        fn = jax.jit(raw)
+            names = arg_names
+
+        def describe(*arrays):
+            return compile_watch.describe_arrays(names, arrays)
+
+        # program identity includes the op's static attrs (a _zeros
+        # per param shape is specialization, not churn). Plain eager
+        # micro-ops are polymorphic by design, so only CachedOp graphs
+        # — one hybridized program, site "op:_cachedopN.<head>" —
+        # participate in recompile-storm detection.
+        fn = compile_watch.jit(raw, "op:%s" % op.name,
+                               describe=describe, statics=key[1:],
+                               storm=op.name.startswith("_cachedop"))
         with _jit_lock:
             _jit_cache[key] = fn
     return fn

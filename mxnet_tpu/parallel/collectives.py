@@ -8,7 +8,12 @@ inside pjit programs, sharding annotations let XLA insert them.
 
 Observability: with a telemetry run active (``mxnet_tpu.telemetry``),
 each eager collective is accounted — input bytes and caller-observed
-latency — under comm kind ``collective`` keyed by the primitive name.
+latency — under comm kind ``collective`` keyed by the primitive name;
+with the compile watch active (``mxnet_tpu.compile_watch``) each
+primitive's compiles are captured under site ``collective:<name>``.
+The shard_map callable is built once per (primitive, mesh, statics)
+and cached — the old per-call closure forced a re-trace on every
+eager call.
 """
 from __future__ import annotations
 
@@ -16,6 +21,30 @@ import functools
 
 __all__ = ["all_reduce", "all_gather", "reduce_scatter", "broadcast",
            "ppermute", "barrier", "psum_eager"]
+
+# (primitive, mesh, statics) -> compile_watch-wrapped jitted shard_map
+_prim_cache = {}
+
+
+def _watched(prim, mesh, statics, build):
+    """The cached, compile-watched form of one collective primitive.
+    ``build()`` returns the shard_map-wrapped pure function; the
+    wrapper jits it (jit(shard_map(f)) is the canonical spelling) so
+    repeated eager calls stop re-tracing and every XLA compile is
+    observable."""
+    key = (prim, mesh, statics)
+    fn = _prim_cache.get(key)
+    if fn is None:
+        from .. import compile_watch
+
+        def describe(*arrays):
+            return compile_watch.describe_arrays(["x"], arrays)
+
+        fn = compile_watch.jit(build(), "collective:%s" % prim,
+                               describe=describe,
+                               statics=(str(mesh), statics))
+        _prim_cache[key] = fn
+    return fn
 
 
 def _shard_map():
@@ -60,8 +89,10 @@ def all_reduce(x, mesh, axis="dp", op="sum"):
         raise ValueError(op)
 
     def run():
-        return _shard_map()(f, mesh=mesh, in_specs=(P(axis),),
-                            out_specs=P())(x)
+        return _watched(
+            "all_reduce", mesh, (axis, op),
+            lambda: _shard_map()(f, mesh=mesh, in_specs=(P(axis),),
+                                 out_specs=P()))(x)
 
     from .. import telemetry
     with telemetry.comm_span("collective", "all_reduce", x):
@@ -77,8 +108,10 @@ def all_gather(x, mesh, axis="dp", tiled=True):
 
     from .. import telemetry
     with telemetry.comm_span("collective", "all_gather", x):
-        return _shard_map()(f, mesh=mesh, in_specs=(P(axis),),
-                            out_specs=P())(x)
+        return _watched(
+            "all_gather", mesh, (axis, bool(tiled)),
+            lambda: _shard_map()(f, mesh=mesh, in_specs=(P(axis),),
+                                 out_specs=P()))(x)
 
 
 def reduce_scatter(x, mesh, axis="dp"):
@@ -90,8 +123,10 @@ def reduce_scatter(x, mesh, axis="dp"):
 
     from .. import telemetry
     with telemetry.comm_span("collective", "reduce_scatter", x):
-        return _shard_map()(f, mesh=mesh, in_specs=(P(),),
-                            out_specs=P(axis))(x)
+        return _watched(
+            "reduce_scatter", mesh, (axis,),
+            lambda: _shard_map()(f, mesh=mesh, in_specs=(P(),),
+                                 out_specs=P(axis)))(x)
 
 
 def ppermute(x, mesh, axis, perm):
@@ -103,8 +138,10 @@ def ppermute(x, mesh, axis, perm):
 
     from .. import telemetry
     with telemetry.comm_span("collective", "ppermute", x):
-        return _shard_map()(f, mesh=mesh, in_specs=(P(axis),),
-                            out_specs=P(axis))(x)
+        return _watched(
+            "ppermute", mesh, (axis, tuple(map(tuple, perm))),
+            lambda: _shard_map()(f, mesh=mesh, in_specs=(P(axis),),
+                                 out_specs=P(axis)))(x)
 
 
 def broadcast(x, mesh, axis="dp", root=0):
@@ -119,8 +156,10 @@ def broadcast(x, mesh, axis="dp", root=0):
 
     from .. import telemetry
     with telemetry.comm_span("collective", "broadcast", x):
-        return _shard_map()(f, mesh=mesh, in_specs=(P(axis),),
-                            out_specs=P(axis))(x)
+        return _watched(
+            "broadcast", mesh, (axis, int(root)),
+            lambda: _shard_map()(f, mesh=mesh, in_specs=(P(axis),),
+                                 out_specs=P(axis)))(x)
 
 
 def psum_eager(arrays):

@@ -56,7 +56,12 @@ set) on the next ``Module.fit`` / gluon ``Trainer.step``
 
 JSONL record types: ``run_start`` (meta), ``step`` (seq, dur_ms,
 phases_ms, samples, skipped, retries), ``memory`` (per-device bytes),
-``summary`` (the :func:`report` dict, written at :func:`stop`).
+``summary`` (the :func:`report` dict, written at :func:`stop`) — plus,
+only when the compile watch is active (``mxnet_tpu.compile_watch``),
+``compile`` (per-XLA-compile duration/cause/flops) and ``utilization``
+(per-step MFU / memory-bandwidth utilization). With the watch off
+those kinds never appear and the sink is byte-identical to a run
+without the subsystem.
 """
 from __future__ import annotations
 
@@ -71,7 +76,8 @@ from .base import get_env
 __all__ = ["PHASES", "enabled", "start", "stop", "reset", "maybe_start",
            "step_begin", "step_end", "step_tick", "span", "comm",
            "comm_span", "h2d", "note", "recent_rate", "sample_memory",
-           "flush", "report", "quick_stats", "percentile"]
+           "flush", "report", "quick_stats", "percentile",
+           "external_record"]
 
 PHASES = ("data_wait", "compute", "optimizer", "sync", "checkpoint",
           "eval")
@@ -80,6 +86,15 @@ _lock = threading.Lock()
 _run = None          # the active _Run
 _last_run = None     # most recently stopped run (report() after fit)
 _env_cfg = None      # cached (enabled, filename) from the environment
+# per-step utilization hooks, installed by compile_watch.enable():
+# _util_probe is called at each step boundary (under _lock — it must
+# not call back in) with (step_seq, dur_s) and returns the extra
+# fields of a ``utilization`` record, or None; _util_reset is called
+# at step_begin so pre-step dispatch backlog (warmup, init) never
+# inflates the first step's MFU. One global None check each when the
+# watch is off.
+_util_probe = None
+_util_reset = None
 
 
 class _Run:
@@ -109,6 +124,7 @@ class _Run:
         self.mem_watermarks = {}     # device -> peak/last bytes
         self.fault_base = None       # fault.stats() at start
         self.counters_base = {}      # profiler.counters() at start
+        self.cw_base = None          # compile_watch compile baseline
         self._step_t0 = None         # perf_counter at step_begin
         self._last_boundary = None   # perf_counter at last step end
         # spans only count on the accounting thread (the one driving
@@ -178,9 +194,14 @@ def start(filename=None, run_id=None, meta=None):
     global _run, _atexit_registered
     # baselines first, outside the lock (fault/profiler take their own
     # locks; a loser's snapshot is simply discarded below)
-    from . import fault, profiler
+    from . import compile_watch, fault, profiler
     fault_base = fault.stats()
     counters_base = profiler.counters()
+    compile_watch.maybe_enable()   # MXNET_COMPILE_WATCH rides the run
+    compile_watch.run_reset()      # utilization is scoped to THIS run
+    cw = compile_watch.stats()
+    cw_base = {"count": cw["compiles"],
+               "total_s": cw["compile_total_s"]} if cw else None
     with _lock:
         if _run is not None:
             return _run.run_id     # racer lost: report the winner's id
@@ -189,6 +210,7 @@ def start(filename=None, run_id=None, meta=None):
         run = _Run(_per_worker_filename(filename), run_id, meta)
         run.fault_base = fault_base
         run.counters_base = counters_base
+        run.cw_base = cw_base
         _run = run
     if not _atexit_registered:
         _atexit_registered = True
@@ -306,6 +328,14 @@ def _close_step_locked(run, now, samples):
     run._step_fault_base = dict(run.fault_counters)
     run.ring.append(rec)
     run.records.append(rec)
+    probe = _util_probe
+    if probe is not None:
+        util = probe(run.steps, dur)
+        if util:
+            urec = {"type": "utilization", "seq": run.steps,
+                    "t": rec["t"], "dur_ms": rec["dur_ms"]}
+            urec.update(util)
+            run.records.append(urec)
     if not run.filename and len(run.records) > run._max_records:
         # memory-only run: bound the record list (the ring and the
         # accumulators keep the summary exact; only raw records drop).
@@ -328,9 +358,17 @@ def step_begin():
     if run is None:
         return
     now = time.perf_counter()
+    resetf = _util_reset
     with _lock:
         if run._step_t0 is not None:
+            # a still-open step: close it FIRST so the utilization
+            # probe drains its dispatch accumulators into its record
             _close_step_locked(run, now, None)
+        elif resetf is not None:
+            # no step was open: anything accrued since the last
+            # boundary is pre-step backlog (warmup, eval, init), not
+            # this step's work — drop it so MFU can't exceed reality
+            resetf()
         run._step_t0 = now
         run._thread = threading.get_ident()
         run.pending_phases = {}
@@ -519,6 +557,18 @@ def h2d(key, nbytes=0, seconds=0.0):
 # ---------------------------------------------------------------------------
 # fault/goodput unification
 # ---------------------------------------------------------------------------
+
+def external_record(rec):
+    """Append one externally-built record (a ``compile`` event from
+    compile_watch) to the active run. No-op without a run. The caller
+    must not hold any of its own locks that its telemetry callbacks
+    also take (lock order: telemetry._lock is innermost here)."""
+    run = _run
+    if run is None:
+        return
+    with _lock:
+        run.records.append(dict(rec))
+
 
 def note(name, delta=1):
     """Count one resilience/bookkeeping event against the run.
@@ -717,6 +767,22 @@ def report():
              if k.startswith("fused_step")}
     if fused:
         out["counters"] = fused
+    # compile & hardware-utilization blocks — only when the compile
+    # watch is active, so an off-run's summary (and sink) stays
+    # byte-identical to one without the subsystem
+    from . import compile_watch
+    cblock, ublock = compile_watch.summary_blocks()
+    if cblock is not None:
+        base = getattr(run, "cw_base", None)
+        if base:
+            # count/seconds scoped to THIS run; the per-program table
+            # stays process-lifetime (program identity outlives runs)
+            cblock["count"] = cblock["count"] - base["count"]
+            cblock["total_s"] = round(
+                cblock["total_s"] - base["total_s"], 6)
+        out["compile"] = cblock
+    if ublock is not None:
+        out["utilization"] = ublock
     return out
 
 

@@ -10,8 +10,13 @@ Telemetry mode: ``python -m mxnet_tpu.tools.diagnose <run>.jsonl``
 reads a ``mxnet_tpu.telemetry`` JSONL sink back into human tables —
 step-time percentiles, per-phase breakdown, goodput (productive vs.
 skipped/retried, unified with ``fault.stats()``), memory watermarks,
-and per-key comms bytes/latency. This supersedes scraping the same
-facts out of log lines with ``tools/parse_log.py``.
+and per-key comms bytes/latency — plus, when the run was recorded with
+``mxnet_tpu.compile_watch`` active, the compile log (per-program
+compile count/seconds/causes, recompile storms, the fused-step cache
+counters) and the hardware-utilization table (MFU and memory-bandwidth
+percentiles from the per-step ``utilization`` records). This
+supersedes scraping the same facts out of log lines with
+``tools/parse_log.py``.
 """
 from __future__ import annotations
 
@@ -104,7 +109,8 @@ def read_telemetry(path):
     skipped (a crash can strand at most one trailing partial line).
     A sink holding several runs (consecutive fits appending to the
     same MXNET_TELEMETRY_FILE) yields the LAST run."""
-    out = {"run": None, "steps": [], "memory": [], "summary": None}
+    out = {"run": None, "steps": [], "memory": [], "compiles": [],
+           "utilization": [], "summary": None}
     with open(path) as f:
         for line in f:
             line = line.strip()
@@ -117,11 +123,16 @@ def read_telemetry(path):
             kind = rec.get("type")
             if kind == "run_start":
                 out = {"run": rec, "steps": [], "memory": [],
+                       "compiles": [], "utilization": [],
                        "summary": None}
             elif kind == "step":
                 out["steps"].append(rec)
             elif kind == "memory":
                 out["memory"].append(rec)
+            elif kind == "compile":
+                out["compiles"].append(rec)
+            elif kind == "utilization":
+                out["utilization"].append(rec)
             elif kind == "summary":
                 out["summary"] = rec
     return out
@@ -132,6 +143,13 @@ def _fmt_bytes(n):
         if abs(n) < 1024.0 or unit == "GiB":
             return "%.1f %s" % (n, unit)
         n /= 1024.0
+
+
+def _fmt_flops(n):
+    for unit in ("FLOP", "KFLOP", "MFLOP", "GFLOP", "TFLOP"):
+        if abs(n) < 1000.0 or unit == "TFLOP":
+            return "%.2f %s" % (n, unit)
+        n /= 1000.0
 
 
 def format_telemetry(tel):
@@ -148,6 +166,7 @@ def format_telemetry(tel):
     if run.get("meta"):
         lines.append("meta         : %s" % json.dumps(run["meta"]))
 
+    compiles = tel.get("compiles") or []
     lines.append("----------Step time----------")
     durs = [s["dur_ms"] for s in steps if s.get("dur_ms") is not None]
     if durs:
@@ -157,6 +176,12 @@ def format_telemetry(tel):
             lines.append("p%-2d(ms)      : %.3f" % (q,
                                                     percentile(durs, q)))
         lines.append("max(ms)      : %.3f" % max(durs))
+    elif compiles:
+        # a sink with compiles but no steps is not a broken file — the
+        # run crashed before step 1, or was a compile-only run
+        lines.append("no step records — run recorded %d compile(s) "
+                     "but no steps (crashed before step 1, or a "
+                     "compile-only run)" % len(compiles))
     else:
         lines.append("no step records")
 
@@ -176,6 +201,97 @@ def format_telemetry(tel):
                          % (phase, totals[phase],
                             100.0 * totals[phase] / whole))
 
+    # -- compile log (mxnet_tpu.compile_watch) --------------------------
+    sum_compile = summary.get("compile") or {}
+    if compiles or sum_compile:
+        lines.append("----------Compilation----------")
+        progs = {}
+        for c in compiles:
+            p = progs.setdefault(c.get("program", "?"),
+                                 {"count": 0, "ms": 0.0, "causes": {},
+                                  "churn": {}})
+            p["count"] += 1
+            p["ms"] += c.get("dur_ms", 0.0)
+            cause = (c.get("cause") or "?").split(" ", 1)[0]
+            p["causes"][cause] = p["causes"].get(cause, 0) + 1
+            for arg in c.get("changed", ()):
+                p["churn"][arg] = p["churn"].get(arg, 0) + 1
+        if not progs:
+            # compile records flushed out of an earlier file segment:
+            # fall back to the summary's per-program table
+            for name, s in (sum_compile.get("programs") or {}).items():
+                progs[name] = {"count": s.get("count", 0),
+                               "ms": s.get("total_s", 0.0) * 1e3,
+                               "causes": dict(s.get("causes") or {}),
+                               "churn": dict(s.get("churn") or {})}
+        total_ms = 0.0
+        lines.append("%-28s %6s %10s  %s"
+                     % ("program", "count", "time(ms)",
+                        "causes [churning arg]"))
+        for name in sorted(progs, key=lambda n: -progs[n]["ms"]):
+            p = progs[name]
+            total_ms += p["ms"]
+            causes = ",".join("%s:%d" % kv
+                              for kv in sorted(p["causes"].items()))
+            if p["churn"]:
+                causes += " [%s]" % max(p["churn"], key=p["churn"].get)
+            lines.append("%-28s %6d %10.1f  %s"
+                         % (name[:28], p["count"], p["ms"], causes))
+        lines.append("%-28s %6d %10.1f" % (
+            "TOTAL", sum(p["count"] for p in progs.values()), total_ms))
+        for s in sum_compile.get("storms") or []:
+            lines.append("RECOMPILE STORM: %s compiled %sx within %s "
+                         "steps — churning argument '%s'"
+                         % (s.get("program"), s.get("compiles"),
+                            s.get("window_steps"), s.get("arg")))
+        fused = {k: v for k, v in (summary.get("counters") or {}).items()
+                 if k.startswith("fused_step")}
+        if fused:
+            lines.append("fused-step cache: " + ", ".join(
+                "%s=%s" % (k[len("fused_step_"):],
+                           round(v, 1) if isinstance(v, float) else v)
+                for k, v in sorted(fused.items())))
+
+    # -- hardware utilization (MFU / memory bandwidth) ------------------
+    utils = tel.get("utilization") or []
+    sum_util = summary.get("utilization") or {}
+    if utils or sum_util:
+        lines.append("----------Utilization----------")
+        if sum_util.get("device_kind"):
+            lines.append("device       : %s x%d (peak %.1f TFLOP/s, "
+                         "%.0f GB/s each)"
+                         % (sum_util["device_kind"],
+                            sum_util.get("n_devices", 1),
+                            sum_util.get("peak_flops", 0.0) / 1e12,
+                            sum_util.get("peak_bw", 0.0) / 1e9))
+        mfus = [u["mfu"] for u in utils if u.get("mfu") is not None]
+        if not mfus and sum_util.get("mfu"):
+            m = sum_util["mfu"]
+            lines.append("MFU p50      : %8.3f %%" % (100 * m["p50"]))
+            lines.append("MFU p90      : %8.3f %%" % (100 * m["p90"]))
+        elif mfus:
+            lines.append("MFU p50      : %8.3f %%"
+                         % (100 * percentile(mfus, 50)))
+            lines.append("MFU p90      : %8.3f %%"
+                         % (100 * percentile(mfus, 90)))
+        bwus = [u["bw_util"] for u in utils
+                if u.get("bw_util") is not None]
+        if bwus:
+            lines.append("HBM BW p50   : %8.3f %%"
+                         % (100 * percentile(bwus, 50)))
+        flops = [u.get("flops", 0.0) for u in utils]
+        fdurs = [u.get("dur_ms") for u in utils
+                 if u.get("dur_ms") and u.get("flops")]
+        if any(flops):
+            lines.append("flops/step   : %s (dispatched, XLA cost "
+                         "model)" % _fmt_flops(
+                             sum(flops) / max(1, len(flops))))
+            if fdurs:
+                tf = sum(u["flops"] for u in utils
+                         if u.get("dur_ms") and u.get("flops"))
+                lines.append("sustained    : %s/s"
+                             % _fmt_flops(tf / (sum(fdurs) / 1e3)))
+
     lines.append("----------Goodput----------")
     skipped = sum(s.get("skipped", 0) for s in steps)
     retried = sum(s.get("retries", 0) for s in steps)
@@ -191,6 +307,10 @@ def format_telemetry(tel):
                      % (samples / (sum(durs) / 1e3)))
     if summary.get("fault"):
         lines.append("fault.stats  : %s" % json.dumps(summary["fault"]))
+    if summary.get("events"):
+        # free-form telemetry.note() events — e.g.
+        # fused_step_eager_monitor explains "why was this run eager"
+        lines.append("events       : %s" % json.dumps(summary["events"]))
 
     lines.append("----------Memory----------")
     watermarks = {}

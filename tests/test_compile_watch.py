@@ -1,0 +1,449 @@
+"""Compile & hardware-utilization observability
+(mxnet_tpu.compile_watch): compile-event capture at the
+executor/fused-step/cached-op jit sites, recompile-cause diffs naming
+the churning argument, the one-time recompile-storm warning, MFU math
+against hand-computed flops, the JSONL round trip through
+tools.diagnose (CLI included), and the always-cheap-when-off path.
+"""
+import json
+import logging
+import os
+import warnings
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import compile_watch, profiler, telemetry
+from mxnet_tpu.model import BatchEndParam
+from mxnet_tpu.tools import diagnose
+
+
+@pytest.fixture(autouse=True)
+def _clean_state(monkeypatch):
+    for var in ("MXNET_TELEMETRY", "MXNET_TELEMETRY_FILE",
+                "MXNET_COMPILE_WATCH", "MXNET_COMPILE_STORM_K",
+                "MXNET_COMPILE_STORM_STEPS", "MXNET_DEVICE_PEAK_FLOPS",
+                "MXNET_DEVICE_PEAK_BW", "MXNET_FUSED_STEP"):
+        monkeypatch.delenv(var, raising=False)
+    compile_watch.disable()
+    telemetry.reset()
+    yield
+    compile_watch.disable()
+    telemetry.reset()
+
+
+def _mlp_sym():
+    data = mx.sym.var("data")
+    x = mx.sym.FullyConnected(data, num_hidden=8, name="fc1")
+    x = mx.sym.Activation(x, act_type="relu")
+    x = mx.sym.FullyConnected(x, num_hidden=3, name="fc2")
+    return mx.sym.SoftmaxOutput(x, mx.sym.var("softmax_label"),
+                                name="softmax")
+
+
+def _train_iter(n=24, batch=8):
+    rng = np.random.RandomState(7)
+    X = rng.uniform(size=(n, 6)).astype(np.float32)
+    Y = rng.randint(0, 3, (n,)).astype(np.float32)
+    return mx.io.NDArrayIter(X, Y, batch_size=batch)
+
+
+def _fit_once(sink=None, epochs=1):
+    telemetry.start(filename=sink, meta={"case": "compile_watch_test"})
+    mod = mx.module.Module(_mlp_sym(), context=mx.cpu())
+    mod.fit(_train_iter(), num_epoch=epochs, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.05})
+    return telemetry.stop()
+
+
+def _bind_fc(batch):
+    data = mx.sym.var("data")
+    sym = mx.sym.FullyConnected(data, num_hidden=4, name="fc")
+    args = {"data": mx.nd.array(np.ones((batch, 6), np.float32)),
+            "fc_weight": mx.nd.array(np.zeros((4, 6), np.float32)),
+            "fc_bias": mx.nd.array(np.zeros((4,), np.float32))}
+    return sym.bind(mx.cpu(), args)
+
+
+# ---------------------------------------------------------------------------
+# off path
+# ---------------------------------------------------------------------------
+
+def test_off_is_a_noop(tmp_path):
+    """With the watch off: no compile/utilization records, no summary
+    blocks, no warnings, no compile counters — the sink carries exactly
+    the PR 3-era record kinds."""
+    sink = str(tmp_path / "off.jsonl")
+    ctr_before = profiler.counters().get("fused_step_compile_ms", 0)
+    with warnings.catch_warnings(record=True) as wlog:
+        warnings.simplefilter("always")
+        summary = _fit_once(sink=sink)
+    assert not compile_watch.enabled()
+    assert compile_watch.stats() is None
+    assert compile_watch.recent_mfu() is None
+    assert "compile" not in summary
+    assert "utilization" not in summary
+    kinds = {json.loads(line)["type"] for line in open(sink)}
+    assert kinds <= {"run_start", "step", "memory", "summary"}
+    assert not [w for w in wlog if "compile_watch" in str(w.message)]
+    assert profiler.counters().get("fused_step_compile_ms", 0) \
+        == ctr_before
+
+
+def test_env_enables_with_telemetry_run(tmp_path, monkeypatch):
+    monkeypatch.setenv("MXNET_COMPILE_WATCH", "1")
+    sink = str(tmp_path / "env.jsonl")
+    summary = _fit_once(sink=sink)
+    assert compile_watch.enabled()
+    assert summary["compile"]["count"] > 0
+    kinds = {json.loads(line)["type"] for line in open(sink)}
+    assert "compile" in kinds and "utilization" in kinds
+
+
+# ---------------------------------------------------------------------------
+# compile-event capture per site
+# ---------------------------------------------------------------------------
+
+def test_executor_site_captured():
+    compile_watch.enable()
+    ex = _bind_fc(3)
+    ex.forward(is_train=False)
+    progs = compile_watch.stats()["programs"]
+    assert "executor:fwd:eval" in progs
+    p = progs["executor:fwd:eval"]
+    assert p["count"] == 1 and p["total_s"] > 0
+    assert p["causes"] == {"first_compile": 1}
+
+
+def test_fused_step_site_and_counter_bridge(tmp_path):
+    """The fused-step compile lands under its own site, mirrors compile
+    ms into profiler.counters()['fused_step_compile_ms'], and the
+    telemetry summary bridges cache hit/miss/fallback counters AND the
+    compile seconds (satellite: reconciliation covers compilation)."""
+    compile_watch.enable()
+    sink = str(tmp_path / "fused.jsonl")
+    summary = _fit_once(sink=sink)
+    progs = compile_watch.stats()["programs"]
+    assert "fused_step:module" in progs
+    ctr = summary["counters"]
+    assert ctr.get("fused_step_cache_misses", 0) >= 1
+    assert ctr.get("fused_step_dispatches", 0) >= 1
+    assert ctr.get("fused_step_compile_ms", 0) > 0
+    # the same figure the compile block carries, different ledger
+    assert summary["compile"]["programs"]["fused_step:module"][
+        "total_s"] > 0
+
+
+def test_cached_op_site_captured():
+    from mxnet_tpu.cached_op import CachedOp
+    compile_watch.enable()
+    x = mx.sym.var("x")
+    op = CachedOp(2 * x + 1)
+    out = op(mx.nd.array(np.ones((3,), np.float32)))
+    out = out[0] if isinstance(out, (list, tuple)) else out
+    np.testing.assert_allclose(out.asnumpy(), [3, 3, 3])
+    sites = [s for s in compile_watch.stats()["programs"]
+             if s.startswith("op:_cachedop")]
+    assert sites, "CachedOp compile not captured"
+
+
+# ---------------------------------------------------------------------------
+# recompile-cause diff + storm
+# ---------------------------------------------------------------------------
+
+def test_recompile_diff_names_changed_argument():
+    compile_watch.enable()
+    for batch in (3, 5):
+        ex = _bind_fc(batch)
+        ex.forward(is_train=False)
+    p = compile_watch.stats()["programs"]["executor:fwd:eval"]
+    assert p["count"] == 2
+    assert p["causes"].get("changed") == 1
+    # the diff names the ONE churning argument: the batch input
+    assert p["churn"] == {"data": 1}
+
+
+def test_storm_warning_fires_once_and_names_argument(monkeypatch):
+    monkeypatch.setenv("MXNET_COMPILE_STORM_K", "3")
+    compile_watch.enable()
+    with warnings.catch_warnings(record=True) as wlog:
+        warnings.simplefilter("always")
+        for batch in (3, 5, 7, 9, 11):   # forced shape churn
+            ex = _bind_fc(batch)
+            ex.forward(is_train=False)
+    storms = [w for w in wlog if "recompile storm" in str(w.message)]
+    assert len(storms) == 1, "storm warning must fire exactly once"
+    msg = str(storms[0].message)
+    assert "executor:fwd:eval" in msg and "'data'" in msg
+    s = compile_watch.stats()["storms"]
+    assert len(s) == 1 and s[0]["arg"] == "data"
+
+
+def test_rebinds_without_arg_churn_do_not_storm():
+    """Binding N same-shaped models (ensemble / CV folds / eval
+    clones) produces N first_compile/rebind compiles of one site with
+    no churning argument — setup cost, not a storm."""
+    compile_watch.enable()
+    with warnings.catch_warnings(record=True) as wlog:
+        warnings.simplefilter("always")
+        for _ in range(5):
+            ex = _bind_fc(3)
+            ex.forward(is_train=False)
+    assert not [w for w in wlog
+                if "recompile storm" in str(w.message)]
+    assert compile_watch.stats()["storms"] == []
+
+
+def test_distinct_models_at_one_site_do_not_storm(monkeypatch):
+    """Binding DIFFERENT architectures at one site (sweep/ensemble)
+    changes the argument SET, not any one argument's signature — the
+    cause reads 'rebound', and no storm fires."""
+    monkeypatch.setenv("MXNET_COMPILE_STORM_K", "3")
+    compile_watch.enable()
+    with warnings.catch_warnings(record=True) as wlog:
+        warnings.simplefilter("always")
+        for depth in (1, 2, 3, 1, 2, 3):
+            data = mx.sym.var("data")
+            x = data
+            args = {"data": mx.nd.array(np.ones((4, 6), np.float32))}
+            width = 6
+            for d in range(depth):
+                name = "fc%d" % d
+                x = mx.sym.FullyConnected(x, num_hidden=4, name=name)
+                args[name + "_weight"] = mx.nd.array(
+                    np.zeros((4, width), np.float32))
+                args[name + "_bias"] = mx.nd.array(
+                    np.zeros((4,), np.float32))
+                width = 4
+            x.bind(mx.cpu(), args).forward(is_train=False)
+    assert not [w for w in wlog
+                if "recompile storm" in str(w.message)]
+    causes = compile_watch.stats()["programs"]["executor:fwd:eval"][
+        "causes"]
+    assert causes.get("rebound", 0) >= 2
+
+
+def test_one_time_zeros_specializations_do_not_storm():
+    """Parameter-init style polymorphism (one _zeros compile per shape)
+    is specialization, not churn — no storm, no churn attribution."""
+    compile_watch.enable()
+    with warnings.catch_warnings(record=True) as wlog:
+        warnings.simplefilter("always")
+        for shape in ((2,), (3, 3), (4, 4, 4), (5,), (6, 2)):
+            mx.nd.zeros(shape).asnumpy()
+    assert not [w for w in wlog
+                if "recompile storm" in str(w.message)]
+    assert compile_watch.stats()["storms"] == []
+
+
+# ---------------------------------------------------------------------------
+# MFU math
+# ---------------------------------------------------------------------------
+
+def test_mfu_against_hand_computed_matmul_flops(monkeypatch):
+    """A (8,16)@(16,4) matmul is exactly 2*8*16*4 = 1024 flops in
+    XLA's cost model; the utilization record's MFU must equal
+    flops / (step_seconds * peak * n_devices) for the overridden
+    peak."""
+    peak = 1e9
+    monkeypatch.setenv("MXNET_DEVICE_PEAK_FLOPS", str(peak))
+    compile_watch.enable()
+    a = mx.nd.array(np.ones((8, 16), np.float32))
+    b = mx.nd.array(np.ones((16, 4), np.float32))
+
+    telemetry.start()
+    telemetry.step_begin()
+    mx.nd.dot(a, b).asnumpy()
+    rec = telemetry.step_end()
+    summary = telemetry.stop()
+
+    run_records = [r for r in (telemetry._last_run.records or [])
+                   if r.get("type") == "utilization"]
+    assert len(run_records) == 1
+    util = run_records[0]
+    assert util["flops"] == 2 * 8 * 16 * 4
+    n_dev = compile_watch.stats()["n_devices"]
+    expect = util["flops"] / ((rec["dur_ms"] / 1e3) * peak * n_dev)
+    assert util["mfu"] == pytest.approx(expect, rel=1e-3)
+    assert summary["utilization"]["mfu"]["samples"] == 1
+    assert summary["utilization"]["peak_flops"] == peak
+
+
+def test_step_without_watched_dispatch_emits_no_utilization():
+    compile_watch.enable()
+    telemetry.start()
+    telemetry.step_begin()
+    telemetry.step_end()
+    telemetry.stop()
+    assert not [r for r in telemetry._last_run.records
+                if r.get("type") == "utilization"]
+
+
+def test_prestep_backlog_never_inflates_first_step(monkeypatch):
+    """Dispatches before the first step (warmup/init) and work from a
+    previous run are dropped at run start / step_begin — the first
+    step's utilization counts only its own dispatch."""
+    monkeypatch.setenv("MXNET_DEVICE_PEAK_FLOPS", "1e9")
+    compile_watch.enable()
+    a = mx.nd.array(np.ones((8, 16), np.float32))
+    b = mx.nd.array(np.ones((16, 4), np.float32))
+    for _ in range(5):                       # pre-run backlog
+        mx.nd.dot(a, b).asnumpy()
+    telemetry.start()
+    mx.nd.dot(a, b).asnumpy()                # pre-step backlog
+    telemetry.step_begin()
+    mx.nd.dot(a, b).asnumpy()                # the step's real work
+    telemetry.step_end()
+    summary = telemetry.stop()
+    utils = [r for r in telemetry._last_run.records
+             if r.get("type") == "utilization"]
+    assert len(utils) == 1
+    assert utils[0]["dispatches"] == 1
+    assert utils[0]["flops"] == 2 * 8 * 16 * 4
+    # and the summary's utilization block is THIS run's, not lifetime
+    assert summary["utilization"]["mfu"]["samples"] == 1
+    assert summary["utilization"]["total_flops"] == 2 * 8 * 16 * 4
+
+
+# ---------------------------------------------------------------------------
+# JSONL round trip through diagnose
+# ---------------------------------------------------------------------------
+
+def test_diagnose_renders_compile_and_utilization_tables(tmp_path,
+                                                         capsys):
+    compile_watch.enable()
+    sink = str(tmp_path / "run.jsonl")
+    _fit_once(sink=sink)
+    text = diagnose.format_telemetry(diagnose.read_telemetry(sink))
+    assert "----------Compilation----------" in text
+    assert "fused_step:module" in text
+    assert "TOTAL" in text
+    assert "fused-step cache:" in text
+    assert "----------Utilization----------" in text
+    assert "MFU p50" in text
+    # and through the CLI entry point
+    diagnose.main([sink])
+    out = capsys.readouterr().out
+    assert "----------Compilation----------" in out
+    assert "MFU p50" in out
+
+
+def test_diagnose_off_run_has_no_new_tables(tmp_path):
+    sink = str(tmp_path / "plain.jsonl")
+    _fit_once(sink=sink)
+    text = diagnose.format_telemetry(diagnose.read_telemetry(sink))
+    assert "Compilation" not in text
+    assert "Utilization" not in text
+
+
+def test_diagnose_zero_step_run_message(tmp_path):
+    """A sink with compiles but no steps (crash before step 1 /
+    compile-only run) renders a clear message instead of degenerate
+    tables."""
+    sink = str(tmp_path / "nostep.jsonl")
+    with open(sink, "w") as f:
+        f.write(json.dumps({"type": "run_start", "run_id": "r0",
+                            "time": 0.0, "meta": {}}) + "\n")
+        for i in range(2):
+            f.write(json.dumps({"type": "compile",
+                                "program": "executor:fwd:train",
+                                "n": i + 1, "dur_ms": 12.5,
+                                "cause": "first_compile"}) + "\n")
+    text = diagnose.format_telemetry(diagnose.read_telemetry(sink))
+    assert "run recorded 2 compile(s) but no steps" in text
+    assert "----------Compilation----------" in text
+    assert "executor:fwd:train" in text
+
+
+def test_diagnose_empty_run_still_plain_message(tmp_path):
+    sink = str(tmp_path / "empty.jsonl")
+    with open(sink, "w") as f:
+        f.write(json.dumps({"type": "run_start", "run_id": "r0",
+                            "time": 0.0, "meta": {}}) + "\n")
+    text = diagnose.format_telemetry(diagnose.read_telemetry(sink))
+    assert "no step records" in text
+    assert "run recorded" not in text
+
+
+# ---------------------------------------------------------------------------
+# Speedometer MFU column
+# ---------------------------------------------------------------------------
+
+def _speedometer_lines(caplog):
+    speed = mx.callback.Speedometer(batch_size=8, frequent=2,
+                                    auto_reset=False)
+    with caplog.at_level(logging.INFO):
+        for nbatch in range(1, 5):
+            speed(BatchEndParam(epoch=0, nbatch=nbatch,
+                                eval_metric=None, locals=None))
+    return [r.getMessage() for r in caplog.records
+            if "samples/sec" in r.getMessage()]
+
+
+def test_speedometer_appends_mfu_when_available(caplog,
+                                                monkeypatch):
+    monkeypatch.setenv("MXNET_DEVICE_PEAK_FLOPS", "1e9")
+    compile_watch.enable()
+    telemetry.start()
+    a = mx.nd.array(np.ones((8, 16), np.float32))
+    b = mx.nd.array(np.ones((16, 4), np.float32))
+    for _ in range(3):
+        telemetry.step_begin()
+        mx.nd.dot(a, b).asnumpy()
+        telemetry.step_end(samples=8)
+    lines = _speedometer_lines(caplog)
+    telemetry.stop()
+    assert lines and all("MFU: " in ln for ln in lines)
+
+
+def test_speedometer_unchanged_when_watch_off(caplog):
+    telemetry.start()
+    telemetry.step_begin()
+    telemetry.step_end(samples=8)
+    lines = _speedometer_lines(caplog)
+    telemetry.stop()
+    assert lines and all("MFU" not in ln for ln in lines)
+
+
+# ---------------------------------------------------------------------------
+# monitor-forced-eager note
+# ---------------------------------------------------------------------------
+
+def test_monitor_fallback_noted_once(tmp_path):
+    """Installed monitors silently force the fused step back to eager
+    (PR 2 fallback matrix); the run must carry a one-time note so
+    diagnose can explain why the run was eager."""
+    sink = str(tmp_path / "mon.jsonl")
+    telemetry.start(filename=sink)
+    mod = mx.module.Module(_mlp_sym(), context=mx.cpu())
+    mod.bind(data_shapes=[("data", (8, 6))],
+             label_shapes=[("softmax_label", (8,))])
+    mod.init_params()
+    mod.init_optimizer(optimizer="sgd")
+    mod._exec.set_monitor_callback(lambda *a: None)
+    batch = mx.io.DataBatch(
+        data=[mx.nd.array(np.ones((8, 6), np.float32))],
+        label=[mx.nd.array(np.zeros((8,), np.float32))])
+    for _ in range(3):
+        mod.forward(batch, is_train=True)
+        mod.backward()
+        mod.update()
+    summary = telemetry.stop()
+    assert summary["events"]["fused_step_eager_monitor"] == 1
+    text = diagnose.format_telemetry(diagnose.read_telemetry(sink))
+    assert "fused_step_eager_monitor" in text
+
+
+# ---------------------------------------------------------------------------
+# degradation safety valve
+# ---------------------------------------------------------------------------
+
+def test_kwarg_calls_bypass_staging():
+    """A watched function called with kwargs (nothing in-tree does,
+    but the wrapper must not crash on it) delegates to plain jit."""
+    compile_watch.enable()
+    fn = compile_watch.jit(lambda x, y=1.0: x + y, "test:kwargs")
+    out = fn(np.float32(1.0), y=np.float32(2.0))
+    assert float(out) == 3.0
+    assert "test:kwargs" not in compile_watch.stats()["programs"]
