@@ -847,6 +847,122 @@ def _input_pipeline_record():
     return record
 
 
+def _bench_checkpoint_case(build_sym, data_shape, steps=60, warmup=5,
+                           ckpt_every=5, rounds=3):
+    """Train-step time with checkpointing OFF vs SYNC (the durable
+    write on the training thread, MXNET_ASYNC_CHECKPOINT=0 path) vs
+    ASYNC (snapshot + bounded enqueue on the training thread, durable
+    write on the background writer). Every mode runs the same fit-style
+    save cadence (params host-sync + optimizer-state pickle + manager
+    save every ``ckpt_every`` steps); per-step wall times are kept so
+    the p99 — which is where a blocking save lands — is the headline.
+    Rounds are interleaved (off, sync, async, ...) and each mode keeps
+    its best (lowest-p99) round so host-load noise hits all three
+    symmetrically. The acceptance bar: async p99 impact strictly below
+    the synchronous path's."""
+    import shutil
+    import tempfile
+
+    import numpy as np_
+    import mxnet_tpu as mx
+    from mxnet_tpu.checkpoint import CheckpointManager
+    from mxnet_tpu.telemetry import percentile
+
+    rng = np_.random.RandomState(0)
+    batch = mx.io.DataBatch(
+        data=[mx.nd.array(
+            rng.uniform(0, 1, data_shape).astype(np_.float32))],
+        label=[mx.nd.array(
+            rng.randint(0, 10, (data_shape[0],)).astype(np_.float32))])
+
+    mod = mx.module.Module(build_sym(), context=mx.current_context())
+    mod.bind(data_shapes=[("data", data_shape)],
+             label_shapes=[("softmax_label", (data_shape[0],))])
+    mod.init_params(initializer=mx.init.Xavier())
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.05,
+                                         "momentum": 0.9})
+    for _ in range(warmup):
+        mod.forward_backward(batch)
+        mod.update()
+    _sync_module(mod)
+
+    workdir = tempfile.mkdtemp(prefix="bench_ckpt_")
+
+    def run_round(mode, tag):
+        mgr = None
+        if mode != "off":
+            mgr = CheckpointManager(
+                os.path.join(workdir, "%s_%s" % (mode, tag)),
+                async_=(mode == "async"))
+        durs = []
+        epoch = 0
+        for i in range(steps):
+            t0 = time.perf_counter()
+            mod.forward_backward(batch)
+            mod.update()
+            if mgr is not None and (i + 1) % ckpt_every == 0:
+                args_, auxs_ = mod.get_params()
+                mgr.save(epoch, args_, auxs_,
+                         states_bytes=mod._optimizer_state_bytes())
+                epoch += 1
+            durs.append((time.perf_counter() - t0) * 1e3)
+        _sync_module(mod)
+        if mgr is not None:
+            mgr.close()
+            assert mgr.stats()["failures"] == 0
+        return durs
+
+    best = {}
+    try:
+        for r in range(rounds):
+            for mode in ("off", "sync", "async"):
+                durs = run_round(mode, "r%d" % r)
+                if mode not in best or percentile(durs, 99) \
+                        < percentile(best[mode], 99):
+                    best[mode] = durs
+    finally:
+        shutil.rmtree(workdir, ignore_errors=True)
+
+    out = {"steps": steps, "ckpt_every": ckpt_every,
+           "batch": data_shape[0]}
+    for mode, durs in best.items():
+        total_s = sum(durs) / 1e3
+        out["%s_steps_per_sec" % mode] = round(steps / total_s, 2)
+        out["%s_p50_ms" % mode] = round(percentile(durs, 50), 3)
+        out["%s_p99_ms" % mode] = round(percentile(durs, 99), 3)
+    for mode in ("sync", "async"):
+        out["%s_p99_impact_pct" % mode] = round(
+            100.0 * (out["%s_p99_ms" % mode] / out["off_p99_ms"] - 1.0),
+            2)
+    out["async_p99_below_sync"] = bool(
+        out["async_p99_ms"] < out["sync_p99_ms"])
+    return out
+
+
+def _checkpoint_record():
+    """The checkpoint-overhead benchmark record (BENCH_r10.json).
+    CPU-friendly — runs wherever the tier-1 suite runs."""
+    import jax
+    record = {"metric": "checkpoint_overhead", "unit": "ms/step (p99)",
+              "dtype": "float32", "optimizer": "sgd_momentum",
+              "platform": jax.default_backend(), "cases": {}}
+    errors = {}
+    try:
+        record["cases"]["mlp"] = _bench_checkpoint_case(
+            _mlp_sym, (64, 784))
+    except Exception as exc:                     # noqa: BLE001
+        errors["mlp"] = _err_str(exc)
+    try:
+        record["cases"]["convnet"] = _bench_checkpoint_case(
+            _convnet_sym, (32, 1, 28, 28))
+    except Exception as exc:                     # noqa: BLE001
+        errors["convnet"] = _err_str(exc)
+    if errors:
+        record["errors"] = errors
+    return record
+
+
 def _err_str(exc):
     return "%s: %s" % (type(exc).__name__, str(exc)[:400])
 
@@ -975,5 +1091,10 @@ if __name__ == "__main__":
         # CPU-friendly standalone mode: compile-watch-off vs -on fused
         # MLP train-step time, one JSON line (the BENCH_r09 artifact)
         print(json.dumps(_compile_watch_record()))
+    elif "--checkpoint-overhead" in sys.argv:
+        # CPU-friendly standalone mode: step-time p99 with
+        # checkpointing off vs sync vs async on the MLP and convnet
+        # cases, one JSON line (the BENCH_r10 artifact)
+        print(json.dumps(_checkpoint_record()))
     else:
         main()

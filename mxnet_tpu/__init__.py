@@ -82,6 +82,7 @@ from . import visualization as viz
 from . import profiler
 from . import telemetry
 from . import compile_watch
+from . import checkpoint
 from . import model
 from . import rnn
 from . import storage

@@ -54,8 +54,11 @@ __all__ = ["FaultPlan", "InjectedFault", "InjectedHang",
 
 _ACTIONS = ("raise", "hang", "nan", "inf")
 # the wired injection points; a typo'd site would otherwise make a
-# chaos run silently test nothing
-_SITES = ("push", "pull", "allreduce", "wait", "init", "grad")
+# chaos run silently test nothing. ckpt_write/ckpt_fsync sit inside
+# checkpoint.atomic_write_file so a planned fault can abort or stall a
+# save at an exact file boundary (torn-write / slow-disk testing).
+_SITES = ("push", "pull", "allreduce", "wait", "init", "grad",
+          "ckpt_write", "ckpt_fsync")
 # corruption needs a value to corrupt — only the grad site carries one
 _VALUE_SITES = ("grad",)
 _GUARD_POLICIES = ("skip_step", "scale_backoff")
@@ -177,7 +180,9 @@ _jitter_rng = random.Random(0)
 
 def _fresh_stats():
     return {"skipped_steps": 0, "retries": 0, "timeouts": 0,
-            "injected": {}, "resumed_from_epoch": None}
+            "injected": {}, "resumed_from_epoch": None,
+            "clean_resumes": 0, "rollback_resumes": 0,
+            "rollback_epochs": 0}
 
 
 _stats = _fresh_stats()
@@ -602,9 +607,27 @@ def fused_step_guard(all_finite):
 # stats
 # ---------------------------------------------------------------------------
 
-def note_resume(epoch):
+def note_resume(epoch, skipped_epochs=0):
+    """Record a checkpoint resume. ``skipped_epochs`` counts newer
+    epochs the scan rejected (torn shards, corrupt params or corrupt
+    sibling optimizer state) before settling on ``epoch`` — a
+    *rollback* resume loses their steps; a clean resume loses none.
+    tools.diagnose reconciles the rollback against the run's goodput."""
+    skipped_epochs = int(skipped_epochs)
     with _lock:
         _stats["resumed_from_epoch"] = epoch
+        if skipped_epochs > 0:
+            _stats["rollback_resumes"] += 1
+            _stats["rollback_epochs"] += skipped_epochs
+        else:
+            _stats["clean_resumes"] += 1
+    if skipped_epochs > 0:
+        from . import telemetry
+        telemetry.note("resume_rollback_epochs", skipped_epochs)
+        # the epoch training actually restarts from — the run's meta
+        # begin_epoch was recorded before the resume bumped it, so
+        # diagnose needs this to compute the epochs really trained
+        telemetry.note("resume_next_epoch", int(epoch) + 1)
 
 
 def stats():

@@ -301,18 +301,37 @@ class Trainer:
     _update = _apply_updates
 
     # -- optimizer-state checkpointing ------------------------------------
-    def save_states(self, fname):
+    def save_states(self, fname, background=False):
+        """Durably write the optimizer state (tmp + fsync +
+        ``os.replace`` through ``mxnet_tpu.checkpoint``, so the write
+        is fault-injectable at ``ckpt_write``/``ckpt_fsync`` and a
+        kill mid-save never strands a torn file). The pickle snapshot
+        always happens here, on the calling thread (state buffers are
+        replaced per step); ``background=True`` hands the durable
+        write itself to the shared checkpoint writer thread —
+        ``mxnet_tpu.checkpoint.flush_async_writes()`` blocks until it
+        lands and raises on a write that failed (the deferred
+        equivalent of the exception the synchronous path would have
+        raised here)."""
         if self._optimizer is None:
             raise AssertionError("no optimizer to save")
         if not self._kv_initialized:
             self._init_kvstore()
+        from .. import checkpoint as ckpt
         if self._update_on_kvstore and self._kvstore is not None:
-            self._kvstore.save_optimizer_states(fname,
-                                                dump_optimizer=True)
-            return
-        from ..base import atomic_write_bytes
-        atomic_write_bytes(
-            fname, self._updaters[0].get_states(dump_optimizer=True))
+            # same durable/async write as the local-updater path — the
+            # kvstore only supplies the state bytes
+            updater = getattr(self._kvstore, '_updater', None)
+            assert updater is not None, \
+                "Cannot save states for distributed training " \
+                "without updater"
+            payload = updater.get_states(dump_optimizer=True)
+        else:
+            payload = self._updaters[0].get_states(dump_optimizer=True)
+        if background:
+            ckpt.write_bytes_async(fname, payload)
+        else:
+            ckpt.atomic_write_file(fname, payload)
 
     def load_states(self, fname):
         if not self._kv_initialized:

@@ -58,10 +58,27 @@ from .io import DataBatch, DataIter
 
 __all__ = ["AsyncInputPipeline", "data_workers", "pipeline_enabled",
            "placement_for_module", "make_sharded_pipeline",
-           "place_batch"]
+           "place_batch", "stop_aware_put"]
 
 _SENTINEL = object()      # end-of-epoch marker
 _PUT_TICK = 0.05          # stop-aware put poll interval (seconds)
+
+
+def stop_aware_put(q, item, stop, tick=_PUT_TICK):
+    """Bounded put that gives up when ``stop`` fires, so a full queue
+    can never wedge a producer thread past shutdown. Returns False
+    when the put was abandoned. The one copy of the discipline every
+    off-critical-path background stage uses (this pipeline's decode/
+    placer threads; ``checkpoint.py``'s writer keeps the plain
+    blocking put because its queue-full state IS the intended
+    backpressure on the training thread)."""
+    while not stop.is_set():
+        try:
+            q.put(item, timeout=tick)
+            return True
+        except queue.Full:
+            continue
+    return False
 
 
 def data_workers(default=2):
@@ -319,17 +336,7 @@ class AsyncInputPipeline(DataIter):
         placer.start()
 
     def _stop_aware_put(self, q, item):
-        """Bounded put that gives up when the stop event fires, so a
-        full queue can never wedge a worker past shutdown. Returns
-        False when the put was abandoned."""
-        stop = self._stop
-        while not stop.is_set():
-            try:
-                q.put(item, timeout=_PUT_TICK)
-                return True
-            except queue.Full:
-                continue
-        return False
+        return stop_aware_put(q, item, self._stop)
 
     def _scheduler(self):
         """Stage-1 driver: pull work from the source IN ORDER (the

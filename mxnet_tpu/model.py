@@ -117,7 +117,23 @@ def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params,
 
 
 def load_params(prefix, epoch):
-    save_dict = nd.load('%s-%04d.params' % (prefix, epoch))
+    """Load one epoch's parameters. A manifest checkpoint (the async
+    sharded writer, ``mxnet_tpu.checkpoint``) is re-assembled from its
+    checksummed shard files — torn artifacts raise instead of loading
+    silently; a PR 1-era single file loads through the legacy path
+    unchanged."""
+    from . import checkpoint as ckpt
+    if ckpt.load_manifest(prefix, epoch) is not None:
+        save_dict = ckpt.load_arrays(prefix, epoch)
+    else:
+        save_dict = nd.load('%s-%04d.params' % (prefix, epoch))
+        if any(ckpt._PIECE_SEP in k for k in save_dict):
+            # piece keys mean a sharded save whose manifest never
+            # landed (killed between shard and manifest writes):
+            # loading shard 0 alone would silently drop parameters
+            raise MXNetError(
+                'checkpoint %s-%04d.params holds shard pieces but no '
+                'manifest (torn sharded save)' % (prefix, epoch))
     arg_params = {}
     aux_params = {}
     for k, v in save_dict.items():
@@ -149,18 +165,53 @@ def list_checkpoint_epochs(prefix):
     return sorted(epochs)
 
 
+def _validate_sibling_states(prefix, epoch):
+    """A param file whose sibling optimizer-state file is corrupt must
+    reject the whole epoch (resuming with params but silently fresh
+    optimizer state is a trajectory change, not a resume). Missing
+    states are fine — the save simply didn't include them. Manifest
+    epochs checksum their states inside the manifest (verified by
+    ``checkpoint.load_arrays`` during the load itself); this is the
+    legacy-epoch equivalent (a full pickle parse), skipped when a
+    manifest exists so the states file is not parsed twice."""
+    import pickle
+    from . import checkpoint as ckpt
+    if ckpt.load_manifest(prefix, epoch) is not None:
+        return
+    states_file = '%s-%04d.states' % (prefix, epoch)
+    if not os.path.isfile(states_file):
+        return
+    with open(states_file, 'rb') as src:
+        pickle.loads(src.read())
+
+
 def load_latest_valid_checkpoint(prefix):
     """Newest checkpoint under ``prefix`` that loads cleanly, as
-    ``(epoch, arg_params, aux_params)``; corrupt or partial param files
-    (a preempted non-atomic writer, a torn copy) are skipped with a
-    warning and the scan falls back to the next older epoch. Returns
-    None when nothing usable exists."""
-    for epoch in reversed(list_checkpoint_epochs(prefix)):
+    ``(epoch, arg_params, aux_params)``; corrupt or partial artifacts
+    (a torn shard from a killed writer, a preempted non-atomic copy,
+    a corrupt sibling optimizer-state file) reject the whole epoch
+    with a warning and the scan falls back to the next older one.
+    Manifest epochs (``mxnet_tpu.checkpoint``) are checksum-verified;
+    legacy epochs are validated by loading. Returns None when nothing
+    usable exists; :func:`latest_checkpoint_scan` additionally reports
+    how many newer epochs were rejected (the rollback depth)."""
+    found = latest_checkpoint_scan(prefix)
+    return None if found is None else found[:3]
+
+
+def latest_checkpoint_scan(prefix):
+    """Like :func:`load_latest_valid_checkpoint` but returns
+    ``(epoch, arg_params, aux_params, skipped_epochs)`` so the resume
+    path can account a rollback (``fault.note_resume``) — the steps of
+    every skipped newer epoch are lost work."""
+    epochs = list_checkpoint_epochs(prefix)
+    for pos, epoch in enumerate(reversed(epochs)):
         try:
+            _validate_sibling_states(prefix, epoch)
             arg_params, aux_params = load_params(prefix, epoch)
-            return (epoch, arg_params, aux_params)
+            return (epoch, arg_params, aux_params, pos)
         except Exception as exc:
             logging.warning(
-                'skipping corrupt/partial checkpoint %s-%04d.params '
+                'skipping corrupt/partial checkpoint %s-%04d '
                 '(%s: %s)', prefix, epoch, type(exc).__name__, exc)
     return None

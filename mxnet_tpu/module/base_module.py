@@ -146,7 +146,7 @@ class BaseModule:
         files are skipped with a warning) and return
         (next_epoch, arg_params, aux_params), or None when nothing
         usable exists."""
-        from ..model import load_latest_valid_checkpoint
+        from ..model import latest_checkpoint_scan
         from .. import fault
         prefix = resume_from_checkpoint \
             if isinstance(resume_from_checkpoint, str) else checkpoint_prefix
@@ -154,15 +154,19 @@ class BaseModule:
             raise ValueError(
                 'resume_from_checkpoint needs a prefix: pass '
                 'checkpoint_prefix=... or resume_from_checkpoint="<prefix>"')
-        found = load_latest_valid_checkpoint(prefix)
+        found = latest_checkpoint_scan(prefix)
         if found is None:
             self.logger.info(
                 'fit: no usable checkpoint under %s; starting fresh',
                 prefix)
             return None
-        epoch, args, auxs = found
+        epoch, args, auxs, skipped = found
         self._stage_resume_opt_states('%s-%04d.states' % (prefix, epoch))
-        fault.note_resume(epoch)
+        fault.note_resume(epoch, skipped_epochs=skipped)
+        if skipped:
+            self.logger.warning(
+                'fit: rolled back past %d corrupt newer epoch(s); '
+                'their steps are lost work (fault.stats())', skipped)
         self.logger.info(
             'fit: resuming from checkpoint %s-%04d.params at epoch %d',
             prefix, epoch, epoch + 1)
@@ -203,12 +207,19 @@ class BaseModule:
 
         Fault tolerance extensions (see README "Fault tolerance"):
         ``checkpoint_prefix`` saves an atomic epoch-granularity
-        checkpoint every ``checkpoint_period`` epochs, and
+        checkpoint every ``checkpoint_period`` epochs — asynchronously
+        sharded via ``mxnet_tpu.checkpoint`` (manifest + checksummed
+        per-shard files written off the step critical path;
+        ``MXNET_ASYNC_CHECKPOINT=0`` for the blocking path) — and
         ``resume_from_checkpoint=True`` (or an explicit prefix string)
-        scans that prefix for the latest epoch whose params validate,
-        loads them, and continues from the following epoch — corrupt or
-        truncated files are skipped with a warning. Non-finite-gradient
-        skip counts accumulate in ``mxnet_tpu.fault.stats()``.
+        scans that prefix for the latest epoch whose artifacts
+        checksum/validate, loads them against the *current* device
+        topology, and continues from the following epoch — torn or
+        corrupt epochs (including a corrupt sibling optimizer-state
+        file) are rolled past with a warning and accounted in
+        ``fault.stats()`` (clean vs rollback resumes).
+        Non-finite-gradient skip counts accumulate in
+        ``mxnet_tpu.fault.stats()``.
 
         Observability (see README "Observability"): with telemetry
         enabled (``MXNET_TELEMETRY``/``MXNET_TELEMETRY_FILE`` or an
@@ -239,6 +250,7 @@ class BaseModule:
         # error (bad optimizer name, bind shape mismatch) would
         # otherwise leak the run this fit owns
         owned_pipeline = None
+        ckpt_mgr = None
         try:
             if resume_from_checkpoint:
                 resumed = self._resume_point(resume_from_checkpoint,
@@ -325,16 +337,25 @@ class BaseModule:
                 self.set_params(arg_params, aux_params)
                 if checkpoint_prefix is not None and \
                         (epoch + 1) % max(checkpoint_period, 1) == 0:
-                    with telemetry.span("checkpoint"):
-                        from ..model import save_checkpoint as _save_ckpt
-                        _save_ckpt(checkpoint_prefix, epoch, self.symbol,
-                                   arg_params, aux_params)
-                        if getattr(self, 'optimizer_initialized',
-                                   False) and \
-                                hasattr(self, 'save_optimizer_states'):
-                            self.save_optimizer_states(
-                                '%s-%04d.states' % (checkpoint_prefix,
-                                                    epoch))
+                    # async sharded checkpointing (checkpoint.py):
+                    # snapshot is a reference grab, the durable write
+                    # runs on the manager's background thread unless
+                    # MXNET_ASYNC_CHECKPOINT=0 — either way the save
+                    # lands as checksummed shard files + a manifest
+                    # the resume scan validates
+                    if ckpt_mgr is None:
+                        from ..checkpoint import CheckpointManager
+                        ckpt_mgr = CheckpointManager(
+                            checkpoint_prefix, symbol=self.symbol,
+                            logger=self.logger)
+                    states = None
+                    if getattr(self, 'optimizer_initialized', False):
+                        to_bytes = getattr(
+                            self, '_optimizer_state_bytes', None)
+                        states = to_bytes() if to_bytes is not None \
+                            else None
+                    ckpt_mgr.save(epoch, arg_params, aux_params,
+                                  states_bytes=states)
                 if epoch_end_callback is not None:
                     for callback in _as_list(epoch_end_callback):
                         callback(epoch, self.symbol, arg_params,
@@ -360,6 +381,10 @@ class BaseModule:
                         'non-finite gradient guard (fault.stats())',
                         skipped)
         finally:
+            if ckpt_mgr is not None:
+                # drain in-flight saves so a resume scan right after
+                # fit() sees the final epoch's manifest
+                ckpt_mgr.close()
             if owned_pipeline is not None:
                 owned_pipeline.close()
             if owns_telemetry:

@@ -90,20 +90,35 @@ class Module(BaseModule):
 
     def save_checkpoint(self, prefix, epoch, save_optimizer_states=False,
                         remove_amp_cast=True):
-        # every piece is written atomically (tmp + os.replace in
-        # Symbol.save / nd.save / base.atomic_write_bytes) so a
-        # preempted save never strands a truncated file
+        """One durable checkpoint through ``mxnet_tpu.checkpoint``:
+        checksummed shard files + a manifest written last (tmp + fsync
+        + ``os.replace`` each), so a kill mid-save is detected by the
+        resume scan instead of silently loading a torn file. The
+        optimizer-state file gets the identical atomic write and its
+        checksum rides in the manifest — a corrupt sibling rejects the
+        epoch at resume rather than resuming with fresh state. Shard 0
+        keeps the legacy ``prefix-%04d.params`` name/format, so older
+        loaders keep working."""
         from .. import telemetry
+        from ..checkpoint import save_arrays, snapshot_params
         with telemetry.span("checkpoint"):
             self._symbol.save('%s-symbol.json' % prefix)
-            param_file = '%s-%04d.params' % (prefix, epoch)
-            self.save_params(param_file)
-            logging.info('Saved checkpoint to \"%s\"', param_file)
+            arg_params, aux_params = self.get_params()
+            states = None
             if save_optimizer_states:
-                state_file = '%s-%04d.states' % (prefix, epoch)
-                self.save_optimizer_states(state_file)
-                logging.info('Saved optimizer state to \"%s\"',
-                             state_file)
+                assert self.optimizer_initialized
+                states = self._optimizer_state_bytes()
+                assert states is not None, \
+                    "Cannot save states for distributed training " \
+                    "without updater"
+            save_arrays(prefix, epoch,
+                        snapshot_params(arg_params, aux_params),
+                        states_bytes=states)
+            logging.info('Saved checkpoint to "%s-%04d.params"',
+                         prefix, epoch)
+            if save_optimizer_states:
+                logging.info('Saved optimizer state to "%s-%04d'
+                             '.states"', prefix, epoch)
 
     # -- properties --------------------------------------------------------
     data_names = property(lambda self: self._data_names)
@@ -548,13 +563,29 @@ class Module(BaseModule):
         mon.install(self._exec)
 
     # -- optimizer state serialization --------------------------------------
+    def _optimizer_state_bytes(self):
+        """The serialized optimizer state for a checkpoint save — the
+        one snapshot that must happen on the training thread (state
+        buffers are replaced in place per step, so the async writer
+        cannot defer this pickle). None before init_optimizer."""
+        if not self.optimizer_initialized:
+            return None
+        if self._update_on_kvstore:
+            ensure = getattr(self._kvstore, '_ensure_updater', None)
+            if ensure is not None:
+                ensure()
+            updater = getattr(self._kvstore, '_updater', None)
+        else:
+            updater = self._updater
+        return updater.get_states() if updater is not None else None
+
     def save_optimizer_states(self, fname):
         assert self.optimizer_initialized
         if self._update_on_kvstore:
             self._kvstore.save_optimizer_states(fname)
             return
-        from ..base import atomic_write_bytes
-        atomic_write_bytes(fname, self._updater.get_states())
+        from ..checkpoint import atomic_write_file
+        atomic_write_file(fname, self._updater.get_states())
 
     def load_optimizer_states(self, fname):
         assert self.optimizer_initialized

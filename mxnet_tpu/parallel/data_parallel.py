@@ -42,9 +42,13 @@ def _put_unless_placed(value, sharding):
 
 def shard_params(params: Dict[str, Any], mesh, rules=None):
     """Place a name→array dict on the mesh. ``rules`` maps substring →
-    PartitionSpec; default replicates everything."""
+    PartitionSpec; default replicates everything. NDArray values are
+    unwrapped/rewrapped, so a checkpoint roster restored by
+    ``mxnet_tpu.checkpoint.restore_params`` re-places directly against
+    the current mesh regardless of the topology it was saved on."""
     import jax
     from jax.sharding import NamedSharding, PartitionSpec as P
+    from ..ndarray import NDArray
     rules = rules or {}
     out = {}
     for name, arr in params.items():
@@ -53,7 +57,12 @@ def shard_params(params: Dict[str, Any], mesh, rules=None):
             if pat in name:
                 spec = s
                 break
-        out[name] = jax.device_put(arr, NamedSharding(mesh, spec))
+        sharding = NamedSharding(mesh, spec)
+        if isinstance(arr, NDArray):
+            out[name] = NDArray(
+                _put_unless_placed(arr._data, sharding), ctx=arr._ctx)
+        else:
+            out[name] = _put_unless_placed(arr, sharding)
     return out
 
 

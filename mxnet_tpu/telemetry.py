@@ -59,9 +59,12 @@ phases_ms, samples, skipped, retries), ``memory`` (per-device bytes),
 ``summary`` (the :func:`report` dict, written at :func:`stop`) — plus,
 only when the compile watch is active (``mxnet_tpu.compile_watch``),
 ``compile`` (per-XLA-compile duration/cause/flops) and ``utilization``
-(per-step MFU / memory-bandwidth utilization). With the watch off
-those kinds never appear and the sink is byte-identical to a run
-without the subsystem.
+(per-step MFU / memory-bandwidth utilization), and, only when the
+checkpoint subsystem saves (``mxnet_tpu.checkpoint``), one
+``checkpoint`` record per save (epoch, bytes, snapshot/serialize/
+write/manifest sub-spans, blocking vs async split, last good epoch).
+With those subsystems unused the kinds never appear and the sink is
+byte-identical to a run without them.
 """
 from __future__ import annotations
 
@@ -77,7 +80,7 @@ __all__ = ["PHASES", "enabled", "start", "stop", "reset", "maybe_start",
            "step_begin", "step_end", "step_tick", "span", "comm",
            "comm_span", "h2d", "note", "recent_rate", "sample_memory",
            "flush", "report", "quick_stats", "percentile",
-           "external_record"]
+           "external_record", "checkpoint_event"]
 
 PHASES = ("data_wait", "compute", "optimizer", "sync", "checkpoint",
           "eval")
@@ -118,6 +121,7 @@ class _Run:
         self.open_phases = set()     # same-phase reentrancy guard
         self.pending_phases = {}     # phase -> seconds since boundary
         self.comms = {}              # (kind, key) -> calls/bytes/time_ms
+        self.ckpt = None             # checkpoint-save aggregates (lazy)
         self.fault_counters = {"skipped_steps": 0, "retries": 0,
                                "timeouts": 0}
         self.extra_counters = {}     # free-form note() names
@@ -570,6 +574,41 @@ def external_record(rec):
         run.records.append(dict(rec))
 
 
+def checkpoint_event(fields):
+    """Append one ``checkpoint`` record for a save performed by
+    ``mxnet_tpu.checkpoint`` (the writer thread calls this — record
+    appends are lock-protected, and off-thread is exactly why this is
+    a record + aggregate, not a span). Also rolls the save into the
+    run's checkpoint summary block (count, bytes, blocking vs async
+    milliseconds, failures, last good epoch). No-op without a run, so
+    a run that never checkpoints keeps a byte-identical sink."""
+    run = _run
+    if run is None:
+        return
+    rec = {"type": "checkpoint", "seq": run.steps,
+           "t": round(time.time() - run.t0_wall, 6)}
+    rec.update(fields)
+    with _lock:
+        agg = run.ckpt
+        if agg is None:
+            agg = run.ckpt = {"saves": 0, "failures": 0, "bytes": 0,
+                              "blocking_ms": 0.0, "async_ms": 0.0,
+                              "last_good_epoch": None}
+        if fields.get("ok"):
+            agg["saves"] += 1
+            agg["bytes"] += int(fields.get("bytes", 0) or 0)
+        else:
+            agg["failures"] += 1
+        agg["blocking_ms"] += float(fields.get("blocking_ms", 0.0) or 0)
+        agg["async_ms"] += float(fields.get("async_ms", 0.0) or 0)
+        last = fields.get("last_good_epoch")
+        if last is not None:
+            prev = agg["last_good_epoch"]
+            agg["last_good_epoch"] = last if prev is None \
+                else max(prev, last)
+        run.records.append(rec)
+
+
 def note(name, delta=1):
     """Count one resilience/bookkeeping event against the run.
     fault.py calls this at the exact branch points that advance its own
@@ -737,6 +776,11 @@ def report():
         }
         if run.extra_counters:
             out["events"] = dict(run.extra_counters)
+        if run.ckpt is not None:
+            ck = dict(run.ckpt)
+            ck["blocking_ms"] = round(ck["blocking_ms"], 3)
+            ck["async_ms"] = round(ck["async_ms"], 3)
+            out["checkpoint"] = ck
         if run.records_dropped:
             out["records_dropped"] = run.records_dropped
         total_s = run.total_step_s

@@ -13,10 +13,13 @@ skipped/retried, unified with ``fault.stats()``), memory watermarks,
 and per-key comms bytes/latency — plus, when the run was recorded with
 ``mxnet_tpu.compile_watch`` active, the compile log (per-program
 compile count/seconds/causes, recompile storms, the fused-step cache
-counters) and the hardware-utilization table (MFU and memory-bandwidth
-percentiles from the per-step ``utilization`` records). This
-supersedes scraping the same facts out of log lines with
-``tools/parse_log.py``.
+counters), the hardware-utilization table (MFU and memory-bandwidth
+percentiles from the per-step ``utilization`` records), and — when the
+run checkpointed through ``mxnet_tpu.checkpoint`` — the Checkpoints
+table (per-save bytes/duration, blocking vs async split, failed saves,
+last good epoch) plus the goodput line reconciling steps lost to a
+resume rollback. This supersedes scraping the same facts out of log
+lines with ``tools/parse_log.py``.
 """
 from __future__ import annotations
 
@@ -110,7 +113,7 @@ def read_telemetry(path):
     A sink holding several runs (consecutive fits appending to the
     same MXNET_TELEMETRY_FILE) yields the LAST run."""
     out = {"run": None, "steps": [], "memory": [], "compiles": [],
-           "utilization": [], "summary": None}
+           "utilization": [], "checkpoints": [], "summary": None}
     with open(path) as f:
         for line in f:
             line = line.strip()
@@ -124,7 +127,7 @@ def read_telemetry(path):
             if kind == "run_start":
                 out = {"run": rec, "steps": [], "memory": [],
                        "compiles": [], "utilization": [],
-                       "summary": None}
+                       "checkpoints": [], "summary": None}
             elif kind == "step":
                 out["steps"].append(rec)
             elif kind == "memory":
@@ -133,6 +136,8 @@ def read_telemetry(path):
                 out["compiles"].append(rec)
             elif kind == "utilization":
                 out["utilization"].append(rec)
+            elif kind == "checkpoint":
+                out["checkpoints"].append(rec)
             elif kind == "summary":
                 out["summary"] = rec
     return out
@@ -292,6 +297,50 @@ def format_telemetry(tel):
                 lines.append("sustained    : %s/s"
                              % _fmt_flops(tf / (sum(fdurs) / 1e3)))
 
+    # -- checkpoint saves (mxnet_tpu.checkpoint) ------------------------
+    ckpts = tel.get("checkpoints") or []
+    sum_ckpt = summary.get("checkpoint") or {}
+    if ckpts or sum_ckpt:
+        lines.append("----------Checkpoints----------")
+        lines.append("%5s %4s %12s %10s %10s %10s %7s"
+                     % ("epoch", "ok", "bytes", "total(ms)",
+                        "block(ms)", "async(ms)", "shards"))
+        for c in ckpts:
+            lines.append("%5s %4s %12d %10.1f %10.1f %10.1f %7s"
+                         % (c.get("epoch", "?"),
+                            "yes" if c.get("ok") else "NO",
+                            c.get("bytes", 0) or 0,
+                            c.get("total_ms", 0.0) or 0.0,
+                            c.get("blocking_ms", 0.0) or 0.0,
+                            c.get("async_ms", 0.0) or 0.0,
+                            c.get("shards", "-")))
+        blocking = sum_ckpt.get("blocking_ms") if sum_ckpt else None
+        if blocking is None:
+            blocking = sum(c.get("blocking_ms", 0.0) or 0.0
+                           for c in ckpts)
+        async_ms = sum_ckpt.get("async_ms") if sum_ckpt else None
+        if async_ms is None:
+            async_ms = sum(c.get("async_ms", 0.0) or 0.0 for c in ckpts)
+        total = blocking + async_ms
+        if total > 0:
+            lines.append("async share  : %.1f%% of %.1f ms save work "
+                         "ran off the training thread (blocking "
+                         "%.1f ms)" % (100.0 * async_ms / total, total,
+                                       blocking))
+        failures = sum_ckpt.get("failures",
+                                sum(1 for c in ckpts
+                                    if not c.get("ok")))
+        if failures:
+            lines.append("failed saves : %d (training continued; the "
+                         "previous good epoch stays the resume point)"
+                         % failures)
+        last_good = sum_ckpt.get("last_good_epoch")
+        if last_good is None and ckpts:
+            last_good = ckpts[-1].get("last_good_epoch")
+        lines.append("last good    : epoch %s" % (last_good
+                                                  if last_good is not None
+                                                  else "none"))
+
     lines.append("----------Goodput----------")
     skipped = sum(s.get("skipped", 0) for s in steps)
     retried = sum(s.get("retries", 0) for s in steps)
@@ -302,6 +351,24 @@ def format_telemetry(tel):
                  "retried ops %d)" % (n, productive, skipped, retried))
     if n:
         lines.append("goodput      : %.1f%%" % (100.0 * productive / n))
+    events = summary.get("events") or {}
+    rollback = events.get("resume_rollback_epochs")
+    if rollback:
+        # reconcile lost work with the rollback the resume scan took:
+        # steps/epoch comes from the run itself. The meta begin_epoch
+        # predates the resume bump, so prefer the resume_next_epoch
+        # event (the epoch training actually restarted from)
+        meta = run.get("meta") or {}
+        begin = events.get("resume_next_epoch",
+                           meta.get("begin_epoch"))
+        lost = ""
+        if n and meta.get("num_epoch") is not None \
+                and begin is not None:
+            epochs_run = max(int(meta["num_epoch"]) - int(begin), 1)
+            lost = " (~%d steps of lost work re-trained)" \
+                % (rollback * (n // epochs_run))
+        lines.append("rollback     : resume skipped %d corrupt newer "
+                     "epoch(s)%s" % (rollback, lost))
     if samples and durs:
         lines.append("samples/sec  : %.2f"
                      % (samples / (sum(durs) / 1e3)))

@@ -1,0 +1,493 @@
+"""Async sharded checkpointing + elastic resume (mxnet_tpu.checkpoint).
+
+Covers the robustness gate to preemptible-capacity training: durable
+manifest + per-shard artifacts (kill-mid-save leaves no manifest that
+references a torn shard), checksum-verified resume that rolls back past
+corrupt epochs, topology-elastic restore (save on one mesh, resume on
+another), bit-identical trajectories async vs sync, bounded-queue
+backpressure, and the telemetry ``checkpoint`` records rendered by
+``tools.diagnose``.
+"""
+import json
+import os
+
+import numpy as np
+import pytest
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+import mxnet_tpu as mx
+from mxnet_tpu import checkpoint as ck
+from mxnet_tpu import fault, telemetry
+from mxnet_tpu.model import (latest_checkpoint_scan,
+                             list_checkpoint_epochs,
+                             load_latest_valid_checkpoint)
+from mxnet_tpu.parallel.mesh import create_mesh
+
+
+@pytest.fixture(autouse=True)
+def _clean_state(monkeypatch):
+    monkeypatch.delenv("MXNET_FAULT_PLAN", raising=False)
+    monkeypatch.delenv("MXNET_ASYNC_CHECKPOINT", raising=False)
+    fault.reset()
+    telemetry.reset()
+    yield
+    fault.reset()
+    telemetry.reset()
+
+
+def _mlp_sym():
+    d = mx.sym.Variable("data")
+    f1 = mx.sym.FullyConnected(d, num_hidden=16, name="fc1")
+    a1 = mx.sym.Activation(f1, act_type="relu")
+    f2 = mx.sym.FullyConnected(a1, num_hidden=10, name="fc2")
+    return mx.sym.SoftmaxOutput(f2, name="softmax")
+
+
+def _toy_data(n=64, dim=32, seed=5):
+    rng = np.random.RandomState(seed)
+    x = rng.normal(0, 1, (n, dim)).astype(np.float32)
+    y = rng.randint(0, 10, n).astype(np.float32)
+    return x, y
+
+
+def _fit_once(num_epoch, context=None, **fit_kwargs):
+    x, y = _toy_data()
+    it = mx.io.NDArrayIter(x, y, batch_size=32,
+                           label_name="softmax_label")
+    mx.random.seed(7)
+    np.random.seed(7)
+    mod = mx.module.Module(_mlp_sym(), context=context or mx.cpu())
+    mod.fit(it, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1, "momentum": 0.9},
+            num_epoch=num_epoch, initializer=mx.init.Xavier(),
+            **fit_kwargs)
+    return mod
+
+
+def _params_np(mod):
+    return {k: v.asnumpy() for k, v in mod.get_params()[0].items()}
+
+
+# ---------------------------------------------------------------------------
+# manifest format: save / load / validate
+# ---------------------------------------------------------------------------
+
+def test_manager_roundtrip_and_manifest(tmp_path):
+    prefix = str(tmp_path / "ck")
+    args = {"w": mx.nd.array(
+        np.arange(12, dtype=np.float32).reshape(3, 4)),
+        "b": mx.nd.ones((4,))}
+    auxs = {"m": mx.nd.zeros((2,))}
+    mgr = ck.CheckpointManager(prefix, async_=False)
+    mgr.save(0, args, auxs, states_bytes=b"\x80\x04N.")  # pickle None
+    files = sorted(os.listdir(tmp_path))
+    assert "ck-0000.params" in files
+    assert "ck-0000.ckpt.json" in files
+    assert "ck-0000.states" in files
+    assert not any(f.endswith(".tmp") for f in files)
+    man = ck.load_manifest(prefix, 0)
+    assert man["epoch"] == 0 and len(man["shards"]) == 1
+    assert man["optimizer_states"]["sha256"]
+    loaded_args, loaded_auxs = ck.restore_params(prefix, 0)
+    np.testing.assert_array_equal(loaded_args["w"].asnumpy(),
+                                  args["w"].asnumpy())
+    np.testing.assert_array_equal(loaded_auxs["m"].asnumpy(),
+                                  auxs["m"].asnumpy())
+    assert mgr.stats()["saves"] == 1
+    assert mgr.stats()["last_good_epoch"] == 0
+
+
+def test_shard0_is_legacy_loadable(tmp_path):
+    """A single-topology save keeps the PR 1 single-file key format in
+    shard 0, so the legacy loader reads new checkpoints unchanged."""
+    prefix = str(tmp_path / "legacy")
+    args = {"w": mx.nd.array(np.eye(3, dtype=np.float32))}
+    ck.CheckpointManager(prefix, async_=False).save(2, args, {})
+    payload = mx.nd.load(prefix + "-0002.params")
+    np.testing.assert_array_equal(payload["arg:w"].asnumpy(), np.eye(3))
+
+
+def test_sharded_save_writes_per_mesh_position_files(tmp_path):
+    prefix = str(tmp_path / "sh")
+    mesh = create_mesh({"dp": 2}, devices=jax.devices()[:2])
+    arr = jax.device_put(
+        np.arange(8, dtype=np.float32).reshape(4, 2),
+        NamedSharding(mesh, P("dp")))
+    rep = jax.device_put(np.ones(3, np.float32),
+                         NamedSharding(mesh, P()))
+    args = {"w": mx.nd.NDArray(arr), "b": mx.nd.NDArray(rep)}
+    ck.CheckpointManager(prefix, async_=False).save(0, args, {})
+    files = sorted(os.listdir(tmp_path))
+    assert "sh-0000.params" in files                 # mesh position 0
+    assert "sh-0000.shard01-of-02.params" in files   # mesh position 1
+    man = ck.load_manifest(prefix, 0)
+    pieces = man["params"]["arg:w"]["pieces"]
+    assert len(pieces) == 2
+    assert pieces[0]["index"] == [[0, 2], [0, 2]]
+    assert pieces[1]["index"] == [[2, 4], [0, 2]]
+    # replicated entries stay whole, in shard 0, under the legacy key
+    assert man["params"]["arg:b"]["pieces"][0]["index"] is None
+    loaded, _ = ck.restore_params(prefix, 0)
+    np.testing.assert_array_equal(
+        loaded["w"].asnumpy(),
+        np.arange(8, dtype=np.float32).reshape(4, 2))
+
+
+def test_sharded_save_with_noncontiguous_owners_roundtrips(tmp_path):
+    """On a multi-axis mesh a param sharded over one axis has its
+    distinct pieces owned by NON-contiguous flat mesh positions (here
+    0 and 2 on a 2x2 ('dp','mp') mesh). Shard ids must be renumbered
+    densely so the manifest shard list, the piece references and the
+    file names all agree — else the load indexes past the shard
+    list."""
+    prefix = str(tmp_path / "nc")
+    mesh = create_mesh({"dp": 2, "mp": 2}, devices=jax.devices()[:4])
+    val = np.arange(8, dtype=np.float32).reshape(4, 2)
+    arr = jax.device_put(val, NamedSharding(mesh, P("dp")))
+    ck.CheckpointManager(prefix, async_=False).save(
+        0, {"w": mx.nd.NDArray(arr)}, {})
+    man = ck.load_manifest(prefix, 0)
+    assert [e["shard"] for e in man["shards"]] \
+        == list(range(len(man["shards"])))
+    for entry in man["params"].values():
+        for piece in entry["pieces"]:
+            assert piece["shard"] < len(man["shards"])
+    ck.validate_manifest(prefix, 0)   # every referenced file exists
+    loaded, _ = ck.restore_params(prefix, 0)
+    np.testing.assert_array_equal(loaded["w"].asnumpy(), val)
+
+
+def test_save_checkpoint_states_requires_optimizer(tmp_path):
+    """save_optimizer_states=True without an initialized optimizer
+    must fail loudly (the PR 1 path asserted) — not log 'Saved
+    optimizer state' while writing no states file."""
+    x, _ = _toy_data()
+    mod = mx.module.Module(_mlp_sym(), context=mx.cpu())
+    mod.bind(data_shapes=[("data", (32, 32))],
+             label_shapes=[("softmax_label", (32,))])
+    mod.init_params(initializer=mx.init.Xavier())
+    prefix = str(tmp_path / "noopt")
+    with pytest.raises(AssertionError):
+        mod.save_checkpoint(prefix, 0, save_optimizer_states=True)
+
+
+def test_elastic_restore_across_topologies(tmp_path):
+    """Save on an N-device mesh, resume on an M-device mesh (both
+    directions) — values identical, placement against the CURRENT
+    mesh."""
+    prefix = str(tmp_path / "el")
+    val = np.arange(16, dtype=np.float32).reshape(8, 2)
+    # save sharded on 2 devices
+    mesh2 = create_mesh({"dp": 2}, devices=jax.devices()[:2])
+    args = {"w": mx.nd.NDArray(
+        jax.device_put(val, NamedSharding(mesh2, P("dp"))))}
+    ck.CheckpointManager(prefix, async_=False).save(0, args, {})
+    # resume on 4 devices, sharded
+    mesh4 = create_mesh({"dp": 4}, devices=jax.devices()[:4])
+    a4, _ = ck.restore_params(prefix, 0, mesh=mesh4,
+                              rules={"w": P("dp")})
+    np.testing.assert_array_equal(a4["w"].asnumpy(), val)
+    assert len(a4["w"]._data.sharding.device_set) == 4
+    # resume on 1 device
+    mesh1 = create_mesh({"dp": 1}, devices=jax.devices()[:1])
+    a1, _ = ck.restore_params(prefix, 0, mesh=mesh1)
+    np.testing.assert_array_equal(a1["w"].asnumpy(), val)
+    # and the reverse direction: a host/1-device save resumes sharded
+    ck.CheckpointManager(prefix, async_=False).save(
+        1, {"w": mx.nd.array(val)}, {})
+    a2, _ = ck.restore_params(prefix, 1, mesh=mesh2,
+                              rules={"w": P("dp")})
+    np.testing.assert_array_equal(a2["w"].asnumpy(), val)
+    assert len(a2["w"]._data.sharding.device_set) == 2
+
+
+# ---------------------------------------------------------------------------
+# torn writes: kill-mid-save, checksum scan, sibling states
+# ---------------------------------------------------------------------------
+
+def test_kill_mid_save_never_references_torn_shard(tmp_path):
+    """A fault-injected abort of the shard write leaves NO manifest for
+    that epoch (the manifest is written last), so the resume scan lands
+    on the previous complete checkpoint."""
+    prefix = str(tmp_path / "kill")
+    args = {"w": mx.nd.ones((4, 4))}
+    mgr = ck.CheckpointManager(prefix, async_=False)
+    mgr.save(0, args, {})
+    # set_plan resets visit counters: epoch 1's shard write is visit 1
+    fault.set_plan("ckpt_write:step=1:raise")
+    mgr.save(1, args, {})            # shard write of epoch 1 aborts
+    assert mgr.stats()["failures"] == 1
+    assert mgr.stats()["last_good_epoch"] == 0
+    assert ck.load_manifest(prefix, 1) is None
+    assert not os.path.exists(prefix + "-0001.params")
+    found = latest_checkpoint_scan(prefix)
+    assert found is not None and found[0] == 0
+    assert fault.stats()["injected"]["ckpt_write"] == 1
+
+
+def test_kill_before_manifest_rejects_epoch(tmp_path):
+    """Killed between the shard writes and the manifest write: on a
+    sharded save the stranded ``.params`` holds shard pieces — the
+    scan must not half-load it through the legacy path."""
+    prefix = str(tmp_path / "mankill")
+    mesh = create_mesh({"dp": 2}, devices=jax.devices()[:2])
+    args = {"w": mx.nd.NDArray(jax.device_put(
+        np.arange(8, dtype=np.float32).reshape(4, 2),
+        NamedSharding(mesh, P("dp"))))}
+    mgr = ck.CheckpointManager(prefix, async_=False)
+    mgr.save(0, args, {})
+    # visits per save here: 2 shards + manifest; set_plan reset the
+    # counter, so epoch 1's manifest write is visit 3
+    fault.set_plan("ckpt_write:step=3:raise")
+    mgr.save(1, args, {})
+    assert os.path.exists(prefix + "-0001.params")
+    assert ck.load_manifest(prefix, 1) is None
+    found = latest_checkpoint_scan(prefix)
+    assert found is not None and found[0] == 0 and found[3] == 1
+
+
+def test_kill_between_states_and_params_never_accepts_epoch(tmp_path):
+    """The states file is written BEFORE the shard files: a kill at
+    that boundary strands only a .states (an epoch with no .params is
+    never listed). The reverse order would leave a durable
+    legacy-loadable .params whose missing states the scan accepts —
+    resuming the failed epoch with silently-fresh optimizer state."""
+    import pickle
+    prefix = str(tmp_path / "sb")
+    states = pickle.dumps({"momentum": 1})
+    args = {"w": mx.nd.ones((2,))}
+    mgr = ck.CheckpointManager(prefix, async_=False)
+    mgr.save(0, args, {}, states_bytes=states)
+    # set_plan resets visit counters; epoch 1's save visits
+    # states(1), shard(2), manifest(3) — kill the shard write
+    fault.set_plan("ckpt_write:step=2:raise")
+    mgr.save(1, args, {}, states_bytes=states)
+    assert mgr.stats()["failures"] == 1
+    assert mgr.stats()["last_good_epoch"] == 0
+    assert not os.path.exists(prefix + "-0001.params")
+    assert list_checkpoint_epochs(prefix) == [0]
+    found = latest_checkpoint_scan(prefix)
+    assert found is not None and found[0] == 0
+    assert fault.stats()["injected"]["ckpt_write"] == 1
+
+
+def test_fsync_site_is_injectable(tmp_path):
+    prefix = str(tmp_path / "fsync")
+    fault.set_plan("ckpt_fsync:step=1:raise")
+    mgr = ck.CheckpointManager(prefix, async_=False)
+    mgr.save(0, {"w": mx.nd.ones((2,))}, {})
+    assert mgr.stats()["failures"] == 1
+    assert ck.load_manifest(prefix, 0) is None
+    assert fault.stats()["injected"]["ckpt_fsync"] == 1
+
+
+def test_truncated_shard_fails_checksum_and_scan_falls_back(tmp_path):
+    prefix = str(tmp_path / "torn")
+    args = {"w": mx.nd.ones((8, 8))}
+    mgr = ck.CheckpointManager(prefix, async_=False)
+    mgr.save(0, args, {})
+    mgr.save(1, args, {})
+    # tear epoch 1's shard the way SIGKILL mid-copy would
+    with open(prefix + "-0001.params", "r+b") as f:
+        f.truncate(32)
+    with pytest.raises(mx.base.MXNetError, match="torn/corrupt"):
+        ck.validate_manifest(prefix, 1)
+    found = latest_checkpoint_scan(prefix)
+    assert found is not None and found[0] == 0 and found[3] == 1
+
+
+def test_corrupt_states_checksum_rejects_manifest_epoch(tmp_path):
+    prefix = str(tmp_path / "sib")
+    args = {"w": mx.nd.ones((2, 2))}
+    mgr = ck.CheckpointManager(prefix, async_=False)
+    states = __import__("pickle").dumps({"momentum": 1})
+    mgr.save(0, args, {}, states_bytes=states)
+    mgr.save(1, args, {}, states_bytes=states)
+    with open(prefix + "-0001.states", "wb") as f:
+        f.write(b"torn")
+    found = latest_checkpoint_scan(prefix)
+    assert found is not None and found[0] == 0 and found[3] == 1
+
+
+# ---------------------------------------------------------------------------
+# async behavior: trajectory identity, backpressure, failed-save survival
+# ---------------------------------------------------------------------------
+
+def test_fit_async_vs_sync_bit_identical_trajectory(tmp_path,
+                                                    monkeypatch):
+    monkeypatch.setenv("MXNET_ASYNC_CHECKPOINT", "0")
+    m_sync = _fit_once(3, checkpoint_prefix=str(tmp_path / "s"))
+    monkeypatch.setenv("MXNET_ASYNC_CHECKPOINT", "1")
+    m_async = _fit_once(3, checkpoint_prefix=str(tmp_path / "a"))
+    ps, pa = _params_np(m_sync), _params_np(m_async)
+    assert set(ps) == set(pa)
+    for k in ps:
+        np.testing.assert_array_equal(ps[k], pa[k])
+    # both produced the same manifest checkpoints
+    assert list_checkpoint_epochs(str(tmp_path / "s")) == [0, 1, 2]
+    assert list_checkpoint_epochs(str(tmp_path / "a")) == [0, 1, 2]
+    for e in range(3):
+        assert ck.load_manifest(str(tmp_path / "a"), e) is not None
+
+
+def test_fit_survives_killed_save_and_resumes_elastic(tmp_path):
+    """The acceptance path: async fit survives a fault-injected kill
+    during a save and resumes from the last complete manifest. Resumed
+    on the SAME topology the trajectory from the restored step is
+    bit-identical to the uninterrupted run; resumed on a
+    DIFFERENTLY-sized CPU mesh (elastic topology change) it matches to
+    float32 reduction-order noise (the dp psum reassociates the batch
+    sum — XLA, not the checkpoint, owns that ulp)."""
+    prefix = str(tmp_path / "acc")
+    ref = _fit_once(4, checkpoint_prefix=str(tmp_path / "ref"))
+    # interrupted run: epoch 0 saves fine; epoch 1's save is killed
+    # mid-write (visits per save: states + shard + manifest = 3)
+    fault.set_plan("ckpt_write:step=4:raise")
+    _fit_once(2, checkpoint_prefix=prefix)
+    fault.set_plan(None)
+    assert latest_checkpoint_scan(prefix)[0] == 0
+    p_ref = _params_np(ref)
+    # elastic resume on a 2-device dp mesh from epoch 0's manifest
+    # (saves land under a different prefix so the source stays at
+    # epoch 0 for the same-topology leg below)
+    resumed = _fit_once(4, context=[mx.cpu(0), mx.cpu(1)],
+                        checkpoint_prefix=str(tmp_path / "acc2"),
+                        resume_from_checkpoint=prefix)
+    assert fault.stats()["resumed_from_epoch"] == 0
+    for k, v in _params_np(resumed).items():
+        np.testing.assert_allclose(p_ref[k], v, rtol=2e-5, atol=2e-6)
+    # same-topology resume: bit-identical from the restored step
+    same = _fit_once(4, checkpoint_prefix=prefix,
+                     resume_from_checkpoint=True)
+    assert fault.stats()["resumed_from_epoch"] == 0
+    for k, v in _params_np(same).items():
+        np.testing.assert_array_equal(p_ref[k], v)
+
+
+def test_backpressure_queue_is_bounded(tmp_path, monkeypatch):
+    monkeypatch.setenv("MXNET_CHECKPOINT_INFLIGHT", "1")
+    prefix = str(tmp_path / "bp")
+    mgr = ck.CheckpointManager(prefix, async_=True)
+    assert mgr._q.maxsize == 1
+    args = {"w": mx.nd.ones((64, 64))}
+    for e in range(6):
+        mgr.save(e, args, {})        # bounded put = backpressure
+    mgr.close()
+    st = mgr.stats()
+    assert st["saves"] == 6 and st["failures"] == 0
+    assert st["last_good_epoch"] == 5
+    assert latest_checkpoint_scan(prefix)[0] == 5
+
+
+def test_failed_async_save_warns_but_training_continues(tmp_path):
+    fault.set_plan("ckpt_write:step=1:raise")
+    mod = _fit_once(2, checkpoint_prefix=str(tmp_path / "ok"))
+    # epoch 0's save died, epoch 1's landed; fit finished regardless
+    assert _params_np(mod)
+    found = latest_checkpoint_scan(str(tmp_path / "ok"))
+    assert found is not None and found[0] == 1
+
+
+# ---------------------------------------------------------------------------
+# telemetry records + diagnose round trip
+# ---------------------------------------------------------------------------
+
+def test_checkpoint_records_and_diagnose_round_trip(tmp_path, capsys):
+    sink = str(tmp_path / "run.jsonl")
+    telemetry.start(filename=sink, meta={"source": "Module.fit",
+                                         "begin_epoch": 0,
+                                         "num_epoch": 3})
+    _fit_once(3, checkpoint_prefix=str(tmp_path / "tel"))
+    summary = telemetry.stop()
+    assert summary["checkpoint"]["saves"] == 3
+    assert summary["checkpoint"]["failures"] == 0
+    assert summary["checkpoint"]["last_good_epoch"] == 2
+    assert summary["checkpoint"]["bytes"] > 0
+    with open(sink) as f:
+        recs = [json.loads(l) for l in f if l.strip()]
+    ckpts = [r for r in recs if r["type"] == "checkpoint"]
+    assert len(ckpts) == 3
+    for r in ckpts:
+        assert r["ok"] and r["bytes"] > 0 and r["shards"] == 1
+        for key in ("snapshot_ms", "serialize_ms", "write_ms",
+                    "manifest_ms", "blocking_ms", "async_ms"):
+            assert key in r
+    from mxnet_tpu.tools import diagnose
+    diagnose.main([sink])
+    out = capsys.readouterr().out
+    assert "Checkpoints" in out
+    assert "last good    : epoch 2" in out
+    assert "async share" in out
+
+
+def test_diagnose_shows_rollback_lost_steps(tmp_path, capsys):
+    prefix = str(tmp_path / "rb")
+    _fit_once(2, checkpoint_prefix=prefix)
+    with open(prefix + "-0001.params", "wb") as f:
+        f.write(b"\x00garbage")
+    sink = str(tmp_path / "rb.jsonl")
+    telemetry.start(filename=sink, meta={"source": "Module.fit",
+                                         "begin_epoch": 1,
+                                         "num_epoch": 4})
+    _fit_once(4, checkpoint_prefix=prefix,
+              resume_from_checkpoint=True)
+    summary = telemetry.stop()
+    assert summary["events"]["resume_rollback_epochs"] == 1
+    from mxnet_tpu.tools import diagnose
+    diagnose.main([sink])
+    out = capsys.readouterr().out
+    assert "rollback     : resume skipped 1 corrupt newer epoch(s)" \
+        in out
+    # resumed at epoch 1 of num_epoch=4 -> 3 epochs x 2 steps;
+    # steps/epoch must come from the POST-resume epoch count
+    assert "(~2 steps of lost work re-trained)" in out
+
+
+def test_sink_has_no_checkpoint_kind_without_saves(tmp_path):
+    sink = str(tmp_path / "plain.jsonl")
+    telemetry.start(filename=sink)
+    _fit_once(1)                      # no checkpoint_prefix
+    summary = telemetry.stop()
+    assert "checkpoint" not in summary
+    with open(sink) as f:
+        assert not any(json.loads(l)["type"] == "checkpoint"
+                       for l in f if l.strip())
+
+
+# ---------------------------------------------------------------------------
+# gluon Trainer background state writes
+# ---------------------------------------------------------------------------
+
+def test_trainer_save_states_background(tmp_path):
+    from mxnet_tpu import gluon
+    net = gluon.nn.Dense(4)
+    net.initialize()
+    net(mx.nd.ones((2, 3)))
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1, "momentum": 0.9})
+    with mx.autograd.record():
+        loss = net(mx.nd.ones((2, 3))).sum()
+    loss.backward()
+    trainer.step(2)
+    fname = str(tmp_path / "trainer.states")
+    trainer.save_states(fname, background=True)
+    ck.flush_async_writes()
+    assert os.path.exists(fname) and not os.path.exists(fname + ".tmp")
+    trainer.load_states(fname)       # round trips through the loader
+
+
+def test_flush_async_writes_raises_on_failed_write(tmp_path):
+    """A deferred durable write must not fail silently: flush raises
+    the error the synchronous path would have raised, naming the file,
+    and a subsequent flush is clean again."""
+    bad = str(tmp_path / "no" / "such" / "dir" / "x.states")
+    ck.write_bytes_async(bad, b"abc")
+    with pytest.raises(mx.base.MXNetError, match="x.states"):
+        ck.flush_async_writes()
+    ck.flush_async_writes()           # error cleared by the raise
+    good = str(tmp_path / "ok.states")
+    ck.write_bytes_async(good, b"abc")
+    ck.flush_async_writes()
+    assert os.path.exists(good)

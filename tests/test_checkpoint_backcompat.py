@@ -109,6 +109,76 @@ def test_gluon_save_parameters_loads(tag):
 
 
 @pytest.mark.parametrize("tag", _TAGS)
+def test_legacy_checkpoint_through_new_scan_and_loader(tag):
+    """PR 1-era single-file checkpoints (no manifest) must keep
+    loading through the manifest-aware loader and the resume scan —
+    including the sibling optimizer-state validation, which these
+    committed generations must pass."""
+    from mxnet_tpu import checkpoint as ck
+    from mxnet_tpu.model import latest_checkpoint_scan, load_params
+    man = _manifest(tag)
+    prefix = os.path.join(_FIX_ROOT, tag, "mlp")
+    assert ck.load_manifest(prefix, 1) is None   # genuinely legacy
+    arg_params, _ = load_params(prefix, 1)
+    found = latest_checkpoint_scan(prefix)
+    assert found is not None
+    epoch, scanned_args, _, skipped = found
+    assert epoch == 1 and skipped == 0
+    for name, arr in arg_params.items():
+        np.testing.assert_array_equal(arr.asnumpy(),
+                                      scanned_args[name].asnumpy())
+    # the loaded params still produce the pinned forward outputs
+    sym = mx.sym.load(prefix + "-symbol.json")
+    mod = mx.mod.Module(sym, data_names=("data",),
+                        label_names=("softmax_label",),
+                        context=mx.cpu())
+    x = np.asarray(man["x_fix"], np.float32)
+    mod.bind(data_shapes=[("data", x.shape)],
+             label_shapes=[("softmax_label", (x.shape[0],))],
+             for_training=False)
+    mod.set_params(arg_params, found[2])
+    import mxnet_tpu.io as mio
+    mod.forward(mio.DataBatch(data=[mx.nd.array(x)],
+                              label=[mx.nd.zeros((x.shape[0],))]),
+                is_train=False)
+    np.testing.assert_allclose(mod.get_outputs()[0].asnumpy(),
+                               np.asarray(man["mlp_forward"]),
+                               rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.parametrize("tag", _TAGS)
+def test_new_manifest_checkpoint_via_legacy_entry_points(tag, tmp_path):
+    """The reverse direction: a checkpoint written by the new sharded
+    writer loads through the PR 1-era entry points
+    (``mx.model.load_checkpoint`` / ``Module.load``) and reproduces
+    the committed generation's pinned forward outputs."""
+    from mxnet_tpu import checkpoint as ck
+    man = _manifest(tag)
+    old_prefix = os.path.join(_FIX_ROOT, tag, "mlp")
+    sym, arg_params, aux_params = mx.model.load_checkpoint(old_prefix, 1)
+    new_prefix = str(tmp_path / "rewrap")
+    mgr = ck.CheckpointManager(new_prefix, symbol=sym, async_=False)
+    mgr.save(1, arg_params, aux_params)
+    assert ck.load_manifest(new_prefix, 1) is not None
+    sym2, args2, auxs2 = mx.model.load_checkpoint(new_prefix, 1)
+    mod = mx.mod.Module(sym2, data_names=("data",),
+                        label_names=("softmax_label",),
+                        context=mx.cpu())
+    x = np.asarray(man["x_fix"], np.float32)
+    mod.bind(data_shapes=[("data", x.shape)],
+             label_shapes=[("softmax_label", (x.shape[0],))],
+             for_training=False)
+    mod.set_params(args2, auxs2)
+    import mxnet_tpu.io as mio
+    mod.forward(mio.DataBatch(data=[mx.nd.array(x)],
+                              label=[mx.nd.zeros((x.shape[0],))]),
+                is_train=False)
+    np.testing.assert_allclose(mod.get_outputs()[0].asnumpy(),
+                               np.asarray(man["mlp_forward"]),
+                               rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.parametrize("tag", _TAGS)
 def test_nd_save_payload_with_sparse(tag):
     man = _manifest(tag)
     payload = mx.nd.load(os.path.join(_FIX_ROOT, tag, "arrays.nd"))

@@ -313,16 +313,45 @@ def test_fit_resumes_past_corrupt_epoch_file(tmp_path):
     assert os.path.exists(prefix + "-0000.states")
     with open(prefix + "-0001.params", "wb") as f:
         f.write(b"\x00garbage")                  # corrupt newest epoch
-    with open(prefix + "-0000.states", "wb") as f:
-        f.write(b"torn")    # corrupt states: params-only resume + warn
     _, acc = _fit_once(num_epoch=4, checkpoint_prefix=prefix,
                        resume_from_checkpoint=True)
-    # resumed from epoch 0 (1 was corrupt), trained epochs 1..3
+    # resumed from epoch 0 (1 was corrupt), trained epochs 1..3 —
+    # a ROLLBACK resume: the skipped newer epoch is counted as lost
     assert fault.stats()["resumed_from_epoch"] == 0
+    assert fault.stats()["rollback_resumes"] == 1
+    assert fault.stats()["rollback_epochs"] == 1
+    assert fault.stats()["clean_resumes"] == 0
     assert list_checkpoint_epochs(prefix) == [0, 1, 2, 3]
     found = load_latest_valid_checkpoint(prefix)
     assert found is not None and found[0] == 3
     assert acc > 0.8
+
+
+def test_corrupt_sibling_states_rejects_epoch(tmp_path):
+    """A valid params file whose sibling optimizer-state file is torn
+    must reject the WHOLE epoch — silently resuming with fresh
+    optimizer state is a trajectory change, not a resume — and the
+    scan falls back to the previous epoch."""
+    prefix = str(tmp_path / "sib")
+    _fit_once(num_epoch=2, checkpoint_prefix=prefix)
+    with open(prefix + "-0001.states", "wb") as f:
+        f.write(b"torn")
+    found = load_latest_valid_checkpoint(prefix)
+    assert found is not None and found[0] == 0
+    _fit_once(num_epoch=3, checkpoint_prefix=prefix,
+              resume_from_checkpoint=True)
+    assert fault.stats()["resumed_from_epoch"] == 0
+    assert fault.stats()["rollback_resumes"] == 1
+
+
+def test_clean_resume_counted_separately(tmp_path):
+    prefix = str(tmp_path / "clean")
+    _fit_once(num_epoch=2, checkpoint_prefix=prefix)
+    _fit_once(num_epoch=3, checkpoint_prefix=prefix,
+              resume_from_checkpoint=True)
+    assert fault.stats()["resumed_from_epoch"] == 1
+    assert fault.stats()["clean_resumes"] == 1
+    assert fault.stats()["rollback_resumes"] == 0
 
 
 def test_fit_resume_restores_optimizer_states(tmp_path):
