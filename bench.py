@@ -963,6 +963,237 @@ def _checkpoint_record():
     return record
 
 
+def _bench_grad_overlap_case(steps=30, warmup=5, rounds=3,
+                             batch=64, bucket_mb=0.5):
+    """The grad-sync perf oracle on the 8-device CPU mesh: the
+    UNBUCKETED baseline (ROADMAP item 4's "one monolithic blob after
+    backward completes" — forward+backward dispatch, then a
+    host-dispatched blob reduce-scatter + all-gather under a real
+    telemetry ``sync`` span, then the update dispatch) vs the OVERLAP
+    path from parallel.grad_sync (backward-ordered buckets constrained
+    to P('dp') inside ONE compiled step — the partitioner schedules
+    each bucket's reduce-scatter against the remaining backward, so
+    there is no host-observable sync phase at all). Same MLP, same
+    data, same SGD rule; the two trajectories are checked to agree
+    before timing. Rounds are interleaved (post, overlap, ...) and
+    each mode keeps its best (highest steps/sec) round. The acceptance
+    bar: overlap's telemetry sync-phase share strictly below the
+    unbucketed baseline's."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from mxnet_tpu import telemetry
+    from mxnet_tpu.parallel import collectives, grad_sync
+    from mxnet_tpu.parallel.data_parallel import make_data_parallel_step
+    from mxnet_tpu.parallel.mesh import local_mesh
+
+    mesh = local_mesh("dp")
+    n_dev = int(mesh.devices.size)
+    sizes = (256, 512, 512, 512, 10)
+    lr = 0.1
+
+    rng = np_random = np.random.RandomState(0)
+    init = {}
+    for li in range(len(sizes) - 1):
+        init["w%d" % li] = (np_random.normal(
+            0, 0.1, (sizes[li], sizes[li + 1])).astype(np.float32))
+        init["b%d" % li] = np.zeros((sizes[li + 1],), np.float32)
+    x_host = rng.normal(0, 1, (batch, sizes[0])).astype(np.float32)
+    y_host = rng.normal(0, 1, (batch, sizes[-1])).astype(np.float32)
+
+    def loss_fn(params, b):
+        # sum/GLOBAL normalization: per-device partial losses/grads SUM
+        # to the global ones, so the post-mode blob reduce needs no
+        # rescale and both modes optimize the identical objective
+        h = b["x"]
+        nl = len(sizes) - 1
+        for li in range(nl):
+            h = h @ params["w%d" % li] + params["b%d" % li]
+            if li < nl - 1:
+                h = jnp.tanh(h)
+        return jnp.sum((h - b["y"]) ** 2) / (batch * sizes[-1])
+
+    names = sorted(init)
+    shapes = [init[n].shape for n in names]
+    flat_sizes = [int(np.prod(s)) for s in shapes]
+    offs, off = [], 0
+    for s in flat_sizes:
+        offs.append(off)
+        off += s
+    rep = NamedSharding(mesh, P())
+    dp = NamedSharding(mesh, P("dp"))
+
+    # -- the unbucketed baseline: blob exchange AFTER backward ----------
+    def local_fwdbwd(pv, xb, yb):
+        loss, g = jax.value_and_grad(loss_fn)(
+            pv, {"x": xb, "y": yb})
+        return loss[None], [g[n][None] for n in names]
+
+    fwdbwd = jax.jit(collectives._shard_map()(
+        local_fwdbwd, mesh=mesh, in_specs=(P(), P("dp"), P("dp")),
+        out_specs=(P("dp"), [P("dp") for _ in names])))
+
+    def apply_blob(pv, blob):
+        return {n: pv[n] - lr * blob[o:o + s].reshape(pv[n].shape)
+                for n, o, s in zip(names, offs, flat_sizes)}
+
+    update = jax.jit(apply_blob)
+
+    def run_post(n_steps, params, with_tel):
+        xb = jax.device_put(x_host, dp)
+        yb = jax.device_put(y_host, dp)
+        losses = []
+        t0 = time.perf_counter()
+        for _ in range(n_steps):
+            if with_tel:
+                telemetry.step_begin()
+            with telemetry.span("compute"):
+                loss, stacked = fwdbwd(params, xb, yb)
+                jax.block_until_ready(stacked)
+            with telemetry.span("sync"):
+                flat = collectives.bucket_reduce_scatter(
+                    stacked, mesh, key="blob")
+                blob = collectives.bucket_all_gather(flat, mesh,
+                                                     key="blob")
+                blob.block_until_ready()
+            with telemetry.span("optimizer"):
+                params = update(params, blob)
+                jax.block_until_ready(params)
+            losses.append(float(jnp.sum(loss)))
+            if with_tel:
+                telemetry.step_end(samples=batch)
+        return time.perf_counter() - t0, losses, params
+
+    # -- the overlap path: bucketed reduce-scatter INSIDE the step ------
+    step_fn, batch_sharding = make_data_parallel_step(
+        loss_fn, mesh, optimizer_update=lambda p, g: p - lr * g,
+        donate=False, grad_overlap=True, bucket_mb=bucket_mb)
+    plan = grad_sync.GradSyncPlan(
+        [init[n].shape for n in sorted(init)],
+        [init[n].dtype for n in sorted(init)],
+        axis_size=n_dev, cap_bytes=int(bucket_mb * (1 << 20)))
+
+    def run_overlap(n_steps, params, with_tel):
+        b = {"x": jax.device_put(x_host, batch_sharding),
+             "y": jax.device_put(y_host, batch_sharding)}
+        losses = []
+        t0 = time.perf_counter()
+        for _ in range(n_steps):
+            if with_tel:
+                telemetry.step_begin()
+            with telemetry.span("compute"):
+                loss, params = step_fn(params, b)
+                jax.block_until_ready(params)
+            grad_sync.account_in_program_sync(plan)
+            losses.append(float(loss))
+            if with_tel:
+                telemetry.step_end(samples=batch)
+        return time.perf_counter() - t0, losses, params
+
+    def fresh():
+        return {n: jax.device_put(jnp.asarray(v), rep)
+                for n, v in init.items()}
+
+    # warmup (compiles) + trajectory agreement before any timing
+    _, l_post, _ = run_post(warmup, fresh(), False)
+    _, l_over, _ = run_overlap(warmup, fresh(), False)
+    traj = bool(np.allclose(l_post, l_over, rtol=1e-4, atol=1e-6))
+
+    runners = {"post": run_post, "overlap": run_overlap}
+    best = {}
+    for _ in range(rounds):
+        for mode, runner in runners.items():
+            telemetry.start()
+            dt, _, _ = runner(steps, fresh(), True)
+            rep_tel = telemetry.report()
+            telemetry.stop()
+            sps = steps / dt
+            if mode not in best or sps > best[mode][0]:
+                best[mode] = (sps, rep_tel["phases_ms"])
+
+    out = {"steps": steps, "batch": batch, "n_dev": n_dev,
+           "bucket_mb": bucket_mb, "buckets": len(plan.buckets),
+           "params_mb": round(sum(flat_sizes) * 4 / (1 << 20), 2),
+           "trajectory_match": traj}
+    for mode, (sps, phases) in best.items():
+        whole = sum(phases.values()) or 1.0
+        out["%s_steps_per_sec" % mode] = round(sps, 2)
+        out["%s_sync_share_pct" % mode] = round(
+            100.0 * phases.get("sync", 0.0) / whole, 2)
+        out["%s_phases_ms" % mode] = {k: round(v, 1)
+                                      for k, v in phases.items()}
+    out["speedup"] = round(out["overlap_steps_per_sec"]
+                           / out["post_steps_per_sec"], 3)
+    out["overlap_sync_below_post"] = bool(
+        out["overlap_sync_share_pct"] < out["post_sync_share_pct"])
+    return out
+
+
+def _bench_zero1_state_memory(steps=2):
+    """The ZeRO-1 memory oracle: per-device resident optimizer-state
+    bytes through the DistributedTrainer with Adam, overlap off
+    (replicated — the full two-slot f32 copy on every device) vs on
+    (flat dp-sharded — 1/N each). The ledger is the same one
+    tests/test_grad_sync.py verifies against the actual device
+    shards."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon
+    from mxnet_tpu.gluon import nn
+    from mxnet_tpu.parallel import DistributedTrainer
+    from mxnet_tpu.parallel.mesh import local_mesh
+
+    mesh = local_mesh("dp")
+    n_dev = int(mesh.devices.size)
+    rng = np.random.RandomState(1)
+
+    def run(overlap):
+        net = nn.HybridSequential()
+        net.add(nn.Dense(256, activation="relu", in_units=128),
+                nn.Dense(10, in_units=256))
+        net.initialize()
+        tr = DistributedTrainer(
+            net, gluon.loss.SoftmaxCrossEntropyLoss(), mesh,
+            optimizer="adam", optimizer_params={"learning_rate": 0.01},
+            grad_overlap=overlap, bucket_mb=0.125)
+        for _ in range(steps):
+            data = mx.nd.array(rng.randn(16, 128).astype(np.float32))
+            label = mx.nd.array(
+                rng.randint(0, 10, (16,)).astype(np.float32))
+            tr.fit_batch(data, label).asnumpy()
+        return tr.state_bytes_per_device()
+
+    off_b, on_b = run(False), run(True)
+    return {"n_dev": n_dev, "optimizer": "adam",
+            "off_state_bytes_per_device": off_b,
+            "on_state_bytes_per_device": on_b,
+            "on_over_off": round(on_b / off_b, 4),
+            "reduced_one_over_n": bool(on_b * n_dev == off_b)}
+
+
+def _grad_overlap_record():
+    """The gradient-sync benchmark record (BENCH_r11.json): unbucketed
+    post-backward blob vs in-program bucketed overlap on the 8-device
+    CPU mesh, plus the ZeRO-1 per-device state-memory split."""
+    import jax
+    record = {"metric": "grad_overlap", "unit": "steps/sec",
+              "dtype": "float32",
+              "platform": jax.default_backend(),
+              "devices": len(jax.devices()), "cases": {}}
+    errors = {}
+    try:
+        record["cases"]["mesh_mlp"] = _bench_grad_overlap_case()
+    except Exception as exc:                     # noqa: BLE001
+        errors["mesh_mlp"] = _err_str(exc)
+    try:
+        record["cases"]["zero1_state"] = _bench_zero1_state_memory()
+    except Exception as exc:                     # noqa: BLE001
+        errors["zero1_state"] = _err_str(exc)
+    if errors:
+        record["errors"] = errors
+    return record
+
+
 def _err_str(exc):
     return "%s: %s" % (type(exc).__name__, str(exc)[:400])
 
@@ -1091,6 +1322,18 @@ if __name__ == "__main__":
         # CPU-friendly standalone mode: compile-watch-off vs -on fused
         # MLP train-step time, one JSON line (the BENCH_r09 artifact)
         print(json.dumps(_compile_watch_record()))
+    elif "--grad-overlap" in sys.argv:
+        # CPU-friendly standalone mode on a forced 8-device host mesh:
+        # unbucketed post-backward blob vs in-program bucketed
+        # reduce-scatter + ZeRO-1 state memory, one JSON line (the
+        # BENCH_r11 artifact). Topology must be set before jax loads.
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8"
+            ).strip()
+        print(json.dumps(_grad_overlap_record()))
     elif "--checkpoint-overhead" in sys.argv:
         # CPU-friendly standalone mode: step-time p99 with
         # checkpointing off vs sync vs async on the MLP and convnet

@@ -206,18 +206,22 @@ def flush_async_writes():
 
 def _snapshot_entry(key, value, flat):
     """Capture one roster entry into ``flat`` without blocking: dense
-    NDArrays (and raw jax arrays) contribute a device-side COPY of
-    their buffer — an async dispatch, not a host sync. The copy (not a
-    bare reference) matters: the fit loop re-points the executor's
-    buffers at these same arrays (same-device ``device_put`` aliases),
-    and the fused train step then DONATES them to XLA — a reference
-    snapshot would be reading a deleted buffer by the time the writer
-    thread serializes it. Sparse NDArrays and numpy fall back to a
-    host copy now (their buffers can be replaced component-wise)."""
+    NDArrays (and raw jax arrays — e.g. the flat dp-sharded optimizer
+    state of ``parallel.grad_sync``) contribute a device-side COPY of
+    their buffer — an async dispatch preserving the source's sharding,
+    not a host sync. The copy (not a bare reference) matters: the fit
+    loop re-points the executor's buffers at these same arrays
+    (same-device ``device_put`` aliases), and the fused train step
+    then DONATES them to XLA — a reference snapshot would be reading a
+    deleted buffer by the time the writer thread serializes it. Sparse
+    NDArrays and numpy fall back to a host copy now (their buffers can
+    be replaced component-wise)."""
     data = getattr(value, "_data", None)
     if data is not None and getattr(value, "stype", "default") \
             == "default":
         flat[key] = data.copy()       # donation-proof device-side copy
+    elif hasattr(value, "addressable_shards"):
+        flat[key] = value.copy()      # raw jax array, sharding kept
     elif hasattr(value, "asnumpy"):
         # sparse: reuse the nd.save component layout inside shard 0
         from .ndarray.ndarray import _flatten_entry
@@ -226,16 +230,21 @@ def _snapshot_entry(key, value, flat):
         flat[key] = _np.asarray(value)
 
 
-def snapshot_params(arg_params, aux_params=None):
+def snapshot_params(arg_params, aux_params=None, extra=None):
     """A consistent point-in-time capture of ``{'arg:name': buffer}``
     (plus ``aux:``) suitable for handing to the background writer —
     O(#params) reference grabs, no device sync, no host copy for dense
-    entries."""
+    entries. ``extra`` entries carry their full key verbatim (the
+    ``opt:bucketBB.slotS`` sharded-optimizer-state roster rides here;
+    its per-device pieces land in the manifest's shard files exactly
+    like a sharded parameter's)."""
     flat = {}
     for k, v in (arg_params or {}).items():
         _snapshot_entry("arg:%s" % k, v, flat)
     for k, v in (aux_params or {}).items():
         _snapshot_entry("aux:%s" % k, v, flat)
+    for k, v in (extra or {}).items():
+        _snapshot_entry(k, v, flat)
     return flat
 
 
@@ -528,15 +537,17 @@ class CheckpointManager:
         self._idle.set()
 
     # -- public surface ---------------------------------------------------
-    def save(self, epoch, arg_params, aux_params=None, states_bytes=None):
+    def save(self, epoch, arg_params, aux_params=None, states_bytes=None,
+             extra=None):
         """Checkpoint ``epoch``. Blocking cost in async mode is the
         snapshot + (only under backpressure) the bounded-queue wait;
         sync mode blocks for the whole durable write. Both run under
-        the telemetry ``checkpoint`` phase."""
+        the telemetry ``checkpoint`` phase. ``extra`` rides verbatim
+        keys into the shard roster (sharded optimizer state)."""
         from . import telemetry
         with telemetry.span("checkpoint"):
             t0 = time.perf_counter()
-            flat = snapshot_params(arg_params, aux_params)
+            flat = snapshot_params(arg_params, aux_params, extra=extra)
             if not self.async_:
                 self._write(epoch, flat, states_bytes, t0,
                             blocking=True)

@@ -60,7 +60,8 @@ import numpy as _np
 
 from .base import MXNetError
 
-__all__ = ["fused_step_enabled", "FusedStepExecutor", "FusedUpdater"]
+__all__ = ["fused_step_enabled", "FusedStepExecutor", "FusedUpdater",
+           "pack_step_scalars", "make_apply"]
 
 
 def fused_step_enabled():
@@ -98,6 +99,70 @@ def _flat_state_handles(state):
 
 def _sig(arrays):
     return tuple((tuple(a.shape), str(a.dtype)) for a in arrays)
+
+
+def pack_step_scalars(optimizer, indices):
+    """The per-step scalar block as ONE host f32 vector
+    ``[lr_0..lr_n-1, wd_0..wd_n-1, rescale]`` — handed to the compiled
+    call as a plain numpy array so pjit's own argument path does the
+    single transfer. LR schedules, per-param multipliers, and
+    loss-scale-driven rescale changes tick per step WITHOUT
+    recompiling. Advances the optimizer's update counters exactly like
+    the eager ``_step_inputs``. Shared by the fused executors here and
+    ``parallel.data_parallel.DistributedTrainer``."""
+    n = len(indices)
+    block = _np.empty((2 * n + 1,), _np.float32)
+    for k, i in enumerate(indices):
+        lr, wd = optimizer.fused_step_scalars(i)
+        block[k] = lr
+        block[n + k] = wd
+    block[2 * n] = optimizer.rescale_grad
+    return block
+
+
+def make_apply(step_fns, state_counts, guard, inject):
+    """The traceable all-parameter update shared by every fused path:
+    splice in poison, test finiteness, run each param's step fn, and
+    (under the guard) keep the old weight/state via jnp.where for
+    non-finite grads — the compiled-step equivalent of
+    filter_gradient's skip. ``parallel.grad_sync.make_bucketed_apply``
+    is the drop-in bucketed/sharded form of this contract."""
+    import jax.numpy as jnp
+    n = len(step_fns)
+
+    def apply(grads, weights, states, scalars, poisons):
+        # scalars = [lr_0..lr_n-1, wd_0..wd_n-1, rescale]
+        rescale = scalars[2 * n]
+        new_ws, new_sts, oks = [], [], []
+        si = 0
+        for i, fn in enumerate(step_fns):
+            g, w = grads[i], weights[i]
+            st = tuple(states[si:si + state_counts[i]])
+            si += state_counts[i]
+            if inject:
+                g = jnp.where(jnp.isfinite(poisons[i]), g,
+                              jnp.full_like(g, poisons[i]
+                                            .astype(g.dtype)))
+            if guard:
+                ok = jnp.isfinite(g).all()
+            # cast the traced scalars to the grad dtype: the eager
+            # ops see python floats, which JAX weak-types (f64 →
+            # weak f32 → operand dtype) — an uncast strong-f32
+            # scalar would PROMOTE low-precision weights to f32
+            nw, nst = fn(g, w, st, scalars[i].astype(g.dtype),
+                         scalars[n + i].astype(g.dtype),
+                         rescale.astype(g.dtype))
+            if guard:
+                nw = jnp.where(ok, nw, w)
+                nst = tuple(jnp.where(ok, new_s, old_s)
+                            for new_s, old_s in zip(nst, st))
+                oks.append(ok)
+            new_ws.append(nw)
+            new_sts.extend(nst)
+        mask = jnp.stack(oks) if oks else \
+            jnp.ones((n,), jnp.bool_)
+        return tuple(new_ws), tuple(new_sts), mask
+    return apply
 
 
 class _FusedCore:
@@ -147,22 +212,10 @@ class _FusedCore:
 
     # -- per-step traced scalars -----------------------------------------
     def _scalars(self, indices):
-        """The per-step scalar block as ONE host f32 vector
-        ``[lr_0..lr_n-1, wd_0..wd_n-1, rescale]`` — handed to the
-        compiled call as a plain numpy array so pjit's own argument
-        path does the single transfer (an explicit jnp.asarray per
-        scalar group cost ~1ms/step host-side). LR schedules, per-param
-        multipliers, and loss-scale-driven rescale changes tick per
-        step WITHOUT recompiling. Advances the optimizer's update
-        counters exactly like the eager ``_step_inputs``."""
-        n = len(indices)
-        block = _np.empty((2 * n + 1,), _np.float32)
-        for k, i in enumerate(indices):
-            lr, wd = self._opt.fused_step_scalars(i)
-            block[k] = lr
-            block[n + k] = wd
-        block[2 * n] = self._opt.rescale_grad
-        return block
+        """See :func:`pack_step_scalars` (an explicit jnp.asarray per
+        scalar group cost ~1ms/step host-side, hence the single numpy
+        block)."""
+        return pack_step_scalars(self._opt, indices)
 
     def _poisons(self, indices):
         """Planned grad-site faults for this step as a poison vector
@@ -191,46 +244,9 @@ class _FusedCore:
 
     # -- traced composition ----------------------------------------------
     def _make_apply(self, step_fns, state_counts, guard, inject):
-        """The traceable all-parameter update: splice in poison, test
-        finiteness, run each param's step fn, and (under the guard)
-        keep the old weight/state via jnp.where for non-finite grads —
-        the compiled-step equivalent of filter_gradient's skip."""
-        import jax.numpy as jnp
-        n = len(step_fns)
-
-        def apply(grads, weights, states, scalars, poisons):
-            # scalars = [lr_0..lr_n-1, wd_0..wd_n-1, rescale]
-            rescale = scalars[2 * n]
-            new_ws, new_sts, oks = [], [], []
-            si = 0
-            for i, fn in enumerate(step_fns):
-                g, w = grads[i], weights[i]
-                st = tuple(states[si:si + state_counts[i]])
-                si += state_counts[i]
-                if inject:
-                    g = jnp.where(jnp.isfinite(poisons[i]), g,
-                                  jnp.full_like(g, poisons[i]
-                                                .astype(g.dtype)))
-                if guard:
-                    ok = jnp.isfinite(g).all()
-                # cast the traced scalars to the grad dtype: the eager
-                # ops see python floats, which JAX weak-types (f64 →
-                # weak f32 → operand dtype) — an uncast strong-f32
-                # scalar would PROMOTE low-precision weights to f32
-                nw, nst = fn(g, w, st, scalars[i].astype(g.dtype),
-                             scalars[n + i].astype(g.dtype),
-                             rescale.astype(g.dtype))
-                if guard:
-                    nw = jnp.where(ok, nw, w)
-                    nst = tuple(jnp.where(ok, new_s, old_s)
-                                for new_s, old_s in zip(nst, st))
-                    oks.append(ok)
-                new_ws.append(nw)
-                new_sts.extend(nst)
-            mask = jnp.stack(oks) if oks else \
-                jnp.ones((n,), jnp.bool_)
-            return tuple(new_ws), tuple(new_sts), mask
-        return apply
+        """See :func:`make_apply` (module-level so the data-parallel
+        trainer composes the identical update without an executor)."""
+        return make_apply(step_fns, state_counts, guard, inject)
 
     # -- host-side guard accounting --------------------------------------
     def _post_step(self, indices, mask, guard):
@@ -385,7 +401,201 @@ class FusedStepExecutor(_FusedCore):
 class FusedUpdater(_FusedCore):
     """Gluon-Trainer-path fused update: autograd already produced the
     gradients, so the fused program is the all-parameter optimizer
-    update — one donated dispatch instead of ~2·P eager launches."""
+    update — one donated dispatch instead of ~2·P eager launches.
+
+    In-program sync mode (``MXNET_GRAD_OVERLAP=1`` + ``sync_mesh``):
+    the update lowers through ``parallel.grad_sync`` — gradients are
+    bucketed, constrained to the dp axis (the partitioner's
+    reduce-scatter point), the update runs on each device's slice
+    against ZeRO-1 flat-sharded optimizer state, and only the updated
+    params all-gather back. Donation and the in-program fault guard
+    are intact; every ineligibility (sparse grads, non-mesh weights,
+    unfusable optimizer/state layout) falls back to the plain fused
+    or eager path exactly as before."""
+
+    def __init__(self, optimizer, updater, sync_mesh=None,
+                 sync_axis="dp"):
+        super().__init__(optimizer, updater)
+        self._sync_mesh = sync_mesh
+        self._sync_axis = sync_axis
+        self._sync_plan = None
+        self._sync_state = None
+        self._sync_sig = None
+        self._sync_failed_sig = None  # negative probe cache
+        self._sync_weights = None    # last roster, for state export
+
+    # -- sync-mode helpers ------------------------------------------------
+    def _sync_eligible(self, weights_nd, grads_nd):
+        """True when every weight and grad already lives replicated on
+        the sync mesh — the only placement the bucketed constraints
+        are correct for."""
+        if self._sync_mesh is None:
+            return False
+        for arr in list(weights_nd) + list(grads_nd):
+            sh = getattr(arr._data, "sharding", None)
+            if sh is None or getattr(sh, "mesh", None) is None:
+                return False
+            if sh.mesh != self._sync_mesh \
+                    or not arr._data.is_fully_replicated:
+                return False
+        return True
+
+    def _sync_setup(self, indices, weights_nd):
+        """(Re)build the bucket plan + sharded state when the roster
+        changes; seed state from any per-param Updater states (the
+        load_states interchange), consuming them so the replicated
+        copies do not defeat the 1/N layout. None → no sync path."""
+        from .parallel import grad_sync
+        sig = tuple((tuple(w.shape), str(w.dtype), i)
+                    for i, w in zip(indices, weights_nd))
+        if sig == self._sync_sig and self._sync_state is not None:
+            self._sync_weights = list(weights_nd)
+            return self._sync_plan, self._sync_state
+        if sig == self._sync_failed_sig:
+            # this roster already failed the layout probe — don't pay
+            # the plan rebuild + eager state allocations every step
+            return None
+        if self._sync_state is not None:
+            # roster changed: the live moments are in the OLD sharded
+            # flats — materialize them back first so the re-seed below
+            # picks them up instead of silently restarting from zeros
+            self.export_states_to_updater()
+        plan = grad_sync.GradSyncPlan(
+            [w.shape for w in weights_nd],
+            [w.dtype for w in weights_nd],
+            axis_size=int(self._sync_mesh.devices.size))
+        state = grad_sync.ShardedOptState(plan, self._sync_mesh,
+                                          self._sync_axis)
+        if not state.probe(self._opt, indices, weights_nd):
+            self._sync_failed_sig = sig
+            return None
+        seed = {}
+        for pos, i in enumerate(indices):
+            st = self._updater.states.pop(i, None)
+            self._updater.states_synced.pop(i, None)
+            flat = _flat_state_handles(st)
+            if flat:
+                seed[pos] = [_np.asarray(h._data) for h in flat]
+        if seed:
+            # seed_per_param builds the full flats itself — ensure()
+            # first would allocate sharded zeros only to discard them
+            state.seed_per_param(seed)
+        else:
+            state.ensure()
+        self._sync_plan, self._sync_state = plan, state
+        self._sync_sig = sig
+        self._sync_weights = list(weights_nd)
+        return plan, state
+
+    def invalidate_sync(self):
+        """Force the next update to rebuild + re-seed the sharded
+        state (Trainer.load_states just replaced the Updater's)."""
+        self._sync_sig = None
+        self._sync_state = None
+        self._sync_failed_sig = None
+
+    def export_states_to_updater(self):
+        """Materialize the flat-sharded state back into the shared
+        Updater's per-param layout (``Trainer.save_states`` pickles
+        that), keeping .states files interchangeable with every
+        non-sync run."""
+        if self._sync_state is None or self._sync_weights is None:
+            return
+        import jax.numpy as jnp
+        indices = [i for (_, _, i) in self._sync_sig] \
+            if self._sync_sig else []
+        shapes = {pos: tuple(w.shape)
+                  for pos, w in enumerate(self._sync_weights)}
+        per_param = self._sync_state.export_per_param(shapes)
+        for pos, i in enumerate(indices):
+            template = self._opt.create_state_multi_precision(
+                i, self._sync_weights[pos])
+            flat = _flat_state_handles(template)
+            vals = per_param.get(pos)
+            if flat is None or vals is None:
+                continue
+            for h, v in zip(flat, vals):
+                h._set_data(jnp.asarray(v))
+            self._updater.states[i] = template
+            self._updater.states_synced[i] = True
+
+    def _update_sync(self, items, indices, weights_nd, fns):
+        """The bucketed reduce-scatter + sharded-update dispatch.
+        Returns True when it ran; None → caller takes the plain fused
+        path."""
+        from .parallel import grad_sync
+        built = self._sync_setup(indices, weights_nd)
+        if built is None:
+            return None
+        plan, sync_state = built
+        states = sync_state.ensure()
+        weights = tuple(w._data for w in weights_nd)
+        grads = tuple(g._data for _, _, g in items)
+        poisons = self._poisons(indices)
+        guard = self._guard_active()
+        inject = poisons is not None
+        scalars = self._scalars(indices)
+        fn = self._compiled_sync(grads, weights, states, plan, fns,
+                                 guard, inject, tuple(indices))
+        if poisons is None:
+            poisons = self._zero_poisons(len(fns))
+        from . import telemetry
+        with telemetry.span("optimizer"):
+            new_ws, new_sts, mask = fn(grads, weights, states, scalars,
+                                       poisons)
+        self.dispatch_count += 1
+        _count("fused_step_dispatches")
+        _count("fused_step_sync_dispatches")
+        grad_sync.account_in_program_sync(plan)
+        for w_nd, w in zip(weights_nd, new_ws):
+            w_nd._set_data(w)
+        sync_state.store(new_sts)
+        self._sync_weights = list(weights_nd)
+        self._post_step(indices, mask, guard)
+        return True
+
+    def _compiled_sync(self, grads, weights, states, plan, fns, guard,
+                       inject, idx_key):
+        key = ("sync", _sig(grads), _sig(weights), _sig(states),
+               plan.signature(), guard, inject, idx_key,
+               self._opt.fused_static_key())
+        cached = self._cache.get(key)
+        if cached is not None:
+            _count("fused_step_cache_hits")
+            return cached
+        _count("fused_step_cache_misses")
+        from .parallel import grad_sync
+        apply_fn = grad_sync.make_bucketed_apply(
+            fns, self._sync_state.n_slots, plan, self._sync_mesh,
+            self._sync_axis, guard, inject)
+
+        def program(grads, weights, states, scalars, poisons):
+            self._trace_count += 1
+            return apply_fn(grads, weights, states, scalars, poisons)
+
+        def describe(grads, weights, states, scalars, poisons):
+            from .compile_watch import describe_arrays
+            d = describe_arrays(
+                ["grad:param%d" % i for i in idx_key], grads)
+            d.update(describe_arrays(
+                ["param%d" % i for i in idx_key], weights))
+            d.update(describe_arrays(
+                ["state%d" % i for i in range(len(states))], states))
+            d.update(describe_arrays(
+                ["scalars", "poisons"], [scalars, poisons]))
+            return d
+
+        from . import compile_watch
+        from .engine import compiler_options
+        fn = compile_watch.jit(
+            program, "fused_step:trainer_sync", describe=describe,
+            counter="fused_step_compile_ms",
+            statics=(plan.signature(), guard, inject, idx_key,
+                     self._opt.fused_static_key()),
+            donate_argnums=(1, 2),
+            compiler_options=compiler_options())
+        self._cache[key] = fn
+        return fn
 
     def update(self, items):
         """``items``: ordered ``[(index, weight_nd, grad_nd)]`` for the
@@ -398,6 +608,20 @@ class FusedUpdater(_FusedCore):
         if fns is None:
             _count("fused_step_fallbacks")
             return False
+        if self._sync_mesh is not None and \
+                self._sync_eligible(weights_nd,
+                                    [g for _, _, g in items]):
+            ran = self._update_sync(items, indices, weights_nd, fns)
+            if ran is not None:
+                return ran
+        if self._sync_state is not None:
+            # leaving the sync path (roster/placement ineligible this
+            # step): the live moments are in the sharded flats, not the
+            # Updater — put them back so the plain/eager update
+            # continues the same trajectory, and force a re-seed if
+            # sync mode resumes later
+            self.export_states_to_updater()
+            self.invalidate_sync()
         handles, counts = self._states_for(indices, weights_nd)
         if handles is None:
             _count("fused_step_fallbacks")

@@ -139,11 +139,25 @@ class Trainer:
 
     # -- the step ---------------------------------------------------------
     def allreduce_grads(self):
-        """Cross-worker gradient reduction (reference: trainer.py:331)."""
+        """Cross-worker gradient reduction (reference: trainer.py:331).
+
+        With ``MXNET_GRAD_OVERLAP=1`` the dense-gradient exchange goes
+        through ``parallel.grad_sync.bucketed_kvstore_sync`` — one
+        concatenated push/pull per size-capped bucket instead of one
+        per key (exact: concatenation and the store's elementwise sum
+        commute). Hosted updates (``update_on_kvstore``) keep the
+        per-key loop: the server's optimizer runs per key."""
         if not self._kv_initialized:
             self._init_kvstore()
         if self._kvstore is None:
             return
+        if not self._update_on_kvstore:
+            from ..parallel import grad_sync
+            if grad_sync.overlap_enabled():
+                items = [(i, p.grad()) for i, p in
+                         enumerate(self._params) if p.grad_req != 'null']
+                if grad_sync.bucketed_kvstore_sync(self._kvstore, items):
+                    return
         for i, param in enumerate(self._params):
             if param.grad_req != 'null':
                 self._kvstore.push(i, param.grad())
@@ -238,20 +252,57 @@ class Trainer:
         return RowSparseNDArray(grad.take(rows_nd), rows_nd, grad.shape,
                                 ctx=grad.context)
 
+    def _sync_mesh(self):
+        """The mesh the in-program bucketed sync would run over: the
+        params' own NamedSharding mesh when it has a ``dp`` axis and
+        ``MXNET_GRAD_OVERLAP=1`` — None otherwise (plain fused
+        update)."""
+        from ..parallel import grad_sync
+        if not grad_sync.overlap_enabled():
+            return None
+        for p in self._params:
+            if p._data is None:
+                continue
+            sharding = getattr(p._data._data, "sharding", None)
+            mesh = getattr(sharding, "mesh", None)
+            if mesh is None or "dp" not in getattr(mesh, "axis_names",
+                                                   ()):
+                return None
+            return mesh if mesh.devices.size > 1 else None
+        return None
+
     def _get_fused(self):
         """The fused all-parameter update program (fused_step.py): one
         donated XLA dispatch per step instead of ~2·P eager launches.
         None when MXNET_FUSED_STEP=0; the FusedUpdater itself reports
-        False (→ eager loop) for optimizers without a compiled path."""
+        False (→ eager loop) for optimizers without a compiled path.
+        On a dp mesh with ``MXNET_GRAD_OVERLAP=1`` the updater carries
+        the sync mesh: the update lowers through the bucketed
+        reduce-scatter + ZeRO-1 sharded-state composition of
+        ``parallel.grad_sync``."""
         from ..fused_step import FusedUpdater, fused_step_enabled
         if not fused_step_enabled():
+            if self._fused_updater is not None:
+                # the gate can be flipped off mid-run: the live
+                # moments may sit in the updater's ZeRO-sharded flats
+                # — put them back before the eager loop reads the
+                # shared Updater, or momentum/Adam state resets
+                self._fused_updater.export_states_to_updater()
+                self._fused_updater.invalidate_sync()
             return None
+        mesh = self._sync_mesh()
         fused = self._fused_updater
         if fused is not None and fused._opt is self._optimizer and \
-                fused._updater is self._updaters[0]:
+                fused._updater is self._updaters[0] and \
+                fused._sync_mesh == mesh:
             return fused
+        if fused is not None:
+            # don't strand ZeRO-sharded state in a discarded updater —
+            # put it back into the shared Updater's per-param layout
+            fused.export_states_to_updater()
         self._fused_updater = FusedUpdater(self._optimizer,
-                                           self._updaters[0])
+                                           self._updaters[0],
+                                           sync_mesh=mesh)
         return self._fused_updater
 
     def _apply_updates(self, ignore_stale_grad=False):
@@ -327,6 +378,12 @@ class Trainer:
                 "without updater"
             payload = updater.get_states(dump_optimizer=True)
         else:
+            fused = self._fused_updater
+            if fused is not None:
+                # materialize ZeRO-sharded flat state back into the
+                # Updater's per-param layout so the .states pickle
+                # stays interchangeable with every non-sync run
+                fused.export_states_to_updater()
             payload = self._updaters[0].get_states(dump_optimizer=True)
         if background:
             ckpt.write_bytes_async(fname, payload)
@@ -347,3 +404,7 @@ class Trainer:
                 updater.optimizer = self._updaters[0].optimizer
             self._optimizer = self._updaters[0].optimizer
         self._optimizer.param_dict = dict(enumerate(self._params))
+        if self._fused_updater is not None:
+            # the Updater's per-param states were just replaced — the
+            # next sync-mode update must re-seed its sharded flats
+            self._fused_updater.invalidate_sync()

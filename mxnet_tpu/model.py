@@ -83,6 +83,7 @@ def _update_params_on_kvstore(param_arrays, grad_arrays, kvstore,
 def _update_params(param_arrays, grad_arrays, updater, num_device,
                    kvstore=None, param_names=None):
     updates = [[] for _ in range(num_device)]
+    bucketed = _bucketed_exchange(grad_arrays, kvstore)
     for i, pair in enumerate(zip(param_arrays, grad_arrays)):
         arg_list, grad_list = pair
         if not isinstance(arg_list, list):
@@ -90,7 +91,7 @@ def _update_params(param_arrays, grad_arrays, updater, num_device,
         if grad_list[0] is None:
             continue
         index = i
-        if kvstore:
+        if kvstore and not bucketed:
             name = param_names[index]
             kvstore.push(name, grad_list, priority=-index)
             kvstore.pull(name, grad_list, priority=-index)
@@ -101,6 +102,31 @@ def _update_params(param_arrays, grad_arrays, updater, num_device,
         for upd in dev_updates:
             i, g, w = upd
             updater(i, g, w)
+
+
+def _bucketed_exchange(grad_arrays, kvstore):
+    """The ``MXNET_GRAD_OVERLAP=1`` eager gradient exchange: dense
+    single-copy gradients go through the kvstore as size-capped concat
+    buckets (``parallel.grad_sync.bucketed_kvstore_sync`` — one
+    push/pull per bucket instead of per key, exact because concat and
+    the store's elementwise sum commute). Returns True when the
+    exchange already happened; multi-copy or sparse rosters return
+    False and keep the per-key loop above."""
+    if not kvstore:
+        return False
+    from .parallel import grad_sync
+    if not grad_sync.overlap_enabled():
+        return False
+    items = []
+    for i, grad_list in enumerate(grad_arrays):
+        if not isinstance(grad_list, list):
+            grad_list = [grad_list]
+        if grad_list[0] is None:
+            continue
+        if len(grad_list) != 1:
+            return False          # per-device copies need per-key sums
+        items.append((i, grad_list[0]))
+    return grad_sync.bucketed_kvstore_sync(kvstore, items)
 
 
 def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params,

@@ -516,9 +516,27 @@ class Module(BaseModule):
                 _update_params_on_kvstore(weights, grads, self._kvstore,
                                           self._param_names)
         else:
+            kvstore = self._kvstore
+            if kvstore is not None:
+                # worker-side update: the gradient exchange is the
+                # "sync" phase. With MXNET_GRAD_OVERLAP=1 it runs as
+                # size-capped concat buckets (grad_sync) — one
+                # push/pull per bucket; otherwise per key, as before.
+                from ..model import _bucketed_exchange
+                with telemetry.span("sync"):
+                    if _bucketed_exchange(grads, kvstore):
+                        kvstore = None      # exchange already done
+                    else:
+                        for i, name in enumerate(self._param_names):
+                            g = grads[i]
+                            if g is None:
+                                continue
+                            kvstore.push(name, [g], priority=-i)
+                            kvstore.pull(name, [g], priority=-i)
+                        kvstore = None
             with telemetry.span("optimizer"):
                 _update_params(weights, grads, updater=self._updater,
-                               num_device=1, kvstore=self._kvstore,
+                               num_device=1, kvstore=kvstore,
                                param_names=self._param_names)
 
     def get_outputs(self, merge_multi_context=True):

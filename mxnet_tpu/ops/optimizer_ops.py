@@ -25,6 +25,19 @@ def _prep_grad(grad, attrs):
     return g
 
 
+def stable_sqrt(x):
+    """sqrt whose downstream division stays exact IEEE: the
+    optimization barrier stops XLA's div-of-sqrt fusion, whose
+    approximate (rsqrt-style) codegen is SHAPE-DEPENDENT — the same
+    elements come out ~1 ULP apart on a replicated buffer vs a
+    reduce-scattered slice. With the barrier, sqrt and the divide are
+    each exact elementwise ops, so AdaGrad/RMSProp updates compute
+    bit-identically whether they run per-parameter, fused, or on the
+    flat dp-sharded buckets of ``parallel/grad_sync.py`` — the
+    trajectory-identity oracle both fused_step and grad_sync pin."""
+    return lax.optimization_barrier(jnp.sqrt(x))
+
+
 def _prep_grad_wd(grad, weight, attrs):
     """adam/rmsprop/ftml-family ordering (optimizer_op-inl.h:1153,
     1546): fold wd into the gradient FIRST, then clip the sum — unlike
@@ -138,7 +151,7 @@ def _rmsprop_update(attrs, weight, grad, n):
     rho = float(attrs.get("gamma1", 0.95))
     eps = float(attrs.get("epsilon", 1e-8))
     new_n = rho * n + (1 - rho) * jnp.square(g)
-    new_w = weight - lr * g / jnp.sqrt(new_n + eps)
+    new_w = weight - lr * g / stable_sqrt(new_n + eps)
     return _clip_weights(new_w, attrs), new_n
 
 
@@ -157,7 +170,8 @@ def _rmspropalex_update(attrs, weight, grad, n, g_acc, delta):
     eps = float(attrs.get("epsilon", 1e-8))
     new_n = rho * n + (1 - rho) * jnp.square(g)
     new_g = rho * g_acc + (1 - rho) * g
-    new_delta = mu * delta - lr * g / jnp.sqrt(new_n - jnp.square(new_g) + eps)
+    new_delta = mu * delta - lr * g / stable_sqrt(
+        new_n - jnp.square(new_g) + eps)
     return (_clip_weights(weight + new_delta, attrs), new_n, new_g,
             new_delta)
 
@@ -197,7 +211,8 @@ def _adagrad_update(attrs, weight, grad, history):
     wd = float(attrs.get("wd", 0.0))
     eps = float(attrs.get("epsilon", 1e-7))
     new_h = history + jnp.square(g)
-    return weight - lr * (g / jnp.sqrt(new_h + eps) + wd * weight), new_h
+    return weight - lr * (g / stable_sqrt(new_h + eps)
+                          + wd * weight), new_h
 
 
 register("_sparse_adagrad_update", _adagrad_update,

@@ -496,6 +496,7 @@ class AdaGrad(Optimizer):
         if self.multi_precision and _is_low_precision(weight.dtype):
             return None
         import jax.numpy as jnp
+        from ..ops.optimizer_ops import stable_sqrt
         eps, clip = self.float_stable_eps, self.clip_gradient
 
         def fn(grad, weight, states, lr, wd, rescale):
@@ -504,7 +505,7 @@ class AdaGrad(Optimizer):
                 g = jnp.clip(g, -clip, clip)
             (history,) = states
             new_h = history + jnp.square(g)
-            new_w = weight - lr * (g / jnp.sqrt(new_h + eps)
+            new_w = weight - lr * (g / stable_sqrt(new_h + eps)
                                    + wd * weight)
             return new_w, (new_h,)
         return fn
@@ -553,6 +554,7 @@ class RMSProp(Optimizer):
         if self.multi_precision and _is_low_precision(weight.dtype):
             return None
         import jax.numpy as jnp
+        from ..ops.optimizer_ops import stable_sqrt
         rho, mu, eps = self.gamma1, self.gamma2, self.epsilon
         clip, cw = self.clip_gradient, self.clip_weights
         centered = self.centered
@@ -564,13 +566,13 @@ class RMSProp(Optimizer):
             if not centered:
                 (n,) = states
                 new_n = rho * n + (1 - rho) * jnp.square(g)
-                new_w = weight - lr * g / jnp.sqrt(new_n + eps)
+                new_w = weight - lr * g / stable_sqrt(new_n + eps)
                 new_states = (new_n,)
             else:
                 n, g_acc, delta = states
                 new_n = rho * n + (1 - rho) * jnp.square(g)
                 new_g = rho * g_acc + (1 - rho) * g
-                new_delta = mu * delta - lr * g / jnp.sqrt(
+                new_delta = mu * delta - lr * g / stable_sqrt(
                     new_n - jnp.square(new_g) + eps)
                 new_w = weight + new_delta
                 new_states = (new_n, new_g, new_delta)

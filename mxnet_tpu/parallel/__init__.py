@@ -6,7 +6,10 @@ shardings, let XLA insert collectives over ICI/DCN.
 from .mesh import (create_mesh, auto_mesh, mesh_axes, local_mesh,
                    PartitionSpec, NamedSharding, replicated, shard_batch)
 from .collectives import (all_reduce, all_gather, reduce_scatter, broadcast,
-                          ppermute, barrier, psum_eager)
+                          ppermute, barrier, psum_eager,
+                          bucket_reduce_scatter, bucket_all_gather)
+from . import grad_sync
+from .grad_sync import GradSyncPlan, ShardedOptState
 from .ring_attention import ring_attention, ulysses_attention, \
     local_attention
 from .data_parallel import (make_data_parallel_step, shard_params,

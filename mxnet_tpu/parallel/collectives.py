@@ -20,7 +20,8 @@ from __future__ import annotations
 import functools
 
 __all__ = ["all_reduce", "all_gather", "reduce_scatter", "broadcast",
-           "ppermute", "barrier", "psum_eager"]
+           "ppermute", "barrier", "psum_eager",
+           "bucket_reduce_scatter", "bucket_all_gather"]
 
 # (primitive, mesh, statics) -> compile_watch-wrapped jitted shard_map
 _prim_cache = {}
@@ -115,18 +116,101 @@ def all_gather(x, mesh, axis="dp", tiled=True):
 
 
 def reduce_scatter(x, mesh, axis="dp"):
+    """Reduce the per-device contributions of ``x`` and scatter the
+    sum along the mesh axis. A leading dim that does not divide the
+    axis size (formerly a hard XLA shape error inside psum_scatter) is
+    zero-padded up to the next multiple before the collective and the
+    padding rows are sliced back off the (sharded) result — the sum is
+    unaffected because the pad contributes exact zeros."""
     import jax
     from jax.sharding import PartitionSpec as P
 
+    n = dict(zip(mesh.axis_names, mesh.devices.shape))[axis]
+    d0 = int(x.shape[0]) if getattr(x, "ndim", 0) else 1
+    rem = d0 % n
+
     def f(v):
+        if rem:
+            pad = [(0, n - rem)] + [(0, 0)] * (v.ndim - 1)
+            v = jax.numpy.pad(v, pad)
         return jax.lax.psum_scatter(v, axis, tiled=True)
 
     from .. import telemetry
     with telemetry.comm_span("collective", "reduce_scatter", x):
-        return _watched(
-            "reduce_scatter", mesh, (axis,),
+        out = _watched(
+            "reduce_scatter", mesh, (axis, rem),
             lambda: _shard_map()(f, mesh=mesh, in_specs=(P(),),
                                  out_specs=P(axis)))(x)
+    return out[:d0] if rem else out
+
+
+def bucket_reduce_scatter(stacked, mesh, axis="dp", key="bucket"):
+    """One collective for a whole gradient bucket: ``stacked`` is a
+    list of same-dtype ``(axis_size, *shape)`` arrays sharded over
+    ``axis`` on dim 0 — each row one device's local contribution. The
+    bucket is flattened+concatenated per device, zero-padded so the
+    total divides the axis size, and reduce-scattered: the return is
+    the summed flat bucket of length ``padded_total`` sharded over
+    ``axis``, ready for a shard-local (ZeRO) optimizer update. The
+    eager counterpart of ``grad_sync.make_bucketed_apply``'s
+    in-program constraint, accounted as one ``grad_sync`` comm span
+    under ``key``."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    n = dict(zip(mesh.axis_names, mesh.devices.shape))[axis]
+    total = sum(int(_prod(v.shape[1:])) for v in stacked)
+    pad = (-(-total // n) * n) - total
+    sizes = tuple(int(_prod(v.shape[1:])) for v in stacked)
+    dt = stacked[0].dtype
+
+    def f(*vs):
+        segs = [v.reshape(-1) for v in vs]
+        if pad:
+            segs.append(jnp.zeros((pad,), dt))
+        return jax.lax.psum_scatter(jnp.concatenate(segs), axis,
+                                    tiled=True)
+
+    from .. import telemetry
+    # ledger the LOGICAL payload — the reduced padded bucket, one
+    # direction — not the (n_dev, ...) stacked operands, so the bytes
+    # column is comparable with the in-program and kvstore grad_sync
+    # rows (each of which counts bucket bytes once per direction)
+    with telemetry.comm_span("grad_sync", key,
+                             nbytes=(total + pad) * dt.itemsize):
+        return _watched(
+            "bucket_reduce_scatter", mesh,
+            (axis, sizes, str(dt), pad),
+            lambda: _shard_map()(f, mesh=mesh,
+                                 in_specs=tuple(P(axis)
+                                                for _ in stacked),
+                                 out_specs=P(axis)))(*stacked)
+
+
+def bucket_all_gather(flat, mesh, axis="dp", key="bucket"):
+    """Gather a reduce-scattered flat bucket back to a replicated
+    vector (the updated-params all-gather of the eager bucketed path).
+    Accounted as one ``grad_sync`` comm span under ``key``."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    def f(v):
+        return jax.lax.all_gather(v, axis, tiled=True)
+
+    from .. import telemetry
+    with telemetry.comm_span("grad_sync", key, flat):
+        return _watched(
+            "bucket_all_gather", mesh, (axis,),
+            lambda: _shard_map()(f, mesh=mesh, in_specs=(P(axis),),
+                                 out_specs=P()))(flat)
+
+
+def _prod(shape):
+    out = 1
+    for s in shape:
+        out *= int(s)
+    return out
 
 
 def ppermute(x, mesh, axis, perm):

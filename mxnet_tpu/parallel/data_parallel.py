@@ -7,10 +7,21 @@ and XLA inserts the gradient psum where the sharding demands it — the
 allreduce overlaps backprop exactly as the reference's engine-priority
 trick tried to achieve (SURVEY §7 hard-part 2), but scheduled by the
 compiler.
+
+With ``MXNET_GRAD_OVERLAP=1`` (or ``grad_overlap=True``) the step goes
+further (``parallel.grad_sync``): gradients are partitioned into
+backward-ordered size-capped buckets, each bucket's exchange lowers to
+a **reduce-scatter** instead of an all-reduce, the optimizer update
+runs on each device's slice against ZeRO-1 flat-sharded optimizer
+state (1/N per-device state memory), and only the updated parameters
+all-gather back — all inside the same compiled step, bit-exact against
+the unbucketed path.
 """
 from __future__ import annotations
 
 from typing import Any, Callable, Dict, Optional
+
+from ..base import MXNetError
 
 __all__ = ["make_data_parallel_step", "shard_params", "DistributedTrainer",
            "sharded_input_pipeline"]
@@ -40,6 +51,10 @@ def _put_unless_placed(value, sharding):
     return jax.device_put(value, sharding)
 
 
+def _axis_size(mesh, axis):
+    return dict(zip(mesh.axis_names, mesh.devices.shape))[axis]
+
+
 def shard_params(params: Dict[str, Any], mesh, rules=None):
     """Place a name→array dict on the mesh. ``rules`` maps substring →
     PartitionSpec; default replicates everything. NDArray values are
@@ -67,25 +82,90 @@ def shard_params(params: Dict[str, Any], mesh, rules=None):
 
 
 def make_data_parallel_step(loss_fn: Callable, mesh, optimizer_update=None,
-                            donate=True):
+                            donate=True, grad_overlap=None,
+                            bucket_mb=None):
     """Compile ``(params, batch) -> (loss, new_params)`` with batch
     sharded over dp and grads reduced implicitly.
 
     loss_fn(params: dict, batch: dict) -> scalar loss (pure JAX).
     optimizer_update(p, g) -> new_p elementwise (default SGD lr=0.01).
+
+    ``grad_overlap`` (None → the ``MXNET_GRAD_OVERLAP`` gate) switches
+    the gradient exchange + update to the bucketed reduce-scatter form:
+    each backward-ordered bucket of the flat gradient roster is
+    constrained to ``P('dp')`` (the partitioner's reduce-scatter
+    point), ``optimizer_update`` runs elementwise on the slice, and the
+    updated params all-gather back. Losses/gradients are identical
+    between modes (weights are pinned replicated before bucketing, so
+    the forward/backward never re-partitions); the updated params may
+    differ ~1 ULP because the gate-closed path keeps its original
+    replicated ``tree_map`` update, whose XLA codegen contracts FMAs
+    the shard-wise update does not. ``DistributedTrainer`` runs BOTH
+    modes through the same shard-wise machinery and is the bit-exact
+    (rtol=0) oracle ``tests/test_grad_sync.py`` pins.
     """
     import jax
     import jax.numpy as jnp
     from jax.sharding import NamedSharding, PartitionSpec as P
+    from . import grad_sync
 
     if optimizer_update is None:
         def optimizer_update(p, g):
             return p - 0.01 * g
 
-    def step(params, batch):
-        loss, grads = jax.value_and_grad(loss_fn)(params, batch)
-        new_params = jax.tree_util.tree_map(optimizer_update, params, grads)
-        return loss, new_params
+    overlap = grad_sync.overlap_enabled() if grad_overlap is None \
+        else bool(grad_overlap)
+
+    if not overlap:
+        def step(params, batch):
+            loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+            new_params = jax.tree_util.tree_map(optimizer_update,
+                                                params, grads)
+            return loss, new_params
+    else:
+        cap = int(bucket_mb * (1 << 20)) if bucket_mb else None
+        shard = NamedSharding(mesh, P("dp"))
+        rep = NamedSharding(mesh, P())
+        wsc = jax.lax.with_sharding_constraint
+
+        def step(params, batch):
+            loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+            leaves_g, treedef = jax.tree_util.tree_flatten(grads)
+            # pin weights replicated BEFORE bucketing (see
+            # grad_sync.make_bucketed_apply): without the pin each
+            # bucket's flat-shard constraint back-propagates through
+            # concatenate onto the weight nodes and re-partitions the
+            # forward/backward
+            leaves_p = [wsc(l, rep)
+                        for l in jax.tree_util.tree_leaves(params)]
+            plan = grad_sync.GradSyncPlan(
+                [l.shape for l in leaves_p],
+                [l.dtype for l in leaves_p],
+                axis_size=_axis_size(mesh, "dp"), cap_bytes=cap)
+            new_leaves = [None] * len(leaves_p)
+            for bucket in plan.buckets:
+                dt = jnp.dtype(bucket.dtype)
+                segs_g = [leaves_g[i].reshape(-1)
+                          for i in bucket.indices]
+                segs_p = [leaves_p[i].reshape(-1)
+                          for i in bucket.indices]
+                pad = bucket.padded_size - bucket.total
+                if pad:
+                    segs_g.append(jnp.zeros((pad,), dt))
+                    segs_p.append(jnp.zeros((pad,), dt))
+                gflat = wsc(jnp.concatenate(segs_g), shard)
+                pflat = wsc(jnp.concatenate(segs_p), shard)
+                # update pinned shard-wise first, gathered after — the
+                # all-gather moves updated params only
+                new_flat = wsc(wsc(optimizer_update(pflat, gflat),
+                                   shard), rep)
+                for i, off, size in zip(bucket.indices, bucket.offsets,
+                                        bucket.sizes):
+                    new_leaves[i] = new_flat[off:off + size] \
+                        .reshape(leaves_p[i].shape)
+            new_params = jax.tree_util.tree_unflatten(treedef,
+                                                      new_leaves)
+            return loss, new_params
 
     batch_sharding = NamedSharding(mesh, P("dp"))
     jit_kwargs = {}
@@ -98,27 +178,82 @@ class DistributedTrainer:
     """Gluon-style trainer whose step is one compiled mesh program.
 
     Usage: build a HybridBlock, call trainer.fit_batch(data, label).
-    Parameters live as mesh-sharded jax arrays inside the compiled step;
-    the Gluon Parameter handles are refreshed after each step.
+    Parameters live as mesh-sharded jax arrays inside the compiled
+    step, placed ONCE at build and kept device-resident across steps
+    (the Gluon Parameter handles are refreshed lazily — call
+    :meth:`sync_gluon_params` to read trained values back through
+    ``net.collect_params()``).
+
+    The update routes through the shared ``Optimizer.fused_step_fn``
+    roster — any registered optimizer with a compiled update path
+    (SGD/momentum, Adam, AdaGrad, RMSProp) works; unknown names and
+    optimizers without a fused path raise at construction/build.
+
+    With ``grad_overlap=True`` (or ``MXNET_GRAD_OVERLAP=1``) the step
+    compiles the bucketed reduce-scatter + ZeRO-1 sharded-update
+    composition from ``parallel.grad_sync``: optimizer state lives
+    permanently dp-sharded (1/N per device) and round-trips through
+    ``checkpoint.py``'s per-shard manifest format
+    (:meth:`save_checkpoint` / :meth:`load_checkpoint`, elastic across
+    mesh sizes). Trajectories are bit-exact vs ``grad_overlap=False``.
     """
 
     def __init__(self, net, loss_block, mesh, optimizer="sgd",
-                 learning_rate=0.01, param_rules=None):
-        import jax
+                 learning_rate=0.01, optimizer_params=None,
+                 param_rules=None, grad_overlap=None, bucket_mb=None):
+        from .. import optimizer as opt_mod
         self._net = net
         self._loss = loss_block
         self._mesh = mesh
-        self._lr = learning_rate
+        if isinstance(optimizer, opt_mod.Optimizer):
+            self._opt = optimizer
+        else:
+            kwargs = dict(optimizer_params or {})
+            kwargs.setdefault("learning_rate", learning_rate)
+            self._opt = opt_mod.create(optimizer, **kwargs)
+        self._overlap = grad_overlap
+        self._bucket_mb = bucket_mb
         self._step_fn = None
-        self._param_names = None
         self._batch_sharding = None
+        self._roster = None
+        self._aux_roster = None
+        self._param_vals = None       # device-resident, placed once
+        self._aux_vals = None
+        self._state_vals = None
+        self._plan = None
+        self._sync_state = None
+        self._poisons_zero = None
+        self._pending_restore = None
+        self._gluon_dirty = False
+        self.dispatch_count = 0
 
+    # -- properties -------------------------------------------------------
+    @property
+    def optimizer(self):
+        return self._opt
+
+    @property
+    def overlap(self):
+        """True when the built step uses the bucketed reduce-scatter
+        + sharded-state path (None before the first fit_batch)."""
+        return None if self._step_fn is None \
+            else self._sync_state.sharded
+
+    def state_bytes_per_device(self):
+        """Resident optimizer-state bytes per device: the sharded 1/N
+        figure in overlap mode, the full replicated size otherwise."""
+        return 0 if self._sync_state is None \
+            else self._sync_state.state_bytes_per_device()
+
+    # -- build ------------------------------------------------------------
     def _build(self, data, label):
         import jax
-        import jax.numpy as jnp
+        import numpy as _np
         from jax.sharding import NamedSharding, PartitionSpec as P
         from ..cached_op import build_graph_callable
+        from ..ndarray import NDArray
         from .. import symbol as sym_mod
+        from . import grad_sync
 
         net, loss_blk = self._net, self._loss
         # trace net(data) -> loss(out, label) into one symbol graph
@@ -132,9 +267,74 @@ class DistributedTrainer:
         self._graph = (fn, arg_names, aux_names)
         self._params = params
         mesh = self._mesh
-        lr = self._lr
+        roster = [n for n in arg_names if n in params]
+        aux_roster = [n for n in aux_names if n in params]
+        self._roster, self._aux_roster = roster, aux_roster
+        indices = list(range(len(roster)))
+        if not self._opt.idx2name:
+            self._opt.idx2name = dict(enumerate(roster))
 
-        def step(param_vals, aux_vals, data_v, label_v, rng):
+        weights_nd = [params[n].data() for n in roster]
+        step_fns = [self._opt.fused_step_fn(i, w)
+                    for i, w in zip(indices, weights_nd)]
+        if any(f is None for f in step_fns):
+            raise MXNetError(
+                "DistributedTrainer: optimizer %s has no compiled "
+                "(fused_step_fn) update path for this roster — use "
+                "SGD/momentum, Adam, AdaGrad or RMSProp"
+                % type(self._opt).__name__)
+
+        rep = NamedSharding(mesh, P())
+        # satellite: parameters placed ONCE at build; steps feed the
+        # device-resident values, never re-device_put per step. The
+        # .copy() breaks any aliasing with the Gluon handles (a
+        # same-device device_put can alias its input): fit_batch
+        # DONATES these buffers, and a donated alias would leave the
+        # Parameter reading a deleted buffer.
+        self._param_vals = [
+            _put_unless_placed(params[n].data()._data, rep).copy()
+            for n in roster]
+        self._aux_vals = [
+            _put_unless_placed(params[n].data()._data, rep).copy()
+            for n in aux_roster]
+
+        # Both modes run the SAME sharded-update machinery; they differ
+        # only in the bucket partition (size-capped backward-order
+        # buckets vs ONE monolithic blob — the "one blob after
+        # backward" baseline ROADMAP item 4 names) and in where the
+        # optimizer state lives (dp-sharded 1/N vs replicated). That
+        # symmetry is what makes the two trajectories bit-identical:
+        # XLA contracts FMAs in replicated elementwise code but not in
+        # partitioned code, so a replicated-update baseline would
+        # drift ~1 ULP/step.
+        overlap = grad_sync.overlap_enabled() if self._overlap is None \
+            else bool(self._overlap)
+        cap = int(self._bucket_mb * (1 << 20)) if self._bucket_mb \
+            else None
+        plan = grad_sync.GradSyncPlan(
+            [w.shape for w in weights_nd],
+            [w.dtype for w in weights_nd],
+            axis_size=_axis_size(mesh, "dp"),
+            cap_bytes=cap if overlap else grad_sync.MONOLITH_CAP)
+        sync_state = grad_sync.ShardedOptState(plan, mesh, "dp",
+                                               sharded=overlap)
+        if not sync_state.probe(self._opt, indices, weights_nd):
+            raise MXNetError(
+                "DistributedTrainer: optimizer %s state layout "
+                "has no sharded path" % type(self._opt).__name__)
+        self._state_vals = list(sync_state.ensure())
+        self._plan, self._sync_state = plan, sync_state
+        apply_fn = grad_sync.make_bucketed_apply(
+            step_fns, sync_state.n_slots, plan, mesh, "dp",
+            guard=False, inject=False, shard_state=overlap)
+
+        self._poisons_zero = _np.zeros((len(roster),), _np.float32)
+        n_aux = len(aux_roster)
+        aux_pos = {n: k for k, n in enumerate(aux_roster)}
+        roster_pos = {n: k for k, n in enumerate(roster)}
+
+        def step(param_vals, state_vals, aux_vals, data_v, label_v,
+                 rng, scalars, poisons):
             def loss_of(pv):
                 vals = []
                 for n in arg_names:
@@ -143,47 +343,162 @@ class DistributedTrainer:
                     elif n == "label":
                         vals.append(label_v)
                     else:
-                        vals.append(pv[n])
-                vals.extend(aux_vals[n] for n in aux_names)
+                        vals.append(pv[roster_pos[n]])
+                vals.extend(aux_vals[aux_pos[n]] for n in aux_names)
                 outs = fn({"__train__": True}, *vals, rng=rng)
                 loss = outs[0].mean()
-                new_aux = {n: v for n, v in
-                           zip(aux_names, outs[n_out:])}
+                new_aux = tuple(outs[n_out:n_out + n_aux])
                 return loss, new_aux
 
             (loss, new_aux), grads = jax.value_and_grad(
                 loss_of, has_aux=True)(param_vals)
-            new_params = jax.tree_util.tree_map(
-                lambda p, g: p - lr * g, param_vals, grads)
-            return loss, new_params, new_aux
+            new_ws, new_sts, _ = apply_fn(grads, param_vals,
+                                          state_vals, scalars, poisons)
+            return loss, new_ws, new_sts, new_aux
 
-        self._step_fn = jax.jit(step, donate_argnums=(0,))
+        self._step_fn = jax.jit(step, donate_argnums=(0, 1, 2))
         self._batch_sharding = NamedSharding(mesh, P("dp"))
+        if self._pending_restore is not None:
+            self._apply_restore(self._pending_restore)
+            self._pending_restore = None
 
+    # -- the step ---------------------------------------------------------
     def fit_batch(self, data, label):
-        """One training step; returns the (host) loss value lazily."""
-        import jax
+        """One training step — forward, backward, gradient exchange
+        and optimizer update in a single compiled dispatch; returns
+        the (host) loss value lazily."""
         from .. import random as _random
+        from .. import telemetry
+        from ..fused_step import pack_step_scalars
         from ..ndarray import NDArray
+        from . import grad_sync
         if self._step_fn is None:
             # ensure params are materialized
             _ = self._net(data)
             self._build(data, label)
-        arg_names = self._graph[1]
-        aux_names = self._graph[2]
-        from jax.sharding import NamedSharding, PartitionSpec as P
-        repl = NamedSharding(self._mesh, P())
-        param_vals = {n: jax.device_put(self._params[n].data()._data, repl)
-                      for n in arg_names if n in self._params}
-        aux_vals = {n: jax.device_put(self._params[n].data()._data, repl)
-                    for n in aux_names if n in self._params}
         data_v = _put_unless_placed(data._data, self._batch_sharding)
         label_v = _put_unless_placed(label._data, self._batch_sharding)
-        loss, new_params, new_aux = self._step_fn(
-            param_vals, aux_vals, data_v, label_v, _random.new_key())
-        for n, v in new_params.items():
-            self._params[n]._data._set_data(v)
-        for n, v in new_aux.items():
-            if n in self._params:
-                self._params[n]._data._set_data(v)
+        scalars = pack_step_scalars(self._opt,
+                                    list(range(len(self._roster))))
+        with telemetry.span("compute"):
+            loss, new_ws, new_sts, new_aux = self._step_fn(
+                tuple(self._param_vals), tuple(self._state_vals),
+                tuple(self._aux_vals), data_v, label_v,
+                _random.new_key(), scalars, self._poisons_zero)
+        self._param_vals = list(new_ws)
+        self._state_vals = list(new_sts)
+        self._aux_vals = list(new_aux)
+        self._sync_state.store(new_sts)
+        if self._sync_state.sharded:
+            # only the overlap mode ledgers grad_sync records — the
+            # gate-closed baseline's telemetry must look like it
+            # always did (and the diagnose table is the overlap-on
+            # oracle)
+            grad_sync.account_in_program_sync(self._plan)
+        self._gluon_dirty = True
+        self.dispatch_count += 1
         return NDArray(loss)
+
+    def sync_gluon_params(self):
+        """Refresh the Gluon Parameter handles from the
+        device-resident roster (lazy — fit_batch marks them stale
+        instead of writing back every step)."""
+        if not self._gluon_dirty:
+            return
+        # copies, not aliases: the next fit_batch donates the roster
+        # arrays, which would delete the Parameter's buffer under it
+        for n, v in zip(self._roster, self._param_vals):
+            self._params[n]._data._set_data(v.copy())
+        for n, v in zip(self._aux_roster, self._aux_vals):
+            self._params[n]._data._set_data(v.copy())
+        self._gluon_dirty = False
+
+    # -- checkpointing ----------------------------------------------------
+    def _checkpoint_roster(self):
+        import numpy as _np
+        arg = dict(zip(self._roster, self._param_vals))
+        aux = dict(zip(self._aux_roster, self._aux_vals))
+        extra = self._sync_state.checkpoint_roster()
+        # the host-side update counters ride along: Adam's bias
+        # correction is t-dependent, so a resume without them would
+        # restart the schedule at t=0 and diverge from the
+        # uninterrupted trajectory
+        opt = self._opt
+        extra["opt:update_counts"] = _np.array(
+            [opt._index_update_count.get(i, opt.begin_num_update)
+             for i in range(len(self._roster))], _np.int64)
+        return arg, aux, extra
+
+    def save_checkpoint(self, prefix, epoch, manager=None):
+        """One durable sharded checkpoint — params, aux, and the
+        optimizer state (flat dp-sharded arrays in overlap mode, whose
+        pieces land per mesh position in the manifest's shard files) —
+        through ``checkpoint.py``'s atomic manifest writer. Pass a
+        ``CheckpointManager`` to save asynchronously."""
+        from .. import checkpoint as ckpt
+        assert self._step_fn is not None, \
+            "fit_batch at least once before checkpointing"
+        arg, aux, extra = self._checkpoint_roster()
+        if manager is not None:
+            manager.save(epoch, arg, aux, extra=extra)
+            return
+        ckpt.save_arrays(prefix, epoch,
+                         ckpt.snapshot_params(arg, aux, extra=extra))
+
+    def load_checkpoint(self, prefix, epoch, validate=True):
+        """Elastic resume from a manifest checkpoint: params/aux are
+        re-placed replicated on the CURRENT mesh and the sharded
+        optimizer state is re-padded for the current dp size —
+        a run saved on N devices resumes on M. Before the first
+        fit_batch the payload is staged and applied at build."""
+        from .. import checkpoint as ckpt
+        flat = ckpt.load_arrays(prefix, epoch, validate=validate)
+        if self._step_fn is None:
+            self._pending_restore = flat
+        else:
+            self._apply_restore(flat)
+
+    def _apply_restore(self, flat):
+        import numpy as _np
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        rep = NamedSharding(self._mesh, P())
+
+        def host(v):
+            return v.asnumpy() if hasattr(v, "asnumpy") \
+                else _np.asarray(v)
+
+        # restore the sharded optimizer state FIRST: load_host_flats
+        # raises on a bucket-layout mismatch (e.g. a different
+        # MXNET_GRAD_BUCKET_MB than the save used) and commits its
+        # flats only on success, so a failed restore leaves the
+        # trainer fully untouched rather than half-restored (params
+        # overwritten, state zeroed, counters advanced)
+        counts = flat.pop("opt:update_counts", None)
+        opt_flat = {k: host(v) for k, v in flat.items()
+                    if k.startswith("opt:")}
+        if opt_flat:
+            self._sync_state.load_host_flats(opt_flat)
+            self._state_vals = list(self._sync_state.ensure())
+        for pos, n in enumerate(self._roster):
+            key = "arg:%s" % n
+            if key in flat:
+                self._param_vals[pos] = _put_unless_placed(
+                    _jnp_asarray(host(flat[key])), rep)
+        for pos, n in enumerate(self._aux_roster):
+            key = "aux:%s" % n
+            if key in flat:
+                self._aux_vals[pos] = _put_unless_placed(
+                    _jnp_asarray(host(flat[key])), rep)
+        if counts is not None:
+            opt = self._opt
+            for i, c in enumerate(
+                    host(counts).astype(_np.int64).tolist()):
+                if c > opt.begin_num_update:
+                    opt._index_update_count[i] = int(c)
+                    opt.num_update = max(opt.num_update, int(c))
+        self._gluon_dirty = True
+
+
+def _jnp_asarray(v):
+    import jax.numpy as jnp
+    return jnp.asarray(v)

@@ -1,0 +1,594 @@
+"""Overlapped gradient sync: bucketed reduce-scatter + ZeRO-1 sharded
+optimizer update (ROADMAP item 4, the optimizer-state half of item 1).
+
+The reference framework overlapped communication with backprop by
+engine priority (SURVEY §7 hard-part 2): late-layer gradients were
+pushed to the kvstore while early layers were still differentiating.
+This module is the TPU-native form of that trick combined with the
+bucketing of PyTorch DDP (Li et al., VLDB 2020) and the
+optimizer-state sharding of ZeRO (Rajbhandari et al., SC 2020):
+
+- **Buckets** — the flat gradient roster is partitioned into
+  size-capped, dtype-uniform buckets (``MXNET_GRAD_BUCKET_MB``) in
+  *backward order* (late-layer grads first), so each bucket's exchange
+  is ready as soon as its layers finish differentiating.
+- **In-program reduce-scatter** — inside the compiled step each
+  bucket's gradients are concatenated flat and constrained to
+  ``P(axis)`` (``jax.lax.with_sharding_constraint``): the SPMD
+  partitioner lowers the pending cross-device sum to a
+  ``reduce-scatter`` instead of an ``all-reduce``, and schedules it
+  against the remaining backward — the reference's engine-priority
+  overlap, decided by the compiler inside ONE XLA program.
+- **ZeRO-1 sharded update** — the optimizer update
+  (``Optimizer.fused_step_fn``; every supported rule is elementwise
+  and index-independent) runs on each device's reduce-scattered slice
+  with per-element lr/wd vectors built in-program, against optimizer
+  state that lives *permanently sharded* along the same flat bucket
+  layout (1/N per device — the memory win). Only the **updated
+  parameters** are all-gathered back to the step's replicated param
+  sharding.
+- **Bit-exactness** — the sharded composition is float-identical to
+  the per-parameter path: the collective sums the same N per-device
+  contributions per element, the update rule applies the same scalar
+  ops per element (vector lr/wd entries equal the per-parameter
+  scalars), and padding is zeros under rules that keep zeros fixed.
+  ``tests/test_grad_sync.py`` pins rtol=0 trajectory identity per
+  optimizer.
+
+``MXNET_GRAD_OVERLAP=1`` turns the mode on for
+``parallel.data_parallel`` (``DistributedTrainer`` /
+``make_data_parallel_step``), the gluon ``Trainer``'s fused update on
+a dp mesh, and the eager kvstore gradient exchange
+(:func:`bucketed_kvstore_sync`, used by ``model._update_params`` and
+``gluon.Trainer.allreduce_grads`` — there the buckets are real
+host-timed ``grad_sync`` comm spans). Default off: every existing
+path is byte-identical with the gate closed.
+
+Sharded optimizer state round-trips through ``checkpoint.py``'s
+per-shard manifest format: each bucket slot is one flat dp-sharded
+array whose pieces land in per-mesh-position shard files, and
+:meth:`ShardedOptState.load_host_flats` re-pads for the *current* axis
+size, so a run saved on N devices resumes on M.
+"""
+from __future__ import annotations
+
+import os
+
+import numpy as _np
+
+from ..base import MXNetError, get_env
+
+__all__ = ["overlap_enabled", "bucket_cap_bytes", "GradSyncPlan",
+           "make_bucketed_apply", "ShardedOptState",
+           "bucketed_kvstore_sync", "account_in_program_sync"]
+
+
+def overlap_enabled():
+    """The ``MXNET_GRAD_OVERLAP`` gate — default OFF; ``1``/``true``/
+    ``on`` enable (re-read per build so tests and benchmarks can
+    toggle it)."""
+    return os.environ.get("MXNET_GRAD_OVERLAP", "0").strip().lower() \
+        in ("1", "true", "on", "yes")
+
+
+def bucket_cap_bytes():
+    """Bucket size cap from ``MXNET_GRAD_BUCKET_MB`` (default 4 MiB —
+    large enough to amortize collective launch latency, small enough
+    that several buckets exist to overlap; see README for tuning)."""
+    mb = get_env("MXNET_GRAD_BUCKET_MB", 4.0, float)
+    return max(1, int(mb * (1 << 20)))
+
+
+class _Bucket:
+    """One bucket of the flat gradient roster: member parameter
+    indices in exchange order, their flat sizes/offsets inside the
+    concatenated vector, and the zero-padded length that divides the
+    sync axis."""
+    __slots__ = ("indices", "sizes", "offsets", "total", "padded_size",
+                 "dtype", "nbytes")
+
+    def __init__(self, indices, sizes, axis_size, dtype):
+        self.indices = tuple(indices)
+        self.sizes = tuple(sizes)
+        offs, off = [], 0
+        for s in sizes:
+            offs.append(off)
+            off += s
+        self.offsets = tuple(offs)
+        self.total = off
+        self.padded_size = -(-off // axis_size) * axis_size
+        self.dtype = str(dtype)
+        self.nbytes = self.padded_size * _np.dtype(dtype).itemsize
+
+
+class GradSyncPlan:
+    """The bucket partition of one parameter roster.
+
+    Buckets are built traversing the roster in REVERSE order — the
+    backward pass produces late-layer gradients first, so bucket 0
+    (the last layers) can start reducing while early layers are still
+    differentiating. A bucket closes when adding the next parameter
+    would exceed the byte cap (every bucket holds at least one
+    parameter) or when the dtype changes (flat concatenation is
+    dtype-uniform)."""
+
+    def __init__(self, shapes, dtypes, axis_size, cap_bytes=None):
+        cap = bucket_cap_bytes() if cap_bytes is None else int(cap_bytes)
+        self.axis_size = int(axis_size)
+        self.n_params = len(shapes)
+        sizes = [int(_np.prod(s)) if len(s) else 1 for s in shapes]
+        buckets = []
+        cur, cur_sizes, cur_bytes, cur_dt = [], [], 0, None
+        for i in reversed(range(len(shapes))):
+            dt = str(dtypes[i])
+            nb = sizes[i] * _np.dtype(dt).itemsize
+            if cur and (dt != cur_dt or cur_bytes + nb > cap):
+                buckets.append(_Bucket(cur, cur_sizes, self.axis_size,
+                                       cur_dt))
+                cur, cur_sizes, cur_bytes = [], [], 0
+            cur.append(i)
+            cur_sizes.append(sizes[i])
+            cur_bytes += nb
+            cur_dt = dt
+        if cur:
+            buckets.append(_Bucket(cur, cur_sizes, self.axis_size,
+                                   cur_dt))
+        self.buckets = buckets
+
+    def signature(self):
+        """Hashable identity for compile-cache keys."""
+        return tuple((b.indices, b.total, b.padded_size, b.dtype)
+                     for b in self.buckets)
+
+    def layout_key(self):
+        """Topology-INDEPENDENT partition identity: which params land
+        in which bucket at which flat offset. Excludes padded_size —
+        padding legitimately differs across axis sizes, and elastic
+        resume re-pads — so a save on N devices matches a restore on M
+        iff the member layout agrees."""
+        return tuple((b.indices, b.sizes, b.dtype)
+                     for b in self.buckets)
+
+    def total_bytes(self):
+        return sum(b.nbytes for b in self.buckets)
+
+    def describe(self):
+        return {"buckets": len(self.buckets),
+                "axis_size": self.axis_size,
+                "bytes": self.total_bytes(),
+                "params": self.n_params}
+
+
+# ---------------------------------------------------------------------------
+# the traced composition
+# ---------------------------------------------------------------------------
+
+MONOLITH_CAP = 1 << 62   # one-blob plan: the unbucketed baseline
+
+
+def make_bucketed_apply(step_fns, n_slots, plan, mesh, axis="dp",
+                        guard=False, inject=False, shard_state=True):
+    """The bucketed, sharded form of ``fused_step.make_apply`` — same
+    call contract ``apply(grads, weights, states, scalars, poisons) ->
+    (new_weights, new_states, finite_mask)`` over raw jax arrays,
+    except ``states`` is the flat bucket layout: ``n_slots`` sharded
+    ``(padded_size,)`` vectors per bucket, ordered
+    ``[b0s0..b0s{k-1}, b1s0, ...]``.
+
+    Per bucket: splice poison / read the finite guard per parameter,
+    concatenate the flat gradients (zero pad), constrain to
+    ``P(axis)`` — the partitioner's reduce-scatter point — slice the
+    replicated weights the same way (free), run the bucket's update
+    rule once over the whole slice with in-program per-element lr/wd
+    vectors, and constrain the updated flat params back to replicated
+    — the all-gather of *updated params only*. Requires every member's
+    ``fused_step_fn`` to be index-independent, true of all compiled
+    optimizers (the closures capture only optimizer-level
+    hyperparameters).
+
+    ``shard_state=False`` is the unbucketed baseline's state layout:
+    states arrive replicated, are sliced for the (identical) sharded
+    update, and the new states are all-gathered back to replicated —
+    full per-device state memory, the profile ZeRO-1 removes. The
+    update arithmetic itself ALWAYS runs on the sharded slices in both
+    layouts: XLA's codegen for replicated elementwise math contracts
+    FMAs that its partitioned codegen does not (measured ~1 ULP per
+    step on CPU), so computing shard-wise in every mode is what makes
+    bucketed-vs-monolithic trajectories bit-identical."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    shard = NamedSharding(mesh, P(axis))
+    rep = NamedSharding(mesh, P())
+    wsc = jax.lax.with_sharding_constraint
+    n = len(step_fns)
+    buckets = plan.buckets
+
+    def apply(grads, weights, states, scalars, poisons):
+        # Pin every weight replicated BEFORE the bucket machinery
+        # touches it. Weights feed the forward matmuls AND the update:
+        # without the pin, each bucket's flat-shard constraint
+        # back-propagates through concatenate onto the weight nodes
+        # and re-partitions the forward/backward — monolithic vs
+        # bucketed plans then produce ~1-ULP-different gradients
+        # (measured on an 8-device CPU mesh) and trajectory identity
+        # dies. The pin stops the propagation at this edge; gradients
+        # are deliberately NOT pinned, so each bucket's pending
+        # cross-device sum still lowers to a reduce-scatter.
+        weights = [wsc(w, rep) for w in weights]
+        rescale = scalars[2 * n]
+        new_ws = [None] * n
+        new_sts = [None] * len(states)
+        oks = [None] * n
+        si = 0
+        for bucket in buckets:
+            dt = jnp.dtype(bucket.dtype)
+            segs_g, segs_w, segs_lr, segs_wd = [], [], [], []
+            for i, size in zip(bucket.indices, bucket.sizes):
+                g = grads[i].reshape(-1)
+                if inject:
+                    g = jnp.where(jnp.isfinite(poisons[i]), g,
+                                  jnp.full_like(g, poisons[i]
+                                                .astype(g.dtype)))
+                if guard:
+                    oks[i] = jnp.isfinite(g).all()
+                segs_g.append(g)
+                segs_w.append(weights[i].reshape(-1))
+                segs_lr.append(jnp.full((size,),
+                                        scalars[i].astype(dt)))
+                segs_wd.append(jnp.full((size,),
+                                        scalars[n + i].astype(dt)))
+            pad = bucket.padded_size - bucket.total
+            if pad:
+                z = jnp.zeros((pad,), dt)
+                for lst in (segs_g, segs_w, segs_lr, segs_wd):
+                    lst.append(z)
+            # the reduce-scatter point: the pending cross-device sum of
+            # gflat lowers to a scatter onto P(axis); wflat is
+            # replicated, so its constraint is a free local slice
+            gflat = wsc(jnp.concatenate(segs_g), shard)
+            wflat = wsc(jnp.concatenate(segs_w), shard)
+            lr_v = wsc(jnp.concatenate(segs_lr), shard)
+            wd_v = wsc(jnp.concatenate(segs_wd), shard)
+            st = tuple(states[si + k] for k in range(n_slots))
+            if not shard_state:
+                # replicated-resident baseline state: slice for the
+                # shard-wise update (free), gather back after
+                st = tuple(wsc(s, shard) for s in st)
+            fn = step_fns[bucket.indices[0]]
+            nw, nst = fn(gflat, wflat, st, lr_v, wd_v,
+                         rescale.astype(dt))
+            # Pin the update OUTPUTS to the shard layout before any
+            # replicated re-constraint: with replicated-resident
+            # baseline state the partitioner would otherwise satisfy
+            # the rep output constraint by gathering the INPUTS and
+            # running the elementwise update replicated — whose XLA
+            # codegen contracts FMAs the partitioned codegen does not
+            # (~1 ULP/step, every stateful optimizer). The pins force
+            # the arithmetic shard-wise in BOTH state layouts; the
+            # gathers happen strictly after.
+            nw = wsc(nw, shard)
+            nst = tuple(wsc(s, shard) for s in nst)
+            if guard:
+                seg_ok = [jnp.full((size,), oks[i])
+                          for i, size in zip(bucket.indices,
+                                             bucket.sizes)]
+                if pad:
+                    seg_ok.append(jnp.ones((pad,), jnp.bool_))
+                ok_v = wsc(jnp.concatenate(seg_ok), shard)
+                nw = jnp.where(ok_v, nw, wflat)
+                nst = tuple(jnp.where(ok_v, s_new, s_old)
+                            for s_new, s_old in zip(nst, st))
+            out_spec = shard if shard_state else rep
+            for k in range(n_slots):
+                new_sts[si + k] = wsc(nst[k], out_spec)
+            si += n_slots
+            # the all-gather of UPDATED params only
+            full_w = wsc(nw, rep)
+            for i, off, size in zip(bucket.indices, bucket.offsets,
+                                    bucket.sizes):
+                new_ws[i] = full_w[off:off + size] \
+                    .reshape(weights[i].shape)
+        mask = jnp.stack(oks) if guard else jnp.ones((n,), jnp.bool_)
+        return tuple(new_ws), tuple(new_sts), mask
+    return apply
+
+
+# ---------------------------------------------------------------------------
+# sharded optimizer state (ZeRO-1)
+# ---------------------------------------------------------------------------
+
+class ShardedOptState:
+    """Flat, bucket-aligned, axis-sharded optimizer state.
+
+    Each bucket contributes ``n_slots`` ``(padded_size,)`` arrays
+    placed with ``NamedSharding(mesh, P(axis))`` — every device holds
+    1/N of every state vector, the ZeRO-1 memory layout
+    (``sharded=False`` keeps them replicated: the unbucketed
+    baseline's full-per-device memory profile). Slot count and dtypes
+    are probed from the optimizer's own eager
+    ``create_state_multi_precision`` (so RMSProp's fp32 accumulators
+    stay fp32); initial values are zeros, matching every compiled
+    optimizer's zero-init eager states."""
+
+    def __init__(self, plan, mesh, axis="dp", sharded=True):
+        self.plan = plan
+        self.mesh = mesh
+        self.axis = axis
+        self.sharded = bool(sharded)
+        self.n_slots = None
+        self._slot_dtypes = None
+        self._flats = None        # list over buckets of tuple(arrays)
+
+    # -- layout probing ---------------------------------------------------
+    def probe(self, optimizer, indices, weights_nd):
+        """Slot count/dtypes from one representative parameter per
+        bucket (the layout must be uniform across the roster — true
+        whenever one optimizer drives it). Returns False when any
+        bucket's layout disagrees (→ caller falls back)."""
+        from ..fused_step import _flat_state_handles
+        n_slots, dtypes = None, None
+        for bucket in self.plan.buckets:
+            i = bucket.indices[0]
+            st = optimizer.create_state_multi_precision(
+                indices[i], weights_nd[i])
+            flat = _flat_state_handles(st)
+            if flat is None:
+                return False
+            if n_slots is None:
+                n_slots = len(flat)
+                dtypes = [str(h.dtype) for h in flat]
+            elif len(flat) != n_slots or \
+                    [str(h.dtype) for h in flat] != dtypes:
+                return False
+        self.n_slots = n_slots
+        self._slot_dtypes = dtypes
+        return True
+
+    def _sharding(self):
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        return NamedSharding(self.mesh,
+                             P(self.axis) if self.sharded else P())
+
+    # -- state roster ------------------------------------------------------
+    def ensure(self):
+        """The flat state tuple for a dispatch, creating sharded zeros
+        on first use. Call :meth:`probe` first."""
+        import jax
+        import jax.numpy as jnp
+        assert self.n_slots is not None, "probe() before ensure()"
+        if self._flats is None:
+            sh = self._sharding()
+            flats = []
+            for bucket in self.plan.buckets:
+                flats.append(tuple(
+                    jax.device_put(
+                        jnp.zeros((bucket.padded_size,),
+                                  jnp.dtype(dt)), sh)
+                    for dt in self._slot_dtypes))
+            self._flats = flats
+        return tuple(a for b in self._flats for a in b)
+
+    def store(self, new_flat_tuple):
+        """Write back a dispatch's output states (same flat order)."""
+        k, out = self.n_slots, []
+        flats = list(new_flat_tuple)
+        for b in range(len(self.plan.buckets)):
+            out.append(tuple(flats[b * k:(b + 1) * k]))
+        self._flats = out
+
+    def state_bytes_per_device(self):
+        """Per-device resident state bytes — the ZeRO denominator the
+        memory-watermark assertions check (~1/axis_size of the
+        replicated layout; the full size when ``sharded=False``)."""
+        if self.n_slots is None:
+            return 0
+        per_dev = 0
+        for bucket in self.plan.buckets:
+            n = bucket.padded_size // self.plan.axis_size \
+                if self.sharded else bucket.padded_size
+            for dt in self._slot_dtypes:
+                per_dev += n * _np.dtype(dt).itemsize
+        return per_dev
+
+    # -- interchange with the per-parameter layout ------------------------
+    def export_per_param(self, shapes):
+        """Assemble the sharded flats on the host and split them back
+        to per-parameter flat numpy arrays: ``{index: [slot arrays]}``
+        — the bridge to ``Updater``-style pickles and eager resume."""
+        out = {}
+        if self._flats is None:
+            return out
+        for bucket, slots in zip(self.plan.buckets, self._flats):
+            host = [_np.asarray(s) for s in slots]
+            for i, off, size in zip(bucket.indices, bucket.offsets,
+                                    bucket.sizes):
+                out[i] = [h[off:off + size].reshape(shapes[i])
+                          for h in host]
+        return out
+
+    def seed_per_param(self, per_param):
+        """Populate the sharded flats from per-parameter state arrays
+        (``{index: [slot numpy arrays]}``) — the resume/interchange
+        path. Missing indices keep zeros."""
+        import jax
+        import jax.numpy as jnp
+        assert self.n_slots is not None, "probe() before seeding"
+        sh = self._sharding()
+        flats = []
+        for bucket in self.plan.buckets:
+            slots = []
+            for k in range(self.n_slots):
+                dt = _np.dtype(self._slot_dtypes[k])
+                full = _np.zeros((bucket.padded_size,), dt)
+                for i, off, size in zip(bucket.indices, bucket.offsets,
+                                        bucket.sizes):
+                    st = per_param.get(i)
+                    if st is not None:
+                        full[off:off + size] = \
+                            _np.asarray(st[k]).reshape(-1)
+                slots.append(jax.device_put(jnp.asarray(full), sh))
+            flats.append(tuple(slots))
+        self._flats = flats
+
+    # -- checkpoint round trip --------------------------------------------
+    def checkpoint_roster(self):
+        """``{'opt:bucketBB.slotS': sharded array}`` — handed to
+        ``checkpoint.snapshot_params(extra=...)``; the manifest's piece
+        format records each shard's mesh position. An ``opt:layout``
+        fingerprint of the (topology-independent) bucket partition
+        rides along so a restore under a different
+        ``MXNET_GRAD_BUCKET_MB`` refuses instead of silently slicing
+        another bucket's moments into the wrong parameters."""
+        out = {}
+        if self._flats is None:
+            return out
+        for b, slots in enumerate(self._flats):
+            for k, arr in enumerate(slots):
+                out["opt:bucket%02d.slot%d" % (b, k)] = arr
+        out["opt:layout"] = self._layout_fingerprint()
+        return out
+
+    def _layout_fingerprint(self):
+        import hashlib
+        digest = hashlib.sha256(
+            repr(self.plan.layout_key()).encode()).digest()
+        return _np.frombuffer(digest, _np.uint8).copy()
+
+    def load_host_flats(self, flat_dict):
+        """Restore from a checkpoint's ``opt:bucketBB.slotS`` host
+        arrays (any save-time topology): strip the save-time padding,
+        re-pad for the CURRENT axis size, and shard onto the current
+        mesh — the elastic-resume leg for optimizer state."""
+        import jax
+        import jax.numpy as jnp
+        assert self.n_slots is not None, "probe() before restore"
+        saved_layout = flat_dict.get("opt:layout")
+        if saved_layout is not None and not _np.array_equal(
+                _np.asarray(saved_layout).reshape(-1),
+                self._layout_fingerprint()):
+            raise MXNetError(
+                "sharded optimizer state: the checkpoint's bucket "
+                "partition differs from the current plan (different "
+                "MXNET_GRAD_BUCKET_MB / roster?) — refusing to slice "
+                "state into the wrong parameters")
+        sh = self._sharding()
+        flats = []
+        for b, bucket in enumerate(self.plan.buckets):
+            slots = []
+            for k in range(self.n_slots):
+                key = "opt:bucket%02d.slot%d" % (b, k)
+                if key not in flat_dict:
+                    raise MXNetError(
+                        "sharded optimizer state: checkpoint is "
+                        "missing %s" % key)
+                host = _np.asarray(flat_dict[key]).reshape(-1)
+                if host.size < bucket.total:
+                    raise MXNetError(
+                        "sharded optimizer state: %s holds %d elements"
+                        " but the roster needs %d (bucket layout "
+                        "changed?)" % (key, host.size, bucket.total))
+                full = _np.zeros((bucket.padded_size,),
+                                 _np.dtype(self._slot_dtypes[k]))
+                full[:bucket.total] = host[:bucket.total]
+                slots.append(jax.device_put(jnp.asarray(full), sh))
+            flats.append(tuple(slots))
+        self._flats = flats
+
+
+# ---------------------------------------------------------------------------
+# telemetry accounting
+# ---------------------------------------------------------------------------
+
+def account_in_program_sync(plan):
+    """Ledger one compiled-step dispatch's bucket traffic: per-bucket
+    ``grad_sync`` comm records (reduce-scatter + updated-param
+    all-gather bytes; latency 0 — the exchange is scheduled INSIDE the
+    program, overlapped with backward, so there is no host-observable
+    span) plus run counters. The eager kvstore leg
+    (:func:`bucketed_kvstore_sync`) records real host-timed spans
+    under the same kind."""
+    from .. import telemetry
+    if not telemetry.enabled():
+        return
+    for b, bucket in enumerate(plan.buckets):
+        # RS moves (N-1)/N of the bucket in, AG the same out; account
+        # the logical payload once per direction
+        telemetry.comm("grad_sync", "bucket%02d" % b,
+                       nbytes=2 * bucket.nbytes, seconds=0.0)
+    telemetry.note("grad_sync_steps")
+
+
+# ---------------------------------------------------------------------------
+# eager kvstore leg (multi-process / kvstore-backed entry points)
+# ---------------------------------------------------------------------------
+
+def _dense(nd_arr):
+    return nd_arr is not None and \
+        getattr(nd_arr, "stype", "default") == "default"
+
+
+def bucketed_kvstore_sync(kvstore, items, cap_bytes=None):
+    """Exchange gradients through the kvstore in size-capped concat
+    buckets instead of one push/pull per key — the eager
+    (cross-process) form of the overlap recipe. ``items`` is an
+    ordered ``[(key_index, grad_nd)]`` roster; each bucket is
+    concatenated flat, pushed/pulled under one ``__grad_bucket`` key,
+    and split back into the original grad buffers in place. Exact:
+    concatenation and the kvstore's element-wise sum commute.
+
+    Returns True when the bucketed path ran; False (nothing touched)
+    when any gradient is sparse or the roster is empty — the caller
+    keeps its per-key loop."""
+    import jax.numpy as jnp
+    from .. import telemetry
+    from ..ndarray import NDArray
+
+    if not items or not all(_dense(g) for _, g in items):
+        return False
+    if getattr(kvstore, "_compression", None) is not None:
+        # 2-bit quantization blocks and error-feedback residuals are
+        # keyed per parameter; a concat bucket would shift block
+        # boundaries and residual state — numerics must never depend
+        # on the overlap gate, so compressed stores keep per-key
+        return False
+    # the plan is a pure function of the roster signature — cache it
+    # on the store so the per-step hot path skips the O(n_params)
+    # rebuild (the roster never changes across a training run)
+    cap = bucket_cap_bytes() if cap_bytes is None else int(cap_bytes)
+    sig = (tuple((tuple(g.shape), str(g.dtype)) for _, g in items),
+           cap)
+    cached = getattr(kvstore, "_grad_bucket_plan", None)
+    if cached is not None and cached[0] == sig:
+        plan = cached[1]
+    else:
+        plan = GradSyncPlan([g.shape for _, g in items],
+                            [g.dtype for _, g in items],
+                            axis_size=1, cap_bytes=cap)
+        kvstore._grad_bucket_plan = (sig, plan)
+    inited = getattr(kvstore, "_grad_bucket_keys", None)
+    if inited is None:
+        inited = kvstore._grad_bucket_keys = set()
+    for b, bucket in enumerate(plan.buckets):
+        key = "__grad_bucket%02d" % b
+        flat = jnp.concatenate(
+            [items[i][1]._data.reshape(-1) for i in bucket.indices])
+        flat_nd = NDArray(flat)
+        if key not in inited:
+            kvstore.init(key, NDArray(jnp.zeros_like(flat)))
+            inited.add(key)
+        with telemetry.comm_span("grad_sync", "bucket%02d" % b,
+                                 nbytes=2 * flat.nbytes):
+            # 2x: bucket bytes once per direction (push + pull),
+            # matching the in-program RS+AG accounting
+            kvstore.push(key, flat_nd, priority=-b)
+            kvstore.pull(key, flat_nd, priority=-b)
+        for i, off, size in zip(bucket.indices, bucket.offsets,
+                                bucket.sizes):
+            g = items[i][1]
+            g._set_data(flat_nd._data[off:off + size].reshape(g.shape))
+    from .. import profiler
+    profiler.increment_counter("grad_sync_kvstore_buckets",
+                               len(plan.buckets))
+    return True

@@ -35,7 +35,15 @@ no shared notion of a *training run*. This module unifies them:
   (``MXNET_TELEMETRY_LIVE_BUFFERS``, default on).
 - **Comms accounting** — bytes and call latency per key for kvstore
   push/pull and per collective in ``parallel/collectives.py``, via
-  :func:`comm_span`.
+  :func:`comm_span`. The bucketed gradient exchange
+  (``parallel/grad_sync.py``, ``MXNET_GRAD_OVERLAP=1``) accounts one
+  ``grad_sync:bucketNN`` row per bucket: eager kvstore buckets are
+  real host-timed :func:`comm_span` calls; in-program buckets
+  (reduce-scatter scheduled by XLA *inside* the compiled step,
+  overlapped with backward) ledger their bytes with zero latency via
+  :func:`comm` plus a ``grad_sync_steps`` :func:`note` — there is no
+  host-observable sync span to time, which is the point. The diagnose
+  Sync table renders both forms.
 
 Everything flows to a structured JSONL sink (``MXNET_TELEMETRY_FILE``)
 and to the :func:`report` summary dict; ``python -m
@@ -533,13 +541,17 @@ class _CommSpan:
         return False
 
 
-def comm_span(kind, key, value=None):
+def comm_span(kind, key, value=None, nbytes=None):
     """Time one communication call and account ``value``'s bytes under
     (kind, key). The latency includes any fault-retry backoff — it is
-    the caller-observed call latency."""
+    the caller-observed call latency. ``nbytes`` overrides the
+    ``value``-derived size for callers whose traced operands don't
+    equal the logical payload (e.g. ``bucket_reduce_scatter``'s
+    stacked per-device contributions)."""
     if _run is None:
         return _NULL
-    return _CommSpan(kind, key, _nbytes(value))
+    return _CommSpan(kind, key,
+                     _nbytes(value) if nbytes is None else int(nbytes))
 
 
 def h2d(key, nbytes=0, seconds=0.0):

@@ -18,8 +18,11 @@ percentiles from the per-step ``utilization`` records), and — when the
 run checkpointed through ``mxnet_tpu.checkpoint`` — the Checkpoints
 table (per-save bytes/duration, blocking vs async split, failed saves,
 last good epoch) plus the goodput line reconciling steps lost to a
-resume rollback. This supersedes scraping the same facts out of log
-lines with ``tools/parse_log.py``.
+resume rollback, and — when the run exchanged gradients through
+``parallel.grad_sync`` (``MXNET_GRAD_OVERLAP=1``) — the Gradient sync
+table (per-bucket bytes/latency, in-program step count, sync-phase
+share). This supersedes scraping the same facts out of log lines with
+``tools/parse_log.py``.
 """
 from __future__ import annotations
 
@@ -398,8 +401,39 @@ def format_telemetry(tel):
 
     all_comms = summary.get("comms") or {}
     h2d = {k: v for k, v in all_comms.items() if k.startswith("h2d:")}
+    sync = {k: v for k, v in all_comms.items()
+            if k.startswith("grad_sync:")}
     comms = {k: v for k, v in all_comms.items()
-             if not k.startswith("h2d:")}
+             if not k.startswith(("h2d:", "grad_sync:"))}
+
+    if sync:
+        # the bucketed gradient exchange (parallel.grad_sync): one row
+        # per bucket. In-program buckets (reduce-scatter scheduled by
+        # XLA inside the step) carry bytes but no host-observable
+        # latency; eager kvstore buckets carry both.
+        lines.append("----------Gradient sync----------")
+        lines.append("%-24s %8s %12s %12s" % ("bucket", "steps",
+                                              "bytes", "time(ms)"))
+        tot_b = tot_ms = 0.0
+        for key in sorted(sync):
+            c = sync[key]
+            tot_b += c.get("bytes", 0)
+            tot_ms += c.get("time_ms", 0.0)
+            lines.append("%-24s %8d %12d %12.3f"
+                         % (key[len("grad_sync:"):], c.get("calls", 0),
+                            c.get("bytes", 0), c.get("time_ms", 0.0)))
+        lines.append("%-24s %8s %12d %12.3f" % ("TOTAL", "", tot_b,
+                                                tot_ms))
+        whole = sum(totals.values()) or 1.0
+        share = 100.0 * totals.get("sync", 0.0) / whole
+        steps_synced = (summary.get("events") or {}).get(
+            "grad_sync_steps")
+        if steps_synced:
+            lines.append("in-program   : %d step(s) synced inside the "
+                         "compiled step (overlapped with backward — "
+                         "no host sync phase)" % steps_synced)
+        lines.append("sync share   : %.1f%% of accounted phase time "
+                     "(%d bucket(s)/step)" % (share, len(sync)))
 
     lines.append("----------Comms----------")
     if comms:
