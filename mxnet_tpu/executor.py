@@ -113,6 +113,33 @@ class Executor:
                                                self.grad_arrays)}
         self.aux_dict = dict(zip(self.aux_names, self.aux_arrays))
 
+        # FSDP (MXNET_PARAM_SHARD=1) on a mesh bind: non-batch args
+        # rule-resolve to sharded placements (parallel.sharding_rules)
+        # — _dp_place keeps them resident at 1/N and the compiled
+        # programs gather them at entry. NDArray handles keep their
+        # logical shapes, so a param the rules would need to PAD stays
+        # replicated here (with a one-time telemetry note naming it);
+        # the padded-storage form lives in DistributedTrainer.
+        self._param_shard_plans = None
+        if self._mesh is not None:
+            from .parallel.sharding_rules import (ShardingRules,
+                                                  param_shard_enabled)
+            if param_shard_enabled():
+                rules = ShardingRules(self._mesh)
+                plans = {}
+                for n, arr in zip(self.arg_names, self.arg_arrays):
+                    if n in self._batch_args:
+                        continue
+                    pl = rules.plan(n, arr.shape)
+                    if not pl.sharded:
+                        continue
+                    if pl.padded:
+                        from . import telemetry
+                        telemetry.note("param_shard_fallback:%s" % n)
+                        continue
+                    plans[n] = pl
+                self._param_shard_plans = plans or None
+
         # persistent output buffers
         self.outputs = [None] * len(self._symbol._outputs)
         self._fns: Dict[Any, Any] = {}
@@ -364,10 +391,36 @@ class Executor:
         run = self._make_graph_fn(is_train)
         site = "executor:%s:%s" % (kind, "train" if is_train else "eval")
         rep = None
+        statics = None
         if self._mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec as P
             rep = NamedSharding(self._mesh, P())
+        gather_entry = None
+        if rep is not None and self._param_shard_plans:
+            # FSDP entry gather: pin the sharded params to replicated
+            # FIRST inside the program (the partitioner's just-in-time
+            # all-gather). The fwdbwd vjp is taken over the GATHERED
+            # values — the gather sits outside the differentiated
+            # function, so the cotangents (and every downstream op)
+            # are the identical traced computation as a replicated
+            # bind. Distinct compile-watch identity: a replicated↔
+            # sharded flip is a new program, not churn of this site.
+            wsc = jax.lax.with_sharding_constraint
+            shard_pos = frozenset(
+                i for i, n in enumerate(self.arg_names)
+                if n in self._param_shard_plans)
+            statics = ("param_shard",)
+
+            def gather_entry(arg_vals):
+                return tuple(wsc(v, rep) if i in shard_pos else v
+                             for i, v in enumerate(arg_vals))
         if kind == "fwd":
+            if gather_entry is not None:
+                inner_run = run
+
+                def run(arg_vals, aux_vals, rng_keys):
+                    return inner_run(gather_entry(arg_vals), aux_vals,
+                                     rng_keys)
             if raw:
                 fn = run
             elif rep is not None:
@@ -375,6 +428,7 @@ class Executor:
                 # math on them never mixes device sets
                 fn = compile_watch.jit(
                     run, site, describe=self._cw_describe,
+                    statics=statics,
                     out_shardings=(None, rep), compiler_options=copts)
             else:
                 fn = compile_watch.jit(run, site,
@@ -384,6 +438,11 @@ class Executor:
             gpos = self._grad_positions
 
             def fwdbwd(arg_vals, aux_vals, rng_keys, out_grads):
+                if gather_entry is not None:
+                    # gather BEFORE the vjp: the diff variables are
+                    # the full logical values, exactly as on a
+                    # replicated bind
+                    arg_vals = gather_entry(arg_vals)
                 def f(gvals):
                     full = list(arg_vals)
                     for p, v in zip(gpos, gvals):
@@ -401,6 +460,7 @@ class Executor:
                 # grads replicated = the in-program allreduce
                 fn = compile_watch.jit(
                     fwdbwd, site, describe=self._cw_describe,
+                    statics=statics,
                     out_shardings=(None, rep, rep),
                     compiler_options=copts)
             else:
@@ -442,10 +502,20 @@ class Executor:
         import jax
         rep, shard = self._dp_shardings()
         n_dp = self._mesh.devices.size
+        plans = self._param_shard_plans
         placed = []
         for name, arr, val in zip(self.arg_names, self.arg_arrays, args):
-            tgt = shard if (name in self._batch_args and val.ndim >= 1
-                            and val.shape[0] % n_dp == 0) else rep
+            if name in self._batch_args and val.ndim >= 1 \
+                    and val.shape[0] % n_dp == 0:
+                tgt = shard
+            elif plans is not None and name in plans:
+                # FSDP residency: the param lives as its 1/N shard
+                # between dispatches; an eager update that returned a
+                # differently-placed value is re-sliced here (local —
+                # the value is already materialized on these devices)
+                tgt = plans[name].sharding(self._mesh)
+            else:
+                tgt = rep
             if val.sharding != tgt:
                 val = jax.device_put(val, tgt)
                 arr._set_data(val)

@@ -426,19 +426,32 @@ class FusedUpdater(_FusedCore):
 
     # -- sync-mode helpers ------------------------------------------------
     def _sync_eligible(self, weights_nd, grads_nd):
-        """True when every weight and grad already lives replicated on
-        the sync mesh — the only placement the bucketed constraints
-        are correct for."""
+        """The in-program sync mode this roster's placement supports:
+        ``"sync"`` when every weight and grad lives replicated on the
+        sync mesh (the PR 7 bucketed path), ``"fsdp"`` when weights
+        are FSDP-sharded on it (``MXNET_PARAM_SHARD=1`` and the rules
+        layer placed them — the program gathers at entry and returns
+        the updated params to their sharded residency), False when
+        anything lives off-mesh (→ plain fused path)."""
         if self._sync_mesh is None:
             return False
+        any_sharded = False
         for arr in list(weights_nd) + list(grads_nd):
             sh = getattr(arr._data, "sharding", None)
             if sh is None or getattr(sh, "mesh", None) is None:
                 return False
-            if sh.mesh != self._sync_mesh \
-                    or not arr._data.is_fully_replicated:
+            if sh.mesh != self._sync_mesh:
                 return False
-        return True
+            if not arr._data.is_fully_replicated:
+                any_sharded = True
+        if not any_sharded:
+            return "sync"
+        # sharded residency is itself the opt-in: only shard_params /
+        # apply_param_sharding / the rules layer ever place weights
+        # non-replicated, so route them through the fsdp program (the
+        # only update that returns them to their shards) regardless
+        # of the env gate's current state
+        return "fsdp"
 
     def _sync_setup(self, indices, weights_nd):
         """(Re)build the bucket plan + sharded state when the roster
@@ -519,10 +532,12 @@ class FusedUpdater(_FusedCore):
             self._updater.states[i] = template
             self._updater.states_synced[i] = True
 
-    def _update_sync(self, items, indices, weights_nd, fns):
-        """The bucketed reduce-scatter + sharded-update dispatch.
-        Returns True when it ran; None → caller takes the plain fused
-        path."""
+    def _update_sync(self, items, indices, weights_nd, fns,
+                     mode="sync"):
+        """The bucketed reduce-scatter + sharded-update dispatch
+        (``mode="fsdp"``: weights arrive FSDP-sharded and return to
+        that residency). Returns True when it ran; None → caller takes
+        the plain fused path."""
         from .parallel import grad_sync
         built = self._sync_setup(indices, weights_nd)
         if built is None:
@@ -536,7 +551,8 @@ class FusedUpdater(_FusedCore):
         inject = poisons is not None
         scalars = self._scalars(indices)
         fn = self._compiled_sync(grads, weights, states, plan, fns,
-                                 guard, inject, tuple(indices))
+                                 guard, inject, tuple(indices),
+                                 mode=mode)
         if poisons is None:
             poisons = self._zero_poisons(len(fns))
         from . import telemetry
@@ -551,13 +567,37 @@ class FusedUpdater(_FusedCore):
             w_nd._set_data(w)
         sync_state.store(new_sts)
         self._sync_weights = list(weights_nd)
+        if telemetry.enabled():
+            # the split is fixed for a given roster+mode — walk the
+            # shards once, not every step
+            bd_key = (tuple(indices), mode)
+            if getattr(self, "_mem_bd_key", None) != bd_key:
+                sharded = replicated = 0
+                for w_nd in weights_nd:
+                    v = w_nd._data
+                    shards = getattr(v, "addressable_shards", None)
+                    b = int(shards[0].data.nbytes) if shards \
+                        else int(getattr(v, "nbytes", 0))
+                    if v.is_fully_replicated:
+                        replicated += b
+                    else:
+                        sharded += b
+                self._mem_bd_key = bd_key
+                self._mem_bd = {
+                    "params_sharded": sharded,
+                    "params_replicated": replicated,
+                    "opt_state": sync_state.state_bytes_per_device()}
+            telemetry.memory_breakdown(**self._mem_bd)
         self._post_step(indices, mask, guard)
         return True
 
     def _compiled_sync(self, grads, weights, states, plan, fns, guard,
-                       inject, idx_key):
-        key = ("sync", _sig(grads), _sig(weights), _sig(states),
-               plan.signature(), guard, inject, idx_key,
+                       inject, idx_key, mode="sync"):
+        shard_key = tuple(str(getattr(a, "sharding", None))
+                          for a in tuple(weights) + tuple(grads)) \
+            if mode == "fsdp" else None
+        key = ("sync", mode, _sig(grads), _sig(weights), _sig(states),
+               plan.signature(), guard, inject, idx_key, shard_key,
                self._opt.fused_static_key())
         cached = self._cache.get(key)
         if cached is not None:
@@ -568,6 +608,30 @@ class FusedUpdater(_FusedCore):
         apply_fn = grad_sync.make_bucketed_apply(
             fns, self._sync_state.n_slots, plan, self._sync_mesh,
             self._sync_axis, guard, inject)
+
+        if mode == "fsdp":
+            # FSDP: weights (and possibly grads) arrive sharded per
+            # the rules layer. Gather both to replicated at program
+            # entry — the partitioner's just-in-time all-gather, exact
+            # — run the IDENTICAL bucketed composition, and constrain
+            # the updated params back to each input's own sharding (a
+            # local slice of the gathered update, not a second
+            # collective), so the 1/N residency survives the step.
+            import jax
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            wsc = jax.lax.with_sharding_constraint
+            rep = NamedSharding(self._sync_mesh, P())
+            out_shardings = tuple(a.sharding for a in weights)
+            inner = apply_fn
+
+            def apply_fn(grads, weights, states, scalars, poisons):
+                grads = tuple(wsc(g, rep) for g in grads)
+                weights = tuple(wsc(w, rep) for w in weights)
+                new_ws, new_sts, mask = inner(grads, weights, states,
+                                              scalars, poisons)
+                new_ws = tuple(wsc(w, sh) for w, sh
+                               in zip(new_ws, out_shardings))
+                return new_ws, new_sts, mask
 
         def program(grads, weights, states, scalars, poisons):
             self._trace_count += 1
@@ -587,11 +651,15 @@ class FusedUpdater(_FusedCore):
 
         from . import compile_watch
         from .engine import compiler_options
+        # a replicated↔sharded flip is a NEW program (fused_step:fsdp),
+        # never a recompile-storm cause against trainer_sync
+        site = "fused_step:fsdp" if mode == "fsdp" \
+            else "fused_step:trainer_sync"
         fn = compile_watch.jit(
-            program, "fused_step:trainer_sync", describe=describe,
+            program, site, describe=describe,
             counter="fused_step_compile_ms",
             statics=(plan.signature(), guard, inject, idx_key,
-                     self._opt.fused_static_key()),
+                     shard_key, self._opt.fused_static_key()),
             donate_argnums=(1, 2),
             compiler_options=compiler_options())
         self._cache[key] = fn
@@ -608,10 +676,12 @@ class FusedUpdater(_FusedCore):
         if fns is None:
             _count("fused_step_fallbacks")
             return False
-        if self._sync_mesh is not None and \
-                self._sync_eligible(weights_nd,
-                                    [g for _, _, g in items]):
-            ran = self._update_sync(items, indices, weights_nd, fns)
+        mode = self._sync_eligible(weights_nd,
+                                   [g for _, _, g in items]) \
+            if self._sync_mesh is not None else False
+        if mode:
+            ran = self._update_sync(items, indices, weights_nd, fns,
+                                    mode)
             if ran is not None:
                 return ran
         if self._sync_state is not None:

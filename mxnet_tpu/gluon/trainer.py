@@ -255,21 +255,38 @@ class Trainer:
     def _sync_mesh(self):
         """The mesh the in-program bucketed sync would run over: the
         params' own NamedSharding mesh when it has a ``dp`` axis and
-        ``MXNET_GRAD_OVERLAP=1`` — None otherwise (plain fused
-        update)."""
+        ``MXNET_GRAD_OVERLAP=1`` — or when any param lives
+        FSDP-sharded on it (a residency only the rules layer places,
+        so it is itself the opt-in): those route the update through
+        the same machinery (the ``fused_step:fsdp`` program) so they
+        return to their sharded residency — None otherwise (plain
+        fused update)."""
         from ..parallel import grad_sync
-        if not grad_sync.overlap_enabled():
-            return None
+        mesh = None
+        any_sharded = False
         for p in self._params:
             if p._data is None:
                 continue
             sharding = getattr(p._data._data, "sharding", None)
-            mesh = getattr(sharding, "mesh", None)
-            if mesh is None or "dp" not in getattr(mesh, "axis_names",
-                                                   ()):
-                return None
-            return mesh if mesh.devices.size > 1 else None
-        return None
+            m = getattr(sharding, "mesh", None)
+            if mesh is None:
+                if m is None or "dp" not in getattr(m, "axis_names",
+                                                    ()):
+                    return None
+                mesh = m if m.devices.size > 1 else None
+                if mesh is None:
+                    return None
+            if not p._data._data.is_fully_replicated:
+                any_sharded = True
+                break
+        # a sharded residency IS the opt-in (apply_param_sharding /
+        # shard_params placed it deliberately, gate or no gate) — the
+        # sync machinery is what returns updated params to their
+        # shards; replicated rosters keep the plain fused update
+        # unless the overlap gate asks for bucketing
+        if any_sharded:
+            return mesh
+        return mesh if grad_sync.overlap_enabled() else None
 
     def _get_fused(self):
         """The fused all-parameter update program (fused_step.py): one

@@ -10,10 +10,14 @@ from .collectives import (all_reduce, all_gather, reduce_scatter, broadcast,
                           bucket_reduce_scatter, bucket_all_gather)
 from . import grad_sync
 from .grad_sync import GradSyncPlan, ShardedOptState
+from . import sharding_rules
+from .sharding_rules import (SpecLayout, ShardingRules, ParamShardPlan,
+                             parameter_spec_from_name,
+                             param_shard_enabled)
 from .ring_attention import ring_attention, ulysses_attention, \
     local_attention
 from .data_parallel import (make_data_parallel_step, shard_params,
-                            DistributedTrainer)
+                            DistributedTrainer, apply_param_sharding)
 from .pipeline import pipeline_apply, stack_stage_params
 from .flash_attention import flash_attention
 from .moe import moe_ffn, topk_route, load_balance_loss

@@ -24,7 +24,7 @@ from typing import Any, Callable, Dict, Optional
 from ..base import MXNetError
 
 __all__ = ["make_data_parallel_step", "shard_params", "DistributedTrainer",
-           "sharded_input_pipeline"]
+           "sharded_input_pipeline", "apply_param_sharding"]
 
 
 def sharded_input_pipeline(source, mesh, prefetch_depth=2,
@@ -55,40 +55,104 @@ def _axis_size(mesh, axis):
     return dict(zip(mesh.axis_names, mesh.devices.shape))[axis]
 
 
-def shard_params(params: Dict[str, Any], mesh, rules=None):
-    """Place a name→array dict on the mesh. ``rules`` maps substring →
-    PartitionSpec; default replicates everything. NDArray values are
-    unwrapped/rewrapped, so a checkpoint roster restored by
+def shard_params(params: Dict[str, Any], mesh, rules=None, pad=False):
+    """Place a name→array dict on the mesh. ``rules`` is either the
+    legacy substring → PartitionSpec mapping or a
+    :class:`~mxnet_tpu.parallel.sharding_rules.ShardingRules` (the
+    FSDP rules layer: user overrides over name heuristics); default
+    replicates everything. NDArray values are unwrapped/rewrapped, so
+    a checkpoint roster restored by
     ``mxnet_tpu.checkpoint.restore_params`` re-places directly against
-    the current mesh regardless of the topology it was saved on."""
+    the current mesh regardless of the topology it was saved on.
+
+    A sharded dim that does not divide its axis size is never dropped
+    silently: with ``pad=True`` the array is zero-padded up to the
+    next multiple and stored sharded (the ``collectives.py``
+    reduce-scatter pad-and-slice convention — callers like
+    ``DistributedTrainer`` slice the logical view back inside the
+    compiled step), otherwise it stays replicated — either way a
+    one-time telemetry note names the parameter."""
     import jax
     from jax.sharding import NamedSharding, PartitionSpec as P
     from ..ndarray import NDArray
-    rules = rules or {}
+    from .sharding_rules import ShardingRules
     out = {}
+    if isinstance(rules, ShardingRules):
+        resolver = rules
+    else:
+        # legacy substring table: express it as pure overrides with a
+        # replicated default, so both forms share one feasibility path
+        table = dict(rules or {})
+        table.setdefault("", P())         # catch-all → replicated
+        resolver = ShardingRules(mesh, overrides=table)
     for name, arr in params.items():
-        spec = P()
-        for pat, s in rules.items():
-            if pat in name:
-                spec = s
-                break
-        sharding = NamedSharding(mesh, spec)
-        if isinstance(arr, NDArray):
-            out[name] = NDArray(
-                _put_unless_placed(arr._data, sharding), ctx=arr._ctx)
+        val = arr._data if isinstance(arr, NDArray) else arr
+        plan = resolver.plan(name, getattr(val, "shape", ()))
+        if plan.padded and not pad:
+            # do not hand a padded array to a caller expecting the
+            # logical shape — fall back to replicated, but never
+            # silently: the note names the parameter
+            from .. import telemetry
+            telemetry.note("param_shard_fallback:%s" % name)
+            placed = _put_unless_placed(val, NamedSharding(mesh, P()))
+        elif plan.padded:
+            resolver.note_padded(name)
+            placed = jax.device_put(plan.pad(val), plan.sharding(mesh))
         else:
-            out[name] = _put_unless_placed(arr, sharding)
+            placed = _put_unless_placed(val, plan.sharding(mesh))
+        if isinstance(arr, NDArray):
+            out[name] = NDArray(placed, ctx=arr._ctx)
+        else:
+            out[name] = placed
     return out
+
+
+def apply_param_sharding(params, mesh, rules=None):
+    """Re-place a gluon ``ParameterDict`` (or ``{name: Parameter}``)
+    in place per the FSDP rules layer: each Parameter's array moves to
+    its rule-resolved ``NamedSharding`` on ``mesh``. Gluon handles
+    must keep their logical shapes, so a param whose sharded dim does
+    not divide the axis stays replicated (with a one-time telemetry
+    note) — the padded-storage form is :class:`DistributedTrainer`'s.
+    Returns the ``{name: ParamShardPlan}`` table for inspection."""
+    from jax.sharding import PartitionSpec as P
+    from .sharding_rules import ParamShardPlan, ShardingRules
+    if not isinstance(rules, ShardingRules):
+        rules = ShardingRules(mesh, overrides=rules)
+    items = list(params.items())
+    roster = {name: p.data() for name, p in items}
+    placed = shard_params(roster, mesh, rules=rules, pad=False)
+    plans = {}
+    for name, p in items:
+        p._data._set_data(placed[name]._data)
+        pl = rules.plan(name, p.data().shape)
+        if pl.padded:
+            # pad=False left this one replicated — the table must say
+            # what actually happened, not what the rules asked for
+            pl = ParamShardPlan(name, P(), pl.shape, pl.shape)
+        plans[name] = pl
+    return plans
 
 
 def make_data_parallel_step(loss_fn: Callable, mesh, optimizer_update=None,
                             donate=True, grad_overlap=None,
-                            bucket_mb=None):
+                            bucket_mb=None, param_shard=None,
+                            param_rules=None):
     """Compile ``(params, batch) -> (loss, new_params)`` with batch
     sharded over dp and grads reduced implicitly.
 
     loss_fn(params: dict, batch: dict) -> scalar loss (pure JAX).
     optimizer_update(p, g) -> new_p elementwise (default SGD lr=0.01).
+
+    ``param_shard`` (None → the ``MXNET_PARAM_SHARD`` gate) keeps the
+    parameters FSDP-sharded at rest: place them beforehand with
+    ``shard_params(params, mesh, rules)``, and the compiled step
+    gathers each sharded param at entry (the partitioner's
+    just-in-time all-gather), runs the identical computation, and
+    constrains the updated params back to their rule specs —
+    ``param_rules`` is the same override table / ``ShardingRules``
+    object. Only divisible dims shard through this dict-tree API (the
+    padded-storage form is :class:`DistributedTrainer`'s).
 
     ``grad_overlap`` (None → the ``MXNET_GRAD_OVERLAP`` gate) switches
     the gradient exchange + update to the bucketed reduce-scatter form:
@@ -167,6 +231,27 @@ def make_data_parallel_step(loss_fn: Callable, mesh, optimizer_update=None,
                                                       new_leaves)
             return loss, new_params
 
+    from .sharding_rules import ShardingRules, param_shard_enabled
+    shard_on = param_shard_enabled() if param_shard is None \
+        else bool(param_shard)
+    if shard_on:
+        rules = param_rules if isinstance(param_rules, ShardingRules) \
+            else ShardingRules(mesh, overrides=param_rules)
+        rep_s = NamedSharding(mesh, P())
+        wsc_s = jax.lax.with_sharding_constraint
+        base_step = step
+
+        def step(params, batch):
+            full = {n: wsc_s(v, rep_s)
+                    if rules.plan(n, v.shape).sharded else v
+                    for n, v in params.items()}
+            loss, new_params = base_step(full, batch)
+            new_params = {
+                n: wsc_s(v, rules.plan(n, v.shape).sharding(mesh))
+                if rules.plan(n, v.shape).sharded else v
+                for n, v in new_params.items()}
+            return loss, new_params
+
     batch_sharding = NamedSharding(mesh, P("dp"))
     jit_kwargs = {}
     if donate:
@@ -200,7 +285,8 @@ class DistributedTrainer:
 
     def __init__(self, net, loss_block, mesh, optimizer="sgd",
                  learning_rate=0.01, optimizer_params=None,
-                 param_rules=None, grad_overlap=None, bucket_mb=None):
+                 param_rules=None, grad_overlap=None, bucket_mb=None,
+                 param_shard=None):
         from .. import optimizer as opt_mod
         self._net = net
         self._loss = loss_block
@@ -213,6 +299,11 @@ class DistributedTrainer:
             self._opt = opt_mod.create(optimizer, **kwargs)
         self._overlap = grad_overlap
         self._bucket_mb = bucket_mb
+        self._param_rules = param_rules
+        self._param_shard = param_shard
+        self._shard_rules = None      # resolved ShardingRules (fsdp on)
+        self._param_plans = None      # per-roster ParamShardPlan list
+        self._mem_bd = None           # cached telemetry byte split
         self._step_fn = None
         self._batch_sharding = None
         self._roster = None
@@ -239,11 +330,34 @@ class DistributedTrainer:
         return None if self._step_fn is None \
             else self._sync_state.sharded
 
+    @property
+    def param_shard(self):
+        """True when the built step keeps the parameters FSDP-sharded
+        at rest (None before the first fit_batch)."""
+        return None if self._step_fn is None \
+            else self._param_plans is not None
+
     def state_bytes_per_device(self):
         """Resident optimizer-state bytes per device: the sharded 1/N
         figure in overlap mode, the full replicated size otherwise."""
         return 0 if self._sync_state is None \
             else self._sync_state.state_bytes_per_device()
+
+    def param_bytes_per_device(self):
+        """Resident parameter bytes per device: with FSDP on, each
+        sharded param counts its padded shard; replicated params (and
+        the whole roster with the gate closed) count their full
+        size — the 1/N claim ``bench.py --param-shard`` measures."""
+        if self._param_vals is None:
+            return 0
+        total = 0
+        for v in list(self._param_vals) + list(self._aux_vals or []):
+            shards = getattr(v, "addressable_shards", None)
+            if shards:
+                total += int(shards[0].data.nbytes)
+            else:
+                total += int(getattr(v, "nbytes", 0))
+        return total
 
     # -- build ------------------------------------------------------------
     def _build(self, data, label):
@@ -285,15 +399,49 @@ class DistributedTrainer:
                 % type(self._opt).__name__)
 
         rep = NamedSharding(mesh, P())
+        # FSDP gate: resolve the sharding-rules layer once per build.
+        # param_rules is either a ShardingRules, a {substring: spec}
+        # override table, or None (pure name heuristics).
+        from .sharding_rules import ShardingRules, param_shard_enabled
+        shard_on = param_shard_enabled() if self._param_shard is None \
+            else bool(self._param_shard)
+        plans = None
+        if shard_on:
+            rules = self._param_rules
+            if not isinstance(rules, ShardingRules):
+                rules = ShardingRules(mesh, overrides=rules)
+            plans = [rules.plan(n, w.shape)
+                     for n, w in zip(roster, weights_nd)]
+            self._shard_rules = rules
+        self._param_plans = plans
+        self._mem_bd = None
         # satellite: parameters placed ONCE at build; steps feed the
         # device-resident values, never re-device_put per step. The
         # .copy() breaks any aliasing with the Gluon handles (a
         # same-device device_put can alias its input): fit_batch
         # DONATES these buffers, and a donated alias would leave the
-        # Parameter reading a deleted buffer.
-        self._param_vals = [
-            _put_unless_placed(params[n].data()._data, rep).copy()
-            for n in roster]
+        # Parameter reading a deleted buffer. With FSDP on, sharded
+        # params are placed as their (padded) 1/N-per-device storage;
+        # the .copy() is just as load-bearing there — a device_put to
+        # the sharding the value ALREADY carries (a roster pre-placed
+        # via apply_param_sharding) aliases its buffers.
+        if plans is None:
+            self._param_vals = [
+                _put_unless_placed(params[n].data()._data, rep).copy()
+                for n in roster]
+        else:
+            self._param_vals = []
+            for n, pl in zip(roster, plans):
+                v = params[n].data()._data
+                if pl.sharded:
+                    if pl.padded:
+                        rules.note_padded(n)
+                    self._param_vals.append(
+                        jax.device_put(pl.pad(v),
+                                       pl.sharding(mesh)).copy())
+                else:
+                    self._param_vals.append(
+                        _put_unless_placed(v, rep).copy())
         self._aux_vals = [
             _put_unless_placed(params[n].data()._data, rep).copy()
             for n in aux_roster]
@@ -333,8 +481,23 @@ class DistributedTrainer:
         aux_pos = {n: k for k, n in enumerate(aux_roster)}
         roster_pos = {n: k for k, n in enumerate(roster)}
 
+        wsc = jax.lax.with_sharding_constraint
+
         def step(param_vals, state_vals, aux_vals, data_v, label_v,
                  rng, scalars, poisons):
+            if plans is not None:
+                # FSDP: gather each sharded resident param to its
+                # full logical value at program entry — the SPMD
+                # partitioner lowers the constraint to a just-in-time
+                # all-gather ahead of the forward — and slice off the
+                # pad rows. Everything downstream (forward, backward,
+                # bucketed reduce-scatter, shard-local update) is the
+                # IDENTICAL traced computation as the replicated
+                # mode, which is what makes FSDP-on vs off bit-exact.
+                param_vals = tuple(
+                    plan.logical(wsc(v, rep)) if plan.sharded else v
+                    for plan, v in zip(plans, param_vals))
+
             def loss_of(pv):
                 vals = []
                 for n in arg_names:
@@ -354,9 +517,47 @@ class DistributedTrainer:
                 loss_of, has_aux=True)(param_vals)
             new_ws, new_sts, _ = apply_fn(grads, param_vals,
                                           state_vals, scalars, poisons)
+            if plans is not None:
+                # updated params go back to their sharded residency:
+                # re-pad (exact zeros) and constrain to the plan's
+                # spec — a LOCAL slice of the already-gathered updated
+                # value, not a second collective; the next step's
+                # entry gather is the only re-assembly.
+                new_ws = tuple(
+                    wsc(plan.pad(w), plan.sharding(mesh))
+                    if plan.sharded else w
+                    for plan, w in zip(plans, new_ws))
             return loss, new_ws, new_sts, new_aux
 
-        self._step_fn = jax.jit(step, donate_argnums=(0, 1, 2))
+        # distinct program names: a replicated↔sharded flip must show
+        # up as a NEW program in the compile log, not as a recompile
+        # (or storm) of one site
+        from .. import compile_watch
+        site = "fused_step:fsdp" if plans is not None \
+            else "fused_step:dist"
+        shard_sig = tuple((p.name, str(p.spec), p.padded_shape)
+                          for p in plans) if plans is not None else None
+        n_states = len(self._state_vals)
+
+        def describe(param_vals, state_vals, aux_vals, data_v, label_v,
+                     rng, scalars, poisons):
+            from ..compile_watch import describe_arrays
+            d = describe_arrays(list(roster), param_vals)
+            d.update(describe_arrays(
+                ["state%d" % i for i in range(n_states)], state_vals))
+            d.update(describe_arrays(
+                ["aux:%s" % n for n in aux_roster], aux_vals))
+            d.update(describe_arrays(
+                ["data", "label", "scalars", "poisons"],
+                [data_v, label_v, scalars, poisons]))
+            return d
+
+        self._step_fn = compile_watch.jit(
+            step, site, describe=describe,
+            counter="fused_step_compile_ms",
+            statics=(plan.signature(), shard_sig,
+                     self._opt.fused_static_key()),
+            donate_argnums=(0, 1, 2))
         self._batch_sharding = NamedSharding(mesh, P("dp"))
         if self._pending_restore is not None:
             self._apply_restore(self._pending_restore)
@@ -389,6 +590,13 @@ class DistributedTrainer:
         self._state_vals = list(new_sts)
         self._aux_vals = list(new_aux)
         self._sync_state.store(new_sts)
+        if telemetry.enabled():
+            # computed once per build (lazily, so the sharded opt
+            # state has materialized) — the split never changes
+            # between rebuilds
+            if self._mem_bd is None:
+                self._mem_bd = self._memory_breakdown()
+            telemetry.memory_breakdown(**self._mem_bd)
         if self._sync_state.sharded:
             # only the overlap mode ledgers grad_sync records — the
             # gate-closed baseline's telemetry must look like it
@@ -399,16 +607,46 @@ class DistributedTrainer:
         self.dispatch_count += 1
         return NDArray(loss)
 
+    def _memory_breakdown(self):
+        """Per-device resident bytes split by kind — the telemetry
+        memory table's ``params_sharded`` / ``params_replicated`` /
+        ``opt_state`` columns."""
+        sharded = replicated = 0
+        plans = self._param_plans
+        for pos, v in enumerate(self._param_vals or []):
+            shards = getattr(v, "addressable_shards", None)
+            b = int(shards[0].data.nbytes) if shards \
+                else int(getattr(v, "nbytes", 0))
+            if plans is not None and plans[pos].sharded:
+                sharded += b
+            else:
+                replicated += b
+        for v in self._aux_vals or []:
+            shards = getattr(v, "addressable_shards", None)
+            replicated += int(shards[0].data.nbytes) if shards \
+                else int(getattr(v, "nbytes", 0))
+        return {"params_sharded": sharded,
+                "params_replicated": replicated,
+                "opt_state": self.state_bytes_per_device()}
+
     def sync_gluon_params(self):
         """Refresh the Gluon Parameter handles from the
         device-resident roster (lazy — fit_batch marks them stale
-        instead of writing back every step)."""
+        instead of writing back every step). FSDP-padded params are
+        sliced back to their logical shape on the host first."""
         if not self._gluon_dirty:
             return
+        import numpy as _np
         # copies, not aliases: the next fit_batch donates the roster
         # arrays, which would delete the Parameter's buffer under it
-        for n, v in zip(self._roster, self._param_vals):
-            self._params[n]._data._set_data(v.copy())
+        for pos, (n, v) in enumerate(zip(self._roster,
+                                         self._param_vals)):
+            pl = self._param_plans[pos] if self._param_plans else None
+            if pl is not None and pl.padded:
+                host = pl.logical(_np.asarray(v))
+                self._params[n]._data._set_data(_jnp_asarray(host))
+            else:
+                self._params[n]._data._set_data(v.copy())
         for n, v in zip(self._aux_roster, self._aux_vals):
             self._params[n]._data._set_data(v.copy())
         self._gluon_dirty = False
@@ -416,7 +654,18 @@ class DistributedTrainer:
     # -- checkpointing ----------------------------------------------------
     def _checkpoint_roster(self):
         import numpy as _np
-        arg = dict(zip(self._roster, self._param_vals))
+        # sharded params ride the manifest as per-mesh-position pieces
+        # (the format already expresses the layout); PADDED storage is
+        # the one exception — the manifest must stay logical-shaped so
+        # any topology (and any gate state) can restore it, so those
+        # few params are sliced to their logical value on the host
+        arg = {}
+        for pos, n in enumerate(self._roster):
+            v = self._param_vals[pos]
+            pl = self._param_plans[pos] if self._param_plans else None
+            if pl is not None and pl.padded:
+                v = pl.logical(_np.asarray(v)).copy()
+            arg[n] = v
         aux = dict(zip(self._aux_roster, self._aux_vals))
         extra = self._sync_state.checkpoint_roster()
         # the host-side update counters ride along: Adam's bias
@@ -482,8 +731,19 @@ class DistributedTrainer:
         for pos, n in enumerate(self._roster):
             key = "arg:%s" % n
             if key in flat:
-                self._param_vals[pos] = _put_unless_placed(
-                    _jnp_asarray(host(flat[key])), rep)
+                val = _jnp_asarray(host(flat[key]))
+                pl = self._param_plans[pos] if self._param_plans \
+                    else None
+                if pl is not None and pl.sharded:
+                    # elastic: the manifest holds the logical value —
+                    # re-pad for the CURRENT mesh's plan and place it
+                    # sharded, whatever topology saved it
+                    import jax
+                    self._param_vals[pos] = jax.device_put(
+                        pl.pad(val), pl.sharding(self._mesh))
+                else:
+                    self._param_vals[pos] = _put_unless_placed(val,
+                                                               rep)
         for pos, n in enumerate(self._aux_roster):
             key = "aux:%s" % n
             if key in flat:

@@ -87,8 +87,8 @@ from .base import get_env
 __all__ = ["PHASES", "enabled", "start", "stop", "reset", "maybe_start",
            "step_begin", "step_end", "step_tick", "span", "comm",
            "comm_span", "h2d", "note", "recent_rate", "sample_memory",
-           "flush", "report", "quick_stats", "percentile",
-           "external_record", "checkpoint_event"]
+           "memory_breakdown", "flush", "report", "quick_stats",
+           "percentile", "external_record", "checkpoint_event"]
 
 PHASES = ("data_wait", "compute", "optimizer", "sync", "checkpoint",
           "eval")
@@ -134,6 +134,7 @@ class _Run:
                                "timeouts": 0}
         self.extra_counters = {}     # free-form note() names
         self.mem_watermarks = {}     # device -> peak/last bytes
+        self.mem_breakdown = None    # params_sharded/... split (lazy)
         self.fault_base = None       # fault.stats() at start
         self.counters_base = {}      # profiler.counters() at start
         self.cw_base = None          # compile_watch compile baseline
@@ -683,6 +684,33 @@ def _sample_memory(run):
         _record_memory(run, "host_live_buffers", total, total)
 
 
+def memory_breakdown(**kinds):
+    """Account a per-device resident-bytes split by kind —
+    ``params_sharded`` / ``params_replicated`` / ``opt_state`` from
+    the FSDP/ZeRO training paths. Watermark semantics: each kind
+    keeps its max over the run; a ``memory_breakdown`` record is
+    appended only when some kind grows (so a steady-state loop adds
+    one record, not one per step). No-op without a run — a run that
+    never shards keeps a byte-identical sink."""
+    run = _run
+    if run is None:
+        return
+    with _lock:
+        bd = run.mem_breakdown
+        if bd is None:
+            bd = run.mem_breakdown = {}
+        grew = False
+        for k, v in kinds.items():
+            v = int(v or 0)
+            if v > bd.get(k, -1):
+                bd[k] = v
+                grew = True
+        if grew:
+            rec = {"type": "memory_breakdown", "seq": run.steps}
+            rec.update(bd)
+            run.records.append(rec)
+
+
 def _record_memory(run, device, in_use, peak):
     rec = {"type": "memory", "device": device, "seq": run.steps,
            "bytes_in_use": in_use, "peak_bytes_in_use": peak}
@@ -786,6 +814,8 @@ def report():
             "comms": {"%s:%s" % k: dict(c)
                       for k, c in sorted(run.comms.items())},
         }
+        if run.mem_breakdown is not None:
+            out["memory_breakdown"] = dict(run.mem_breakdown)
         if run.extra_counters:
             out["events"] = dict(run.extra_counters)
         if run.ckpt is not None:

@@ -116,7 +116,8 @@ def read_telemetry(path):
     A sink holding several runs (consecutive fits appending to the
     same MXNET_TELEMETRY_FILE) yields the LAST run."""
     out = {"run": None, "steps": [], "memory": [], "compiles": [],
-           "utilization": [], "checkpoints": [], "summary": None}
+           "utilization": [], "checkpoints": [], "breakdown": None,
+           "summary": None}
     with open(path) as f:
         for line in f:
             line = line.strip()
@@ -130,11 +131,14 @@ def read_telemetry(path):
             if kind == "run_start":
                 out = {"run": rec, "steps": [], "memory": [],
                        "compiles": [], "utilization": [],
-                       "checkpoints": [], "summary": None}
+                       "checkpoints": [], "breakdown": None,
+                       "summary": None}
             elif kind == "step":
                 out["steps"].append(rec)
             elif kind == "memory":
                 out["memory"].append(rec)
+            elif kind == "memory_breakdown":
+                out["breakdown"] = rec      # watermarks: last is max
             elif kind == "compile":
                 out["compiles"].append(rec)
             elif kind == "utilization":
@@ -398,6 +402,21 @@ def format_telemetry(tel):
                          % (dev, _fmt_bytes(watermarks[dev])))
     else:
         lines.append("no memory samples (backend without memory_stats)")
+    breakdown = summary.get("memory_breakdown") or tel.get("breakdown")
+    if breakdown:
+        # the FSDP/ZeRO split: how much of each device's residency is
+        # a 1/N shard vs a full replica — the observable form of the
+        # "params drop to 1/N" claim, per run
+        total = sum(int(breakdown.get(k, 0) or 0)
+                    for k in ("params_sharded", "params_replicated",
+                              "opt_state"))
+        for key, label in (("params_sharded", "params sharded (1/N)"),
+                           ("params_replicated", "params replicated"),
+                           ("opt_state", "optimizer state")):
+            b = int(breakdown.get(key, 0) or 0)
+            share = (100.0 * b / total) if total else 0.0
+            lines.append("%-24s %12s  (%5.1f%%) per device"
+                         % (label, _fmt_bytes(b), share))
 
     all_comms = summary.get("comms") or {}
     h2d = {k: v for k, v in all_comms.items() if k.startswith("h2d:")}
