@@ -1,0 +1,94 @@
+"""Inference serving end to end: export a convnet as a
+multi-signature deploy artifact (one StableHLO program per bucket
+batch size), then serve it with the continuous-batching
+InferenceServer — bounded queue, bucket-ladder padding, per-request
+deadlines — and print the serving stats a production deployment would
+scrape from the telemetry sink.
+
+    python examples/serve_artifact.py
+
+Set MXNET_TELEMETRY_FILE=/tmp/serve.jsonl first to also get the
+JSONL sink; render it with
+``python -m mxnet_tpu.tools.diagnose /tmp/serve.jsonl``
+(the Serving table).
+"""
+import json
+import os
+import tempfile
+import threading
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import serving, telemetry
+
+
+def build_convnet():
+    data = mx.sym.var("data")
+    h = mx.sym.Convolution(data, name="conv1", kernel=(3, 3),
+                           num_filter=8, pad=(1, 1))
+    h = mx.sym.Activation(h, act_type="relu")
+    h = mx.sym.Pooling(h, kernel=(2, 2), stride=(2, 2), pool_type="max")
+    h = mx.sym.Flatten(h)
+    out = mx.sym.FullyConnected(h, name="fc", num_hidden=10)
+    rs = np.random.RandomState(0)
+    params = {
+        "conv1_weight": mx.nd.array(rs.randn(8, 3, 3, 3) * 0.1),
+        "conv1_bias": mx.nd.zeros((8,)),
+        "fc_weight": mx.nd.array(rs.randn(10, 8 * 16 * 16) * 0.01),
+        "fc_bias": mx.nd.zeros((10,)),
+    }
+    return out, params
+
+
+def main():
+    sink = os.environ.get("MXNET_TELEMETRY_FILE")
+    if sink:
+        telemetry.start(filename=sink)
+
+    symbol, params = build_convnet()
+    ladder = [1, 2, 4, 8]
+    with tempfile.TemporaryDirectory() as d:
+        artifact = os.path.join(d, "convnet.mxp")
+        mx.deploy.export_compiled(
+            symbol, artifact, params=params,
+            input_shapes={"data": (1, 3, 32, 32)}, batch_sizes=ladder)
+        print("exported %s (%d bytes, buckets %s)"
+              % (artifact, os.path.getsize(artifact), ladder))
+
+        pred = mx.deploy.load_compiled(artifact)
+        with serving.InferenceServer(pred, max_queue=64,
+                                     batch_window_ms=2.0,
+                                     default_deadline_ms=2000) as srv:
+            rs = np.random.RandomState(1)
+
+            def client(n, results):
+                for _ in range(n):
+                    x = rs.randn(3, 32, 32).astype(np.float32)
+                    try:
+                        y = srv.predict(x, timeout=30)
+                        results.append(np.asarray(y).argmax())
+                    except serving.ServerOverloadedError:
+                        results.append(None)      # shed: retry later
+
+            results = []
+            threads = [threading.Thread(target=client,
+                                        args=(25, results))
+                       for _ in range(4)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            stats = srv.stats()
+        served = sum(1 for r in results if r is not None)
+        print("served %d/%d requests" % (served, len(results)))
+        print(json.dumps(stats, indent=2))
+
+    if sink:
+        telemetry.stop()
+        print("telemetry sink: %s — render with "
+              "python -m mxnet_tpu.tools.diagnose %s" % (sink, sink))
+
+
+if __name__ == "__main__":
+    main()

@@ -6,9 +6,13 @@ module answers the two questions a TPU-native stack lives or dies by:
 1. **How much did XLA compilation cost this run — and why did it
    recompile?** Every framework ``jax.jit`` site (the executor's
    forward / forward+backward programs, the fused train step, the
-   per-op eager jit cache that backs ``CachedOp``, and the eager
-   collectives) routes through :func:`jit`, which stages compilation
-   explicitly (``lower()`` + ``compile()``) so each compile is:
+   per-op eager jit cache that backs ``CachedOp``, the eager
+   collectives, and the inference server's bucket-ladder programs —
+   ``serving:bN``, one per bucket, staged through :func:`jit` so the
+   "fixed program cache under arbitrary request mixes" claim is a
+   checkable :func:`site_stats` oracle) routes through :func:`jit`,
+   which stages compilation explicitly (``lower()`` + ``compile()``)
+   so each compile is:
 
    - timed (per-compile duration + cumulative compile seconds),
    - keyed (the argument-signature cache key that triggered it),
@@ -70,8 +74,9 @@ from collections import deque
 from .base import get_env
 
 __all__ = ["enabled", "enable", "disable", "reset", "maybe_enable",
-           "jit", "stats", "recent_mfu", "peak_table", "describe_arrays",
-           "step_reset", "run_reset", "WatchedFunction"]
+           "jit", "stats", "site_stats", "recent_mfu", "peak_table",
+           "describe_arrays", "step_reset", "run_reset",
+           "WatchedFunction"]
 
 _lock = threading.Lock()
 _watch = None          # the active _Watch; module-global None check
@@ -728,6 +733,28 @@ def stats():
         out["bw_util"] = {"p50": percentile(bwu, 50),
                           "p90": percentile(bwu, 90),
                           "samples": len(bwu)}
+    return out
+
+
+def site_stats(prefix=None):
+    """Per-site compile counts — ``{site: {"count", "total_s"}}``,
+    optionally filtered to sites starting with ``prefix``. The serving
+    tests and ``bench.py --serving`` use this as the bounded-program-
+    cache oracle: under any request mix, ``site_stats("serving")``
+    must hold exactly the bucket-ladder sites, each compiled once per
+    replica device. None when the watch is off."""
+    w = _watch
+    if w is None:
+        return None
+    out = {}
+    with _lock:
+        for p in w.programs.values():
+            site = p["site"]
+            if prefix is not None and not site.startswith(prefix):
+                continue
+            agg = out.setdefault(site, {"count": 0, "total_s": 0.0})
+            agg["count"] += p["count"]
+            agg["total_s"] = round(agg["total_s"] + p["total_s"], 6)
     return out
 
 

@@ -13,6 +13,26 @@ model code, no param files.
                               input_shapes={"data": (1, 3, 224, 224)})
     pred = mx.deploy.load_compiled("model.mxp")
     probs = pred(x)                      # numpy/jax array in, out
+
+Artifact format 2 (written by default; format-1 files still load):
+
+- the meta block records the **output** shapes/dtypes next to the
+  inputs, and :class:`Predictor` validates every call against the
+  recorded signature (argument count, non-batch dims, dtype) so a
+  mismatched call raises a descriptive :class:`MXNetError` instead of
+  an opaque XLA shape error;
+- ``export_compiled(..., batch_sizes=[1, 2, 4, 8])`` emits a
+  **multi-signature** artifact: one exported program per bucket batch
+  size in the same single file. :class:`Predictor` dispatches a call
+  of batch ``b`` to the smallest bucket ``>= b`` (zero-pad rows in,
+  slice rows back out — exact, a row's result never depends on its
+  batch-mates), and ``mxnet_tpu.serving.InferenceServer`` uses the
+  same ladder to coalesce concurrent requests with a fixed program
+  cache (no recompile storms under arbitrary request mixes).
+
+The on-disk layout stays backward compatible: MAGIC + meta length +
+meta JSON + the program blobs back to back (format 1 readers of a
+single-program format-2 file see exactly the old layout).
 """
 from __future__ import annotations
 
@@ -23,13 +43,13 @@ import numpy as _np
 
 from .base import MXNetError
 
-__all__ = ["export_compiled", "load_compiled", "Predictor"]
+__all__ = ["export_compiled", "load_compiled", "Predictor",
+           "check_cast_dtype"]
 
 _MAGIC = b"MXTPUDEPLOY1"
 
 
 def _graph_fn(symbol, arg_params, aux_params, input_shapes, dtype):
-    import jax
     import jax.numpy as jnp
     from .cached_op import build_graph_callable
 
@@ -56,18 +76,60 @@ def _graph_fn(symbol, arg_params, aux_params, input_shapes, dtype):
         outs = fn({"__train__": False}, *vals)[:n_out]
         return outs[0] if n_out == 1 else tuple(outs)
 
-    specs = [jax.ShapeDtypeStruct(tuple(input_shapes[n]),
-                                  jnp.dtype(dtype))
-             for n in data_names]
-    return forward, specs, data_names
+    return forward, data_names
+
+
+def _specs(input_shapes, data_names, dtype, batch=None):
+    """ShapeDtypeStructs for the data inputs; ``batch`` (a bucket
+    size) replaces the leading dim of every input — by convention all
+    data inputs share the batch dimension."""
+    import jax
+    import jax.numpy as jnp
+    specs = []
+    for n in data_names:
+        shape = tuple(input_shapes[n])
+        if batch is not None:
+            if not shape:
+                raise MXNetError(
+                    "export_compiled: input %r is a scalar — "
+                    "batch_sizes needs a leading batch dim" % n)
+            shape = (int(batch),) + shape[1:]
+        specs.append(jax.ShapeDtypeStruct(shape, jnp.dtype(dtype)))
+    return specs
+
+
+def _out_meta(exported):
+    return [{"shape": [int(s) for s in a.shape], "dtype": str(a.dtype)}
+            for a in exported.out_avals]
+
+
+def check_cast_dtype(name, arr, dtype_str, who="Predictor"):
+    """The one dtype gate for artifact-described inputs (shared by
+    :class:`Predictor` and ``serving.InferenceServer``): a
+    ``same_kind`` cast is applied silently, anything else raises a
+    descriptive error naming the input."""
+    if dtype_str and str(arr.dtype) != dtype_str:
+        if not _np.can_cast(arr.dtype, _np.dtype(dtype_str),
+                            casting="same_kind"):
+            raise MXNetError(
+                "%s: input %r dtype %s cannot safely cast to the "
+                "artifact's recorded %s"
+                % (who, name, arr.dtype, dtype_str))
+        arr = arr.astype(_np.dtype(dtype_str), copy=False)
+    return arr
 
 
 def export_compiled(model, path, input_shapes, params=None,
-                    aux_params=None, dtype="float32"):
+                    aux_params=None, dtype="float32", batch_sizes=None):
     """Serialize ``model`` (a hybridized Gluon block, or a Symbol plus
     ``params``/``aux_params`` dicts) into one portable StableHLO file.
     Parameters are baked in as constants — the artifact is fully
-    self-contained, like the reference's amalgamation build."""
+    self-contained, like the reference's amalgamation build.
+
+    ``batch_sizes`` (optional) exports one program per bucket batch
+    size — a multi-signature artifact whose leading input dim is each
+    bucket in turn (the serving bucket ladder). Without it, one
+    program with exactly ``input_shapes`` is exported."""
     import jax
     from jax import export as jexport
     from . import symbol as sym_mod
@@ -91,14 +153,34 @@ def export_compiled(model, path, input_shapes, params=None,
             elif name in aux_names:
                 aux[name] = p.data()
 
-    forward, specs, data_names = _graph_fn(symbol, arg_params, aux,
-                                           input_shapes, dtype)
-    exported = jexport.export(jax.jit(forward))(*specs)
-    blob = exported.serialize()
+    forward, data_names = _graph_fn(symbol, arg_params, aux,
+                                    input_shapes, dtype)
+    jitted = jax.jit(forward)
+    if batch_sizes is not None:
+        buckets = sorted({int(b) for b in batch_sizes})
+        if not buckets or buckets[0] < 1:
+            raise MXNetError(
+                "export_compiled: batch_sizes must be positive ints, "
+                "got %r" % (batch_sizes,))
+    else:
+        buckets = [None]
+    programs = []
+    for b in buckets:
+        exported = jexport.export(jitted)(
+            *_specs(input_shapes, data_names, dtype, batch=b))
+        if b is None:
+            shape0 = tuple(input_shapes[data_names[0]])
+            b = int(shape0[0]) if shape0 else 1
+        programs.append((int(b), exported))
+    blobs = [e.serialize() for _, e in programs]
     meta = {
-        "format": 1,
+        "format": 2,
         "inputs": [{"name": n, "shape": list(input_shapes[n]),
                     "dtype": str(dtype)} for n in data_names],
+        "outputs": _out_meta(programs[0][1]),
+        "programs": [{"batch": b, "length": len(blob),
+                      "outputs": _out_meta(e)}
+                     for (b, e), blob in zip(programs, blobs)],
         "framework": "mxnet_tpu",
     }
     meta_bytes = json.dumps(meta).encode()
@@ -106,33 +188,138 @@ def export_compiled(model, path, input_shapes, params=None,
         f.write(_MAGIC)
         f.write(struct.pack("<I", len(meta_bytes)))
         f.write(meta_bytes)
-        f.write(blob)
+        for blob in blobs:
+            f.write(blob)
     return path
 
 
 class Predictor:
     """Callable wrapper over a deserialized deploy artifact (the
-    c_predict_api MXPredCreate/MXPredForward role)."""
+    c_predict_api MXPredCreate/MXPredForward role).
 
-    def __init__(self, exported, meta):
-        self._exported = exported
+    Calls are validated against the artifact meta — argument count,
+    per-input non-batch dims, dtype — and a batch of ``b`` rows is
+    dispatched to the smallest exported bucket ``>= b`` (rows
+    zero-padded in, sliced back out; exact). A call that cannot match
+    any recorded signature raises a descriptive :class:`MXNetError`
+    instead of surfacing an opaque XLA error."""
+
+    def __init__(self, programs, meta):
+        if hasattr(programs, "call"):      # legacy (exported, meta)
+            shape0 = (meta.get("inputs") or [{}])[0].get("shape") or []
+            batch = int(shape0[0]) if shape0 else 1
+            programs = [(batch, programs)]
+        self._programs = sorted(programs, key=lambda p: p[0])
         self.meta = meta
 
     @property
     def input_names(self):
         return [i["name"] for i in self.meta["inputs"]]
 
+    @property
+    def batch_sizes(self):
+        """The exported bucket ladder (ascending)."""
+        return [b for b, _ in self._programs]
+
+    @property
+    def output_info(self):
+        """Recorded output shapes/dtypes (format 2; None on format-1
+        artifacts that predate the field)."""
+        return self.meta.get("outputs")
+
+    # -- validation --------------------------------------------------------
+    def _validate(self, arrays):
+        """Check ``arrays`` against the artifact meta; returns the
+        shared batch size (None when the meta records no shapes)."""
+        inputs = self.meta.get("inputs") or []
+        if inputs and len(arrays) != len(inputs):
+            raise MXNetError(
+                "Predictor: artifact takes %d input(s) %s, got %d "
+                "argument(s)" % (len(inputs),
+                                 [i.get("name") for i in inputs],
+                                 len(arrays)))
+        batch = None
+        for spec, arr in zip(inputs, arrays):
+            name = spec.get("name", "?")
+            want = [int(s) for s in (spec.get("shape") or [])]
+            if want:
+                got = list(arr.shape)
+                if len(got) != len(want):
+                    raise MXNetError(
+                        "Predictor: input %r has rank %d, artifact "
+                        "recorded shape %s (rank %d)"
+                        % (name, len(got), want, len(want)))
+                if got[1:] != want[1:]:
+                    raise MXNetError(
+                        "Predictor: input %r non-batch dims %s do not "
+                        "match the artifact's recorded %s"
+                        % (name, got[1:], want[1:]))
+                if batch is None:
+                    batch = got[0]
+                elif got[0] != batch:
+                    raise MXNetError(
+                        "Predictor: inconsistent batch dims — input "
+                        "%r has %d rows where earlier inputs had %d"
+                        % (name, got[0], batch))
+            check_cast_dtype(name, arr, spec.get("dtype"))
+        return batch
+
+    def _cast(self, arrays):
+        inputs = self.meta.get("inputs") or []
+        return [check_cast_dtype(inputs[i].get("name", "?"), arr,
+                                 inputs[i].get("dtype"))
+                if i < len(inputs) else arr
+                for i, arr in enumerate(arrays)]
+
+    def bucket_for(self, batch):
+        """The smallest exported bucket ``>= batch``; raises a
+        descriptive error past the ladder's top."""
+        from .serving.batcher import BucketLadder
+        b = BucketLadder(self.batch_sizes).bucket_for(batch)
+        if b is None:
+            raise MXNetError(
+                "Predictor: batch %d exceeds the largest exported "
+                "bucket %d (ladder %s) — re-export with a bigger "
+                "bucket or split the call"
+                % (batch, self._programs[-1][0], self.batch_sizes))
+        return b
+
+    def program(self, bucket):
+        """The exported program for an exact bucket size."""
+        for b, e in self._programs:
+            if b == bucket:
+                return e
+        raise MXNetError("Predictor: no program for bucket %d "
+                         "(ladder %s)" % (bucket, self.batch_sizes))
+
+    # -- prediction --------------------------------------------------------
     def __call__(self, *args):
         arrays = [a.asnumpy() if hasattr(a, "asnumpy")
                   else _np.asarray(a) for a in args]
-        return self._exported.call(*arrays)
+        batch = self._validate(arrays)
+        arrays = self._cast(arrays)
+        if batch is None:                  # shape-less legacy meta
+            return self._programs[0][1].call(*arrays)
+        bucket = self.bucket_for(batch)
+        exported = self.program(bucket)
+        if bucket != batch:
+            arrays = [_np.concatenate(
+                [a, _np.zeros((bucket - batch,) + a.shape[1:],
+                              dtype=a.dtype)]) for a in arrays]
+        out = exported.call(*arrays)
+        if bucket != batch:
+            if isinstance(out, tuple):
+                out = tuple(o[:batch] for o in out)
+            else:
+                out = out[:batch]
+        return out
 
     predict = __call__
 
 
 def load_compiled(path):
-    """Load an ``export_compiled`` artifact. Needs only jax — not the
-    framework's model code or parameter files."""
+    """Load an ``export_compiled`` artifact (format 1 or 2). Needs
+    only jax — not the framework's model code or parameter files."""
     from jax import export as jexport
     with open(path, "rb") as f:
         magic = f.read(len(_MAGIC))
@@ -141,5 +328,19 @@ def load_compiled(path):
                              % path)
         (mlen,) = struct.unpack("<I", f.read(4))
         meta = json.loads(f.read(mlen).decode())
-        blob = f.read()
-    return Predictor(jexport.deserialize(blob), meta)
+        if meta.get("format", 1) >= 2 and meta.get("programs"):
+            programs = []
+            for p in meta["programs"]:
+                blob = f.read(int(p["length"]))
+                if len(blob) != int(p["length"]):
+                    raise MXNetError(
+                        "%s is truncated: program for bucket %s is "
+                        "short" % (path, p.get("batch")))
+                programs.append((int(p["batch"]),
+                                 jexport.deserialize(blob)))
+        else:                              # format 1: one trailing blob
+            blob = f.read()
+            shape0 = (meta.get("inputs") or [{}])[0].get("shape") or []
+            batch = int(shape0[0]) if shape0 else 1
+            programs = [(batch, jexport.deserialize(blob))]
+    return Predictor(programs, meta)

@@ -57,8 +57,13 @@ _ACTIONS = ("raise", "hang", "nan", "inf")
 # chaos run silently test nothing. ckpt_write/ckpt_fsync sit inside
 # checkpoint.atomic_write_file so a planned fault can abort or stall a
 # save at an exact file boundary (torn-write / slow-disk testing).
+# serve_admit/serve_dispatch sit on the inference-serving request path
+# (serving/server.py): admit fires per submitted request, dispatch per
+# batcher pass — a planned hang at dispatch stalls batch formation so
+# queued requests age past their deadlines (deterministic shed/timeout
+# testing), a raise there is counted and survived, never fatal.
 _SITES = ("push", "pull", "allreduce", "wait", "init", "grad",
-          "ckpt_write", "ckpt_fsync")
+          "ckpt_write", "ckpt_fsync", "serve_admit", "serve_dispatch")
 # corruption needs a value to corrupt — only the grad site carries one
 _VALUE_SITES = ("grad",)
 _GUARD_POLICIES = ("skip_step", "scale_backoff")

@@ -3,8 +3,9 @@
 Everything here is mesh-first: pick axes (dp/tp/sp/ep/pp), annotate
 shardings, let XLA insert collectives over ICI/DCN.
 """
-from .mesh import (create_mesh, auto_mesh, mesh_axes, local_mesh,
-                   PartitionSpec, NamedSharding, replicated, shard_batch)
+from .mesh import (create_mesh, auto_mesh, make_mesh, mesh_axes,
+                   local_mesh, PartitionSpec, NamedSharding, replicated,
+                   shard_batch)
 from .collectives import (all_reduce, all_gather, reduce_scatter, broadcast,
                           ppermute, barrier, psum_eager,
                           bucket_reduce_scatter, bucket_all_gather)

@@ -20,10 +20,10 @@ from typing import Dict, Optional, Sequence
 
 import numpy as np
 
-__all__ = ["create_mesh", "auto_mesh", "mesh_axes", "local_mesh",
-           "PartitionSpec", "NamedSharding", "replicated", "shard_batch",
-           "dp_mesh", "distinct_devices", "use_mesh", "current_mesh",
-           "set_current_mesh"]
+__all__ = ["create_mesh", "auto_mesh", "make_mesh", "mesh_axes",
+           "local_mesh", "PartitionSpec", "NamedSharding", "replicated",
+           "shard_batch", "dp_mesh", "distinct_devices", "use_mesh",
+           "current_mesh", "set_current_mesh"]
 
 _DP_MESH_CACHE = {}
 _CURRENT_MESH = [None]
@@ -129,6 +129,48 @@ def auto_mesh(n_devices: Optional[int] = None,
         i -= 1
     sizes[axes[0]] = rem
     return create_mesh(sizes, devices=jax.devices()[:n])
+
+
+def make_mesh(data=None, fsdp=None, tp=None, devices=None):
+    """The multi-axis mesh entry point for the sharding-rules layer
+    (``parallel.sharding_rules``): axes are named with the rules
+    layer's own vocabulary — ``data`` carries the batch, ``fsdp`` the
+    parameter row shards, ``tp`` the tensor-parallel column shards —
+    so ``SpecLayout.for_mesh`` resolves them literally instead of
+    folding everything onto a 1-axis ``dp`` mesh.
+
+    Sizes left ``None`` default to 1, except ``data`` which absorbs
+    whatever devices remain: ``make_mesh(fsdp=4, tp=2)`` on 8 devices
+    is a ``data=1 × fsdp=4 × tp=2`` mesh; on 16 it is ``data=2``.
+    Axis order is data-outermost (``data``, ``fsdp``, ``tp``), the
+    GSPMD convention that keeps fsdp/tp collectives on the
+    fastest-varying (densest-ICI) device neighbors."""
+    import jax
+    devices = list(devices) if devices is not None else jax.devices()
+    n = len(devices)
+    fsdp = int(fsdp) if fsdp is not None else 1
+    tp = int(tp) if tp is not None else 1
+    if fsdp < 1 or tp < 1:
+        raise ValueError("make_mesh: axis sizes must be >= 1, got "
+                         "fsdp=%s tp=%s" % (fsdp, tp))
+    inner = fsdp * tp
+    if data is None:
+        if n % inner:
+            raise ValueError(
+                "make_mesh: fsdp*tp = %d does not divide the %d "
+                "available devices" % (inner, n))
+        data = n // inner
+    data = int(data)
+    if data < 1:
+        raise ValueError("make_mesh: axis sizes must be >= 1, got "
+                         "data=%s" % data)
+    total = data * inner
+    if total > n:
+        raise ValueError(
+            "make_mesh: data=%d x fsdp=%d x tp=%d needs %d devices, "
+            "only %d available" % (data, fsdp, tp, total, n))
+    return create_mesh({"data": data, "fsdp": fsdp, "tp": tp},
+                       devices=devices[:total])
 
 
 def local_mesh(axis_name="dp"):

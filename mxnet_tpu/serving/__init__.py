@@ -1,0 +1,29 @@
+"""Production inference serving over deploy artifacts (ROADMAP item 2
+— the "millions of users" half of the north star).
+
+The reference framework's deploy story ends at the standalone predict
+ABI (``c_predict_api``): load an artifact, call forward, one request
+at a time. This package serves it: :class:`InferenceServer` admits
+requests through a bounded queue with backpressure and load-shedding,
+coalesces them Orca/vLLM-style into a small geometric ladder of bucket
+batch shapes (pad to bucket, slice per-request responses back out — so
+the XLA program cache stays fixed, no recompile storms under arbitrary
+request mixes), dispatches batches to replicas placed across mesh
+devices (least-outstanding wins), and wires request latency
+percentiles, requests/sec, batch occupancy, queue depth, and
+shed/timeout counts into the telemetry JSONL sink as ``serving``
+records (``python -m mxnet_tpu.tools.diagnose run.jsonl`` renders the
+Serving table).
+
+    pred = mx.deploy.load_compiled("model.mxp")      # bucket ladder
+    with serving.InferenceServer(pred, max_queue=256) as srv:
+        fut = srv.submit(x)                          # one sample
+        y = fut.result(timeout=1.0)
+"""
+from .batcher import BucketLadder, pad_batch, slice_rows
+from .server import (InferenceServer, ServerOverloadedError,
+                     RequestTimeoutError, ServerClosedError)
+
+__all__ = ["InferenceServer", "BucketLadder", "pad_batch", "slice_rows",
+           "ServerOverloadedError", "RequestTimeoutError",
+           "ServerClosedError"]

@@ -1,0 +1,585 @@
+"""The continuous-batching inference server.
+
+One :class:`InferenceServer` = one model (a deploy artifact's bucket
+ladder, or an in-process batched callable), one bounded admission
+queue, one batcher thread, and one worker thread per replica:
+
+- **Admission** — :meth:`InferenceServer.submit` validates the request
+  against the artifact meta, then either enqueues it (FIFO, bounded by
+  ``max_queue``) or sheds it with :class:`ServerOverloadedError` when
+  the queue is full (``block=True`` instead waits for space —
+  backpressure — bounded by the request's own deadline). The queue
+  depth can never exceed ``max_queue``: overload degrades into sheds,
+  not unbounded latency.
+- **Batching** — the batcher thread coalesces waiting requests (after
+  a ``batch_window_ms`` straggler window) into the smallest ladder
+  bucket that fits, drops requests whose deadline already passed
+  (:class:`RequestTimeoutError`), and hands the batch to the replica
+  with the fewest outstanding batches.
+- **Replicas** — each replica owns one mesh device; its worker pads
+  the batch to the bucket shape, places it on its device, and runs the
+  bucket's compiled program there (one program instance per bucket per
+  device — ``compile_watch`` sees a fixed set, never a storm). Rows
+  are sliced back out per request; the padding is exact.
+- **Faults** — ``MXNET_FAULT_PLAN`` sites ``serve_admit`` (visited per
+  admitted request) and ``serve_dispatch`` (visited per batcher pass)
+  make the shed/timeout paths deterministically testable: a planned
+  ``hang`` at ``serve_dispatch`` stalls dispatch so queued requests
+  age past their deadlines, a ``raise`` fails that pass and is
+  counted, never fatal.
+- **Telemetry** — cumulative serving stats (latency percentiles,
+  requests/sec, batch occupancy, queue depth, shed/timeout counts, per
+  bucket batch counts) flow to the active telemetry run as ``serving``
+  JSONL records every ``record_every`` batches and at :meth:`stop`;
+  ``tools.diagnose`` renders them as the Serving table.
+"""
+from __future__ import annotations
+
+import queue as _queue_mod
+import threading
+import time
+from collections import deque
+
+import numpy as _np
+
+from ..base import MXNetError, get_env
+from .. import fault, telemetry
+from .batcher import BucketLadder, pad_batch, slice_rows
+
+__all__ = ["InferenceServer", "ServerOverloadedError",
+           "RequestTimeoutError", "ServerClosedError"]
+
+
+class ServerOverloadedError(MXNetError):
+    """The bounded request queue is full — the request was shed (or a
+    blocking submit's deadline passed while waiting for space). Retry
+    with backoff, raise ``max_queue``, or add replicas."""
+
+
+class RequestTimeoutError(MXNetError):
+    """The request's deadline passed before a batch picked it up."""
+
+
+class ServerClosedError(MXNetError):
+    """The server was stopped; the request cannot be served."""
+
+
+class _Request:
+    """One in-flight request: the per-sample input arrays and a
+    future-style completion event."""
+
+    __slots__ = ("args", "t_submit", "deadline", "_event", "_value",
+                 "_error", "_t_done")
+
+    def __init__(self, args, t_submit, deadline):
+        self.args = args
+        self.t_submit = t_submit
+        self.deadline = deadline
+        self._event = threading.Event()
+        self._value = None
+        self._error = None
+        self._t_done = None
+
+    @property
+    def latency(self):
+        """Seconds from submit to completion (None until served) —
+        the same figure the server's latency percentiles aggregate."""
+        if self._t_done is None:
+            return None
+        return self._t_done - self.t_submit
+
+    def _fulfill(self, value):
+        self._value = value
+        self._t_done = time.monotonic()
+        self._event.set()
+
+    def _fail(self, exc):
+        self._error = exc
+        self._event.set()
+
+    def done(self):
+        return self._event.is_set()
+
+    def result(self, timeout=None):
+        """Block for the response (row(s) of the batched program
+        output, batch dim sliced off). Raises the request's error —
+        RequestTimeoutError / ServerClosedError / the model's own."""
+        if not self._event.wait(timeout):
+            raise RequestTimeoutError(
+                "request did not complete within %ss" % timeout)
+        if self._error is not None:
+            raise self._error
+        return self._value
+
+
+class InferenceServer:
+    """Continuous-batching server over a deploy artifact (path or
+    :class:`~mxnet_tpu.deploy.Predictor`) or an in-process batched
+    callable (``fn(*batched_inputs) -> batched_output(s)``, must be
+    jax-traceable; requires ``ladder`` or ``max_batch``)."""
+
+    def __init__(self, model, *, ladder=None, max_batch=None,
+                 max_queue=64, batch_window_ms=2.0, replicas=1,
+                 devices=None, default_deadline_ms=None,
+                 record_every=None, name=None, start=True):
+        from .. import compile_watch
+        self._meta_inputs = None
+        predictor = None
+        if isinstance(model, str):
+            from ..deploy import load_compiled
+            predictor = load_compiled(model)
+        elif hasattr(model, "batch_sizes") and hasattr(model, "program"):
+            predictor = model
+        elif not callable(model):
+            raise MXNetError(
+                "InferenceServer: model must be an artifact path, a "
+                "deploy.Predictor, or a batched callable — got %r"
+                % type(model).__name__)
+
+        if predictor is not None:
+            artifact_buckets = list(predictor.batch_sizes)
+            if ladder is None:
+                ladder = BucketLadder(artifact_buckets)
+            else:
+                ladder = ladder if isinstance(ladder, BucketLadder) \
+                    else BucketLadder(ladder)
+                missing = [b for b in ladder.buckets
+                           if b not in artifact_buckets]
+                if missing:
+                    raise MXNetError(
+                        "InferenceServer: ladder buckets %s are not in "
+                        "the artifact (exported buckets: %s)"
+                        % (missing, artifact_buckets))
+            self._meta_inputs = (predictor.meta.get("inputs") or None)
+        else:
+            if ladder is None:
+                if max_batch is None:
+                    raise MXNetError(
+                        "InferenceServer: a callable model needs "
+                        "ladder= or max_batch=")
+                ladder = BucketLadder.geometric(max_batch)
+            elif not isinstance(ladder, BucketLadder):
+                ladder = BucketLadder(ladder)
+        self._ladder = ladder
+
+        site = "serving" if not name else "serving:%s" % name
+        self._programs = {}
+        for b in ladder.buckets:
+            if predictor is not None:
+                exported = predictor.program(b)
+                fn = (lambda *a, _e=exported: _e.call(*a))
+            else:
+                fn = (lambda *a, _f=model: _f(*a))
+            # one logical program per bucket: a recompile inside one
+            # bucket site IS churn; distinct buckets are distinct
+            # programs by construction (statics carry the bucket)
+            self._programs[b] = compile_watch.jit(
+                fn, "%s:b%d" % (site, b), statics=(site, b))
+
+        import jax
+        replicas = int(replicas)
+        if devices is not None:
+            devices = list(devices)
+            if len(devices) < replicas:
+                raise MXNetError(
+                    "InferenceServer: %d replicas need %d devices, "
+                    "got %d" % (replicas, replicas, len(devices)))
+        else:
+            avail = jax.devices()
+            if replicas > len(avail):
+                raise MXNetError(
+                    "InferenceServer: %d replicas exceed the %d "
+                    "available devices" % (replicas, len(avail)))
+            devices = avail
+        self._devices = [devices[i] for i in range(replicas)]
+        self._replicas = replicas
+
+        self._max_queue = max(1, int(max_queue))
+        # in-flight batches per replica: one running + one staged.
+        # Bounding this is what closes the backpressure chain — when
+        # every replica is saturated the batcher STOPS draining the
+        # admission queue, so the queue (the only unbounded-wait spot)
+        # fills to its bound and sheds, instead of requests waiting
+        # unboundedly in an invisible dispatch buffer.
+        self._max_outstanding = max(
+            1, get_env("MXNET_SERVING_MAX_OUTSTANDING", 2, int))
+        self._window = max(0.0, float(batch_window_ms)) / 1e3
+        self._default_deadline = (float(default_deadline_ms) / 1e3
+                                  if default_deadline_ms is not None
+                                  else None)
+        self._record_every = int(record_every) if record_every \
+            else get_env("MXNET_SERVING_RECORD_EVERY", 50, int)
+
+        self._cond = threading.Condition()
+        self._queue = deque()
+        self._stats = {"requests": 0, "completed": 0, "shed": 0,
+                       "timeouts": 0, "errors": 0, "dispatch_faults": 0,
+                       "batches": 0, "occupancy_sum": 0.0,
+                       "queue_peak": 0}
+        self._bucket_counts = {}
+        self._replica_batches = [0] * replicas
+        self._outstanding = [0] * replicas
+        self._latencies = deque(
+            maxlen=max(1, get_env("MXNET_SERVING_LATENCY_RING",
+                                  8192, int)))
+        self._batches_since_record = 0
+        self._n_inputs = len(self._meta_inputs) \
+            if self._meta_inputs else None
+
+        self._stopping = False
+        self._drain = True
+        self._closed = False
+        self._started = False
+        self._t0 = time.perf_counter()
+        self._work = [_queue_mod.Queue() for _ in range(replicas)]
+        self._threads = []
+        if start:
+            self.start()
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self):
+        """Spawn the batcher + replica worker threads (idempotent;
+        the constructor calls this unless ``start=False``)."""
+        if self._started:
+            return self
+        if self._closed:
+            raise ServerClosedError("InferenceServer already stopped")
+        self._started = True
+        self._t0 = time.perf_counter()
+        t = threading.Thread(target=self._batch_loop,
+                             name="mxnet-serving-batcher", daemon=True)
+        t.start()
+        self._threads.append(t)
+        for i in range(self._replicas):
+            t = threading.Thread(target=self._worker_loop, args=(i,),
+                                 name="mxnet-serving-replica%d" % i,
+                                 daemon=True)
+            t.start()
+            self._threads.append(t)
+        return self
+
+    def stop(self, drain=True):
+        """Stop the server. ``drain=True`` serves every queued request
+        first; ``drain=False`` fails them with ServerClosedError.
+        Emits a final ``serving`` telemetry record."""
+        if self._closed:
+            return
+        with self._cond:
+            self._stopping = True
+            self._drain = drain
+            self._cond.notify_all()
+        for t in self._threads[:1]:        # the batcher drains first
+            t.join()
+        if not drain:
+            with self._cond:
+                leftovers = list(self._queue)
+                self._queue.clear()
+            for r in leftovers:
+                r._fail(ServerClosedError("server stopped"))
+        for q in self._work:
+            q.put(None)
+        for t in self._threads[1:]:
+            t.join()
+        self._closed = True
+        self._emit_record()
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+    def warmup(self, *example):
+        """Compile every bucket program on every replica device before
+        taking traffic, so no live request ever pays an XLA compile.
+        Artifact-backed servers build zero samples from the meta;
+        callable models need one ``example`` sample array per input.
+        Returns the number of (bucket, device) programs compiled."""
+        import jax
+        if example:
+            samples = [a.asnumpy() if hasattr(a, "asnumpy")
+                       else _np.asarray(a) for a in example]
+            samples = self._validate_sample(samples)
+        elif self._meta_inputs and \
+                all(i.get("shape") for i in self._meta_inputs):
+            samples = [_np.zeros(
+                tuple(int(s) for s in i["shape"][1:]),
+                _np.dtype(i.get("dtype") or "float32"))
+                for i in self._meta_inputs]
+        else:
+            raise MXNetError(
+                "serving: warmup() on a callable model needs one "
+                "example sample per input")
+        n = 0
+        for dev in self._devices:
+            for b in self._ladder.buckets:
+                inputs = [jax.device_put(pad_batch([s], b), dev)
+                          for s in samples]
+                jax.block_until_ready(self._programs[b](*inputs))
+                n += 1
+        return n
+
+    # -- admission ---------------------------------------------------------
+    def _validate_sample(self, arrays):
+        """Per-sample validation against the artifact meta (a request
+        carries ONE sample: the recorded shape minus the batch dim)."""
+        if self._n_inputs is not None and len(arrays) != self._n_inputs:
+            names = [i.get("name") for i in self._meta_inputs] \
+                if self._meta_inputs else "?"
+            raise MXNetError(
+                "serving: model takes %d input(s) %s per request, got "
+                "%d" % (self._n_inputs, names, len(arrays)))
+        if self._n_inputs is None:
+            self._n_inputs = len(arrays)
+        if not self._meta_inputs:
+            return arrays
+        from ..deploy import check_cast_dtype
+        out = []
+        for spec, arr in zip(self._meta_inputs, arrays):
+            name = spec.get("name", "?")
+            want = [int(s) for s in (spec.get("shape") or [])]
+            if want and list(arr.shape) != want[1:]:
+                raise MXNetError(
+                    "serving: input %r sample shape %s does not match "
+                    "the artifact's per-sample %s (a request is ONE "
+                    "sample — no batch dim)"
+                    % (name, list(arr.shape), want[1:]))
+            out.append(check_cast_dtype(name, arr, spec.get("dtype"),
+                                        who="serving"))
+        return out
+
+    def submit(self, *args, deadline_ms=None, block=False):
+        """Admit one request (one SAMPLE per input — no batch dim).
+        Returns a future; ``.result(timeout)`` yields the response
+        rows. Sheds with :class:`ServerOverloadedError` when the
+        bounded queue is full (``block=True`` waits for space instead,
+        up to the request's deadline)."""
+        if self._closed or not self._started:
+            raise ServerClosedError("InferenceServer is not running")
+        arrays = [a.asnumpy() if hasattr(a, "asnumpy")
+                  else _np.asarray(a) for a in args]
+        arrays = self._validate_sample(arrays)
+        fault.inject("serve_admit")
+        if deadline_ms is None:
+            deadline_s = self._default_deadline
+        else:
+            deadline_s = float(deadline_ms) / 1e3
+        now = time.monotonic()
+        # deadline 0 means "expire unless dispatchable now", not "no
+        # deadline" — only None disables
+        req = _Request(arrays, now,
+                       now + deadline_s if deadline_s is not None
+                       else None)
+        shed = stopping = False
+        with self._cond:
+            if self._stopping:
+                stopping = True
+            else:
+                self._stats["requests"] += 1
+                if len(self._queue) >= self._max_queue and block:
+                    while len(self._queue) >= self._max_queue \
+                            and not self._stopping:
+                        if req.deadline is not None:
+                            left = req.deadline - time.monotonic()
+                            if left <= 0:
+                                break
+                            self._cond.wait(left)
+                        else:
+                            self._cond.wait(0.05)
+                if self._stopping:
+                    # stop() raced the blocking wait: this is a
+                    # shutdown, not overload — don't count a shed or
+                    # tell the caller to retry
+                    self._stats["requests"] -= 1
+                    stopping = True
+                elif len(self._queue) >= self._max_queue:
+                    self._stats["shed"] += 1
+                    shed = True
+                else:
+                    # admit under the SAME lock hold as the bound
+                    # check — the queue depth can never exceed the
+                    # bound, even against racing submitters
+                    self._queue.append(req)
+                    depth = len(self._queue)
+                    if depth > self._stats["queue_peak"]:
+                        self._stats["queue_peak"] = depth
+                    self._cond.notify_all()
+        if stopping:
+            raise ServerClosedError(
+                "InferenceServer is stopping; request not admitted")
+        if shed:
+            telemetry.note("serving_shed")
+            raise ServerOverloadedError(
+                "serving: request queue full (max_queue=%d) — request "
+                "shed; retry with backoff, raise max_queue, or add "
+                "replicas" % self._max_queue)
+        return req
+
+    def predict(self, *args, timeout=None, deadline_ms=None):
+        """Synchronous convenience: submit + result."""
+        return self.submit(*args, deadline_ms=deadline_ms) \
+            .result(timeout=timeout)
+
+    # -- batching ----------------------------------------------------------
+    def _batch_loop(self):
+        max_b = self._ladder.max_batch
+        while True:
+            with self._cond:
+                while not self._queue and not self._stopping:
+                    self._cond.wait(0.05)
+                if self._stopping and (not self._queue
+                                       or not self._drain):
+                    break
+                if self._window > 0 and len(self._queue) < max_b \
+                        and not self._stopping:
+                    # straggler window: let concurrent submitters
+                    # coalesce into one fuller (cheaper) batch
+                    self._cond.wait(self._window)
+            try:
+                fault.inject("serve_dispatch")
+            except fault.InjectedFault:
+                # a planned raise/hang at the dispatch site: count it
+                # and keep serving — queued requests age meanwhile,
+                # which is exactly how deadline tests drive the
+                # timeout path deterministically
+                with self._cond:
+                    self._stats["dispatch_faults"] += 1
+                continue
+            # reserve a replica slot BEFORE popping requests: while
+            # every replica is at its outstanding cap the requests
+            # stay in the bounded admission queue (filling it, aging
+            # toward their deadlines, shedding new arrivals) instead
+            # of piling into an unbounded dispatch buffer
+            r = None
+            with self._cond:
+                while not (self._stopping and not self._drain):
+                    free = [i for i in range(self._replicas)
+                            if self._outstanding[i]
+                            < self._max_outstanding]
+                    if free:
+                        # least-outstanding replica wins the batch
+                        r = min(free,
+                                key=lambda i: self._outstanding[i])
+                        self._outstanding[r] += 1
+                        break
+                    self._cond.wait(0.05)
+            if r is None:
+                break
+            now = time.monotonic()
+            batch, expired = [], []
+            with self._cond:
+                while self._queue and len(batch) < max_b:
+                    req = self._queue.popleft()
+                    if req.deadline is not None and now > req.deadline:
+                        expired.append(req)
+                        continue
+                    batch.append(req)
+                if expired:
+                    self._stats["timeouts"] += len(expired)
+                if not batch:
+                    self._outstanding[r] -= 1   # nothing to dispatch
+                self._cond.notify_all()     # space for blocked submits
+            for req in expired:
+                telemetry.note("serving_timeout")
+                req._fail(RequestTimeoutError(
+                    "request deadline passed after %.1f ms in queue "
+                    "(deadline %.1f ms)"
+                    % ((now - req.t_submit) * 1e3,
+                       (req.deadline - req.t_submit) * 1e3)))
+            if not batch:
+                continue
+            bucket = self._ladder.bucket_for(len(batch))
+            self._work[r].put((batch, bucket))
+
+    # -- replicas ----------------------------------------------------------
+    def _worker_loop(self, idx):
+        import jax
+        dev = self._devices[idx]
+        while True:
+            item = self._work[idx].get()
+            if item is None:
+                break
+            batch, bucket = item
+            try:
+                inputs = []
+                for j in range(len(batch[0].args)):
+                    arr = pad_batch([r.args[j] for r in batch], bucket)
+                    inputs.append(jax.device_put(arr, dev))
+                out = self._programs[bucket](*inputs)
+                out = jax.block_until_ready(out)
+            except Exception as exc:        # noqa: BLE001 — model errors
+                with self._cond:            # belong to the requests
+                    self._stats["errors"] += len(batch)
+                    self._outstanding[idx] -= 1
+                    self._cond.notify_all()
+                for r in batch:
+                    r._fail(exc)
+                continue
+            done = time.monotonic()
+            for i, r in enumerate(batch):
+                r._fulfill(slice_rows(out, i))
+            with self._cond:
+                n = len(batch)
+                self._stats["completed"] += n
+                self._stats["batches"] += 1
+                self._stats["occupancy_sum"] += n / float(bucket)
+                self._bucket_counts[bucket] = \
+                    self._bucket_counts.get(bucket, 0) + 1
+                self._replica_batches[idx] += 1
+                self._outstanding[idx] -= 1
+                self._cond.notify_all()     # wake the slot-reserving
+                for r in batch:             # batcher promptly
+                    self._latencies.append(done - r.t_submit)
+                self._batches_since_record += 1
+                emit = self._batches_since_record >= self._record_every
+                if emit:
+                    self._batches_since_record = 0
+            if emit:
+                self._emit_record()
+
+    # -- stats & telemetry -------------------------------------------------
+    def stats(self):
+        """Cumulative serving stats snapshot: request counts
+        (completed/shed/timeout/errors), latency percentiles,
+        requests/sec, mean batch occupancy, queue depth (now/peak/
+        bound), per-bucket batch counts, per-replica batch counts."""
+        elapsed = max(time.perf_counter() - self._t0, 1e-9)
+        with self._cond:
+            s = dict(self._stats)
+            lats = [v * 1e3 for v in self._latencies]
+            buckets = {str(k): v
+                       for k, v in sorted(self._bucket_counts.items())}
+            depth = len(self._queue)
+            replica_batches = list(self._replica_batches)
+        out = {
+            "requests": s["requests"],
+            "completed": s["completed"],
+            "shed": s["shed"],
+            "timeouts": s["timeouts"],
+            "errors": s["errors"],
+            "dispatch_faults": s["dispatch_faults"],
+            "batches": s["batches"],
+            "occupancy": round(s["occupancy_sum"] / s["batches"], 4)
+            if s["batches"] else None,
+            "queue_depth": depth,
+            "queue_peak": s["queue_peak"],
+            "max_queue": self._max_queue,
+            "rps": round(s["completed"] / elapsed, 3),
+            "ladder": list(self._ladder.buckets),
+            "buckets": buckets,
+            "replicas": self._replicas,
+            "replica_batches": replica_batches,
+        }
+        if lats:
+            out["latency_ms"] = {
+                "mean": round(sum(lats) / len(lats), 3),
+                "p50": round(telemetry.percentile(lats, 50), 3),
+                "p90": round(telemetry.percentile(lats, 90), 3),
+                "p99": round(telemetry.percentile(lats, 99), 3),
+                "max": round(max(lats), 3),
+            }
+        return out
+
+    def _emit_record(self):
+        telemetry.serving_event(self.stats())

@@ -70,8 +70,12 @@ only when the compile watch is active (``mxnet_tpu.compile_watch``),
 (per-step MFU / memory-bandwidth utilization), and, only when the
 checkpoint subsystem saves (``mxnet_tpu.checkpoint``), one
 ``checkpoint`` record per save (epoch, bytes, snapshot/serialize/
-write/manifest sub-spans, blocking vs async split, last good epoch).
-With those subsystems unused the kinds never appear and the sink is
+write/manifest sub-spans, blocking vs async split, last good epoch),
+and, only when an inference server runs (``mxnet_tpu.serving``),
+periodic cumulative ``serving`` records (request counts, latency
+percentiles, requests/sec, batch occupancy, queue depth, shed/timeout
+counts — rendered as the diagnose Serving table). With those
+subsystems unused the kinds never appear and the sink is
 byte-identical to a run without them.
 """
 from __future__ import annotations
@@ -88,7 +92,8 @@ __all__ = ["PHASES", "enabled", "start", "stop", "reset", "maybe_start",
            "step_begin", "step_end", "step_tick", "span", "comm",
            "comm_span", "h2d", "note", "recent_rate", "sample_memory",
            "memory_breakdown", "flush", "report", "quick_stats",
-           "percentile", "external_record", "checkpoint_event"]
+           "percentile", "external_record", "checkpoint_event",
+           "serving_event"]
 
 PHASES = ("data_wait", "compute", "optimizer", "sync", "checkpoint",
           "eval")
@@ -130,6 +135,7 @@ class _Run:
         self.pending_phases = {}     # phase -> seconds since boundary
         self.comms = {}              # (kind, key) -> calls/bytes/time_ms
         self.ckpt = None             # checkpoint-save aggregates (lazy)
+        self.serving = None          # latest cumulative serving stats
         self.fault_counters = {"skipped_steps": 0, "retries": 0,
                                "timeouts": 0}
         self.extra_counters = {}     # free-form note() names
@@ -349,19 +355,25 @@ def _close_step_locked(run, now, samples):
                     "t": rec["t"], "dur_ms": rec["dur_ms"]}
             urec.update(util)
             run.records.append(urec)
-    if not run.filename and len(run.records) > run._max_records:
-        # memory-only run: bound the record list (the ring and the
-        # accumulators keep the summary exact; only raw records drop).
-        # Drop a 10% block, not one element — a per-step front-shift
-        # of a 100k list under the lock would cost O(cap) every step
-        drop = max(len(run.records) - run._max_records,
-                   run._max_records // 10)
-        drop = min(drop, len(run.records) - 1)   # keep run_start
-        del run.records[1:1 + drop]
-        run.records_dropped += drop
+    _cap_records_locked(run)
     run._steps_since_flush += 1
     run._steps_since_mem += 1
     return rec
+
+
+def _cap_records_locked(run):
+    """Bound a memory-only run's record list (the ring and the
+    accumulators keep the summary exact; only raw records drop).
+    Drop a 10% block, not one element — a per-record front-shift of a
+    100k list under the lock would cost O(cap) every record. Caller
+    holds the lock. Sink-backed runs flush instead."""
+    if run.filename or len(run.records) <= run._max_records:
+        return
+    drop = max(len(run.records) - run._max_records,
+               run._max_records // 10)
+    drop = min(drop, len(run.records) - 1)       # keep run_start
+    del run.records[1:1 + drop]
+    run.records_dropped += drop
 
 
 def step_begin():
@@ -622,6 +634,28 @@ def checkpoint_event(fields):
         run.records.append(rec)
 
 
+def serving_event(fields):
+    """Append one cumulative ``serving`` record from an
+    ``mxnet_tpu.serving.InferenceServer`` (request counts, latency
+    percentiles, rps, occupancy, queue depth — the server emits one
+    every ``record_every`` batches and at stop). The latest snapshot
+    also lands in the summary's ``serving`` block. No-op without a
+    run, so a run that never serves keeps a byte-identical sink."""
+    run = _run
+    if run is None:
+        return
+    rec = {"type": "serving", "seq": run.steps,
+           "t": round(time.time() - run.t0_wall, 6)}
+    rec.update(fields)
+    with _lock:
+        run.serving = dict(fields)     # cumulative: latest wins
+        run.records.append(rec)
+        # a stepless sink-less process hosting a long-lived server
+        # would otherwise grow records unboundedly (steps cap them,
+        # but a pure serving process never steps)
+        _cap_records_locked(run)
+
+
 def note(name, delta=1):
     """Count one resilience/bookkeeping event against the run.
     fault.py calls this at the exact branch points that advance its own
@@ -823,6 +857,8 @@ def report():
             ck["blocking_ms"] = round(ck["blocking_ms"], 3)
             ck["async_ms"] = round(ck["async_ms"], 3)
             out["checkpoint"] = ck
+        if run.serving is not None:
+            out["serving"] = dict(run.serving)
         if run.records_dropped:
             out["records_dropped"] = run.records_dropped
         total_s = run.total_step_s

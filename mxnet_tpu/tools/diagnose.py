@@ -21,7 +21,11 @@ last good epoch) plus the goodput line reconciling steps lost to a
 resume rollback, and — when the run exchanged gradients through
 ``parallel.grad_sync`` (``MXNET_GRAD_OVERLAP=1``) — the Gradient sync
 table (per-bucket bytes/latency, in-program step count, sync-phase
-share). This supersedes scraping the same facts out of log lines with
+share), and — when the run hosted an ``mxnet_tpu.serving``
+``InferenceServer`` — the Serving table (request counts with
+shed/timeout splits, latency percentiles, requests/sec, bucket-ladder
+occupancy, queue-depth peak vs bound, per-replica dispatch). This
+supersedes scraping the same facts out of log lines with
 ``tools/parse_log.py``.
 """
 from __future__ import annotations
@@ -116,8 +120,8 @@ def read_telemetry(path):
     A sink holding several runs (consecutive fits appending to the
     same MXNET_TELEMETRY_FILE) yields the LAST run."""
     out = {"run": None, "steps": [], "memory": [], "compiles": [],
-           "utilization": [], "checkpoints": [], "breakdown": None,
-           "summary": None}
+           "utilization": [], "checkpoints": [], "serving": [],
+           "breakdown": None, "summary": None}
     with open(path) as f:
         for line in f:
             line = line.strip()
@@ -131,8 +135,8 @@ def read_telemetry(path):
             if kind == "run_start":
                 out = {"run": rec, "steps": [], "memory": [],
                        "compiles": [], "utilization": [],
-                       "checkpoints": [], "breakdown": None,
-                       "summary": None}
+                       "checkpoints": [], "serving": [],
+                       "breakdown": None, "summary": None}
             elif kind == "step":
                 out["steps"].append(rec)
             elif kind == "memory":
@@ -145,6 +149,8 @@ def read_telemetry(path):
                 out["utilization"].append(rec)
             elif kind == "checkpoint":
                 out["checkpoints"].append(rec)
+            elif kind == "serving":
+                out["serving"].append(rec)
             elif kind == "summary":
                 out["summary"] = rec
     return out
@@ -347,6 +353,47 @@ def format_telemetry(tel):
         lines.append("last good    : epoch %s" % (last_good
                                                   if last_good is not None
                                                   else "none"))
+
+    # -- inference serving (mxnet_tpu.serving) --------------------------
+    servings = tel.get("serving") or []
+    # records are cumulative snapshots: the last one is the run's truth
+    sv = servings[-1] if servings else (summary.get("serving") or {})
+    if sv:
+        lines.append("----------Serving----------")
+        lines.append("requests     : %d submitted (completed %d, shed "
+                     "%d, timeout %d, errors %d)"
+                     % (sv.get("requests", 0), sv.get("completed", 0),
+                        sv.get("shed", 0), sv.get("timeouts", 0),
+                        sv.get("errors", 0)))
+        lat = sv.get("latency_ms") or {}
+        if lat:
+            lines.append("latency(ms)  : p50 %.3f  p90 %.3f  p99 %.3f "
+                         " max %.3f"
+                         % (lat.get("p50", 0.0), lat.get("p90", 0.0),
+                            lat.get("p99", 0.0), lat.get("max", 0.0)))
+        lines.append("throughput   : %.2f req/s over %d batch(es)"
+                     % (sv.get("rps", 0.0), sv.get("batches", 0)))
+        occ = sv.get("occupancy")
+        if occ is not None:
+            per_bucket = " ".join(
+                "b%s:%s" % kv
+                for kv in sorted((sv.get("buckets") or {}).items(),
+                                 key=lambda kv: int(kv[0])))
+            lines.append("occupancy    : %.1f%% mean of bucket slots "
+                         "(%s)" % (100.0 * occ, per_bucket or "-"))
+        lines.append("queue depth  : peak %d of bound %d (ladder %s)"
+                     % (sv.get("queue_peak", 0),
+                        sv.get("max_queue", 0),
+                        sv.get("ladder", [])))
+        rb = sv.get("replica_batches") or []
+        if sv.get("replicas", 1) > 1:
+            lines.append("replicas     : %d (batches per replica: %s — "
+                         "least-outstanding dispatch)"
+                         % (sv["replicas"],
+                            ", ".join(str(b) for b in rb)))
+        if sv.get("dispatch_faults"):
+            lines.append("faults       : %d injected dispatch fault(s) "
+                         "survived" % sv["dispatch_faults"])
 
     lines.append("----------Goodput----------")
     skipped = sum(s.get("skipped", 0) for s in steps)

@@ -72,3 +72,101 @@ def test_bad_magic_rejected(tmp_path):
     p.write_bytes(b"not an artifact")
     with pytest.raises(mx.base.MXNetError, match="deploy artifact"):
         mx.deploy.load_compiled(str(p))
+
+
+def _fc_artifact(tmp_path, batch_sizes=None, batch=3):
+    d = mx.sym.var("data")
+    w = mx.sym.var("w")
+    b = mx.sym.var("b")
+    out = mx.sym.FullyConnected(d, w, b, num_hidden=4)
+    params = {"w": mx.nd.random.uniform(-1, 1, (4, 6)),
+              "b": mx.nd.zeros((4,))}
+    path = str(tmp_path / "fc.mxp")
+    mx.deploy.export_compiled(out, path, params=params,
+                              input_shapes={"data": (batch, 6)},
+                              batch_sizes=batch_sizes)
+    return path, params
+
+
+def test_meta_records_outputs(tmp_path):
+    path, _ = _fc_artifact(tmp_path)
+    pred = mx.deploy.load_compiled(path)
+    assert pred.meta["format"] == 2
+    assert pred.output_info == [{"shape": [3, 4], "dtype": "float32"}]
+    assert pred.batch_sizes == [3]
+
+
+def test_predictor_validates_calls(tmp_path):
+    path, _ = _fc_artifact(tmp_path)
+    pred = mx.deploy.load_compiled(path)
+    with pytest.raises(mx.base.MXNetError, match="1 input"):
+        pred(np.zeros((3, 6), np.float32), np.zeros((3, 6), np.float32))
+    with pytest.raises(mx.base.MXNetError, match="non-batch dims"):
+        pred(np.zeros((3, 7), np.float32))
+    with pytest.raises(mx.base.MXNetError, match="rank"):
+        pred(np.zeros((3, 6, 1), np.float32))
+    with pytest.raises(mx.base.MXNetError, match="cannot safely"):
+        pred(np.zeros((3, 6), np.complex64))
+    with pytest.raises(mx.base.MXNetError, match="largest exported"):
+        pred(np.zeros((5, 6), np.float32))
+    # a safe same-kind dtype (f64) casts instead of erroring opaquely
+    out = np.asarray(pred(np.zeros((3, 6), np.float64)))
+    assert out.shape == (3, 4)
+
+
+def test_multi_signature_artifact_pads_and_slices(tmp_path):
+    path, params = _fc_artifact(tmp_path, batch_sizes=[1, 2, 4, 8])
+    pred = mx.deploy.load_compiled(path)
+    assert pred.batch_sizes == [1, 2, 4, 8]
+    assert [p["batch"] for p in pred.meta["programs"]] == [1, 2, 4, 8]
+    x = np.random.RandomState(0).randn(3, 6).astype(np.float32)
+    want = x @ params["w"].asnumpy().T + params["b"].asnumpy()
+    # batch 3 rides the 4-bucket (pad + slice); exact rows
+    np.testing.assert_allclose(np.asarray(pred(x)), want, rtol=1e-5,
+                               atol=1e-6)
+    # every bucket's exact batch works too
+    for b in (1, 2, 4, 8):
+        xb = np.random.RandomState(b).randn(b, 6).astype(np.float32)
+        got = np.asarray(pred(xb))
+        assert got.shape == (b, 4)
+        np.testing.assert_allclose(
+            got, xb @ params["w"].asnumpy().T + params["b"].asnumpy(),
+            rtol=1e-5, atol=1e-6)
+
+
+def test_format1_artifact_still_loads(tmp_path):
+    """A pre-format-2 file (single trailing blob, no programs/outputs
+    meta) loads and predicts through the new Predictor."""
+    import json
+    import struct
+    from jax import export as jexport
+    path, params = _fc_artifact(tmp_path)
+    # rewrite the artifact in the OLD layout
+    with open(path, "rb") as f:
+        f.read(12)
+        (mlen,) = struct.unpack("<I", f.read(4))
+        meta = json.loads(f.read(mlen).decode())
+        blob = f.read()
+    old_meta = {"format": 1, "inputs": meta["inputs"],
+                "framework": "mxnet_tpu"}
+    mb = json.dumps(old_meta).encode()
+    old = tmp_path / "old.mxp"
+    with open(old, "wb") as f:
+        f.write(b"MXTPUDEPLOY1")
+        f.write(struct.pack("<I", len(mb)))
+        f.write(mb)
+        f.write(blob)
+    pred = mx.deploy.load_compiled(str(old))
+    assert pred.meta["format"] == 1
+    assert pred.output_info is None
+    assert pred.batch_sizes == [3]
+    x = np.random.RandomState(0).randn(3, 6).astype(np.float32)
+    want = x @ params["w"].asnumpy().T + params["b"].asnumpy()
+    np.testing.assert_allclose(np.asarray(pred(x)), want, rtol=1e-5,
+                               atol=1e-6)
+    # and the declared bucket still pads smaller batches (1 -> 3)
+    x1 = x[:1]
+    np.testing.assert_allclose(np.asarray(pred(x1)), want[:1],
+                               rtol=1e-5, atol=1e-6)
+    # sanity: the raw blob really is format-1 era jax.export output
+    assert jexport.deserialize(blob) is not None

@@ -1,0 +1,351 @@
+"""The continuous-batching inference server (mxnet_tpu.serving) over
+multi-signature deploy artifacts: bucket-ladder batching with a fixed
+program cache (compile_watch oracle), backpressure/shedding, planned
+deadline timeouts, replica placement, and telemetry/diagnose wiring."""
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import compile_watch, fault, serving, telemetry
+from mxnet_tpu.serving import (BucketLadder, InferenceServer,
+                               RequestTimeoutError,
+                               ServerOverloadedError)
+
+
+@pytest.fixture(autouse=True)
+def _clean_state():
+    fault.reset()
+    telemetry.reset()
+    compile_watch.disable()
+    yield
+    fault.reset()
+    telemetry.reset()
+    compile_watch.disable()
+
+
+def _mlp_artifact(path, batch_sizes, in_dim=12, classes=5):
+    """A small symbol MLP exported as a multi-signature artifact."""
+    d = mx.sym.var("data")
+    h = mx.sym.FullyConnected(d, name="fc1", num_hidden=16)
+    h = mx.sym.Activation(h, act_type="relu")
+    out = mx.sym.FullyConnected(h, name="fc2", num_hidden=classes)
+    rs = np.random.RandomState(7)
+    params = {
+        "fc1_weight": mx.nd.array(rs.randn(16, in_dim) * 0.1),
+        "fc1_bias": mx.nd.zeros((16,)),
+        "fc2_weight": mx.nd.array(rs.randn(classes, 16) * 0.1),
+        "fc2_bias": mx.nd.zeros((classes,)),
+    }
+    mx.deploy.export_compiled(out, path, params=params,
+                              input_shapes={"data": (1, in_dim)},
+                              batch_sizes=batch_sizes)
+    return mx.deploy.load_compiled(path)
+
+
+# ---------------------------------------------------------------------------
+# ladder
+# ---------------------------------------------------------------------------
+
+def test_ladder_geometric_and_bucket_for():
+    lad = BucketLadder.geometric(8)
+    assert lad.buckets == [1, 2, 4, 8]
+    assert lad.bucket_for(1) == 1
+    assert lad.bucket_for(3) == 4
+    assert lad.bucket_for(8) == 8
+    assert lad.bucket_for(9) is None
+    assert BucketLadder.geometric(6).buckets == [1, 2, 4, 6]
+    with pytest.raises(mx.base.MXNetError):
+        BucketLadder([0, 2])
+
+
+# ---------------------------------------------------------------------------
+# batching correctness
+# ---------------------------------------------------------------------------
+
+def test_batched_bit_identical_to_predictor(tmp_path):
+    """A response must not depend on its batch-mates: with a single
+    bucket both one-by-one Predictor calls and coalesced server
+    batches run the SAME program with zero-pad rows, so the results
+    are bit-identical."""
+    pred = _mlp_artifact(str(tmp_path / "m.mxp"), batch_sizes=[4])
+    rs = np.random.RandomState(0)
+    xs = [rs.randn(12).astype(np.float32) for _ in range(7)]
+    one_by_one = [np.asarray(pred(x[None]))[0] for x in xs]
+    srv = InferenceServer(pred, max_queue=32, batch_window_ms=5.0)
+    try:
+        futs = [srv.submit(x) for x in xs]
+        got = [np.asarray(f.result(timeout=30)) for f in futs]
+    finally:
+        srv.stop()
+    for want, have in zip(one_by_one, got):
+        assert (want == have).all()
+
+
+def test_mixed_buckets_match_predictor_closely(tmp_path):
+    """Across ladder buckets XLA may pick different (equally valid)
+    kernels, so cross-bucket results agree to float tolerance."""
+    pred = _mlp_artifact(str(tmp_path / "m.mxp"),
+                         batch_sizes=[1, 2, 4, 8])
+    rs = np.random.RandomState(1)
+    xs = [rs.randn(12).astype(np.float32) for _ in range(13)]
+    ref = [np.asarray(pred(x[None]))[0] for x in xs]
+    srv = InferenceServer(pred, max_queue=64, batch_window_ms=5.0)
+    try:
+        futs = [srv.submit(x) for x in xs]
+        got = [np.asarray(f.result(timeout=30)) for f in futs]
+    finally:
+        srv.stop()
+    for want, have in zip(ref, got):
+        np.testing.assert_allclose(have, want, rtol=1e-5, atol=1e-6)
+    st = srv.stats()
+    assert st["completed"] == 13
+    assert st["shed"] == 0 and st["timeouts"] == 0
+
+
+def test_callable_model_in_process():
+    """An in-process jax-traceable callable serves without any
+    artifact (the 'wrap a bound model' path)."""
+    import jax.numpy as jnp
+    w = jnp.asarray(np.random.RandomState(2)
+                    .randn(6, 3).astype(np.float32))
+
+    def model(x):
+        return x @ w
+
+    srv = InferenceServer(model, max_batch=4, max_queue=16,
+                          batch_window_ms=1.0)
+    try:
+        xs = [np.random.RandomState(i).randn(6).astype(np.float32)
+              for i in range(5)]
+        futs = [srv.submit(x) for x in xs]
+        got = [np.asarray(f.result(timeout=30)) for f in futs]
+    finally:
+        srv.stop()
+    for x, y in zip(xs, got):
+        np.testing.assert_allclose(y, x @ np.asarray(w), rtol=1e-5,
+                                   atol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# the fixed-program-cache oracle
+# ---------------------------------------------------------------------------
+
+def test_program_cache_bounded_by_ladder(tmp_path):
+    """Under a randomized request-size mix the compile watch must see
+    exactly one compile per bucket program — and ZERO further compiles
+    once every bucket is warm (steady state)."""
+    compile_watch.enable()
+    pred = _mlp_artifact(str(tmp_path / "m.mxp"),
+                         batch_sizes=[1, 2, 4, 8])
+    srv = InferenceServer(pred, max_queue=256, batch_window_ms=1.0)
+    rs = np.random.RandomState(3)
+    try:
+        # deterministic warmup: every bucket program compiles once
+        assert srv.warmup() == 4
+        warm = compile_watch.site_stats("serving")
+        assert warm and len(warm) == 4     # one site per ladder bucket
+        assert all(s["count"] == 1 for s in warm.values()), warm
+        # mixed-size traffic after warmup
+        for burst in (1, 2, 3, 5, 8, 4, 7, 6):
+            futs = [srv.submit(rs.randn(12).astype(np.float32))
+                    for _ in range(burst)]
+            for f in futs:
+                f.result(timeout=30)
+        assert compile_watch.site_stats("serving") == warm
+        # steady state: a fresh randomized mix must not compile again
+        for _ in range(6):
+            burst = int(rs.randint(1, 9))
+            futs = [srv.submit(rs.randn(12).astype(np.float32))
+                    for _ in range(burst)]
+            for f in futs:
+                f.result(timeout=30)
+        steady = compile_watch.site_stats("serving")
+        assert steady == warm, (warm, steady)
+    finally:
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# backpressure, shedding, deadlines (deterministic via fault plan)
+# ---------------------------------------------------------------------------
+
+def test_backpressure_bound_and_shed(tmp_path, monkeypatch):
+    """With dispatch stalled by a planned hang, the bounded queue
+    fills and the next submits shed — the depth never exceeds the
+    bound."""
+    monkeypatch.setenv("MXNET_FAULT_HANG_SECONDS", "0.01")
+    pred = _mlp_artifact(str(tmp_path / "m.mxp"), batch_sizes=[4])
+    srv = InferenceServer(pred, max_queue=4, batch_window_ms=0.0)
+    fault.set_plan("serve_dispatch:step=1:hang:count=inf")
+    try:
+        x = np.zeros((12,), np.float32)
+        for _ in range(4):
+            srv.submit(x)
+        shed = 0
+        for _ in range(3):
+            with pytest.raises(ServerOverloadedError):
+                srv.submit(x)
+            shed += 1
+        st = srv.stats()
+        assert st["queue_peak"] <= 4
+        assert st["queue_depth"] <= 4
+        assert st["shed"] == shed
+        assert st["requests"] == 7
+    finally:
+        fault.set_plan(None)
+        srv.stop(drain=False)
+
+
+def test_deadline_timeouts_are_deterministic(tmp_path, monkeypatch):
+    """A planned hang at the dispatch site stalls batch formation so
+    queued requests age past their deadlines; when dispatch resumes
+    they are shed with RequestTimeoutError, never served."""
+    monkeypatch.setenv("MXNET_FAULT_HANG_SECONDS", "0.05")
+    pred = _mlp_artifact(str(tmp_path / "m.mxp"), batch_sizes=[4])
+    srv = InferenceServer(pred, max_queue=16, batch_window_ms=0.0)
+    fault.set_plan("serve_dispatch:step=1:hang:count=2")
+    try:
+        x = np.zeros((12,), np.float32)
+        futs = [srv.submit(x, deadline_ms=1) for _ in range(3)]
+        for f in futs:
+            with pytest.raises(RequestTimeoutError):
+                f.result(timeout=30)
+        st = srv.stats()
+        assert st["timeouts"] == 3
+        assert st["completed"] == 0
+        assert st["dispatch_faults"] >= 1
+        # the server survives: a fresh no-deadline request is served
+        y = srv.predict(x, timeout=30)
+        assert np.asarray(y).shape == (5,)
+    finally:
+        fault.set_plan(None)
+        srv.stop()
+
+
+def test_admit_site_raise_rejects_single_request(tmp_path):
+    pred = _mlp_artifact(str(tmp_path / "m.mxp"), batch_sizes=[2])
+    srv = InferenceServer(pred, max_queue=8, batch_window_ms=0.0)
+    fault.set_plan("serve_admit:step=2:raise")
+    try:
+        x = np.zeros((12,), np.float32)
+        srv.submit(x).result(timeout=30)          # visit 1: clean
+        with pytest.raises(fault.InjectedFault):   # visit 2: rejected
+            srv.submit(x)
+        srv.submit(x).result(timeout=30)          # visit 3: clean again
+    finally:
+        fault.set_plan(None)
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# validation
+# ---------------------------------------------------------------------------
+
+def test_submit_validates_sample_against_meta(tmp_path):
+    pred = _mlp_artifact(str(tmp_path / "m.mxp"), batch_sizes=[2])
+    srv = InferenceServer(pred, max_queue=8)
+    try:
+        with pytest.raises(mx.base.MXNetError, match="1 input"):
+            srv.submit(np.zeros((12,), np.float32),
+                       np.zeros((12,), np.float32))
+        with pytest.raises(mx.base.MXNetError, match="sample shape"):
+            srv.submit(np.zeros((11,), np.float32))
+        with pytest.raises(mx.base.MXNetError, match="cannot safely"):
+            srv.submit(np.zeros((12,), np.complex64))
+        # float64/int32 safely cast to the artifact dtype — admitted
+        y = srv.predict(np.zeros((12,), np.float64), timeout=30)
+        assert np.asarray(y).shape == (5,)
+    finally:
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# replicas
+# ---------------------------------------------------------------------------
+
+def test_replicas_spread_batches_least_outstanding(tmp_path):
+    import jax
+    if len(jax.devices()) < 2:
+        pytest.skip("needs the 8-device CPU mesh")
+    pred = _mlp_artifact(str(tmp_path / "m.mxp"), batch_sizes=[1, 2])
+    srv = InferenceServer(pred, max_queue=128, batch_window_ms=0.0,
+                          replicas=2)
+    rs = np.random.RandomState(5)
+    try:
+        futs = [srv.submit(rs.randn(12).astype(np.float32))
+                for _ in range(40)]
+        got = [np.asarray(f.result(timeout=30)) for f in futs]
+    finally:
+        srv.stop()
+    assert len(got) == 40 and all(y.shape == (5,) for y in got)
+    st = srv.stats()
+    assert st["replicas"] == 2
+    assert sum(st["replica_batches"]) == st["batches"]
+    # least-outstanding dispatch: with 40 requests trickling through
+    # 1/2-sized buckets, both replicas must have taken batches
+    assert all(b > 0 for b in st["replica_batches"]), st
+
+
+# ---------------------------------------------------------------------------
+# telemetry & diagnose
+# ---------------------------------------------------------------------------
+
+def test_serving_records_and_diagnose_table(tmp_path, capsys):
+    pred = _mlp_artifact(str(tmp_path / "m.mxp"), batch_sizes=[1, 4])
+    sink = str(tmp_path / "run.jsonl")
+    telemetry.start(filename=sink)
+    srv = InferenceServer(pred, max_queue=32, batch_window_ms=1.0,
+                          record_every=2)
+    rs = np.random.RandomState(6)
+    try:
+        futs = [srv.submit(rs.randn(12).astype(np.float32))
+                for _ in range(9)]
+        for f in futs:
+            f.result(timeout=30)
+    finally:
+        srv.stop()
+    summary = telemetry.stop()
+    assert summary["serving"]["completed"] == 9
+    assert summary["serving"]["shed"] == 0
+    kinds = set()
+    with open(sink) as f:
+        for line in f:
+            kinds.add(json.loads(line).get("type"))
+    assert "serving" in kinds
+    from mxnet_tpu.tools import diagnose
+    diagnose.main([sink])
+    out = capsys.readouterr().out
+    assert "----------Serving----------" in out
+    assert "9 submitted (completed 9" in out
+    assert "latency(ms)" in out
+    assert "queue depth" in out
+
+
+def test_no_server_keeps_sink_byte_identical(tmp_path):
+    """A run that never serves must not grow serving records or a
+    serving summary block."""
+    sink = str(tmp_path / "run.jsonl")
+    telemetry.start(filename=sink)
+    telemetry.step_begin()
+    telemetry.step_end(samples=4)
+    summary = telemetry.stop()
+    assert "serving" not in summary
+    with open(sink) as f:
+        kinds = {json.loads(line).get("type") for line in f}
+    assert "serving" not in kinds
+
+
+def test_stop_drain_serves_queued_requests(tmp_path):
+    pred = _mlp_artifact(str(tmp_path / "m.mxp"), batch_sizes=[8])
+    srv = InferenceServer(pred, max_queue=64, batch_window_ms=20.0)
+    x = np.zeros((12,), np.float32)
+    futs = [srv.submit(x) for _ in range(5)]
+    srv.stop(drain=True)
+    for f in futs:
+        assert np.asarray(f.result(timeout=1)).shape == (5,)
+    with pytest.raises(serving.ServerClosedError):
+        srv.submit(x)
