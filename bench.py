@@ -1455,6 +1455,106 @@ def _bench_serving_sweep(rates=(50, 100, 200, 400, 800, 1600, 3200),
     }
 
 
+def _bench_bucketing_case(n_sentences=240, batch=8,
+                          ladder=(11, 22, 32, 42), len_lo=3, len_hi=43,
+                          epochs=2):
+    """Variable-length LSTM text model (BENCH_r14): bucketed training
+    over a small geometric ladder vs the naive one-program-per-
+    distinct-length alternative. The win the record captures is the
+    COMPILE bill — ladder-size programs vs O(distinct lengths) — and
+    the total wall clock including compile time (epoch 1 cold, epoch 2
+    warm), via the compile-watch `bucketing:<len>` site oracle."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import compile_watch
+
+    compile_watch.enable()
+    rng = np.random.RandomState(7)
+    V, E, H = 24, 12, 16
+    sents = [list(rng.randint(1, V, size=L))
+             for L in rng.choice(np.arange(len_lo, len_hi),
+                                 size=n_sentences)]
+    distinct = sorted({len(s) for s in sents})
+
+    def sym_gen(seq_len):
+        data = mx.sym.var("data")
+        label = mx.sym.var("softmax_label")
+        emb = mx.sym.Embedding(data, input_dim=V, output_dim=E,
+                               name="embed")
+        stack = mx.rnn.SequentialRNNCell()
+        stack.add(mx.rnn.LSTMCell(H, prefix="lstm_"))
+        outputs, _ = stack.unroll(seq_len, emb, layout="NTC",
+                                  merge_outputs=True)
+        pred = mx.sym.Reshape(outputs, shape=(-1, H))
+        pred = mx.sym.FullyConnected(pred, num_hidden=V, name="pred")
+        label_f = mx.sym.Reshape(label, shape=(-1,))
+        out = mx.sym.SoftmaxOutput(pred, label_f, name="softmax",
+                                   use_ignore=True, ignore_label=0,
+                                   normalization="valid")
+        return out, ("data",), ("softmax_label",)
+
+    def run(buckets):
+        np.random.seed(0)           # iterator shuffles are np.random
+        it = mx.rnn.BucketSentenceIter(sents, batch_size=batch,
+                                       buckets=list(buckets),
+                                       invalid_label=0)
+        mod = mx.mod.BucketingModule(
+            sym_gen, default_bucket_key=it.default_bucket_key)
+        before = {k: v["count"] for k, v in
+                  (compile_watch.site_stats("bucketing") or {}).items()}
+        before_s = sum(
+            v["total_s"] for v in
+            (compile_watch.site_stats("bucketing") or {}).values())
+        epoch_wall = []
+        for epoch in range(epochs):
+            t0 = time.perf_counter()
+            mod.fit(it, num_epoch=1,
+                    eval_metric=mx.metric.Perplexity(ignore_label=0),
+                    optimizer="sgd",
+                    optimizer_params={"learning_rate": 0.05})
+            epoch_wall.append(round(time.perf_counter() - t0, 3))
+        after = compile_watch.site_stats("bucketing") or {}
+        compiles = sum(v["count"] for v in after.values()) \
+            - sum(before.values())
+        compile_s = sum(v["total_s"] for v in after.values()) - before_s
+        steps = it.bucketing.snapshot()["batches"]
+        return {"buckets": len(buckets), "compiles": compiles,
+                "compile_s": round(compile_s, 3),
+                "wall_s_cold_epoch": epoch_wall[0],
+                "wall_s_warm_epoch": epoch_wall[-1],
+                "wall_s_total": round(sum(epoch_wall), 3),
+                "steps": steps}
+
+    bucketed = run(ladder)
+    naive = run(distinct)       # one bucket (= one program) per length
+    out = {
+        "sentences": n_sentences,
+        "distinct_lengths": len(distinct),
+        "ladder": list(ladder),
+        "bucketed": bucketed,
+        "naive_per_length": naive,
+        "compile_ratio": round(naive["compiles"]
+                               / max(1, bucketed["compiles"]), 2),
+        "total_wall_speedup": round(naive["wall_s_total"]
+                                    / bucketed["wall_s_total"], 3),
+        "oracle_compiles_equal_ladder": bool(
+            bucketed["compiles"] == len(ladder)),
+    }
+    return out
+
+
+def _bucketing_record():
+    """The shape-bucketing benchmark record (BENCH_r14.json):
+    variable-length text training bucketed vs naive-per-length —
+    compile count (ladder size vs O(distinct lengths)) and total wall
+    clock including compiles. CPU backend."""
+    record = {"bench": "bucketing", "platform": "cpu"}
+    try:
+        record.update(_bench_bucketing_case())
+    except Exception as exc:                     # noqa: BLE001
+        record["errors"] = {"bucketing": _err_str(exc)}
+    return record
+
+
 def _serving_record():
     """The serving benchmark record (BENCH_r13.json): offered-load
     sweep — arrival rate x bucket ladder -> latency/throughput curve,
@@ -1627,6 +1727,12 @@ if __name__ == "__main__":
         # ladder -> latency/throughput curve, shed rate at overload,
         # program-cache oracle), one JSON line (the BENCH_r13 artifact)
         print(json.dumps(_serving_record()))
+    elif "--bucketing" in sys.argv:
+        # CPU-friendly standalone mode: variable-length LSTM text
+        # training bucketed over a 4-rung ladder vs naively compiling
+        # one program per distinct length — compile bill + wall clock,
+        # one JSON line (the BENCH_r14 artifact)
+        print(json.dumps(_bucketing_record()))
     elif "--checkpoint-overhead" in sys.argv:
         # CPU-friendly standalone mode: step-time p99 with
         # checkpointing off vs sync vs async on the MLP and convnet

@@ -71,6 +71,7 @@ from . import io
 from . import recordio
 from . import rtc
 from . import deploy
+from . import bucketing
 from . import serving
 from . import registry
 from . import log

@@ -1,0 +1,49 @@
+"""Shape bucketing: variable-shape training AND serving without
+recompile storms (ROADMAP item 5 — the training-side twin of the
+serving batcher, now one shared subsystem).
+
+A compiled-program runtime pays a full XLA compile per distinct input
+shape; ragged workloads (text, detection, variable batch tails) would
+compile one program per distinct length — the storm ``compile_watch``
+warns about. This package bounds the program cache to a small
+**ladder** of shapes and makes the padding that buys it exact:
+
+- :mod:`ladder` — :class:`ShapeLadder` (multi-dim bucket shapes,
+  smallest-fitting lookup, ``geometric()`` or explicit lists,
+  ``MXNET_BUCKET_LADDER``) and the 1-D :class:`BucketLadder` the
+  serving batcher re-exports;
+- :mod:`padding` — pad-to-bucket batch assembly returning validity
+  masks (``valid_lengths`` per sample, ``position_mask``), with
+  bit-exact row/position slicing back out;
+- :mod:`masked` — mask-aware loss/metric adapters: padded positions
+  contribute zero to loss, gradients, and metric denominators;
+- :mod:`iter` — :class:`BucketedPipeline`, grouping any ragged sample
+  stream into ladder buckets under a bounded straggler window,
+  pluggable into the async input pipeline;
+- :mod:`record` — the cumulative ``bucketing`` telemetry record
+  (per-bucket step counts, padding-overhead share, discards) rendered
+  by the diagnose Bucketing table.
+
+Each bucket's program compiles once under a ``bucketing:<shape>``
+compile-watch site (statics = the bucket key), so
+``compile_watch.site_stats("bucketing")`` is the test oracle: compile
+count == ladder size, zero steady-state recompiles, never a storm.
+"""
+from .ladder import (ShapeLadder, BucketLadder, as_ladder,
+                     ladder_from_env, bucket_site, format_bucket)
+from .padding import (pad_batch, slice_rows, pad_samples,
+                      position_mask, slice_valid)
+from .masked import (MaskedSoftmaxCELoss, MaskedL2Loss,
+                     masked_batch_loss, MaskedMetric)
+from .iter import BucketedPipeline
+from .record import BucketingStats
+
+__all__ = [
+    "ShapeLadder", "BucketLadder", "as_ladder", "ladder_from_env",
+    "bucket_site", "format_bucket",
+    "pad_batch", "slice_rows", "pad_samples", "position_mask",
+    "slice_valid",
+    "MaskedSoftmaxCELoss", "MaskedL2Loss", "masked_batch_loss",
+    "MaskedMetric",
+    "BucketedPipeline", "BucketingStats",
+]

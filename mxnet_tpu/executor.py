@@ -29,10 +29,18 @@ __all__ = ["Executor"]
 
 class Executor:
     def __init__(self, symbol, ctx, args, args_grad=None, grad_req="write",
-                 aux_states=None, batch_args=None, group2ctx=None):
+                 aux_states=None, batch_args=None, group2ctx=None,
+                 cw_bucket=None):
         from .ndarray import NDArray, zeros as nd_zeros
 
         self._symbol = symbol
+        # shape-bucketing identity: when this executor is one bucket of
+        # a ladder (BucketingModule / bucketed fit), its programs stage
+        # under the bucket's own compile-watch site (`bucketing:<key>`,
+        # statics carry the key) so the ladder is a FIXED program set —
+        # site_stats("bucketing") counts it and a bucket switch is
+        # specialization, never storm churn.
+        self._cw_bucket = cw_bucket
         # Multi-context bind = in-program data parallelism: ONE compiled
         # program over a 'dp' device mesh; batch args are sharded on dim
         # 0, params/aux replicated, and XLA's SPMD partitioner inserts
@@ -392,6 +400,10 @@ class Executor:
         site = "executor:%s:%s" % (kind, "train" if is_train else "eval")
         rep = None
         statics = None
+        if self._cw_bucket is not None:
+            from .bucketing.ladder import bucket_site
+            site = bucket_site(self._cw_bucket)
+            statics = ("bucket", kind, is_train, self._cw_bucket)
         if self._mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec as P
             rep = NamedSharding(self._mesh, P())
@@ -409,7 +421,7 @@ class Executor:
             shard_pos = frozenset(
                 i for i, n in enumerate(self.arg_names)
                 if n in self._param_shard_plans)
-            statics = ("param_shard",)
+            statics = (statics or ()) + ("param_shard",)
 
             def gather_entry(arg_vals):
                 return tuple(wsc(v, rep) if i in shard_pos else v
@@ -433,6 +445,7 @@ class Executor:
             else:
                 fn = compile_watch.jit(run, site,
                                        describe=self._cw_describe,
+                                       statics=statics,
                                        compiler_options=copts)
         else:
             gpos = self._grad_positions
@@ -466,6 +479,7 @@ class Executor:
             else:
                 fn = compile_watch.jit(fwdbwd, site,
                                        describe=self._cw_describe,
+                                       statics=statics,
                                        compiler_options=copts)
         self._fns[key] = fn
         return fn
@@ -716,7 +730,8 @@ class Executor:
                                            dtype=g.dtype)
         return Executor(self._symbol, self._ctx_arg, new_args, grads,
                         self._grad_req, self.aux_arrays,
-                        batch_args=self._batch_args)
+                        batch_args=self._batch_args,
+                        cw_bucket=self._cw_bucket)
 
     def copy_params_from(self, arg_params, aux_params=None,
                          allow_extra_params=False):

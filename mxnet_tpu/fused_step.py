@@ -387,11 +387,21 @@ class FusedStepExecutor(_FusedCore):
 
         from . import compile_watch
         from .engine import compiler_options
+        site = "fused_step:module"
+        statics = (counts, guard, inject, self._opt.fused_static_key())
+        bucket = getattr(self._ex, "_cw_bucket", None)
+        if bucket is not None:
+            # one bucket of a shape ladder: the fused program IS this
+            # bucket's compiled step — stage it under the bucket's own
+            # site so site_stats("bucketing") counts the ladder and a
+            # bucket switch is never storm-flagged as churn
+            from .bucketing.ladder import bucket_site
+            site = bucket_site(bucket)
+            statics = statics + ("fused", bucket)
         fn = compile_watch.jit(
-            program, "fused_step:module", describe=describe,
+            program, site, describe=describe,
             counter="fused_step_compile_ms",
-            statics=(counts, guard, inject,
-                     self._opt.fused_static_key()),
+            statics=statics,
             donate_argnums=(0, 1),
             compiler_options=compiler_options(self._ex._ctx))
         self._cache[key] = fn

@@ -179,12 +179,18 @@ def place_batch(batch, placement, data_names=None, label_names=None):
                     placement, name, data), name))
             return out
 
-        return DataBatch(put_roster(batch.data, names_d, "data"),
-                         put_roster(batch.label, names_l, "label"),
-                         pad=batch.pad, index=batch.index,
-                         bucket_key=batch.bucket_key,
-                         provide_data=batch.provide_data,
-                         provide_label=batch.provide_label)
+        placed = DataBatch(put_roster(batch.data, names_d, "data"),
+                           put_roster(batch.label, names_l, "label"),
+                           pad=batch.pad, index=batch.index,
+                           bucket_key=batch.bucket_key,
+                           provide_data=batch.provide_data,
+                           provide_label=batch.provide_label)
+        # bucketed batches (bucketing.BucketedPipeline) ride validity
+        # info as attributes — the mask contract must survive placement
+        for extra in ("valid_lengths", "valid_rows"):
+            if hasattr(batch, extra):
+                setattr(placed, extra, getattr(batch, extra))
+        return placed
     if isinstance(batch, (list, tuple)):
         # a 2-element batch is the (data, label) convention — label the
         # second element's h2d accounting accordingly

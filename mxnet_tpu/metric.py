@@ -179,19 +179,31 @@ class CompositeEvalMetric(EvalMetric):
 @register
 class Accuracy(EvalMetric):
     """Fraction of argmax predictions equal to the label
-    (reference: metric.py:365)."""
+    (reference: metric.py:365).
+
+    ``ignore_label`` drops positions whose label equals it BEFORE
+    counting — hits and the denominator alike — so padded bucketed
+    batches (``mxnet_tpu.bucketing``) score identically to their
+    unpadded samples: the selection is an ordered boolean take, the
+    ignored rows simply never existed."""
 
     def __init__(self, axis=1, name="accuracy", output_names=None,
-                 label_names=None):
-        super().__init__(name, output_names, label_names, axis=axis)
+                 label_names=None, ignore_label=None):
+        super().__init__(name, output_names, label_names, axis=axis,
+                         ignore_label=ignore_label)
         self.axis = axis
+        self.ignore_label = ignore_label
 
     def _batch_stat(self, label, pred):
         if pred.shape != label.shape:
             pred = pred.argmax(axis=self.axis)
         pred = pred.ravel().astype(numpy.int32)
-        label = label.ravel().astype(numpy.int32)
+        label_raw = label.ravel()
+        label = label_raw.astype(numpy.int32)
         check_label_shapes(label, pred)     # no silent broadcasting
+        if self.ignore_label is not None:
+            keep = label_raw != self.ignore_label
+            pred, label = pred[keep], label[keep]
         hits = numpy.equal(pred, label)
         return hits.sum(), hits.size
 
@@ -344,8 +356,14 @@ class Perplexity(EvalMetric):
         flat, probs = _gathered_probs(label, pred)
         count = flat.shape[0]
         if self.ignore_label is not None:
+            # ordered boolean SELECTION, not a where()-to-1.0 mask: the
+            # kept probabilities are the identical array an unpadded
+            # batch would produce, so the summed NLL (and therefore the
+            # perplexity of a padded bucketed batch) matches the
+            # unpadded value bit-for-bit — where() would interleave
+            # exact zeros and shift numpy's pairwise-sum grouping
             keep = flat != self.ignore_label
-            probs = numpy.where(keep, probs, 1.0)
+            probs = probs[keep]
             count = int(keep.sum())
         nll = -numpy.log(numpy.maximum(probs, 1e-10)).sum()
         return nll, count

@@ -38,6 +38,38 @@ def _parse_data_desc(data_names, label_names, data_shapes, label_shapes):
     return data_shapes, label_shapes
 
 
+def _output_pad(eval_batch, out, pad):
+    """Rows to slice off one output for the batch's ``pad`` padded
+    samples. Normally ``pad`` (one output row per sample); when the
+    output's leading dim is a whole multiple of the batch's rows — an
+    LM head reshaped to ``(batch*positions, C)``, the bucketed-text
+    pattern — the padded samples own the LAST ``pad * positions``
+    rows, so the slice scales. Matters since bucketed iterators pad
+    their final partial batch instead of dropping it. Only batch-major
+    batches can be sliced at all: on a time-major ('TN') layout the
+    pad samples are interleaved COLUMNS, so the slice is skipped (the
+    pad rows stay; callers mask by length) rather than cutting real
+    timesteps off axis 0."""
+    if not pad:
+        return 0
+    data = getattr(eval_batch, "data", None)
+    if not data:
+        return pad
+    provide = getattr(eval_batch, "provide_data", None)
+    layout = getattr(provide[0], "layout", None) if provide else None
+    if layout and layout.find("N") > 0:
+        return 0                       # time-major: not sliceable
+    rows = data[0].shape[0]
+    if out.shape[0] == rows:
+        return pad                     # one output row per sample
+    if rows and out.shape[0] % rows == 0:
+        return pad * (out.shape[0] // rows)
+    # an aggregate/odd-shaped output (fewer rows than the batch, or
+    # not row-aligned): no per-sample rows to attribute — slice nothing
+    # rather than truncating a per-batch value
+    return 0
+
+
 class BaseModule:
     """Base of all modules (reference: base_module.py:64)."""
 
@@ -99,8 +131,9 @@ class BaseModule:
             if num_batch is not None and nbatch == num_batch:
                 break
             self.forward(eval_batch, is_train=False)
-            pad = eval_batch.pad
-            outputs = [out[0:out.shape[0] - (pad or 0)]
+            pad = eval_batch.pad or 0
+            outputs = [out[0:out.shape[0]
+                           - _output_pad(eval_batch, out, pad)]
                        for out in self.get_outputs()]
             yield (outputs, nbatch, eval_batch)
 
@@ -122,7 +155,8 @@ class BaseModule:
                 break
             self.forward(eval_batch, is_train=False)
             pad = eval_batch.pad or 0
-            outputs = [out[0:out.shape[0] - pad].copy()
+            outputs = [out[0:out.shape[0]
+                           - _output_pad(eval_batch, out, pad)].copy()
                        for out in self.get_outputs()]
             output_list.append(outputs)
         if len(output_list) == 0:

@@ -147,6 +147,10 @@ class BucketingModule(BaseModule):
                         fixed_param_names=self._fixed_param_names,
                         state_names=self._state_names,
                         compression_params=self._compression_params)
+        # each bucket's programs stage under their own compile-watch
+        # site `bucketing:<key>` — the ladder is a fixed program set
+        # (site_stats("bucketing") oracle), never storm-flagged churn
+        module._bucket_site = self._default_bucket_key
         module.bind(data_shapes, label_shapes, for_training,
                     inputs_need_grad, force_rebind=False,
                     shared_module=None, grad_req=self._grad_req)
@@ -164,6 +168,15 @@ class BucketingModule(BaseModule):
                             fixed_param_names=self._fixed_param_names,
                             state_names=self._state_names,
                             compression_params=self._compression_params)
+            module._bucket_site = bucket_key
+            # the donor's cached _arg_params go stale the moment a
+            # SIBLING bucket module steps (the live buffers are shared;
+            # the caches are not) — force a re-sync so the shared bind
+            # seeds from the current values instead of writing stale
+            # ones back into the live buffers
+            if self.params_initialized:
+                self._buckets[self._default_bucket_key]._params_dirty \
+                    = True
             module.bind(data_shapes, label_shapes, self._curr_module.
                         for_training, self._curr_module.inputs_need_grad,
                         force_rebind=False,

@@ -73,6 +73,12 @@ class Module(BaseModule):
         self._preload_opt_states = None
         self._grad_req = None
         self._exec = None
+        # shape-bucketing identity: BucketingModule stamps each
+        # per-bucket Module with its bucket key before bind, so the
+        # bucket's programs stage under a `bucketing:<key>` compile-
+        # watch site (statics = the key) — the ladder is a fixed
+        # program set, never storm-flagged churn
+        self._bucket_site = None
         self._fused = None            # FusedStepExecutor | False | None
         self._pending_step = False
         self._noted_monitor_eager = False   # one-time telemetry note
@@ -165,6 +171,14 @@ class Module(BaseModule):
             src = provided[name]
             if src is not dst:
                 src.copyto(dst)
+                if dst._data is src._data:
+                    # copyto's device_put was a no-op (same device), so
+                    # dst now ALIASES src's buffer. The fused train
+                    # step donates dst to XLA — an alias would strand
+                    # src (a sibling bucket module's cached params, a
+                    # user's array) on a deleted buffer. Break it with
+                    # a genuine copy.
+                    dst._set_data(dst.copy()._data)
             return
         if initializer is None:
             if not allow_missing:
@@ -300,7 +314,7 @@ class Module(BaseModule):
         self._exec = Executor(
             self._symbol, exec_ctx, args, grads, reqs, aux,
             batch_args=set(self._data_names) | set(self._label_names),
-            group2ctx=g2c)
+            group2ctx=g2c, cw_bucket=self._bucket_site)
         self.binded = True
 
         if shared_module is not None and shared_module.params_initialized:

@@ -1,100 +1,26 @@
-"""The bucket-ladder dynamic batcher primitives.
+"""The bucket-ladder dynamic batcher primitives — now re-exports of
+the shared shape-bucketing subsystem (``mxnet_tpu.bucketing``).
 
 A compiled-program runtime pays a full XLA compile per distinct input
 signature, so a server that batched "however many requests are
 waiting" would compile a program per occupancy — the classic recompile
 storm ``compile_watch`` warns about. The fix (Orca/vLLM-class serving,
-and ROADMAP item 5's training-side twin) is a small **geometric ladder**
-of batch shapes: every dispatch pads the waiting requests up to the
-smallest bucket that fits, so the program cache is bounded by the
-ladder size no matter the request mix, and the padding is exact — a
-row's result never depends on its batch-mates (asserted bit-for-bit in
-``tests/test_serving.py``).
+and ROADMAP item 5's training-side twin) is a small **geometric
+ladder** of batch shapes: every dispatch pads the waiting requests up
+to the smallest bucket that fits, so the program cache is bounded by
+the ladder size no matter the request mix, and the padding is exact —
+a row's result never depends on its batch-mates (asserted bit-for-bit
+in ``tests/test_serving.py``).
+
+The ladder, the pad, and the slice originated here for the serving
+batch dimension; the training side needed the identical machinery for
+sequence lengths, so all three now live in ``mxnet_tpu.bucketing``
+(``ladder.BucketLadder``, ``padding.pad_batch``/``slice_rows``) and
+this module keeps the serving-facing names stable.
 """
 from __future__ import annotations
 
-import numpy as _np
-
-from ..base import MXNetError
+from ..bucketing.ladder import BucketLadder
+from ..bucketing.padding import pad_batch, slice_rows
 
 __all__ = ["BucketLadder", "pad_batch", "slice_rows"]
-
-
-class BucketLadder:
-    """An ascending list of bucket batch sizes.
-
-    ``BucketLadder.geometric(8)`` -> buckets [1, 2, 4, 8]. The ladder
-    is the server's whole program-cache budget: one compiled program
-    per bucket (per replica device), ever."""
-
-    __slots__ = ("buckets",)
-
-    def __init__(self, buckets):
-        bs = sorted({int(b) for b in buckets})
-        if not bs or bs[0] < 1:
-            raise MXNetError(
-                "BucketLadder: buckets must be positive ints, got %r"
-                % (buckets,))
-        self.buckets = bs
-
-    @classmethod
-    def geometric(cls, max_batch, min_batch=1, factor=2):
-        """min_batch, min_batch*factor, ... capped at (and always
-        including) max_batch."""
-        max_batch = int(max_batch)
-        b = int(min_batch)
-        if b < 1 or max_batch < b:
-            raise MXNetError(
-                "BucketLadder.geometric: want 1 <= min_batch <= "
-                "max_batch, got %s..%s" % (min_batch, max_batch))
-        buckets = []
-        while b < max_batch:
-            buckets.append(b)
-            b *= int(factor)
-        buckets.append(max_batch)
-        return cls(buckets)
-
-    @property
-    def max_batch(self):
-        return self.buckets[-1]
-
-    def bucket_for(self, n):
-        """The smallest bucket >= n (None when n exceeds the top)."""
-        for b in self.buckets:
-            if b >= n:
-                return b
-        return None
-
-    def __len__(self):
-        return len(self.buckets)
-
-    def __iter__(self):
-        return iter(self.buckets)
-
-    def __repr__(self):
-        return "BucketLadder(%s)" % self.buckets
-
-
-def pad_batch(samples, bucket):
-    """Stack per-request sample arrays (one input's worth) into a
-    ``(bucket, *sample_shape)`` batch, zero-padding the tail rows.
-    Exact: the pad rows are sliced back off by :func:`slice_rows`."""
-    stacked = _np.stack(samples)
-    n = stacked.shape[0]
-    if n == bucket:
-        return stacked
-    if n > bucket:
-        raise MXNetError("pad_batch: %d samples exceed bucket %d"
-                         % (n, bucket))
-    pad = _np.zeros((bucket - n,) + stacked.shape[1:],
-                    dtype=stacked.dtype)
-    return _np.concatenate([stacked, pad])
-
-
-def slice_rows(outputs, i):
-    """Request ``i``'s response out of a batched program result: row
-    ``i`` of every output (tuple-normalized in, single-or-tuple out to
-    mirror the Predictor's return convention)."""
-    if isinstance(outputs, tuple):
-        return tuple(o[i] for o in outputs)
-    return outputs[i]

@@ -44,6 +44,7 @@ import numpy as _np
 
 from ..base import MXNetError, get_env
 from .. import fault, telemetry
+from ..bucketing.padding import pad_along
 from .batcher import BucketLadder, pad_batch, slice_rows
 
 __all__ = ["InferenceServer", "ServerOverloadedError",
@@ -116,9 +117,22 @@ class InferenceServer:
     """Continuous-batching server over a deploy artifact (path or
     :class:`~mxnet_tpu.deploy.Predictor`) or an in-process batched
     callable (``fn(*batched_inputs) -> batched_output(s)``, must be
-    jax-traceable; requires ``ladder`` or ``max_batch``)."""
+    jax-traceable; requires ``ladder`` or ``max_batch``).
+
+    ``seq_ladder=`` (callable models only) serves variable-length
+    requests: samples may differ along ``seq_axis``, each batch holds
+    requests of ONE sequence rung (a request always pads to its OWN
+    smallest rung — its result can never depend on which batch-mates
+    arrived concurrently), and the program cache stays bounded by the
+    two ladders' product (``compile_watch.site_stats("serving")``
+    oracle, the shared ``mxnet_tpu.bucketing`` ladder contract). The
+    model DOES see the deterministic per-rung zero padding: it must
+    tolerate it (mask internally, or be padding-invariant for the
+    outputs it reports); per-position outputs come back rung-length —
+    callers slice to their own request's length."""
 
     def __init__(self, model, *, ladder=None, max_batch=None,
+                 seq_ladder=None, seq_axis=0,
                  max_queue=64, batch_window_ms=2.0, replicas=1,
                  devices=None, default_deadline_ms=None,
                  record_every=None, name=None, start=True):
@@ -162,6 +176,24 @@ class InferenceServer:
                 ladder = BucketLadder(ladder)
         self._ladder = ladder
 
+        # variable-length requests: a second ladder over the samples'
+        # sequence dimension (``seq_axis`` of the per-sample array).
+        # Each (batch bucket, seq bucket) pair is one program — the
+        # cache stays bounded by |ladder| x |seq_ladder| under any
+        # request-length mix. In-process callables only: a deploy
+        # artifact records ONE fixed per-sample shape per batch bucket.
+        self._seq_axis = int(seq_axis)
+        if seq_ladder is not None:
+            if predictor is not None:
+                raise MXNetError(
+                    "InferenceServer: seq_ladder= needs an in-process "
+                    "callable model — deploy artifacts record fixed "
+                    "per-sample shapes (export one program per shape "
+                    "instead)")
+            if not isinstance(seq_ladder, BucketLadder):
+                seq_ladder = BucketLadder(seq_ladder)
+        self._seq_ladder = seq_ladder
+
         site = "serving" if not name else "serving:%s" % name
         self._programs = {}
         for b in ladder.buckets:
@@ -173,8 +205,14 @@ class InferenceServer:
             # one logical program per bucket: a recompile inside one
             # bucket site IS churn; distinct buckets are distinct
             # programs by construction (statics carry the bucket)
-            self._programs[b] = compile_watch.jit(
-                fn, "%s:b%d" % (site, b), statics=(site, b))
+            if seq_ladder is None:
+                self._programs[b] = compile_watch.jit(
+                    fn, "%s:b%d" % (site, b), statics=(site, b))
+            else:
+                for s in seq_ladder.buckets:
+                    self._programs[(b, s)] = compile_watch.jit(
+                        fn, "%s:b%d:s%d" % (site, b, s),
+                        statics=(site, b, s))
 
         import jax
         replicas = int(replicas)
@@ -312,12 +350,28 @@ class InferenceServer:
                 "serving: warmup() on a callable model needs one "
                 "example sample per input")
         n = 0
+        seq_rungs = [None] if self._seq_ladder is None \
+            else list(self._seq_ladder.buckets)
         for dev in self._devices:
             for b in self._ladder.buckets:
-                inputs = [jax.device_put(pad_batch([s], b), dev)
-                          for s in samples]
-                jax.block_until_ready(self._programs[b](*inputs))
-                n += 1
+                for s_rung in seq_rungs:
+                    warm = samples
+                    key = b
+                    if s_rung is not None:
+                        # one zero sample per seq rung: truncate or
+                        # pad the example's sequence axis to the rung
+                        warm = []
+                        for s in samples:
+                            ax = self._seq_axis
+                            sl = [slice(None)] * s.ndim
+                            sl[ax] = slice(0, min(s.shape[ax], s_rung))
+                            warm.append(pad_along(s[tuple(sl)], s_rung,
+                                                 ax))
+                        key = (b, s_rung)
+                    inputs = [jax.device_put(pad_batch([s], b), dev)
+                              for s in warm]
+                    jax.block_until_ready(self._programs[key](*inputs))
+                    n += 1
         return n
 
     # -- admission ---------------------------------------------------------
@@ -332,6 +386,19 @@ class InferenceServer:
                 "%d" % (self._n_inputs, names, len(arrays)))
         if self._n_inputs is None:
             self._n_inputs = len(arrays)
+        if self._seq_ladder is not None:
+            ax = self._seq_axis
+            top = self._seq_ladder.max_batch
+            for arr in arrays:
+                if arr.ndim <= ax:
+                    raise MXNetError(
+                        "serving: seq_ladder expects samples with a "
+                        "sequence axis %d; got shape %s"
+                        % (ax, list(arr.shape)))
+                if arr.shape[ax] > top:
+                    raise MXNetError(
+                        "serving: sample length %d exceeds the "
+                        "seq ladder top %d" % (arr.shape[ax], top))
         if not self._meta_inputs:
             return arrays
         from ..deploy import check_cast_dtype
@@ -467,14 +534,31 @@ class InferenceServer:
             if r is None:
                 break
             now = time.monotonic()
-            batch, expired = [], []
+            batch, expired, leftover = [], [], []
+            srung = None
             with self._cond:
                 while self._queue and len(batch) < max_b:
                     req = self._queue.popleft()
                     if req.deadline is not None and now > req.deadline:
                         expired.append(req)
                         continue
+                    if self._seq_ladder is not None:
+                        # one batch = ONE sequence rung, the first
+                        # request's own: a request's padding depends
+                        # only on itself, never on which batch-mates
+                        # happened to arrive concurrently — the
+                        # row-independence contract for models that
+                        # see (and must mask or tolerate) the pad
+                        rung = self._req_rung(req)
+                        if srung is None:
+                            srung = rung
+                        elif rung != srung:
+                            leftover.append(req)
+                            continue
                     batch.append(req)
+                if leftover:
+                    # preserve FIFO for the rungs left behind
+                    self._queue.extendleft(reversed(leftover))
                 if expired:
                     self._stats["timeouts"] += len(expired)
                 if not batch:
@@ -490,7 +574,14 @@ class InferenceServer:
             if not batch:
                 continue
             bucket = self._ladder.bucket_for(len(batch))
-            self._work[r].put((batch, bucket))
+            self._work[r].put((batch, bucket, srung))
+
+    def _req_rung(self, req):
+        """One request's own sequence rung: the smallest bucket
+        fitting its longest input (every input pads along seq_axis to
+        the shared rung; all lengths validated <= top at admit)."""
+        lmax = max(a.shape[self._seq_axis] for a in req.args)
+        return self._seq_ladder.bucket_for(lmax)
 
     # -- replicas ----------------------------------------------------------
     def _worker_loop(self, idx):
@@ -500,13 +591,18 @@ class InferenceServer:
             item = self._work[idx].get()
             if item is None:
                 break
-            batch, bucket = item
+            batch, bucket, srung = item
+            pkey = bucket if srung is None else (bucket, srung)
             try:
                 inputs = []
                 for j in range(len(batch[0].args)):
-                    arr = pad_batch([r.args[j] for r in batch], bucket)
+                    samples = [r.args[j] for r in batch]
+                    if srung is not None:
+                        samples = [pad_along(s, srung, self._seq_axis)
+                                   for s in samples]
+                    arr = pad_batch(samples, bucket)
                     inputs.append(jax.device_put(arr, dev))
-                out = self._programs[bucket](*inputs)
+                out = self._programs[pkey](*inputs)
                 out = jax.block_until_ready(out)
             except Exception as exc:        # noqa: BLE001 — model errors
                 with self._cond:            # belong to the requests
@@ -524,8 +620,10 @@ class InferenceServer:
                 self._stats["completed"] += n
                 self._stats["batches"] += 1
                 self._stats["occupancy_sum"] += n / float(bucket)
-                self._bucket_counts[bucket] = \
-                    self._bucket_counts.get(bucket, 0) + 1
+                ckey = str(bucket) if srung is None \
+                    else "%dx%d" % (bucket, srung)
+                self._bucket_counts[ckey] = \
+                    self._bucket_counts.get(ckey, 0) + 1
                 self._replica_batches[idx] += 1
                 self._outstanding[idx] -= 1
                 self._cond.notify_all()     # wake the slot-reserving
@@ -548,8 +646,9 @@ class InferenceServer:
         with self._cond:
             s = dict(self._stats)
             lats = [v * 1e3 for v in self._latencies]
-            buckets = {str(k): v
-                       for k, v in sorted(self._bucket_counts.items())}
+            from ..bucketing.ladder import bucket_sort_key
+            buckets = dict(sorted(self._bucket_counts.items(),
+                                  key=lambda kv: bucket_sort_key(kv[0])))
             depth = len(self._queue)
             replica_batches = list(self._replica_batches)
         out = {

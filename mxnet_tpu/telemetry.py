@@ -74,8 +74,11 @@ write/manifest sub-spans, blocking vs async split, last good epoch),
 and, only when an inference server runs (``mxnet_tpu.serving``),
 periodic cumulative ``serving`` records (request counts, latency
 percentiles, requests/sec, batch occupancy, queue depth, shed/timeout
-counts — rendered as the diagnose Serving table). With those
-subsystems unused the kinds never appear and the sink is
+counts — rendered as the diagnose Serving table), and, only when a
+shape-bucketing producer runs (``mxnet_tpu.bucketing``), cumulative
+``bucketing`` records (per-bucket batch counts, padding-overhead
+share, pad-row/discard counts — the diagnose Bucketing table). With
+those subsystems unused the kinds never appear and the sink is
 byte-identical to a run without them.
 """
 from __future__ import annotations
@@ -93,7 +96,7 @@ __all__ = ["PHASES", "enabled", "start", "stop", "reset", "maybe_start",
            "comm_span", "h2d", "note", "recent_rate", "sample_memory",
            "memory_breakdown", "flush", "report", "quick_stats",
            "percentile", "external_record", "checkpoint_event",
-           "serving_event"]
+           "serving_event", "bucketing_event"]
 
 PHASES = ("data_wait", "compute", "optimizer", "sync", "checkpoint",
           "eval")
@@ -136,6 +139,7 @@ class _Run:
         self.comms = {}              # (kind, key) -> calls/bytes/time_ms
         self.ckpt = None             # checkpoint-save aggregates (lazy)
         self.serving = None          # latest cumulative serving stats
+        self.bucketing = None        # per-producer cumulative bucketing
         self.fault_counters = {"skipped_steps": 0, "retries": 0,
                                "timeouts": 0}
         self.extra_counters = {}     # free-form note() names
@@ -656,6 +660,31 @@ def serving_event(fields):
         _cap_records_locked(run)
 
 
+def bucketing_event(fields):
+    """Append one cumulative ``bucketing`` record from a shape-
+    bucketing producer (``mxnet_tpu.bucketing`` — per-bucket batch
+    counts, padding-overhead share, pad-row and discarded-sample
+    counts; producers emit every ``MXNET_BUCKETING_RECORD_EVERY``
+    batches and at epoch boundaries). Latest snapshot per producer
+    ``name`` lands in the summary's ``bucketing`` block. No-op without
+    a run, so an unbucketed run keeps a byte-identical sink."""
+    run = _run
+    if run is None:
+        return
+    rec = {"type": "bucketing", "seq": run.steps,
+           "t": round(time.time() - run.t0_wall, 6)}
+    rec.update(fields)
+    with _lock:
+        if run.bucketing is None:
+            run.bucketing = {}
+        # cumulative per producer: latest wins
+        run.bucketing[fields.get("name") or "default"] = dict(fields)
+        run.records.append(rec)
+        # a stepless sink-less loop (a bare data-pipeline soak) must
+        # not grow records unboundedly
+        _cap_records_locked(run)
+
+
 def note(name, delta=1):
     """Count one resilience/bookkeeping event against the run.
     fault.py calls this at the exact branch points that advance its own
@@ -859,6 +888,9 @@ def report():
             out["checkpoint"] = ck
         if run.serving is not None:
             out["serving"] = dict(run.serving)
+        if run.bucketing is not None:
+            out["bucketing"] = {k: dict(v)
+                                for k, v in run.bucketing.items()}
         if run.records_dropped:
             out["records_dropped"] = run.records_dropped
         total_s = run.total_step_s

@@ -24,9 +24,11 @@ table (per-bucket bytes/latency, in-program step count, sync-phase
 share), and — when the run hosted an ``mxnet_tpu.serving``
 ``InferenceServer`` — the Serving table (request counts with
 shed/timeout splits, latency percentiles, requests/sec, bucket-ladder
-occupancy, queue-depth peak vs bound, per-replica dispatch). This
-supersedes scraping the same facts out of log lines with
-``tools/parse_log.py``.
+occupancy, queue-depth peak vs bound, per-replica dispatch), and —
+when a shape-bucketing producer ran (``mxnet_tpu.bucketing``) — the
+Bucketing table (per-bucket batch counts, padding-overhead share,
+pad-row and discarded-sample counts per producer). This supersedes
+scraping the same facts out of log lines with ``tools/parse_log.py``.
 """
 from __future__ import annotations
 
@@ -121,7 +123,7 @@ def read_telemetry(path):
     same MXNET_TELEMETRY_FILE) yields the LAST run."""
     out = {"run": None, "steps": [], "memory": [], "compiles": [],
            "utilization": [], "checkpoints": [], "serving": [],
-           "breakdown": None, "summary": None}
+           "bucketing": [], "breakdown": None, "summary": None}
     with open(path) as f:
         for line in f:
             line = line.strip()
@@ -136,7 +138,8 @@ def read_telemetry(path):
                 out = {"run": rec, "steps": [], "memory": [],
                        "compiles": [], "utilization": [],
                        "checkpoints": [], "serving": [],
-                       "breakdown": None, "summary": None}
+                       "bucketing": [], "breakdown": None,
+                       "summary": None}
             elif kind == "step":
                 out["steps"].append(rec)
             elif kind == "memory":
@@ -151,6 +154,8 @@ def read_telemetry(path):
                 out["checkpoints"].append(rec)
             elif kind == "serving":
                 out["serving"].append(rec)
+            elif kind == "bucketing":
+                out["bucketing"].append(rec)
             elif kind == "summary":
                 out["summary"] = rec
     return out
@@ -375,10 +380,11 @@ def format_telemetry(tel):
                      % (sv.get("rps", 0.0), sv.get("batches", 0)))
         occ = sv.get("occupancy")
         if occ is not None:
+            from ..bucketing.ladder import bucket_sort_key
             per_bucket = " ".join(
                 "b%s:%s" % kv
                 for kv in sorted((sv.get("buckets") or {}).items(),
-                                 key=lambda kv: int(kv[0])))
+                                 key=lambda kv: bucket_sort_key(kv[0])))
             lines.append("occupancy    : %.1f%% mean of bucket slots "
                          "(%s)" % (100.0 * occ, per_bucket or "-"))
         lines.append("queue depth  : peak %d of bound %d (ladder %s)"
@@ -394,6 +400,37 @@ def format_telemetry(tel):
         if sv.get("dispatch_faults"):
             lines.append("faults       : %d injected dispatch fault(s) "
                          "survived" % sv["dispatch_faults"])
+
+    # -- shape bucketing (mxnet_tpu.bucketing) --------------------------
+    buck_recs = tel.get("bucketing") or []
+    # records are cumulative per producer name: keep each name's last
+    buck = {}
+    for rec in buck_recs:
+        buck[rec.get("name") or "default"] = rec
+    if not buck:
+        buck = dict(summary.get("bucketing") or {})
+    if buck:
+        lines.append("----------Bucketing----------")
+        for name in sorted(buck):
+            b = buck[name]
+            from ..bucketing.ladder import bucket_sort_key
+            per_bucket = " ".join(
+                "b%s:%s" % kv
+                for kv in sorted((b.get("buckets") or {}).items(),
+                                 key=lambda kv: bucket_sort_key(kv[0])))
+            lines.append("%-12s : %d batch(es) over %d bucket(s) (%s)"
+                         % (name[:12], b.get("batches", 0),
+                            len(b.get("buckets") or {}),
+                            per_bucket or "-"))
+            share = b.get("padding_share")
+            lines.append("  padding    : %s of padded-batch elements "
+                         "were padding (pad rows %d)"
+                         % ("%.1f%%" % (100.0 * share)
+                            if share is not None else "n/a",
+                            b.get("pad_rows", 0)))
+            lines.append("  samples    : %d bucketed, %d discarded "
+                         "(longer than the ladder top)"
+                         % (b.get("samples", 0), b.get("discarded", 0)))
 
     lines.append("----------Goodput----------")
     skipped = sum(s.get("skipped", 0) for s in steps)

@@ -1,0 +1,820 @@
+"""The shape-bucketing subsystem (mxnet_tpu.bucketing): ladders,
+pad-to-bucket assembly with validity masks, mask-aware losses/metrics
+(padded == unpadded, bit-exact where the computation is), the
+BucketedPipeline, the BucketSentenceIter tail-pad fix, the
+compile-storm regression oracle (compile count == ladder size through
+a bucketed Module.fit and the serving sequence-dim ladder), and the
+bucketing telemetry/diagnose wiring."""
+import json
+import warnings
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import bucketing, compile_watch, gluon, telemetry
+from mxnet_tpu.bucketing import (BucketedPipeline, BucketLadder,
+                                 MaskedMetric, MaskedSoftmaxCELoss,
+                                 ShapeLadder, masked_batch_loss,
+                                 pad_samples, position_mask,
+                                 slice_valid)
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.io.io import DataBatch, DataDesc
+
+
+@pytest.fixture(autouse=True)
+def _clean_state():
+    telemetry.reset()
+    compile_watch.disable()
+    yield
+    telemetry.reset()
+    compile_watch.disable()
+
+
+# ---------------------------------------------------------------------------
+# ladders
+# ---------------------------------------------------------------------------
+
+class TestLadders:
+    def test_shape_ladder_explicit_and_lookup(self):
+        lad = ShapeLadder([(4, 8), (4, 16), (8, 16), (8, 32)])
+        assert len(lad) == 4
+        assert lad.bucket_for((3, 7)) == (4, 8)
+        assert lad.bucket_for((5, 9)) == (8, 16)
+        assert lad.bucket_for((8, 32)) == (8, 32)
+        assert lad.bucket_for((9, 4)) is None
+        assert lad.max_shape == (8, 32)
+
+    def test_shape_ladder_geometric_cross_product(self):
+        lad = ShapeLadder.geometric((8, 32), (2, 8))
+        # axis rungs [2,4,8] x [8,16,32]
+        assert len(lad) == 9
+        assert lad.bucket_for((3, 9)) == (4, 16)
+
+    def test_shape_ladder_validation(self):
+        with pytest.raises(mx.base.MXNetError):
+            ShapeLadder([])
+        with pytest.raises(mx.base.MXNetError):
+            ShapeLadder([(0, 4)])
+        with pytest.raises(mx.base.MXNetError):
+            ShapeLadder([(4,), (4, 8)])       # mixed ranks
+        with pytest.raises(mx.base.MXNetError):
+            ShapeLadder([(4, 8)]).bucket_for((3,))
+
+    def test_bucket_ladder_is_the_serving_ladder(self):
+        # satellite: serving re-imports the shared ladder — one class
+        from mxnet_tpu.serving import BucketLadder as ServingLadder
+        assert ServingLadder is BucketLadder
+        assert issubclass(BucketLadder, ShapeLadder)
+        lad = BucketLadder.geometric(8)
+        assert lad.buckets == [1, 2, 4, 8]
+        assert lad.bucket_for(3) == 4
+        assert lad.bucket_for(9) is None
+
+    def test_ladder_from_env(self, monkeypatch):
+        monkeypatch.setenv("MXNET_BUCKET_LADDER", "8,16,32")
+        lad = bucketing.ladder_from_env()
+        assert isinstance(lad, BucketLadder)
+        assert lad.buckets == [8, 16, 32]
+        monkeypatch.setenv("MXNET_BUCKET_LADDER", "4x16,8x16,8x32")
+        lad = bucketing.ladder_from_env()
+        assert lad.shapes == [(4, 16), (8, 16), (8, 32)]
+        monkeypatch.setenv("MXNET_BUCKET_LADDER", "nope")
+        with pytest.raises(mx.base.MXNetError):
+            bucketing.ladder_from_env()
+        monkeypatch.delenv("MXNET_BUCKET_LADDER")
+        assert bucketing.ladder_from_env() is None
+        assert bucketing.ladder_from_env(default=[2, 4]).buckets == [2, 4]
+
+    def test_numpy_int_buckets_accepted(self):
+        lad = bucketing.as_ladder(np.array([8, 16, 32]))
+        assert isinstance(lad, BucketLadder)
+        assert lad.buckets == [8, 16, 32]
+        assert bucketing.as_ladder(np.int64(8)).buckets == [1, 2, 4, 8]
+        assert lad.bucket_for(np.int64(3)) == 8
+        # a ShapeLadder's max_shape is always a REAL bucket
+        lad = ShapeLadder([(4, 32), (8, 16)])
+        assert lad.max_shape in lad.shapes
+
+    def test_bucket_site_names(self):
+        assert bucketing.bucket_site(12) == "bucketing:12"
+        assert bucketing.bucket_site((4, 12)) == "bucketing:4x12"
+
+
+# ---------------------------------------------------------------------------
+# padding
+# ---------------------------------------------------------------------------
+
+class TestPadding:
+    def test_pad_slice_round_trip_bit_exact(self):
+        rng = np.random.RandomState(0)
+        xs = [rng.randn(L, 3).astype(np.float32) for L in (2, 5, 3)]
+        padded, vl, nv = pad_samples(xs, 4, seq_len=8)
+        assert padded.shape == (4, 8, 3)
+        assert vl.tolist() == [2, 5, 3, 0] and nv == 3
+        back = slice_valid(padded, vl, nv)
+        for want, have in zip(xs, back):
+            assert (want == have).all()
+
+    def test_position_mask(self):
+        m = position_mask([2, 4, 0], 5)
+        assert m.shape == (3, 5)
+        assert m.sum(axis=1).tolist() == [2.0, 4.0, 0.0]
+
+    def test_scalar_labels_row_padding(self):
+        labs = [np.float32(2), np.float32(0)]
+        padded, vl, nv = pad_samples(labs, 4, pad_value=-1)
+        assert padded.tolist() == [2.0, 0.0, -1.0, -1.0]
+        assert nv == 2 and vl.tolist() == [1, 1, 0, 0]
+
+    def test_errors(self):
+        with pytest.raises(mx.base.MXNetError):
+            pad_samples([np.zeros(3)], 2, seq_len=2)      # too long
+        with pytest.raises(mx.base.MXNetError):
+            pad_samples([np.zeros(3)] * 4, 2)             # too many rows
+        with pytest.raises(mx.base.MXNetError):
+            pad_samples([], 2)
+
+
+# ---------------------------------------------------------------------------
+# mask-aware losses
+# ---------------------------------------------------------------------------
+
+class TestMaskedLoss:
+    def _samples(self, C=5):
+        rng = np.random.RandomState(5)
+        xs = [rng.randn(L, C).astype(np.float32) for L in (3, 5, 2, 4)]
+        labs = [rng.randint(0, C, size=x.shape[0]).astype(np.float32)
+                for x in xs]
+        return xs, labs
+
+    def _per_sample(self, loss_fn, xs, labs, rows, L, order):
+        px, vl, nv = pad_samples([xs[i] for i in order], rows, seq_len=L)
+        pl, _, _ = pad_samples([labs[i] for i in order], rows, seq_len=L)
+        mask = position_mask(vl, L)
+        out = loss_fn(mx.nd.array(px), mx.nd.array(pl),
+                      mx.nd.array(mask))
+        return out.asnumpy()
+
+    def test_padded_equals_unpadded_bit_exact(self):
+        """The identity oracle: a sample's masked loss from a padded
+        bucketed batch equals its unpadded batch-1 loss BIT-FOR-BIT —
+        padded positions enter every sum as true IEEE zeros."""
+        loss_fn = MaskedSoftmaxCELoss()
+        xs, labs = self._samples()
+        padded = self._per_sample(loss_fn, xs, labs, 6, 8, [0, 1, 2, 3])
+        for i, (x, lab) in enumerate(zip(xs, labs)):
+            ones = np.ones((1, x.shape[0]), np.float32)
+            ref = loss_fn(mx.nd.array(x[None]), mx.nd.array(lab[None]),
+                          mx.nd.array(ones)).asnumpy()[0]
+            assert padded[i] == ref, (i, padded[i], ref)
+        # pad rows contribute exactly zero
+        assert padded[4:].tolist() == [0.0, 0.0]
+
+    def test_batch_mates_and_bucket_do_not_matter(self):
+        """Cross-bucket, reordered, different row padding: every
+        sample's loss is identical bit-for-bit."""
+        loss_fn = MaskedSoftmaxCELoss()
+        xs, labs = self._samples()
+        a = self._per_sample(loss_fn, xs, labs, 6, 8, [0, 1, 2, 3])
+        b = self._per_sample(loss_fn, xs, labs, 4, 16, [2, 0, 3, 1])
+        inv = [1, 3, 0, 2]      # where each of a's samples landed in b
+        for i in range(4):
+            assert a[i] == b[inv[i]]
+
+    def test_masked_batch_loss_reduction(self):
+        vec = mx.nd.array(np.array([1.0, 3.0, 0.0, 0.0], np.float32))
+        total = masked_batch_loss(vec, 2)
+        assert float(total.asnumpy()) == 2.0
+        with pytest.raises(mx.base.MXNetError):
+            masked_batch_loss(vec, 0)
+
+    def test_masked_l2(self):
+        loss_fn = bucketing.MaskedL2Loss()
+        pred = np.array([[1.0, 2.0, 9.0], [3.0, 9.0, 9.0]], np.float32)
+        lab = np.array([[0.0, 4.0, 0.0], [1.0, 0.0, 0.0]], np.float32)
+        mask = np.array([[1, 1, 0], [1, 0, 0]], np.float32)
+        out = loss_fn(mx.nd.array(pred), mx.nd.array(lab),
+                      mx.nd.array(mask)).asnumpy()
+        # sample 0: (0.5*1 + 0.5*4)/2 ; sample 1: 0.5*4/1
+        assert out.tolist() == [1.25, 2.0]
+
+    def test_gluon_training_is_padding_invariant(self):
+        """Three SGD steps on the same ragged stream through two very
+        different paddings (6 rows x len 8 vs 4 rows x len 16): the
+        embedding update is bit-exact (padded positions scatter exact
+        zeros); dense weights agree to one reduction-tree ulp (the
+        contraction over padded zeros regroups XLA's sum — the values
+        are IEEE-equal, the grouping is not). Row REORDERING is a
+        different claim — it permutes the scatter-add order for
+        duplicate token ids — and is covered bit-exactly at the
+        per-sample-loss level by test_batch_mates_and_bucket_do_not_
+        matter."""
+        V, E, C = 10, 6, 4
+        net = nn.HybridSequential()
+        with net.name_scope():
+            net.add(nn.Embedding(V, E))
+            net.add(nn.Dense(C, flatten=False))
+        net.initialize(mx.init.Xavier())
+        net(mx.nd.array(np.ones((2, 3), np.float32)))
+        params0 = {k: v.data().asnumpy().copy()
+                   for k, v in net.collect_params().items()}
+        loss_fn = MaskedSoftmaxCELoss()
+
+        def run(L_pad, rows):
+            for k, v in net.collect_params().items():
+                v.set_data(mx.nd.array(params0[k]))
+            trainer = gluon.Trainer(net.collect_params(), "sgd",
+                                    {"learning_rate": 0.2})
+            rng = np.random.RandomState(9)
+            for _ in range(3):
+                xs = [rng.randint(1, V, size=l).astype(np.float32)
+                      for l in (3, 5, 4, 2)]
+                labs = [rng.randint(0, C, size=len(x))
+                        .astype(np.float32) for x in xs]
+                px, vl, nv = pad_samples(xs, rows, seq_len=L_pad)
+                pl, _, _ = pad_samples(labs, rows, seq_len=L_pad,
+                                       pad_value=0)
+                mask = position_mask(vl, L_pad)
+                with mx.autograd.record():
+                    out = net(mx.nd.array(px))
+                    lvec = loss_fn(out, mx.nd.array(pl),
+                                   mx.nd.array(mask))
+                    total = masked_batch_loss(lvec, nv)
+                total.backward()
+                trainer.step(1)
+            return {k: v.data().asnumpy()
+                    for k, v in net.collect_params().items()}
+
+        a = run(8, 6)
+        b = run(16, 4)
+        for k in a:
+            if "embedding" in k:
+                assert (a[k] == b[k]).all(), k
+            else:
+                np.testing.assert_allclose(a[k], b[k], rtol=0,
+                                           atol=1e-7, err_msg=k)
+
+
+# ---------------------------------------------------------------------------
+# mask-aware metrics (satellite: Accuracy/Perplexity ignore_label)
+# ---------------------------------------------------------------------------
+
+class TestMaskedMetrics:
+    def _padded_case(self):
+        rng = np.random.RandomState(11)
+        C = 6
+        lens = [3, 5, 2, 4]
+        preds = [rng.rand(L, C).astype(np.float32) for L in lens]
+        preds = [p / p.sum(axis=1, keepdims=True) for p in preds]
+        labs = [rng.randint(1, C, size=L).astype(np.float32)
+                for L in lens]
+        pp, vl, nv = pad_samples(preds, 6, seq_len=8)
+        # padded prediction rows must not be all-zero probability rows
+        # (log would see them even though they are ignored): fill with
+        # uniform — they are dropped by selection either way
+        pp[position_mask(vl, 8) == 0] = 1.0 / C
+        pl, _, _ = pad_samples(labs, 6, seq_len=8, pad_value=0)
+        return preds, labs, pp, pl
+
+    def test_perplexity_padded_equals_unpadded_exactly(self):
+        preds, labs, pp, pl = self._padded_case()
+        ref = mx.metric.Perplexity(ignore_label=None)
+        for p, l in zip(preds, labs):
+            ref.update([mx.nd.array(l[None])], [mx.nd.array(p[None])])
+        padded = mx.metric.Perplexity(ignore_label=0)
+        padded.update([mx.nd.array(pl)], [mx.nd.array(pp)])
+        assert padded.sum_metric == ref.sum_metric
+        assert padded.num_inst == ref.num_inst
+        assert padded.get()[1] == ref.get()[1]
+
+    def test_accuracy_padded_equals_unpadded_exactly(self):
+        preds, labs, pp, pl = self._padded_case()
+        ref = mx.metric.Accuracy(axis=-1)
+        for p, l in zip(preds, labs):
+            ref.update([mx.nd.array(l[None])], [mx.nd.array(p[None])])
+        padded = mx.metric.Accuracy(axis=-1, ignore_label=0)
+        padded.update([mx.nd.array(pl)], [mx.nd.array(pp)])
+        assert (padded.sum_metric, padded.num_inst) == \
+            (ref.sum_metric, ref.num_inst)
+        assert padded.get()[1] == ref.get()[1]
+
+    def test_masked_metric_wrapper(self):
+        """MaskedMetric drops ignored positions for metrics WITHOUT an
+        ignore_label knob — same exact-selection contract."""
+        preds, labs, pp, pl = self._padded_case()
+        ref = mx.metric.CrossEntropy()
+        for p, l in zip(preds, labs):
+            ref.update([mx.nd.array(l[None])], [mx.nd.array(p[None])])
+        wrapped = MaskedMetric(mx.metric.CrossEntropy(), ignore_label=0)
+        wrapped.update([mx.nd.array(pl)], [mx.nd.array(pp)])
+        assert wrapped.get()[1] == ref.get()[1]
+        assert wrapped.get()[0].startswith("masked-")
+
+
+# ---------------------------------------------------------------------------
+# BucketedPipeline
+# ---------------------------------------------------------------------------
+
+class TestBucketedPipeline:
+    def _stream(self, n=37, seed=3, top=14):
+        rng = np.random.RandomState(seed)
+        return [(rng.randint(1, 10, size=L).astype(np.float32),
+                 np.float32(L % 3))
+                for L in rng.choice([3, 4, 5, 6, 7, 9, 11, top],
+                                    size=n)]
+
+    def test_no_sample_lost_and_shapes_are_ladder_buckets(self):
+        samples = self._stream()
+        pipe = BucketedPipeline(samples, batch_size=4, ladder=[4, 8, 16])
+        for _ in range(2):
+            seen = 0
+            for b in pipe:
+                assert b.bucket_key in (4, 8, 16)
+                assert b.data[0].shape == (4, b.bucket_key)
+                assert b.label[0].shape == (4,)
+                seen += 4 - b.pad
+            assert seen == len(samples)      # tail flushed, not dropped
+            pipe.reset()
+
+    def test_row_padding_is_mask_aware(self):
+        samples = self._stream(n=5)
+        pipe = BucketedPipeline(samples, batch_size=4, ladder=[16],
+                                invalid_label=-1)
+        batches = list(pipe)
+        partial = [b for b in batches if b.pad][0]
+        lab = partial.label[0].asnumpy()
+        assert (lab[-partial.pad:] == -1).all()
+        assert partial.valid_rows == 4 - partial.pad
+        assert (partial.valid_lengths[-partial.pad:] == 0).all()
+        mask = pipe.mask_for(partial)
+        assert mask.shape == (4, 16)
+        assert (mask[-partial.pad:] == 0).all()
+
+    def test_straggler_window_flushes_partials(self):
+        # one length-9 sample among many length-3s: the window must
+        # flush it row-padded instead of holding it the whole epoch
+        samples = [np.ones(3, np.float32)] * 4 \
+            + [np.ones(9, np.float32)] \
+            + [np.ones(3, np.float32)] * 20
+        pipe = BucketedPipeline(samples, batch_size=4, ladder=[4, 16],
+                                window=6)
+        keys = [b.bucket_key for b in pipe]
+        # the 16-bucket batch must appear before the stream's tail
+        assert 16 in keys[:4], keys
+
+    def test_overlong_samples_discarded_and_counted(self):
+        samples = [np.ones(3, np.float32)] * 4 \
+            + [np.ones(99, np.float32)] * 2
+        pipe = BucketedPipeline(samples, batch_size=4, ladder=[8])
+        n = sum(4 - b.pad for b in pipe)
+        assert n == 4
+        assert pipe.stats.snapshot()["discarded"] == 2
+
+    def test_ladder_from_env(self, monkeypatch):
+        monkeypatch.setenv("MXNET_BUCKET_LADDER", "4,8")
+        pipe = BucketedPipeline(self._stream(top=7), batch_size=4)
+        assert pipe.ladder.buckets == [4, 8]
+        monkeypatch.delenv("MXNET_BUCKET_LADDER")
+        with pytest.raises(mx.base.MXNetError):
+            BucketedPipeline(self._stream(), batch_size=4)
+
+    def test_one_shot_iterator_reset_keeps_samples(self):
+        """reset() on a bare generator source must not drop the
+        peeked/pending samples — one-shot iterators keep their cursor
+        and their partial buckets."""
+        def gen():
+            for L in (3, 3, 3, 5):
+                yield np.ones(L, np.float32)
+        pipe = BucketedPipeline(gen(), batch_size=4, ladder=[4, 8])
+        pipe.reset()                      # must not lose the peek
+        seen = sum(4 - b.pad for b in pipe)
+        assert seen == 4
+        pipe.reset()                      # exhausted one-shot: empty
+        assert sum(1 for _ in pipe) == 0
+
+    def test_label_mode_explicit_per_sample(self):
+        """Fixed-size vector labels that coincide with a sequence
+        length must not be misread as per-position: label_mode=
+        'per_sample' pins the classification."""
+        rng = np.random.RandomState(0)
+        samples = [(rng.randn(L).astype(np.float32),
+                    np.ones(5, np.float32))        # 5-dim target
+                   for L in (5, 3, 7, 4)]          # len 5 coincides
+        pipe = BucketedPipeline(samples, batch_size=4, ladder=[8],
+                                label_mode="per_sample")
+        batch = next(iter(pipe))
+        assert batch.label[0].shape == (4, 5)
+        with pytest.raises(mx.base.MXNetError):
+            BucketedPipeline(samples, batch_size=4, ladder=[8],
+                             label_mode="bogus")
+
+    def test_async_pipeline_wrap_bit_identical(self):
+        """Plugging into the PR 4 AsyncInputPipeline (decode pool +
+        prefetch) must deliver the identical batches, validity
+        attributes included."""
+        from mxnet_tpu.io.pipeline import AsyncInputPipeline
+        eager = [
+            (b.data[0].asnumpy(), b.label[0].asnumpy(), b.bucket_key,
+             b.pad, b.valid_lengths.copy())
+            for b in BucketedPipeline(self._stream(), batch_size=4,
+                                      ladder=[4, 8, 16])]
+        pooled_src = BucketedPipeline(self._stream(), batch_size=4,
+                                      ladder=[4, 8, 16])
+        pooled = AsyncInputPipeline(pooled_src, num_workers=3)
+        got = []
+        for b in pooled:
+            got.append((b.data[0].asnumpy(), b.label[0].asnumpy(),
+                        b.bucket_key, b.pad, b.valid_lengths.copy()))
+        pooled.close()
+        assert len(got) == len(eager)
+        for (d0, l0, k0, p0, v0), (d1, l1, k1, p1, v1) in zip(eager,
+                                                              got):
+            assert k0 == k1 and p0 == p1
+            assert (d0 == d1).all() and (l0 == l1).all()
+            assert (v0 == v1).all()
+
+
+# ---------------------------------------------------------------------------
+# BucketSentenceIter tail-pad fix (satellite)
+# ---------------------------------------------------------------------------
+
+class TestBucketSentenceIterTailPad:
+    def test_partial_tail_is_padded_not_dropped(self):
+        rng = np.random.RandomState(2)
+        # 10 sentences of length 5, batch 4 -> old code dropped 2
+        sents = [list(rng.randint(1, 9, size=5)) for _ in range(10)]
+        it = mx.rnn.BucketSentenceIter(sents, batch_size=4, buckets=[6],
+                                       invalid_label=0)
+        batches = list(it)
+        assert len(batches) == 3
+        assert sum(4 - b.pad for b in batches) == 10
+        partial = [b for b in batches if b.pad][0]
+        assert partial.pad == 2
+        d = partial.data[0].asnumpy()
+        l = partial.label[0].asnumpy()
+        assert (d[-2:] == 0).all() and (l[-2:] == 0).all()
+        # epoch after reset sees the same sample count
+        it.reset()
+        assert sum(4 - b.pad for b in it) == 10
+
+    def test_stats_surface_pads_and_discards(self):
+        rng = np.random.RandomState(4)
+        sents = [list(rng.randint(1, 9, size=5)) for _ in range(6)] \
+            + [list(rng.randint(1, 9, size=50))]     # discarded
+        it = mx.rnn.BucketSentenceIter(sents, batch_size=4, buckets=[6],
+                                       invalid_label=0)
+        list(it)
+        snap = it.bucketing.snapshot()
+        assert snap["discarded"] == 1
+        assert snap["pad_rows"] == 2
+        assert snap["samples"] == 6
+        assert snap["buckets"] == {"6": 2}
+
+    def test_predict_slices_scaled_pad_rows_for_lm_outputs(self):
+        """predict() on a padded tail batch through an LM head
+        (outputs reshaped to (batch*positions, V)) must drop
+        pad*positions rows, not pad rows — one prediction row per REAL
+        position."""
+        rng = np.random.RandomState(3)
+        sents = [list(rng.randint(1, 9, size=5)) for _ in range(6)]
+        it = mx.rnn.BucketSentenceIter(sents, batch_size=4, buckets=[6],
+                                       invalid_label=0)
+        mod = mx.mod.BucketingModule(_lm_sym_gen(9, 4),
+                                     default_bucket_key=6)
+        mod.bind(data_shapes=it.provide_data,
+                 label_shapes=it.provide_label)
+        mod.init_params(mx.init.Xavier())
+        outs = mod.predict(it)
+        # 6 sentences x 6 positions, pad rows gone
+        assert outs.shape[0] == 6 * 6, outs.shape
+
+    def test_pipeline_provide_label_honors_label_mode(self):
+        rng = np.random.RandomState(0)
+        vec = [(rng.randn(L).astype(np.float32),
+                np.ones(5, np.float32)) for L in (5, 3, 7)]
+        pipe = BucketedPipeline(vec, batch_size=4, ladder=[8],
+                                label_mode="per_sample")
+        assert pipe.provide_label[0].shape == (4, 5)
+        lm = [(rng.randn(L).astype(np.float32),
+               rng.randn(L).astype(np.float32)) for L in (5, 3, 7)]
+        pipe = BucketedPipeline(lm, batch_size=4, ladder=[8])
+        assert pipe.provide_label[0].shape == (4, 8)
+
+    def test_training_still_converges_with_padded_tails(self):
+        """The PTB-style smoke with a non-divisible corpus: padded
+        tails (ignore_label rows) must not break learning."""
+        V, E, B = 12, 8, 4
+        rng = np.random.RandomState(7)
+        sents = []
+        for _ in range(45):                 # 45 % 4 != 0 -> tail pads
+            start = rng.randint(1, V)
+            length = rng.randint(4, 8)
+            sents.append([(start + k) % (V - 1) + 1
+                          for k in range(length)])
+        it = mx.rnn.BucketSentenceIter(sents, batch_size=B,
+                                       buckets=[4, 8], invalid_label=0)
+
+        def sym_gen(seq_len):
+            data = mx.sym.var("data")
+            label = mx.sym.var("softmax_label")
+            emb = mx.sym.Embedding(data, input_dim=V, output_dim=E,
+                                   name="embed")
+            pred = mx.sym.Reshape(emb, shape=(-1, E))
+            pred = mx.sym.FullyConnected(pred, num_hidden=V,
+                                         name="pred")
+            label_f = mx.sym.Reshape(label, shape=(-1,))
+            out = mx.sym.SoftmaxOutput(pred, label_f, name="softmax",
+                                       use_ignore=True, ignore_label=0,
+                                       normalization="valid")
+            return out, ("data",), ("softmax_label",)
+
+        mod = mx.mod.BucketingModule(sym_gen, default_bucket_key=8)
+        mod.bind(data_shapes=it.provide_data,
+                 label_shapes=it.provide_label)
+        mod.init_params(mx.init.Xavier())
+        mod.init_optimizer(optimizer="adam",
+                           optimizer_params={"learning_rate": 0.02})
+        ppl = mx.metric.Perplexity(ignore_label=0)
+        first = last = None
+        for _ in range(6):
+            it.reset()
+            ppl.reset()
+            for batch in it:
+                mod.forward(batch, is_train=True)
+                mod.update_metric(ppl, batch.label)
+                mod.backward()
+                mod.update()
+            val = ppl.get()[1]
+            first = first if first is not None else val
+            last = val
+        assert last < first * 0.7, (first, last)
+
+
+# ---------------------------------------------------------------------------
+# numerical identity through the Module path
+# ---------------------------------------------------------------------------
+
+class TestModulePathIdentity:
+    def test_padded_step_equals_tight_step(self):
+        """One fused train step on a padded bucket (8 rows x len 8,
+        ignore-labeled pads) vs the tight batch (3 rows x len 5):
+        weight updates are BIT-exact (the ignored positions contribute
+        exact-zero gradients and normalization='valid' divides by the
+        same count); the bias gradient — a sum over all positions —
+        agrees to one reduction-tree ulp."""
+        V, E = 12, 6
+        rng = np.random.RandomState(0)
+
+        def sym_gen(seq_len):
+            data = mx.sym.var("data")
+            label = mx.sym.var("softmax_label")
+            emb = mx.sym.Embedding(data, input_dim=V, output_dim=E,
+                                   name="embed")
+            pred = mx.sym.Reshape(emb, shape=(-1, E))
+            pred = mx.sym.FullyConnected(pred, num_hidden=V,
+                                         name="pred")
+            out = mx.sym.SoftmaxOutput(
+                pred, mx.sym.Reshape(label, shape=(-1,)),
+                name="softmax", use_ignore=True, ignore_label=0,
+                normalization="valid")
+            return out, ("data",), ("softmax_label",)
+
+        init = {"embed_weight": mx.nd.array(
+                    rng.randn(V, E).astype(np.float32) * 0.1),
+                "pred_weight": mx.nd.array(
+                    rng.randn(V, E).astype(np.float32) * 0.1),
+                "pred_bias": mx.nd.zeros((V,))}
+
+        def one_step(B, L, data, label):
+            mod = mx.mod.BucketingModule(sym_gen, default_bucket_key=L)
+            mod.bind(data_shapes=[DataDesc("data", (B, L))],
+                     label_shapes=[DataDesc("softmax_label", (B, L))])
+            mod.init_params(arg_params={k: v.copy()
+                                        for k, v in init.items()},
+                            aux_params={})
+            mod.init_optimizer(
+                optimizer="sgd",
+                optimizer_params={"learning_rate": 0.1,
+                                  "rescale_grad": 1.0})
+            batch = DataBatch(
+                [mx.nd.array(data)], [mx.nd.array(label)], bucket_key=L,
+                provide_data=[DataDesc("data", (B, L))],
+                provide_label=[DataDesc("softmax_label", (B, L))])
+            mod.forward(batch, is_train=True)
+            mod.backward()
+            mod.update()
+            return {k: v.asnumpy()
+                    for k, v in mod.get_params()[0].items()}
+
+        sents = [rng.randint(1, V, size=L) for L in (3, 5, 4)]
+        Lt = 5
+        tight_d = np.zeros((3, Lt), np.float32)
+        tight_l = np.zeros((3, Lt), np.float32)
+        for i, s in enumerate(sents):
+            tight_d[i, :len(s)] = s
+            tight_l[i, :len(s) - 1] = s[1:]
+        pad_d = np.zeros((8, 8), np.float32)
+        pad_l = np.zeros((8, 8), np.float32)
+        pad_d[:3, :Lt] = tight_d
+        pad_l[:3, :Lt] = tight_l
+
+        tight = one_step(3, Lt, tight_d, tight_l)
+        padded = one_step(8, 8, pad_d, pad_l)
+        assert (tight["embed_weight"] == padded["embed_weight"]).all()
+        assert (tight["pred_weight"] == padded["pred_weight"]).all()
+        np.testing.assert_allclose(tight["pred_bias"],
+                                   padded["pred_bias"], rtol=0,
+                                   atol=1e-8)
+
+
+# ---------------------------------------------------------------------------
+# compile-storm regression (tier-1 CI oracle)
+# ---------------------------------------------------------------------------
+
+def _lm_sym_gen(V, E):
+    def sym_gen(seq_len):
+        data = mx.sym.var("data")
+        label = mx.sym.var("softmax_label")
+        emb = mx.sym.Embedding(data, input_dim=V, output_dim=E,
+                               name="embed")
+        pred = mx.sym.Reshape(emb, shape=(-1, E))
+        pred = mx.sym.FullyConnected(pred, num_hidden=V, name="pred")
+        label_f = mx.sym.Reshape(label, shape=(-1,))
+        out = mx.sym.SoftmaxOutput(pred, label_f, name="softmax",
+                                   use_ignore=True, ignore_label=0,
+                                   normalization="valid")
+        return out, ("data",), ("softmax_label",)
+    return sym_gen
+
+
+class TestCompileStormRegression:
+    def test_bucketed_fit_compiles_ladder_size_programs(self):
+        """~40 distinct sequence lengths (10x the ladder) through a
+        bucketed Module.fit: compile count == ladder size, ZERO
+        steady-state recompiles in epoch 2, and no storm warning —
+        the whole point of the subsystem."""
+        compile_watch.enable()
+        rng = np.random.RandomState(7)
+        sents = [list(rng.randint(1, 20, size=L))
+                 for L in rng.choice(np.arange(3, 43), size=160)]
+        assert len({len(s) for s in sents}) >= 38
+        ladder = [11, 22, 32, 42]
+        it = mx.rnn.BucketSentenceIter(sents, batch_size=8,
+                                       buckets=ladder, invalid_label=0)
+        mod = mx.mod.BucketingModule(
+            _lm_sym_gen(20, 8), default_bucket_key=it.default_bucket_key)
+        with warnings.catch_warnings(record=True) as caught:
+            warnings.simplefilter("always")
+            mod.fit(it, num_epoch=1,
+                    eval_metric=mx.metric.Perplexity(ignore_label=0),
+                    optimizer="sgd",
+                    optimizer_params={"learning_rate": 0.05})
+            warm = compile_watch.site_stats("bucketing")
+            assert len(warm) == len(ladder), warm
+            assert sum(s["count"] for s in warm.values()) == \
+                len(ladder), warm
+            # steady state: a second epoch over the same ragged stream
+            # must not compile anything new
+            mod.fit(it, num_epoch=1,
+                    eval_metric=mx.metric.Perplexity(ignore_label=0),
+                    optimizer="sgd",
+                    optimizer_params={"learning_rate": 0.05},
+                    force_rebind=False, force_init=False)
+            steady = compile_watch.site_stats("bucketing")
+            assert steady == warm, (warm, steady)
+            storms = [w for w in caught
+                      if "recompile storm" in str(w.message)]
+            assert not storms, [str(w.message) for w in storms]
+        assert not (compile_watch.stats() or {}).get("storms")
+
+    def test_serving_seq_ladder_program_cache_bounded(self):
+        """The serving sequence-dim ladder oracle: variable-length
+        requests over a (batch x seq) ladder compile exactly
+        |ladder| x |seq_ladder| programs, zero steady-state
+        recompiles."""
+        import jax.numpy as jnp
+        from mxnet_tpu.serving import InferenceServer
+        compile_watch.enable()
+        w = jnp.asarray(np.random.RandomState(0)
+                        .randn(4, 3).astype(np.float32))
+
+        def model(x):                       # (B, L, 4) -> (B, 3)
+            return jnp.mean(x, axis=1) @ w
+
+        srv = InferenceServer(model, ladder=[1, 2, 4],
+                              seq_ladder=[4, 8], max_queue=64,
+                              batch_window_ms=1.0)
+        rs = np.random.RandomState(1)
+        try:
+            assert srv.warmup(np.zeros((5, 4), np.float32)) == 6
+            warm = compile_watch.site_stats("serving")
+            assert len(warm) == 6
+            assert all(s["count"] == 1 for s in warm.values()), warm
+            futs = [srv.submit(
+                rs.randn(int(rs.randint(1, 9)), 4).astype(np.float32))
+                for _ in range(24)]
+            outs = [np.asarray(f.result(timeout=30)) for f in futs]
+            assert all(o.shape == (3,) for o in outs)
+            assert compile_watch.site_stats("serving") == warm
+            # over-long requests are rejected up front, never compiled
+            with pytest.raises(mx.base.MXNetError, match="exceeds"):
+                srv.submit(np.zeros((9, 4), np.float32))
+        finally:
+            srv.stop()
+
+    def test_seq_ladder_results_are_batch_mate_independent(self):
+        """A request always pads to its OWN rung (batches hold one
+        rung), so even a model that reduces over the padded sequence
+        returns bit-identical responses no matter which batch-mates
+        arrived concurrently."""
+        import jax.numpy as jnp
+        from mxnet_tpu.serving import InferenceServer
+        w = jnp.asarray(np.random.RandomState(0)
+                        .randn(4, 3).astype(np.float32))
+
+        def model(x):           # mean over seq SEES the padding
+            return jnp.mean(x, axis=1) @ w
+
+        probe = np.random.RandomState(2).randn(3, 4).astype(np.float32)
+
+        def serve(mates):
+            srv = InferenceServer(model, ladder=[1, 2, 4],
+                                  seq_ladder=[4, 8], max_queue=64,
+                                  batch_window_ms=5.0)
+            try:
+                srv.warmup(np.zeros((2, 4), np.float32))
+                futs = [srv.submit(m) for m in mates]
+                got = np.asarray(srv.submit(probe).result(timeout=30))
+                for f in futs:
+                    f.result(timeout=30)
+            finally:
+                srv.stop()
+            return got
+
+        alone = serve([])
+        with_short = serve([np.ones((2, 4), np.float32)] * 2)
+        with_long = serve([np.ones((7, 4), np.float32)] * 3)
+        assert (alone == with_short).all()
+        assert (alone == with_long).all()
+
+    def test_seq_ladder_rejected_for_artifacts(self, tmp_path):
+        d = mx.sym.var("data")
+        out = mx.sym.FullyConnected(d, name="fc", num_hidden=2)
+        mx.deploy.export_compiled(
+            out, str(tmp_path / "m.mxp"),
+            params={"fc_weight": mx.nd.zeros((2, 4)),
+                    "fc_bias": mx.nd.zeros((2,))},
+            input_shapes={"data": (1, 4)}, batch_sizes=[2])
+        from mxnet_tpu.serving import InferenceServer
+        with pytest.raises(mx.base.MXNetError, match="seq_ladder"):
+            InferenceServer(str(tmp_path / "m.mxp"), seq_ladder=[4, 8])
+
+
+# ---------------------------------------------------------------------------
+# telemetry & diagnose
+# ---------------------------------------------------------------------------
+
+class TestBucketingTelemetry:
+    def test_records_summary_and_diagnose_table(self, tmp_path, capsys):
+        sink = str(tmp_path / "run.jsonl")
+        telemetry.start(filename=sink)
+        rng = np.random.RandomState(3)
+        samples = [(rng.randint(1, 10, size=L).astype(np.float32),
+                    np.float32(0))
+                   for L in rng.choice([3, 5, 7, 30], size=24)]
+        pipe = BucketedPipeline(samples, batch_size=4, ladder=[8, 16],
+                                record_every=2)
+        for _ in pipe:
+            telemetry.step_begin()
+            telemetry.step_end(samples=4)
+        pipe.stats.emit()
+        summary = telemetry.stop()
+        block = summary["bucketing"]["BucketedPipeline"]
+        assert block["discarded"] == \
+            sum(1 for s, _ in samples if len(s) > 16)
+        assert block["samples"] + block["discarded"] == 24
+        assert 0.0 <= block["padding_share"] < 1.0
+        kinds = set()
+        with open(sink) as f:
+            for line in f:
+                kinds.add(json.loads(line).get("type"))
+        assert "bucketing" in kinds
+        from mxnet_tpu.tools import diagnose
+        diagnose.main([sink])
+        out = capsys.readouterr().out
+        assert "----------Bucketing----------" in out
+        assert "BucketedPipe" in out
+        assert "padding" in out
+        assert "discarded" in out
+
+    def test_unbucketed_run_keeps_sink_byte_identical(self, tmp_path):
+        sink = str(tmp_path / "run.jsonl")
+        telemetry.start(filename=sink)
+        telemetry.step_begin()
+        telemetry.step_end(samples=4)
+        summary = telemetry.stop()
+        assert "bucketing" not in summary
+        with open(sink) as f:
+            kinds = {json.loads(line).get("type") for line in f}
+        assert "bucketing" not in kinds
