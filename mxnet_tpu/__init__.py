@@ -82,8 +82,10 @@ from . import monitor
 from . import visualization
 from . import visualization as viz
 from . import profiler
+from . import tracing
 from . import telemetry
 from . import compile_watch
+from . import livemetrics
 from . import checkpoint
 from . import model
 from . import rnn

@@ -544,13 +544,17 @@ class CheckpointManager:
         sync mode blocks for the whole durable write. Both run under
         the telemetry ``checkpoint`` phase. ``extra`` rides verbatim
         keys into the shard roster (sharded optimizer state)."""
-        from . import telemetry
+        from . import telemetry, tracing
         with telemetry.span("checkpoint"):
             t0 = time.perf_counter()
+            # causal context captured HERE, on the training thread
+            # that triggered the save — the writer thread's trace
+            # span parents to this step via the explicit token
+            ctx = tracing.context()
             flat = snapshot_params(arg_params, aux_params, extra=extra)
             if not self.async_:
                 self._write(epoch, flat, states_bytes, t0,
-                            blocking=True)
+                            blocking=True, ctx=ctx)
                 return
             self._ensure_thread()
             self._idle.clear()
@@ -559,7 +563,7 @@ class CheckpointManager:
             # The enqueue time is stamped AFTER put() returns so that
             # stall lands in blocking_ms (the trainer paid it), not
             # async_ms — the writer reads it through the shared dict
-            timing = {"t0": t0}
+            timing = {"t0": t0, "ctx": ctx}
             self._q.put((epoch, flat, states_bytes, timing))
             timing["t_enq"] = time.perf_counter()
 
@@ -608,7 +612,8 @@ class CheckpointManager:
             try:
                 self._write(epoch, flat, states_bytes, timing["t0"],
                             blocking=False,
-                            t_enq=timing.get("t_enq"))
+                            t_enq=timing.get("t_enq"),
+                            ctx=timing.get("ctx"))
             finally:
                 self._q.task_done()
                 if self._q.unfinished_tasks == 0:
@@ -620,9 +625,12 @@ class CheckpointManager:
             self._symbol_saved = True
 
     def _write(self, epoch, flat, states_bytes, t0, blocking,
-               t_enq=None):
-        """One durable save + its accounting; never raises."""
-        from . import telemetry
+               t_enq=None, ctx=None):
+        """One durable save + its accounting; never raises. ``ctx`` is
+        the trace-context token save() captured on the training thread
+        — the writer's trace span parents to that step explicitly."""
+        from . import telemetry, tracing
+        t_work0 = time.perf_counter()
         if t_enq is None and not blocking:
             # writer won the handoff race before save() stamped the
             # enqueue time — the put cannot have blocked, so now is
@@ -657,4 +665,11 @@ class CheckpointManager:
             rec["blocking_ms"] = round((t_enq - t0) * 1e3, 3)
             rec["async_ms"] = round((now - t_enq) * 1e3, 3)
         rec["last_good_epoch"] = self.last_good_epoch
+        if tracing._tracer is not None:
+            args = dict(ctx or {})
+            args.update(epoch=int(epoch), ok=bool(rec.get("ok")),
+                        bytes=rec.get("bytes", 0))
+            tracing.add("ckpt:epoch%04d" % int(epoch), "checkpoint",
+                        t_work0, now - t_work0,
+                        tid=tracing.track("checkpoint"), args=args)
         telemetry.checkpoint_event(rec)

@@ -576,9 +576,20 @@ def _record_compile(w, site, statics, storm_track, dur, desc, flops,
 
 def _emit_compile_record(event):
     """Append the compile event to the active telemetry run (no-op
-    without one). Called with NO compile_watch lock held."""
-    from . import telemetry
+    without one) and, when tracing is on, render it as a duration
+    event on the trace's ``compile`` track (ts backdated by the
+    compile's own duration). Called with NO compile_watch lock held."""
+    from . import telemetry, tracing
     telemetry.external_record(event)
+    if tracing._tracer is not None:
+        dur_s = event.get("dur_ms", 0.0) / 1e3
+        args = {"program": event.get("program"),
+                "cause": event.get("cause")}
+        if event.get("changed"):
+            args["changed"] = event["changed"]
+        tracing.add("compile:%s" % event.get("program"), "compile",
+                    tracing.now() - dur_s, dur_s,
+                    tid=tracing.track("compile"), args=args)
 
 
 def step_reset():

@@ -52,7 +52,7 @@ __all__ = ["FaultPlan", "InjectedFault", "InjectedHang",
            "loss_scale", "stats", "reset_stats", "grad_poison",
            "fused_step_guard"]
 
-_ACTIONS = ("raise", "hang", "nan", "inf")
+_ACTIONS = ("raise", "hang", "stall", "nan", "inf")
 # the wired injection points; a typo'd site would otherwise make a
 # chaos run silently test nothing. ckpt_write/ckpt_fsync sit inside
 # checkpoint.atomic_write_file so a planned fault can abort or stall a
@@ -329,15 +329,23 @@ def _visit_site(site):
         raise InjectedHang(
             "planned hang at site %r (%r): blocked %.3fs"
             % (site, entry, _hang_seconds()))
+    if entry.action == "stall":
+        # a slow op, not a dead one: sleep MXNET_FAULT_HANG_SECONDS
+        # and carry on — the deterministic "degraded but alive" case
+        # (straggler devices, slow disks) the SLO watchdog's drift
+        # detector is tested against
+        time.sleep(_hang_seconds())
+        return None
     return entry
 
 
 def inject(site, value=None):
     """One injection point. Counts a visit to ``site``; when a plan
     entry fires: ``raise``→InjectedFault, ``hang``→bounded sleep then
-    InjectedHang, ``nan``/``inf``→return a corrupted copy of ``value``.
-    Returns ``value`` (possibly corrupted) otherwise. No-op without an
-    active plan."""
+    InjectedHang, ``stall``→the same bounded sleep but NO exception (a
+    slow op, not a dead one), ``nan``/``inf``→return a corrupted copy
+    of ``value``. Returns ``value`` (possibly corrupted) otherwise.
+    No-op without an active plan."""
     entry = _visit_site(site)
     if entry is not None and value is not None:
         return _corrupt(value, entry.action)

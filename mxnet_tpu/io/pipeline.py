@@ -133,14 +133,25 @@ def _put_one(nd_arr, target, name):
         getattr(target, "device_kind", None) is not None
         and getattr(data, "devices", None) is not None
         and data.devices() == {target})
+    from .. import tracing
     t0 = time.perf_counter()
     out = nd_arr
     if not resident:
         data = jax.device_put(data, target)
         out = NDArray(data, ctx=nd_arr._ctx)
     data.block_until_ready()
-    telemetry.h2d(name, int(getattr(data, "nbytes", 0) or 0),
-                  time.perf_counter() - t0)
+    dur = time.perf_counter() - t0
+    nbytes = int(getattr(data, "nbytes", 0) or 0)
+    telemetry.h2d(name, nbytes, dur)
+    if tracing._tracer is not None:
+        # the placer runs AHEAD of consumption by design; the context
+        # token parents the transfer to the step that was open while
+        # it ran — explicit args, not thread identity (this thread is
+        # off the accounting thread on purpose)
+        args = tracing.context() or {}
+        args["bytes"] = nbytes
+        tracing.add("h2d:%s" % name, "io", t0, dur,
+                    tid=tracing.track("io:h2d"), args=args)
     return out
 
 
@@ -348,18 +359,35 @@ class AsyncInputPipeline(DataIter):
         """Stage-1 driver: pull work from the source IN ORDER (the
         source itself is never touched concurrently), fan decode out to
         the pool, and emit futures/batches in submission order."""
+        from .. import tracing
         stop = self._stop
         src = self._source
         try:
             while not stop.is_set():
+                tracing_on = tracing._tracer is not None
                 try:
                     if self._pool is not None:
                         raw = src.next_raw()
-                        item = self._pool.submit(src.decode_raw, raw)
+                        if tracing_on:
+                            # context captured HERE (the scheduling
+                            # thread) and handed to the pool worker as
+                            # an explicit token — the decode span is
+                            # parented to the step that triggered the
+                            # fetch, never to the worker thread
+                            item = self._pool.submit(
+                                self._decode_traced, raw,
+                                tracing.context())
+                        else:
+                            item = self._pool.submit(src.decode_raw,
+                                                     raw)
                     elif self._split:
                         # one worker: still use the split so randomness
                         # is drawn serially (bit-identical to eager)
-                        item = src.decode_raw(src.next_raw())
+                        if tracing_on:
+                            item = self._decode_traced(
+                                src.next_raw(), tracing.context())
+                        else:
+                            item = src.decode_raw(src.next_raw())
                     else:
                         item = src.next()
                 except StopIteration:
@@ -371,6 +399,18 @@ class AsyncInputPipeline(DataIter):
                     return
         finally:
             self._stop_aware_put(self._decode_q, _SENTINEL)
+
+    def _decode_traced(self, raw, ctx):
+        """Decode one work item with its trace span, parented to the
+        triggering step via the explicitly-propagated ``ctx`` token."""
+        import time as _time
+
+        from .. import tracing
+        t0 = _time.perf_counter()
+        out = self._source.decode_raw(raw)
+        tracing.add("decode", "io", t0, _time.perf_counter() - t0,
+                    tid=tracing.track("io:decode"), args=ctx)
+        return out
 
     def _placer(self):
         """Stage-2 driver: resolve decode results in order, commit them

@@ -1,0 +1,671 @@
+"""Live operational metrics: a stdlib-only Prometheus ``/metrics``
+HTTP endpoint plus an SLO watchdog — the scrape-and-alert half of the
+observability stack.
+
+The telemetry layer already aggregates everything an operator needs
+(step-time percentiles, goodput, MFU, comm bytes, compile counts,
+serving queue depth / occupancy / shed / timeout / latency) — but only
+into a JSONL sink read *after* the run. This module serves the same
+numbers live:
+
+- **/metrics endpoint** — :func:`serve` starts a daemon-thread HTTP
+  server (``http.server``, nothing beyond the stdlib) answering
+  ``GET /metrics`` with Prometheus text exposition (format 0.0.4)
+  rendered on demand from ``telemetry.report()``, the process-global
+  ``profiler.counters()``, and every live
+  :class:`~mxnet_tpu.serving.InferenceServer` (servers register
+  themselves by weakref — a stopped/collected server drops out of the
+  scrape). Binds ``127.0.0.1`` by default — metrics can leak workload
+  shape, so exposing them beyond the host is an explicit
+  ``MXNET_METRICS_HOST`` opt-in. ``MXNET_METRICS_PORT`` (picked up at
+  ``telemetry.start`` and server construction) starts it from the
+  environment; port 0 asks the OS for an ephemeral port (tests).
+
+- **SLO watchdog** — :class:`Watchdog` observes the step records and
+  cumulative serving snapshots already flowing through telemetry (the
+  ``_watch_step``/``_watch_serving`` hooks, one ``None`` check each
+  when off) and raises structured ``alert`` telemetry records plus a
+  one-time warning per alert kind on: sustained step-time p50 drift
+  against a rolling baseline (the baseline stops absorbing samples
+  while a breach is building, so a regression cannot normalize
+  itself), serving shed-rate breach, queue depth pinned at the bound,
+  and per-replica service-time skew — the straggler primitive
+  multi-host scale-out will lean on. Alerts render as the diagnose
+  ``Alerts`` table and count into ``watchdog_alerts`` in
+  ``profiler.counters()``.
+
+Both pieces are off by default and cost nothing when off: the
+watchdog hooks are ``None`` checks, and without :func:`serve` no
+thread, socket, or render ever exists — a run with both off keeps a
+byte-identical telemetry sink.
+"""
+from __future__ import annotations
+
+import bisect
+import itertools
+import os
+import threading
+import warnings
+import weakref
+from collections import deque
+
+from .base import get_env
+
+__all__ = ["serve", "stop_server", "server_port", "render",
+           "register_server", "deregister_server", "Watchdog",
+           "enable_watchdog",
+           "disable_watchdog", "watchdog_enabled", "maybe_start",
+           "LATENCY_BUCKETS_MS"]
+
+# histogram bucket upper bounds (ms) for the recent-window serving
+# latency histogram — roughly log-spaced over sub-ms..seconds
+LATENCY_BUCKETS_MS = (1.0, 2.5, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0,
+                      500.0, 1000.0, 2500.0, 5000.0)
+
+_servers = weakref.WeakSet()      # live InferenceServers
+_http = None                      # (HTTPServer, thread)
+_http_lock = threading.Lock()
+_watchdog = None
+
+
+_label_seq = itertools.count(2)
+_register_lock = threading.Lock()
+
+
+def deregister_server(server):
+    """Drop a server from the scrape (called by
+    ``InferenceServer.stop``; garbage collection also drops it). Its
+    label becomes reusable by a replacement server."""
+    with _register_lock:
+        _servers.discard(server)
+
+
+def register_server(server):
+    """Track one live InferenceServer for the scrape (weakref — a
+    collected server drops out). Called from the server constructor.
+    Each server gets a UNIQUE ``server=`` label: a second unnamed (or
+    same-named) server is suffixed ``-2``, ``-3``, ... — duplicate
+    label sets would make Prometheus reject the whole scrape. The
+    check-and-assign runs under a lock so concurrently constructed
+    servers cannot both claim one label."""
+    with _register_lock:
+        label = getattr(server, "name", None) or "default"
+        taken = {getattr(s, "_metrics_label", None) for s in _servers}
+        if label in taken:
+            label = "%s-%d" % (label, next(_label_seq))
+        server._metrics_label = label
+        _servers.add(server)
+
+
+def maybe_start(fresh_run=False):
+    """Environment entry point (called from ``telemetry.start`` with
+    ``fresh_run=True`` and from ``InferenceServer.__init__``): start
+    the endpoint when ``MXNET_METRICS_PORT`` is set, the watchdog
+    when ``MXNET_WATCHDOG=1``. A fresh telemetry run re-arms a FRESH
+    watchdog — the previous run's rolling step-time baseline belongs
+    to a different workload and would fire spurious drift alerts on
+    the new one."""
+    port = os.environ.get("MXNET_METRICS_PORT", "").strip()
+    if port and _http is None:
+        try:
+            serve(int(port))
+        except (OSError, ValueError) as exc:
+            warnings.warn("livemetrics: cannot start /metrics on port "
+                          "%s (%s) — endpoint disabled" % (port, exc))
+    if os.environ.get("MXNET_WATCHDOG", "").strip().lower() \
+            in ("1", "true", "on", "yes") \
+            and (_watchdog is None or fresh_run):
+        enable_watchdog()
+
+
+# ---------------------------------------------------------------------------
+# Prometheus text rendering
+# ---------------------------------------------------------------------------
+
+def _esc(value):
+    """Prometheus label-value escape."""
+    return str(value).replace("\\", r"\\").replace('"', r'\"') \
+        .replace("\n", r"\n")
+
+
+class _Page:
+    """Accumulates one exposition page; emits # HELP/# TYPE once per
+    metric family."""
+
+    def __init__(self):
+        self.lines = []
+        self._seen = set()
+
+    def add(self, name, value, labels=None, kind="gauge", help_=""):
+        if value is None:
+            return
+        if name not in self._seen:
+            self._seen.add(name)
+            if help_:
+                self.lines.append("# HELP %s %s" % (name, help_))
+            self.lines.append("# TYPE %s %s" % (name, kind))
+        if labels:
+            lab = ",".join('%s="%s"' % (k, _esc(v))
+                           for k, v in sorted(labels.items()))
+            self.lines.append("%s{%s} %s" % (name, lab, _fmt(value)))
+        else:
+            self.lines.append("%s %s" % (name, _fmt(value)))
+
+    def histogram(self, name, le_counts, sum_value, count,
+                  labels=None, help_=""):
+        """One histogram family per the exposition contract: TYPE is
+        declared ONCE on the base name; the ``_bucket``/``_sum``/
+        ``_count`` samples carry no TYPE lines of their own."""
+        if name not in self._seen:
+            self._seen.add(name)
+            if help_:
+                self.lines.append("# HELP %s %s" % (name, help_))
+            self.lines.append("# TYPE %s histogram" % name)
+
+        def line(suffix, value, extra=None):
+            lab = dict(labels or {})
+            if extra:
+                lab.update(extra)
+            if lab:
+                body = ",".join('%s="%s"' % (k, _esc(v))
+                                for k, v in sorted(lab.items()))
+                self.lines.append("%s%s{%s} %s"
+                                  % (name, suffix, body, _fmt(value)))
+            else:
+                self.lines.append("%s%s %s" % (name, suffix,
+                                               _fmt(value)))
+
+        for le, c in le_counts:
+            line("_bucket", c, {"le": le})
+        line("_bucket", count, {"le": "+Inf"})
+        line("_sum", sum_value)
+        line("_count", count)
+
+    def text(self):
+        return "\n".join(self.lines) + "\n"
+
+
+def _fmt(v):
+    if isinstance(v, bool):
+        return "1" if v else "0"
+    if isinstance(v, float):
+        return repr(v)
+    return str(v)
+
+
+def _render_training(page):
+    """Training-run families from ``telemetry.report()`` — the same
+    aggregates the JSONL summary carries, live."""
+    from . import telemetry
+    rep = telemetry.report()
+    page.add("mxnet_telemetry_run_active",
+             1 if telemetry.enabled() else 0,
+             help_="1 while a telemetry run is active")
+    if rep is None:
+        return
+    page.add("mxnet_steps_total", rep["steps"], kind="counter",
+             help_="training steps recorded by the telemetry run")
+    page.add("mxnet_samples_total", rep["samples"], kind="counter")
+    page.add("mxnet_skipped_steps_total", rep["skipped_steps"],
+             kind="counter",
+             help_="steps skipped by the non-finite fault guard")
+    page.add("mxnet_goodput_ratio", rep.get("goodput"))
+    page.add("mxnet_samples_per_sec", rep.get("samples_per_sec"))
+    st = rep.get("step_time_ms") or {}
+    for q in ("p50", "p90", "p99"):
+        page.add("mxnet_step_time_ms", st.get(q),
+                 labels={"quantile": q},
+                 help_="step wall time over the telemetry ring")
+    for phase, ms in (rep.get("phases_ms") or {}).items():
+        page.add("mxnet_phase_ms_total", ms, labels={"phase": phase},
+                 kind="counter",
+                 help_="accounted wall time per step phase")
+    comm_kinds = {}
+    for key, c in (rep.get("comms") or {}).items():
+        kind = key.split(":", 1)[0]
+        agg = comm_kinds.setdefault(kind, [0, 0])
+        agg[0] += c.get("bytes", 0)
+        agg[1] += c.get("calls", 0)
+    for kind, (nbytes, calls) in sorted(comm_kinds.items()):
+        page.add("mxnet_comm_bytes_total", nbytes,
+                 labels={"kind": kind}, kind="counter",
+                 help_="communication payload bytes per kind")
+        page.add("mxnet_comm_calls_total", calls,
+                 labels={"kind": kind}, kind="counter")
+    cb = rep.get("compile") or {}
+    page.add("mxnet_compiles_total", cb.get("count"), kind="counter",
+             help_="XLA compiles this run (compile watch)")
+    page.add("mxnet_compile_seconds_total", cb.get("total_s"),
+             kind="counter")
+    ub = rep.get("utilization") or {}
+    mfu = ub.get("mfu") or {}
+    for q in ("p50", "p90"):
+        page.add("mxnet_mfu_ratio", mfu.get(q),
+                 labels={"quantile": q},
+                 help_="model-flops utilization vs device peak")
+    # alert counts come from the watchdog's own monotonic per-kind
+    # tallies, NOT the run summary's bounded alert window — a window
+    # that trims old entries would make this "counter" decrease
+    # mid-run, which rate()/increase() read as a bogus reset
+    wd = _watchdog
+    if wd is not None:
+        for kind, n in sorted(wd.alerts().items()):
+            page.add("mxnet_watchdog_alerts_total", n,
+                     labels={"kind": kind}, kind="counter",
+                     help_="SLO watchdog alerts by kind")
+
+
+def _render_counters(page):
+    from . import profiler
+    for name, value in sorted(profiler.counters().items()):
+        page.add("mxnet_profiler_counter", value,
+                 labels={"name": name}, kind="counter",
+                 help_="process-global profiler counters (fused step "
+                       "cache, serving shed/timeout/dispatch, h2d, ...)")
+
+
+def _render_serving(page):
+    for srv in list(_servers):
+        try:
+            st = srv.stats()
+            lats = srv.latency_snapshot()
+        except Exception:
+            continue                       # mid-shutdown server
+        lab = {"server": getattr(srv, "_metrics_label", None)
+               or "default"}
+        page.add("mxnet_serving_requests_total", st["requests"],
+                 labels=lab, kind="counter",
+                 help_="requests submitted (admission attempts)")
+        page.add("mxnet_serving_completed_total", st["completed"],
+                 labels=lab, kind="counter")
+        page.add("mxnet_serving_shed_total", st["shed"], labels=lab,
+                 kind="counter",
+                 help_="requests shed at the bounded admission queue")
+        page.add("mxnet_serving_timeouts_total", st["timeouts"],
+                 labels=lab, kind="counter")
+        page.add("mxnet_serving_errors_total", st["errors"],
+                 labels=lab, kind="counter")
+        page.add("mxnet_serving_batches_total", st["batches"],
+                 labels=lab, kind="counter")
+        page.add("mxnet_serving_queue_depth", st["queue_depth"],
+                 labels=lab,
+                 help_="admission queue depth now (bound: max_queue)")
+        page.add("mxnet_serving_queue_peak", st["queue_peak"],
+                 labels=lab)
+        page.add("mxnet_serving_queue_bound", st["max_queue"],
+                 labels=lab)
+        page.add("mxnet_serving_occupancy_ratio", st.get("occupancy"),
+                 labels=lab,
+                 help_="mean filled share of dispatched bucket slots")
+        page.add("mxnet_serving_rps", st.get("rps"), labels=lab)
+        lat = st.get("latency_ms") or {}
+        for q in ("p50", "p90", "p99"):
+            page.add("mxnet_serving_latency_ms", lat.get(q),
+                     labels=dict(lab, quantile=q),
+                     help_="request latency over the recent ring")
+        for i, n in enumerate(st.get("replica_batches") or []):
+            page.add("mxnet_serving_replica_batches_total", n,
+                     labels=dict(lab, replica=str(i)), kind="counter")
+        for i, ms in enumerate(st.get("replica_service_ms") or []):
+            page.add("mxnet_serving_replica_service_ms", ms,
+                     labels=dict(lab, replica=str(i)),
+                     help_="mean batch service time per replica "
+                           "(straggler signal)")
+        # recent-window latency histogram (the ring, not all-time):
+        # cumulative le buckets per the Prometheus histogram
+        # contract, binned in one pass over the ring
+        ms_vals = [v * 1e3 for v in lats]
+        bins = [0] * (len(LATENCY_BUCKETS_MS) + 1)
+        for v in ms_vals:
+            bins[bisect.bisect_left(LATENCY_BUCKETS_MS, v)] += 1
+        le_counts, cum = [], 0
+        for le, c in zip(LATENCY_BUCKETS_MS, bins):
+            cum += c
+            le_counts.append(("%g" % le, cum))
+        page.histogram(
+            "mxnet_serving_latency_recent_ms", le_counts,
+            round(sum(ms_vals), 3), len(ms_vals), labels=lab,
+            help_="request latency histogram over the recent "
+                  "latency ring")
+
+
+def render():
+    """The whole ``/metrics`` page as Prometheus text exposition."""
+    page = _Page()
+    page.add("mxnet_up", 1, help_="the mxnet_tpu process is alive")
+    _render_training(page)
+    _render_counters(page)
+    _render_serving(page)
+    return page.text()
+
+
+# ---------------------------------------------------------------------------
+# the HTTP endpoint
+# ---------------------------------------------------------------------------
+
+def serve(port=None, host=None):
+    """Start the ``/metrics`` endpoint on a daemon thread (idempotent
+    — a second call returns the live port). ``port`` defaults to
+    ``MXNET_METRICS_PORT``; 0 picks an ephemeral port. ``host``
+    defaults to ``MXNET_METRICS_HOST`` or ``127.0.0.1`` — localhost
+    by default on purpose. Returns the bound port."""
+    global _http
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+    with _http_lock:
+        if _http is not None:
+            return _http[0].server_address[1]
+        if port is None:
+            port = get_env("MXNET_METRICS_PORT", 0, int)
+        if host is None:
+            host = os.environ.get("MXNET_METRICS_HOST", "").strip() \
+                or "127.0.0.1"
+
+        class _Handler(BaseHTTPRequestHandler):
+            def do_GET(self):
+                if self.path.split("?", 1)[0] not in ("/metrics", "/"):
+                    self.send_error(404)
+                    return
+                try:
+                    body = render().encode("utf-8")
+                except Exception as exc:      # noqa: BLE001 — a render
+                    # bug must surface as a 500, never kill the server
+                    self.send_error(500, explain=str(exc)[:200])
+                    return
+                self.send_response(200)
+                self.send_header(
+                    "Content-Type",
+                    "text/plain; version=0.0.4; charset=utf-8")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):       # scrapes are not news
+                pass
+
+        httpd = ThreadingHTTPServer((host, int(port)), _Handler)
+        httpd.daemon_threads = True
+        thread = threading.Thread(target=httpd.serve_forever,
+                                  name="mxnet-metrics", daemon=True)
+        thread.start()
+        _http = (httpd, thread)
+        return httpd.server_address[1]
+
+
+def server_port():
+    """The live endpoint's port, or None when not serving."""
+    with _http_lock:
+        return _http[0].server_address[1] if _http else None
+
+
+def stop_server():
+    """Shut the endpoint down (tests; production just lets the daemon
+    thread die with the process)."""
+    global _http
+    with _http_lock:
+        pair, _http = _http, None
+    if pair is not None:
+        pair[0].shutdown()
+        pair[0].server_close()
+        pair[1].join(timeout=5)
+
+
+# ---------------------------------------------------------------------------
+# the SLO watchdog
+# ---------------------------------------------------------------------------
+
+class Watchdog:
+    """Rolling-baseline SLO detector. Observes step records and
+    cumulative serving snapshots (installed as telemetry's
+    ``_watch_step``/``_watch_serving`` hooks) and emits one structured
+    ``alert`` telemetry record + one warning per alert kind:
+
+    - ``step_time_drift`` — recent-window step-time p50 above
+      ``MXNET_WATCHDOG_DRIFT`` (default 1.5) x the rolling baseline
+      p50 for ``MXNET_WATCHDOG_SUSTAIN`` (default 10) consecutive
+      steps. The baseline (``MXNET_WATCHDOG_BASELINE`` steps, default
+      50) only absorbs samples while no breach is building, so a
+      regression cannot slowly become the new normal.
+    - ``serving_shed_rate`` — sheds/submits over the snapshot delta
+      above ``MXNET_WATCHDOG_SHED_RATE`` (default 0.3) once at least
+      ``MXNET_WATCHDOG_MIN_REQUESTS`` (default 20) new requests
+      arrived.
+    - ``serving_queue_full`` — admission queue depth at or above 90%
+      of its bound (``MXNET_WATCHDOG_QUEUE_FRAC``).
+    - ``replica_skew`` — slowest replica's mean batch service time
+      above ``MXNET_WATCHDOG_SKEW`` (default 2.0) x the replica
+      median, each replica having served ≥3 batches — the straggler
+      primitive.
+
+    Serving baselines are kept per server (snapshots carry the server
+    name), and the serving conditions alert on the healthy→breached
+    edge with hysteresis: a breach that persists across snapshots
+    emits ONE alert record, re-arming only when it clears. The
+    telemetry alert list is additionally bounded at the sink.
+    """
+
+    def __init__(self):
+        self.drift = max(1.01, get_env("MXNET_WATCHDOG_DRIFT", 1.5,
+                                       float))
+        self.window = max(2, get_env("MXNET_WATCHDOG_WINDOW", 20, int))
+        self.baseline_n = max(
+            2, get_env("MXNET_WATCHDOG_BASELINE", 50, int))
+        self.sustain = max(1, get_env("MXNET_WATCHDOG_SUSTAIN", 10,
+                                      int))
+        self.shed_rate = get_env("MXNET_WATCHDOG_SHED_RATE", 0.3,
+                                 float)
+        self.min_requests = max(
+            1, get_env("MXNET_WATCHDOG_MIN_REQUESTS", 20, int))
+        self.queue_frac = get_env("MXNET_WATCHDOG_QUEUE_FRAC", 0.9,
+                                  float)
+        self.skew = max(1.01, get_env("MXNET_WATCHDOG_SKEW", 2.0,
+                                      float))
+        self._baseline = deque(maxlen=self.baseline_n)
+        self._recent = deque(maxlen=self.window)
+        self._breach = 0
+        self._prev_serving = {}   # per-server previous snapshot
+        self._fired = {}          # kind -> count (warn once per kind)
+        # serving conditions re-arm instead of re-firing: a breach
+        # alerts once on entry, then stays silent until it CLEARS —
+        # keys are (kind, server)
+        self._active = set()
+        # RLock: on_serving holds it across its read-modify-write of
+        # the previous snapshot (every replica worker thread can emit
+        # a serving record concurrently) and _fire re-enters it
+        self._lock = threading.RLock()
+
+    # -- alert plumbing ----------------------------------------------------
+    def _fire(self, kind, message, **fields):
+        with self._lock:
+            first = kind not in self._fired
+            self._fired[kind] = self._fired.get(kind, 0) + 1
+        from . import profiler, telemetry
+        rec = {"kind": kind, "message": message}
+        rec.update(fields)
+        telemetry.alert_event(rec)
+        profiler.increment_counter("watchdog_alerts")
+        if first:
+            warnings.warn("watchdog: %s — %s" % (kind, message))
+
+    def alerts(self):
+        with self._lock:
+            return dict(self._fired)
+
+    # -- step SLO ----------------------------------------------------------
+    def on_step(self, rec):
+        dur = rec.get("dur_ms")
+        if dur is None:
+            return
+        from .telemetry import percentile
+        with self._lock:
+            self._on_step_locked(dur, percentile)
+
+    def _on_step_locked(self, dur, percentile):
+        if len(self._baseline) < self.baseline_n:
+            self._baseline.append(dur)
+            return
+        self._recent.append(dur)
+        if len(self._recent) < self.window:
+            return
+        base_p50 = percentile(self._baseline, 50)
+        recent_p50 = percentile(self._recent, 50)
+        if base_p50 and recent_p50 > self.drift * base_p50:
+            self._breach += 1
+            if self._breach == self.sustain:
+                self._fire(
+                    "step_time_drift",
+                    "step-time p50 %.3f ms vs rolling baseline %.3f "
+                    "ms (x%.2f > x%.2f) sustained %d steps"
+                    % (recent_p50, base_p50, recent_p50 / base_p50,
+                       self.drift, self.sustain),
+                    recent_p50_ms=round(recent_p50, 3),
+                    baseline_p50_ms=round(base_p50, 3),
+                    ratio=round(recent_p50 / base_p50, 3))
+        else:
+            # healthy sample: the rolling baseline may absorb it
+            self._breach = 0
+            self._baseline.append(dur)
+
+    # -- serving SLOs ------------------------------------------------------
+    def on_serving(self, st):
+        with self._lock:
+            self._on_serving_locked(st)
+
+    def _edge(self, kind, server, in_breach):
+        """Entry-edge detector with hysteresis: True only when the
+        (kind, server) condition goes healthy→breached; a breach that
+        persists across snapshots alerts once, then re-arms when it
+        clears — a days-long breach must not emit thousands of
+        identical alert records."""
+        key = (kind, server)
+        if in_breach:
+            if key in self._active:
+                return False
+            self._active.add(key)
+            return True
+        self._active.discard(key)
+        return False
+
+    def _on_serving_locked(self, st):
+        server = st.get("name") or "default"
+        prev = self._prev_serving.get(server)
+        d_req = None
+        if prev is not None:
+            d_req = st.get("requests", 0) - prev.get("requests", 0)
+            d_shed = st.get("shed", 0) - prev.get("shed", 0)
+            if d_req < 0:
+                # cumulative counters never decrease within one
+                # server lifetime, so a regression is either a
+                # RESTARTED server reusing this label (counters back
+                # near zero — re-seed, or the dead generation's
+                # baseline blinds the check until the new one
+                # out-counts it) or a slightly-stale OUT-OF-ORDER
+                # snapshot from a racing replica worker (counters
+                # just below the baseline — drop it; the newer
+                # snapshot was already evaluated and the baseline
+                # must not rewind)
+                if st.get("requests", 0) * 2 < prev.get("requests",
+                                                        0):
+                    prev = d_req = None
+                else:
+                    return
+        if prev is None:
+            # first snapshot for this server (generation): the
+            # cumulative counters span its whole pre-watchdog history
+            # — seed the baseline without evaluating the rate, or a
+            # long-recovered burst of sheds would fire a spurious
+            # alert on arm
+            self._prev_serving.pop(server, None)
+            self._prev_serving[server] = {
+                "requests": st.get("requests", 0),
+                "shed": st.get("shed", 0)}
+            # bound the per-server table in server-churning processes
+            # (fresh labels accumulate); prune the evicted server's
+            # hysteresis keys with it
+            while len(self._prev_serving) > 128:
+                old = next(iter(self._prev_serving))
+                del self._prev_serving[old]
+                self._active = {k for k in self._active
+                                if k[1] != old}
+        if d_req is not None and d_req >= self.min_requests:
+            # baselines are PER SERVER (snapshots carry the server
+            # name): one server's counters must never dilute
+            # another's deltas. The baseline only advances when the
+            # check actually RUNS — small per-snapshot deltas
+            # accumulate until they clear min_requests instead of
+            # being absorbed unevaluated — and counters only move
+            # forward, so an out-of-order older snapshot (two replica
+            # workers emitting concurrently) cannot rewind it.
+            self._prev_serving[server] = {
+                "requests": max(st.get("requests", 0),
+                                prev.get("requests", 0)),
+                "shed": max(st.get("shed", 0), prev.get("shed", 0))}
+            breach = d_shed > 0 and d_shed / float(d_req) > \
+                self.shed_rate
+            if self._edge("serving_shed_rate", server, breach):
+                self._fire(
+                    "serving_shed_rate",
+                    "server %s shed %d of %d requests (%.0f%% > "
+                    "%.0f%%) since the previous snapshot — sustained "
+                    "overload, raise capacity or shed earlier "
+                    "upstream" % (server, d_shed, d_req,
+                                  100.0 * d_shed / d_req,
+                                  100.0 * self.shed_rate),
+                    server=server, shed=d_shed, requests=d_req,
+                    rate=round(d_shed / float(d_req), 4))
+        bound = st.get("max_queue") or 0
+        depth = st.get("queue_depth", 0)
+        if bound and self._edge("serving_queue_full", server,
+                                depth >= self.queue_frac * bound):
+            self._fire(
+                "serving_queue_full",
+                "server %s admission queue depth %d at %.0f%% of "
+                "bound %d — latency is queue-bound; sheds are "
+                "imminent" % (server, depth, 100.0 * depth / bound,
+                              bound),
+                server=server, queue_depth=depth, max_queue=bound)
+        service = st.get("replica_service_ms") or []
+        batches = st.get("replica_batches") or []
+        valid = [(i, ms) for i, ms in enumerate(service)
+                 if ms is not None and i < len(batches)
+                 and batches[i] >= 3]
+        if len(valid) >= 2:
+            from .telemetry import percentile
+            med = percentile([ms for _, ms in valid], 50)
+            worst_i, worst = max(valid, key=lambda kv: kv[1])
+            breach = bool(med) and worst > self.skew * med
+            if self._edge("replica_skew", server, breach):
+                self._fire(
+                    "replica_skew",
+                    "server %s replica %d mean batch service %.3f ms "
+                    "vs replica median %.3f ms (x%.2f > x%.2f) — "
+                    "straggling device/host"
+                    % (server, worst_i, worst, med, worst / med,
+                       self.skew),
+                    server=server, replica=worst_i,
+                    service_ms=round(worst, 3),
+                    median_ms=round(med, 3),
+                    ratio=round(worst / med, 3))
+
+
+def enable_watchdog():
+    """Install a fresh watchdog as telemetry's step/serving hooks
+    (re-arming any previously fired alerts). Returns it."""
+    global _watchdog
+    from . import telemetry
+    wd = Watchdog()
+    _watchdog = wd
+    telemetry._watch_step = wd.on_step
+    telemetry._watch_serving = wd.on_serving
+    return wd
+
+
+def disable_watchdog():
+    global _watchdog
+    from . import telemetry
+    telemetry._watch_step = None
+    telemetry._watch_serving = None
+    _watchdog = None
+
+
+def watchdog_enabled():
+    return _watchdog is not None

@@ -509,7 +509,17 @@ def account_in_program_sync(plan):
     span) plus run counters. The eager kvstore leg
     (:func:`bucketed_kvstore_sync`) records real host-timed spans
     under the same kind."""
-    from .. import telemetry
+    from .. import telemetry, tracing
+    if tracing._tracer is not None:
+        # in-program buckets have no host-observable span (that is
+        # the point of the overlap) — they render as instant events
+        # on their own trace track, one per bucket per step
+        tid = tracing.track("grad_sync")
+        ctx = tracing.context() or {}
+        for b, bucket in enumerate(plan.buckets):
+            tracing.instant("bucket%02d" % b, "comm", tid=tid,
+                            args=dict(ctx, bytes=2 * bucket.nbytes,
+                                      in_program=True))
     if not telemetry.enabled():
         return
     for b, bucket in enumerate(plan.buckets):
@@ -542,7 +552,7 @@ def bucketed_kvstore_sync(kvstore, items, cap_bytes=None):
     when any gradient is sparse or the roster is empty — the caller
     keeps its per-key loop."""
     import jax.numpy as jnp
-    from .. import telemetry
+    from .. import telemetry, tracing
     from ..ndarray import NDArray
 
     if not items or not all(_dense(g) for _, g in items):
@@ -578,12 +588,21 @@ def bucketed_kvstore_sync(kvstore, items, cap_bytes=None):
         if key not in inited:
             kvstore.init(key, NDArray(jnp.zeros_like(flat)))
             inited.add(key)
+        t_tr = tracing.now() if tracing._tracer is not None else None
         with telemetry.comm_span("grad_sync", "bucket%02d" % b,
                                  nbytes=2 * flat.nbytes):
             # 2x: bucket bytes once per direction (push + pull),
             # matching the in-program RS+AG accounting
             kvstore.push(key, flat_nd, priority=-b)
             kvstore.pull(key, flat_nd, priority=-b)
+        if t_tr is not None:
+            # the eager leg IS host-observable: a real duration event
+            # on the same grad_sync track the in-program instants use
+            tracing.add("bucket%02d" % b, "comm", t_tr,
+                        tracing.now() - t_tr,
+                        tid=tracing.track("grad_sync"),
+                        args={"bytes": 2 * int(flat.nbytes),
+                              "in_program": False})
         for i, off, size in zip(bucket.indices, bucket.offsets,
                                 bucket.sizes):
             g = items[i][1]

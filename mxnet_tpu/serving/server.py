@@ -29,12 +29,22 @@ queue, one batcher thread, and one worker thread per replica:
   counted, never fatal.
 - **Telemetry** — cumulative serving stats (latency percentiles,
   requests/sec, batch occupancy, queue depth, shed/timeout counts, per
-  bucket batch counts) flow to the active telemetry run as ``serving``
-  JSONL records every ``record_every`` batches and at :meth:`stop`;
-  ``tools.diagnose`` renders them as the Serving table.
+  bucket batch counts, per-replica mean service time) flow to the
+  active telemetry run as ``serving`` JSONL records every
+  ``record_every`` batches and at :meth:`stop`; ``tools.diagnose``
+  renders them as the Serving table, the ``/metrics`` endpoint
+  (``mxnet_tpu.livemetrics``) scrapes them live, and the
+  shed/timeout/dispatch counters mirror into ``profiler.counters()``.
+- **Tracing** — every submit assigns a ``request_id`` (returned on
+  the future; present in shed/timeout error messages so log lines
+  join against traces). With ``mxnet_tpu.tracing`` enabled each
+  request's lifetime lands on its own trace track as causally-nested
+  spans: queue wait → batch formation → replica dispatch → pad →
+  device compute → slice/respond.
 """
 from __future__ import annotations
 
+import itertools
 import queue as _queue_mod
 import threading
 import time
@@ -43,7 +53,7 @@ from collections import deque
 import numpy as _np
 
 from ..base import MXNetError, get_env
-from .. import fault, telemetry
+from .. import fault, profiler, telemetry, tracing
 from ..bucketing.padding import pad_along
 from .batcher import BucketLadder, pad_batch, slice_rows
 
@@ -66,16 +76,21 @@ class ServerClosedError(MXNetError):
 
 
 class _Request:
-    """One in-flight request: the per-sample input arrays and a
-    future-style completion event."""
+    """One in-flight request: the per-sample input arrays, the
+    server-assigned ``request_id`` (present on every shed/timeout log
+    line so they join against traces), and a future-style completion
+    event. ``_tr`` holds the trace-clock stamps of the request's
+    lifecycle spans — None whenever tracing is off."""
 
-    __slots__ = ("args", "t_submit", "deadline", "_event", "_value",
-                 "_error", "_t_done")
+    __slots__ = ("args", "t_submit", "deadline", "request_id", "_tr",
+                 "_event", "_value", "_error", "_t_done")
 
-    def __init__(self, args, t_submit, deadline):
+    def __init__(self, args, t_submit, deadline, request_id=None):
         self.args = args
         self.t_submit = t_submit
         self.deadline = deadline
+        self.request_id = request_id
+        self._tr = None
         self._event = threading.Event()
         self._value = None
         self._error = None
@@ -107,7 +122,8 @@ class _Request:
         RequestTimeoutError / ServerClosedError / the model's own."""
         if not self._event.wait(timeout):
             raise RequestTimeoutError(
-                "request did not complete within %ss" % timeout)
+                "request %s did not complete within %ss"
+                % (self.request_id or "?", timeout))
         if self._error is not None:
             raise self._error
         return self._value
@@ -194,6 +210,7 @@ class InferenceServer:
                 seq_ladder = BucketLadder(seq_ladder)
         self._seq_ladder = seq_ladder
 
+        self.name = name
         site = "serving" if not name else "serving:%s" % name
         self._programs = {}
         for b in ladder.buckets:
@@ -256,7 +273,9 @@ class InferenceServer:
                        "queue_peak": 0}
         self._bucket_counts = {}
         self._replica_batches = [0] * replicas
+        self._replica_service_s = [0.0] * replicas
         self._outstanding = [0] * replicas
+        self._rid = itertools.count(1)
         self._latencies = deque(
             maxlen=max(1, get_env("MXNET_SERVING_LATENCY_RING",
                                   8192, int)))
@@ -271,6 +290,13 @@ class InferenceServer:
         self._t0 = time.perf_counter()
         self._work = [_queue_mod.Queue() for _ in range(replicas)]
         self._threads = []
+        # the live /metrics endpoint scrapes every registered server;
+        # MXNET_METRICS_PORT/MXNET_WATCHDOG arm the live stack even
+        # for pure serving processes that never start a telemetry run
+        from .. import livemetrics
+        livemetrics.register_server(self)
+        livemetrics.maybe_start()
+        tracing.maybe_enable()
         if start:
             self.start()
 
@@ -320,6 +346,10 @@ class InferenceServer:
             t.join()
         self._closed = True
         self._emit_record()
+        # off the /metrics scrape: a stopped server must not export
+        # frozen gauges forever, and its label frees for a successor
+        from .. import livemetrics
+        livemetrics.deregister_server(self)
 
     def __enter__(self):
         return self.start()
@@ -435,9 +465,12 @@ class InferenceServer:
         now = time.monotonic()
         # deadline 0 means "expire unless dispatchable now", not "no
         # deadline" — only None disables
+        rid = "r%06d" % next(self._rid)
         req = _Request(arrays, now,
                        now + deadline_s if deadline_s is not None
-                       else None)
+                       else None, request_id=rid)
+        if tracing._tracer is not None:
+            req._tr = {"submit": tracing.now()}
         shed = stopping = False
         with self._cond:
             if self._stopping:
@@ -474,13 +507,19 @@ class InferenceServer:
                     self._cond.notify_all()
         if stopping:
             raise ServerClosedError(
-                "InferenceServer is stopping; request not admitted")
+                "InferenceServer is stopping; request %s not admitted"
+                % rid)
         if shed:
             telemetry.note("serving_shed")
+            profiler.increment_counter("serving_shed")
+            if req._tr is not None:
+                tracing.instant("shed", "serving",
+                                tid=tracing.track("serving"),
+                                args={"request_id": rid})
             raise ServerOverloadedError(
-                "serving: request queue full (max_queue=%d) — request "
-                "shed; retry with backoff, raise max_queue, or add "
-                "replicas" % self._max_queue)
+                "serving: request %s shed — queue full (max_queue=%d); "
+                "retry with backoff, raise max_queue, or add replicas"
+                % (rid, self._max_queue))
         return req
 
     def predict(self, *args, timeout=None, deadline_ms=None):
@@ -555,6 +594,10 @@ class InferenceServer:
                         elif rung != srung:
                             leftover.append(req)
                             continue
+                    if req._tr is not None:
+                        # the queue-wait span ends here: this request
+                        # just joined a forming batch
+                        req._tr["pop"] = tracing.now()
                     batch.append(req)
                 if leftover:
                     # preserve FIFO for the rungs left behind
@@ -566,15 +609,27 @@ class InferenceServer:
                 self._cond.notify_all()     # space for blocked submits
             for req in expired:
                 telemetry.note("serving_timeout")
+                profiler.increment_counter("serving_timeouts")
+                if req._tr is not None:
+                    tid = tracing.track("req %s" % req.request_id)
+                    t_end = tracing.now()
+                    tracing.add("queue", "serving", req._tr["submit"],
+                                t_end - req._tr["submit"], tid=tid,
+                                args={"request_id": req.request_id})
+                    tracing.instant("timeout", "serving", tid=tid,
+                                    args={"request_id": req.request_id})
                 req._fail(RequestTimeoutError(
-                    "request deadline passed after %.1f ms in queue "
-                    "(deadline %.1f ms)"
-                    % ((now - req.t_submit) * 1e3,
+                    "request %s deadline passed after %.1f ms in "
+                    "queue (deadline %.1f ms)"
+                    % (req.request_id, (now - req.t_submit) * 1e3,
                        (req.deadline - req.t_submit) * 1e3)))
             if not batch:
                 continue
             bucket = self._ladder.bucket_for(len(batch))
-            self._work[r].put((batch, bucket, srung))
+            profiler.increment_counter("serving_dispatches")
+            t_put = tracing.now() if tracing._tracer is not None \
+                else None
+            self._work[r].put((batch, bucket, srung, t_put))
 
     def _req_rung(self, req):
         """One request's own sequence rung: the smallest bucket
@@ -591,9 +646,11 @@ class InferenceServer:
             item = self._work[idx].get()
             if item is None:
                 break
-            batch, bucket, srung = item
+            batch, bucket, srung, t_put = item
             pkey = bucket if srung is None else (bucket, srung)
+            t_get = time.perf_counter()
             try:
+                t_pad0 = t_get
                 inputs = []
                 for j in range(len(batch[0].args)):
                     samples = [r.args[j] for r in batch]
@@ -602,6 +659,7 @@ class InferenceServer:
                                    for s in samples]
                     arr = pad_batch(samples, bucket)
                     inputs.append(jax.device_put(arr, dev))
+                t_compute0 = time.perf_counter()
                 out = self._programs[pkey](*inputs)
                 out = jax.block_until_ready(out)
             except Exception as exc:        # noqa: BLE001 — model errors
@@ -610,16 +668,28 @@ class InferenceServer:
                     self._outstanding[idx] -= 1
                     self._cond.notify_all()
                 for r in batch:
+                    if r._tr is not None:
+                        tracing.instant(
+                            "error", "serving",
+                            tid=tracing.track("req %s" % r.request_id),
+                            args={"request_id": r.request_id,
+                                  "error": type(exc).__name__})
                     r._fail(exc)
                 continue
+            t_compute1 = time.perf_counter()
             done = time.monotonic()
-            for i, r in enumerate(batch):
-                r._fulfill(slice_rows(out, i))
+            values = [slice_rows(out, i) for i in range(len(batch))]
+            # account BEFORE fulfilling: the instant a future's event
+            # sets, the client may call stats() (or scrape /metrics)
+            # and must see this batch's completions — fulfilling first
+            # would make the counters trail the observable results
             with self._cond:
                 n = len(batch)
                 self._stats["completed"] += n
                 self._stats["batches"] += 1
                 self._stats["occupancy_sum"] += n / float(bucket)
+                self._replica_service_s[idx] += \
+                    time.perf_counter() - t_get
                 ckey = str(bucket) if srung is None \
                     else "%dx%d" % (bucket, srung)
                 self._bucket_counts[ckey] = \
@@ -633,8 +703,55 @@ class InferenceServer:
                 emit = self._batches_since_record >= self._record_every
                 if emit:
                     self._batches_since_record = 0
+            respond_ends = []
+            for r, value in zip(batch, values):
+                r._fulfill(value)
+                respond_ends.append(time.perf_counter())
+            if t_put is not None:
+                self._trace_batch(batch, bucket, srung, idx, t_put,
+                                  t_get, t_pad0, t_compute0,
+                                  t_compute1, respond_ends)
             if emit:
                 self._emit_record()
+
+    def _trace_batch(self, batch, bucket, srung, replica, t_put, t_get,
+                     t_pad0, t_compute0, t_compute1, respond_ends):
+        """Emit one batch's causally-nested per-request trace spans:
+        each request gets its own named track holding a ``request``
+        parent span with queue → batch → dispatch → pad → compute →
+        respond children, consecutive and non-overlapping by
+        construction (each phase starts where the previous ended).
+        Batch-shared phases (pad/compute) repeat on every member's
+        track — that duplication is what makes a single request's
+        lifetime readable in isolation in Perfetto."""
+        base = {"bucket": bucket, "replica": replica,
+                "batch_size": len(batch)}
+        if srung is not None:
+            base["seq_rung"] = srung
+        for i, r in enumerate(batch):
+            tr = r._tr
+            if tr is None:
+                continue         # admitted before tracing was enabled
+            tid = tracing.track("req %s" % r.request_id)
+            args = dict(base, request_id=r.request_id)
+            sub = tr["submit"]
+            pop = tr.get("pop", t_put)
+            r0 = t_compute1 if i == 0 else respond_ends[i - 1]
+            r1 = respond_ends[i]
+            tracing.add("request", "serving", sub, r1 - sub, tid=tid,
+                        args=args)
+            tracing.add("queue", "serving", sub, pop - sub, tid=tid,
+                        args=args)
+            tracing.add("batch", "serving", pop, t_put - pop, tid=tid,
+                        args=args)
+            tracing.add("dispatch", "serving", t_put, t_get - t_put,
+                        tid=tid, args=args)
+            tracing.add("pad", "serving", t_pad0, t_compute0 - t_pad0,
+                        tid=tid, args=args)
+            tracing.add("compute", "serving", t_compute0,
+                        t_compute1 - t_compute0, tid=tid, args=args)
+            tracing.add("respond", "serving", r0, r1 - r0, tid=tid,
+                        args=args)
 
     # -- stats & telemetry -------------------------------------------------
     def stats(self):
@@ -651,7 +768,14 @@ class InferenceServer:
                                   key=lambda kv: bucket_sort_key(kv[0])))
             depth = len(self._queue)
             replica_batches = list(self._replica_batches)
+            replica_service = list(self._replica_service_s)
         out = {
+            # the /metrics registration dedups this label per process
+            # — stats consumers (the watchdog's per-server baselines)
+            # must key by the same identity, or two unnamed servers
+            # would interleave one counter stream
+            "name": getattr(self, "_metrics_label", None)
+            or self.name or "default",
             "requests": s["requests"],
             "completed": s["completed"],
             "shed": s["shed"],
@@ -669,6 +793,11 @@ class InferenceServer:
             "buckets": buckets,
             "replicas": self._replicas,
             "replica_batches": replica_batches,
+            # mean batch service time per replica — the straggler
+            # signal the SLO watchdog's skew check reads
+            "replica_service_ms": [
+                round(1e3 * s / b, 3) if b else None
+                for s, b in zip(replica_service, replica_batches)],
         }
         if lats:
             out["latency_ms"] = {
@@ -679,6 +808,12 @@ class InferenceServer:
                 "max": round(max(lats), 3),
             }
         return out
+
+    def latency_snapshot(self):
+        """The recent fulfilled-request latencies (seconds) — the
+        /metrics endpoint's histogram source."""
+        with self._cond:
+            return list(self._latencies)
 
     def _emit_record(self):
         telemetry.serving_event(self.stats())

@@ -77,9 +77,19 @@ percentiles, requests/sec, batch occupancy, queue depth, shed/timeout
 counts — rendered as the diagnose Serving table), and, only when a
 shape-bucketing producer runs (``mxnet_tpu.bucketing``), cumulative
 ``bucketing`` records (per-bucket batch counts, padding-overhead
-share, pad-row/discard counts — the diagnose Bucketing table). With
+share, pad-row/discard counts — the diagnose Bucketing table), and,
+only when the SLO watchdog is armed (``mxnet_tpu.livemetrics``,
+``MXNET_WATCHDOG=1``) *and* breaches, structured ``alert`` records
+(kind, message, breach numbers — the diagnose Alerts table). With
 those subsystems unused the kinds never appear and the sink is
 byte-identical to a run without them.
+
+The live half of this stack rides alongside: per-event traces
+(``mxnet_tpu.tracing``, ``MXNET_TRACE=1`` — every span here also
+lands in the trace ring, including the nested and off-thread spans the
+exclusive-phase accounting ignores) and the scrapeable ``/metrics``
+endpoint (``mxnet_tpu.livemetrics``, ``MXNET_METRICS_PORT``) serving
+:func:`report`'s aggregates as Prometheus text.
 """
 from __future__ import annotations
 
@@ -89,6 +99,7 @@ import threading
 import time
 from collections import deque
 
+from . import tracing
 from .base import get_env
 
 __all__ = ["PHASES", "enabled", "start", "stop", "reset", "maybe_start",
@@ -96,7 +107,7 @@ __all__ = ["PHASES", "enabled", "start", "stop", "reset", "maybe_start",
            "comm_span", "h2d", "note", "recent_rate", "sample_memory",
            "memory_breakdown", "flush", "report", "quick_stats",
            "percentile", "external_record", "checkpoint_event",
-           "serving_event", "bucketing_event"]
+           "serving_event", "bucketing_event", "alert_event"]
 
 PHASES = ("data_wait", "compute", "optimizer", "sync", "checkpoint",
           "eval")
@@ -114,6 +125,12 @@ _env_cfg = None      # cached (enabled, filename) from the environment
 # watch is off.
 _util_probe = None
 _util_reset = None
+# SLO-watchdog hooks, installed by livemetrics.enable_watchdog():
+# _watch_step receives each closed step record, _watch_serving each
+# cumulative serving snapshot — both called OUTSIDE the module lock.
+# One global None check each when the watchdog is off.
+_watch_step = None
+_watch_serving = None
 
 
 class _Run:
@@ -140,6 +157,8 @@ class _Run:
         self.ckpt = None             # checkpoint-save aggregates (lazy)
         self.serving = None          # latest cumulative serving stats
         self.bucketing = None        # per-producer cumulative bucketing
+        self.alerts = None           # SLO-watchdog alert list (lazy,
+        self.alerts_dropped = 0      # bounded to _MAX_ALERTS)
         self.fault_counters = {"skipped_steps": 0, "retries": 0,
                                "timeouts": 0}
         self.extra_counters = {}     # free-form note() names
@@ -222,6 +241,11 @@ def start(filename=None, run_id=None, meta=None):
     counters_base = profiler.counters()
     compile_watch.maybe_enable()   # MXNET_COMPILE_WATCH rides the run
     compile_watch.run_reset()      # utilization is scoped to THIS run
+    tracing.maybe_enable()         # MXNET_TRACE rides the run too
+    from . import livemetrics
+    # MXNET_METRICS_PORT / MXNET_WATCHDOG; a new run gets a FRESH
+    # watchdog so the drift baseline never spans workloads
+    livemetrics.maybe_start(fresh_run=True)
     cw = compile_watch.stats()
     cw_base = {"count": cw["compiles"],
                "total_s": cw["compile_total_s"]} if cw else None
@@ -351,6 +375,11 @@ def _close_step_locked(run, now, samples):
     run._step_fault_base = dict(run.fault_counters)
     run.ring.append(rec)
     run.records.append(rec)
+    if tracing._tracer is not None:
+        # the step's own trace span on the accounting thread's track;
+        # phase spans recorded by _Span nest inside it by containment
+        tracing.add("step", "step", now - dur, dur, tid=run._thread,
+                    args={"seq": run.steps})
     probe = _util_probe
     if probe is not None:
         util = probe(run.steps, dur)
@@ -416,6 +445,9 @@ def step_end(samples=None):
     with _lock:
         run._thread = threading.get_ident()   # tick mode: the ticking
         rec = _close_step_locked(run, now, samples)   # thread accounts
+    hook = _watch_step
+    if hook is not None and rec is not None:
+        hook(rec)                  # SLO watchdog — outside the lock
     _after_step(run)
     return rec
 
@@ -466,6 +498,15 @@ class _Span:
         return self
 
     def __exit__(self, *a):
+        if tracing._tracer is not None:
+            # the trace records EVERY span — including the nested and
+            # off-accounting-thread ones the exclusive-phase accounting
+            # (rightly) ignores: nesting shows up as time containment
+            # on the emitting thread's own track. steps + 1 = the step
+            # this span will close under, in begin/end AND tick mode
+            tracing.add(self.phase, "phase", self.t0,
+                        time.perf_counter() - self.t0,
+                        args={"step": self.run.steps + 1})
         if not self.active:
             return False
         dur = time.perf_counter() - self.t0
@@ -646,18 +687,24 @@ def serving_event(fields):
     also lands in the summary's ``serving`` block. No-op without a
     run, so a run that never serves keeps a byte-identical sink."""
     run = _run
-    if run is None:
-        return
-    rec = {"type": "serving", "seq": run.steps,
-           "t": round(time.time() - run.t0_wall, 6)}
-    rec.update(fields)
-    with _lock:
-        run.serving = dict(fields)     # cumulative: latest wins
-        run.records.append(rec)
-        # a stepless sink-less process hosting a long-lived server
-        # would otherwise grow records unboundedly (steps cap them,
-        # but a pure serving process never steps)
-        _cap_records_locked(run)
+    if run is not None:
+        rec = {"type": "serving", "seq": run.steps,
+               "t": round(time.time() - run.t0_wall, 6)}
+        rec.update(fields)
+        with _lock:
+            run.serving = dict(fields)     # cumulative: latest wins
+            run.records.append(rec)
+            # a stepless sink-less process hosting a long-lived server
+            # would otherwise grow records unboundedly (steps cap
+            # them, but a pure serving process never steps)
+            _cap_records_locked(run)
+    # the SLO watchdog observes snapshots EVEN WITHOUT a telemetry run
+    # — a pure serving process (MXNET_WATCHDOG=1, no run) still gets
+    # breach warnings and the watchdog_alerts counter; only the alert
+    # *records* need a run to land in. Called outside the lock.
+    hook = _watch_serving
+    if hook is not None:
+        hook(fields)
 
 
 def bucketing_event(fields):
@@ -683,6 +730,36 @@ def bucketing_event(fields):
         # a stepless sink-less loop (a bare data-pipeline soak) must
         # not grow records unboundedly
         _cap_records_locked(run)
+
+
+def alert_event(fields):
+    """Append one structured ``alert`` record from the SLO watchdog
+    (``mxnet_tpu.livemetrics``) — kind, message, and the breach's
+    numbers. The alert list also lands in the summary's ``alerts``
+    block and renders as the diagnose Alerts table. No-op without a
+    run, so a watchdog-off (or alert-free) run keeps a byte-identical
+    sink."""
+    run = _run
+    if run is None:
+        return
+    rec = {"type": "alert", "seq": run.steps,
+           "t": round(time.time() - run.t0_wall, 6)}
+    rec.update(fields)
+    with _lock:
+        if run.alerts is None:
+            run.alerts = []
+        run.alerts.append(dict(fields))
+        # the summary's alert list is bounded: a condition that stays
+        # in breach for days must not grow host memory — the newest
+        # window plus a drop count tells the whole story
+        if len(run.alerts) > _MAX_ALERTS:
+            run.alerts_dropped += len(run.alerts) - _MAX_ALERTS
+            del run.alerts[:len(run.alerts) - _MAX_ALERTS]
+        run.records.append(rec)
+        _cap_records_locked(run)
+
+
+_MAX_ALERTS = 256
 
 
 def note(name, delta=1):
@@ -891,6 +968,10 @@ def report():
         if run.bucketing is not None:
             out["bucketing"] = {k: dict(v)
                                 for k, v in run.bucketing.items()}
+        if run.alerts is not None:
+            out["alerts"] = [dict(a) for a in run.alerts]
+            if run.alerts_dropped:
+                out["alerts_dropped"] = run.alerts_dropped
         if run.records_dropped:
             out["records_dropped"] = run.records_dropped
         total_s = run.total_step_s

@@ -27,8 +27,12 @@ shed/timeout splits, latency percentiles, requests/sec, bucket-ladder
 occupancy, queue-depth peak vs bound, per-replica dispatch), and —
 when a shape-bucketing producer ran (``mxnet_tpu.bucketing``) — the
 Bucketing table (per-bucket batch counts, padding-overhead share,
-pad-row and discarded-sample counts per producer). This supersedes
-scraping the same facts out of log lines with ``tools/parse_log.py``.
+pad-row and discarded-sample counts per producer), and — when the SLO
+watchdog fired (``mxnet_tpu.livemetrics``, ``MXNET_WATCHDOG=1``) — the
+Alerts table (step, alert kind, breach detail). A truncated trailing
+line (a run killed mid-append) is skipped with a one-line warning;
+the rest of the report renders. This supersedes scraping the same
+facts out of log lines with ``tools/parse_log.py``.
 """
 from __future__ import annotations
 
@@ -117,13 +121,18 @@ def diagnose_backend(timeout):
 # ---------------------------------------------------------------------------
 
 def read_telemetry(path):
-    """Parse a mxnet_tpu.telemetry JSONL sink. Unparseable lines are
-    skipped (a crash can strand at most one trailing partial line).
-    A sink holding several runs (consecutive fits appending to the
-    same MXNET_TELEMETRY_FILE) yields the LAST run."""
+    """Parse a mxnet_tpu.telemetry JSONL sink. Unparseable lines —
+    including a truncated final line from a run killed mid-append, or
+    a line whose JSON prefix parses to a non-record scalar — are
+    counted into ``skipped_lines`` and skipped, never fatal: the
+    report renders everything else and warns once. A sink holding
+    several runs (consecutive fits appending to the same
+    MXNET_TELEMETRY_FILE) yields the LAST run."""
     out = {"run": None, "steps": [], "memory": [], "compiles": [],
            "utilization": [], "checkpoints": [], "serving": [],
-           "bucketing": [], "breakdown": None, "summary": None}
+           "bucketing": [], "alerts": [], "breakdown": None,
+           "summary": None}
+    skipped = 0
     with open(path) as f:
         for line in f:
             line = line.strip()
@@ -132,14 +141,24 @@ def read_telemetry(path):
             try:
                 rec = json.loads(line)
             except ValueError:
+                skipped += 1
+                continue
+            if not isinstance(rec, dict):
+                # a kill mid-append can strand a prefix that is
+                # itself valid JSON (a bare number, null) — still
+                # not a record
+                skipped += 1
                 continue
             kind = rec.get("type")
             if kind == "run_start":
                 out = {"run": rec, "steps": [], "memory": [],
                        "compiles": [], "utilization": [],
                        "checkpoints": [], "serving": [],
-                       "bucketing": [], "breakdown": None,
-                       "summary": None}
+                       "bucketing": [], "alerts": [],
+                       "breakdown": None, "summary": None}
+                skipped = 0     # earlier runs' damage is not THIS
+                                # run's — the warning describes the
+                                # run being rendered
             elif kind == "step":
                 out["steps"].append(rec)
             elif kind == "memory":
@@ -156,8 +175,11 @@ def read_telemetry(path):
                 out["serving"].append(rec)
             elif kind == "bucketing":
                 out["bucketing"].append(rec)
+            elif kind == "alert":
+                out["alerts"].append(rec)
             elif kind == "summary":
                 out["summary"] = rec
+    out["skipped_lines"] = skipped
     return out
 
 
@@ -188,6 +210,11 @@ def format_telemetry(tel):
                                     summary.get("run_id") or "?")]
     if run.get("meta"):
         lines.append("meta         : %s" % json.dumps(run["meta"]))
+    if tel.get("skipped_lines"):
+        lines.append("WARNING      : skipped %d unparseable line(s) — "
+                     "a killed run strands at most one truncated "
+                     "trailing record; the rest renders below"
+                     % tel["skipped_lines"])
 
     compiles = tel.get("compiles") or []
     lines.append("----------Step time----------")
@@ -400,6 +427,22 @@ def format_telemetry(tel):
         if sv.get("dispatch_faults"):
             lines.append("faults       : %d injected dispatch fault(s) "
                          "survived" % sv["dispatch_faults"])
+
+    # -- SLO watchdog alerts (mxnet_tpu.livemetrics) --------------------
+    alerts = tel.get("alerts") or []
+    if not alerts and summary.get("alerts"):
+        alerts = summary["alerts"]
+    if alerts:
+        lines.append("----------Alerts----------")
+        lines.append("%6s %-20s %s" % ("step", "kind", "detail"))
+        for a in alerts:
+            lines.append("%6s %-20s %s"
+                         % (a.get("seq", "-"),
+                            (a.get("kind") or "?")[:20],
+                            a.get("message", "")))
+        lines.append("%d alert(s) fired by the SLO watchdog "
+                     "(MXNET_WATCHDOG=1; thresholds via "
+                     "MXNET_WATCHDOG_* envs)" % len(alerts))
 
     # -- shape bucketing (mxnet_tpu.bucketing) --------------------------
     buck_recs = tel.get("bucketing") or []

@@ -1,0 +1,350 @@
+"""End-to-end request/step tracing with Perfetto-loadable export —
+the live, per-event half of the observability stack (the reference
+framework's ``MXNET_PROFILER_*`` chrome://tracing dumps, grown to
+cover causality across threads and subsystems).
+
+The telemetry layer (PR 3) aggregates: phase totals, percentiles,
+counters — you learn *how much*, never *which one*. This module
+records *events*: every serving request gets a trace id at
+``InferenceServer.submit`` and causally-linked spans across its whole
+lifetime (queue wait → batch formation → replica dispatch → pad →
+device compute → slice/respond), and every training step gets a step
+span with its phase spans nested inside — now *including* the
+off-thread work telemetry's exclusive-phase accounting deliberately
+excludes: async-input-pipeline decode and H2D placement, and the
+checkpoint writer's durable saves, each parented to the step that
+triggered them via an explicit context token captured on the
+triggering thread (:func:`context`), never via thread identity.
+Compile events (``compile_watch``) and gradient-sync bucket events
+(``parallel/grad_sync``) land as duration/instant events on their own
+tracks.
+
+Storage is a bounded ring (``MXNET_TRACE_RING`` events, default
+200000): a week-long run keeps the most recent window, and
+:func:`stats` reports how many events the bound dropped.
+:func:`export` writes the ring as Chrome trace-event JSON
+(``{"traceEvents": [...]}``) loadable in Perfetto / chrome://tracing —
+``X`` complete events nest by time containment per track, serving
+requests each get their own named synthetic track, and the write is
+atomic (tmp + ``os.replace``).
+
+Always cheap when off — the telemetry discipline: every hook is one
+module-global ``None`` check, and :func:`span` returns a shared no-op
+singleton (zero allocation). Enable with ``MXNET_TRACE=1`` (picked up
+at ``telemetry.start``) or explicitly via :func:`enable`; set
+``MXNET_TRACE_FILE`` to auto-export at ``disable``/atexit.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from collections import deque
+
+from .base import get_env
+
+__all__ = ["enabled", "enable", "disable", "reset", "maybe_enable",
+           "now", "add", "instant", "span", "context", "track",
+           "export", "stats"]
+
+_tracer = None          # the active _Trace; module-global None check
+_lock = threading.Lock()
+
+
+class _Trace:
+    """One tracing session's ring + track table. Event appends run
+    under the module lock (producers live on many threads)."""
+
+    def __init__(self):
+        self.t0 = time.perf_counter()
+        self.t0_wall = time.time()
+        self.events = deque(
+            maxlen=max(1, get_env("MXNET_TRACE_RING", 200000, int)))
+        self.dropped = 0
+        self.pid = os.getpid()
+        # synthetic tracks (per-request, compile, grad_sync, ...) get
+        # small ids; real threads use their ident — the two ranges
+        # cannot collide in practice (thread idents are pointers).
+        # The table is BOUNDED (MXNET_TRACE_TRACKS) with LRU
+        # eviction: a long-lived traced server mints one track per
+        # request, and the most-recently-USED labels win — hot
+        # system tracks stay named while cold one-shot per-request
+        # labels age out; events whose label was evicted (and whose
+        # spans have usually rotated out of the ring anyway) export
+        # under their bare numeric tid
+        self.tracks = {}          # label -> tid (insertion-ordered)
+        self.max_tracks = max(
+            16, get_env("MXNET_TRACE_TRACKS", 4096, int))
+        self.next_tid = 1
+
+
+class _NullSpan:
+    """Shared no-op span — the whole cost of :func:`span` when tracing
+    is off. Zero allocation: one module-level singleton."""
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        return False
+
+
+_NULL = _NullSpan()
+
+
+# ---------------------------------------------------------------------------
+# lifecycle
+# ---------------------------------------------------------------------------
+
+def enabled():
+    """True while tracing is active."""
+    return _tracer is not None
+
+
+def enable():
+    """Turn tracing on (idempotent). Returns the tracer."""
+    global _tracer, _atexit_registered
+    with _lock:
+        if _tracer is None:
+            _tracer = _Trace()
+    if not _atexit_registered:
+        _atexit_registered = True
+        import atexit
+        atexit.register(_atexit_export)
+    return _tracer
+
+
+_atexit_registered = False
+
+
+def _atexit_export():
+    """Export to MXNET_TRACE_FILE at interpreter exit for runs that
+    never call disable()/export() themselves."""
+    fname = os.environ.get("MXNET_TRACE_FILE", "").strip()
+    if _tracer is not None and fname:
+        try:
+            export(fname)
+        except OSError:
+            pass
+
+
+def disable():
+    """Turn tracing off. When ``MXNET_TRACE_FILE`` is set the ring is
+    exported there first. Returns the export path (or None)."""
+    global _tracer
+    fname = os.environ.get("MXNET_TRACE_FILE", "").strip() or None
+    out = None
+    if _tracer is not None and fname:
+        try:
+            out = export(fname)
+        except OSError:
+            out = None
+    with _lock:
+        _tracer = None
+    return out
+
+
+def reset():
+    """Forget the tracer entirely (tests)."""
+    global _tracer
+    with _lock:
+        _tracer = None
+
+
+def maybe_enable():
+    """Enable when the environment asks (``MXNET_TRACE=1`` or
+    ``MXNET_TRACE_FILE`` set) — called from ``telemetry.start`` so
+    tracing rides a run the way the compile watch does. Returns True
+    when active after the call."""
+    if _tracer is not None:
+        return True
+    on = os.environ.get("MXNET_TRACE", "").strip().lower() \
+        in ("1", "true", "on", "yes")
+    if on or os.environ.get("MXNET_TRACE_FILE", "").strip():
+        enable()
+        return True
+    return False
+
+
+# ---------------------------------------------------------------------------
+# recording
+# ---------------------------------------------------------------------------
+
+def now():
+    """The tracer's clock (``time.perf_counter`` — the same clock
+    telemetry stamps with, so step/phase/trace timestamps agree)."""
+    return time.perf_counter()
+
+
+def track(label):
+    """The synthetic track (Chrome ``tid``) named ``label``; the name
+    is attached at export as a ``thread_name`` metadata event so
+    Perfetto shows the label. The label table is bounded at
+    ``MXNET_TRACE_TRACKS`` with LRU eviction — the most-recently-used
+    labels keep their names (perpetually-hot system tracks stay
+    resident; cold one-shot per-request labels age out, mirroring the
+    event ring's newest-wins bound); an evicted label's events (if
+    any still survive in the ring) export under a bare numeric tid,
+    with their args (request ids etc.) still carrying the identity.
+    None when tracing is off."""
+    t = _tracer
+    if t is None:
+        return None
+    with _lock:
+        tid = t.tracks.pop(label, None)
+        if tid is None:
+            if len(t.tracks) >= t.max_tracks:
+                # LRU evict: the pop/re-insert below refreshes every
+                # hit, so perpetually-hot system tracks (compile,
+                # grad_sync, io:*) stay resident while cold one-shot
+                # per-request labels age out
+                del t.tracks[next(iter(t.tracks))]
+            tid = t.next_tid
+            t.next_tid += 1
+        t.tracks[label] = tid          # (re-)insert at the MRU end
+        return tid
+
+
+def _append_locked(t, ev):
+    """Ring append; caller holds the lock. A full ring drops the
+    OLDEST event (deque maxlen) and counts the drop."""
+    if len(t.events) == t.events.maxlen:
+        t.dropped += 1
+    t.events.append(ev)
+
+
+def _append(t, ev):
+    with _lock:
+        _append_locked(t, ev)
+
+
+def add(name, cat, t_start, dur_s, tid=None, args=None):
+    """Record one complete (``X``) event: ``t_start`` is a
+    :func:`now` stamp, ``dur_s`` seconds. ``tid`` is a real thread
+    ident or a :func:`track` id (default: the calling thread). No-op
+    when tracing is off."""
+    t = _tracer
+    if t is None:
+        return
+    ev = {"name": name, "cat": cat, "ph": "X",
+          "ts": round((t_start - t.t0) * 1e6, 3),
+          "dur": round(max(dur_s, 0.0) * 1e6, 3),
+          "pid": t.pid,
+          "tid": tid if tid is not None else threading.get_ident()}
+    if args:
+        ev["args"] = args
+    _append(t, ev)
+
+
+def instant(name, cat, tid=None, args=None, t_at=None):
+    """Record one instant (``i``) event at ``t_at`` (default now)."""
+    t = _tracer
+    if t is None:
+        return
+    ev = {"name": name, "cat": cat, "ph": "i", "s": "t",
+          "ts": round(((t_at if t_at is not None
+                        else time.perf_counter()) - t.t0) * 1e6, 3),
+          "pid": t.pid,
+          "tid": tid if tid is not None else threading.get_ident()}
+    if args:
+        ev["args"] = args
+    _append(t, ev)
+
+
+class _Span:
+    __slots__ = ("name", "cat", "tid", "args", "t0")
+
+    def __init__(self, name, cat, tid, args):
+        self.name = name
+        self.cat = cat
+        self.tid = tid
+        self.args = args
+
+    def __enter__(self):
+        self.t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *a):
+        add(self.name, self.cat, self.t0,
+            time.perf_counter() - self.t0, tid=self.tid,
+            args=self.args)
+        return False
+
+
+def span(name, cat="span", tid=None, args=None):
+    """A context manager recording one ``X`` event around its body.
+    The shared no-op singleton when tracing is off."""
+    if _tracer is None:
+        return _NULL
+    return _Span(name, cat, tid, args)
+
+
+def context():
+    """The current causal context, captured ON THE TRIGGERING THREAD
+    and passed to off-thread work (checkpoint writer, decode pool) so
+    its spans are parented to the step that triggered them by an
+    explicit token, never by thread identity. Returns ``{"step": N}``
+    (N = the open/most recent telemetry step) or None when tracing is
+    off / no run is active."""
+    if _tracer is None:
+        return None
+    from . import telemetry
+    run = telemetry._run
+    if run is None:
+        return None
+    # the step this work will CLOSE under: run.steps counts closed
+    # steps, and both step_begin/step_end mode (the open step) and
+    # gluon tick mode (everything between boundaries closes at the
+    # next tick) resolve to steps + 1. Advisory read, no lock — the
+    # token is trace metadata, not accounting.
+    return {"step": run.steps + 1}
+
+
+# ---------------------------------------------------------------------------
+# export
+# ---------------------------------------------------------------------------
+
+def stats():
+    """{"events", "dropped", "tracks"} of the live ring; None when
+    tracing is off."""
+    t = _tracer
+    if t is None:
+        return None
+    with _lock:
+        return {"events": len(t.events), "dropped": t.dropped,
+                "tracks": len(t.tracks)}
+
+
+def export(path=None):
+    """Export the ring as Chrome trace-event JSON. With ``path``,
+    write atomically (tmp + ``os.replace``) and return the path;
+    without, return the trace dict. Loadable in Perfetto
+    (https://ui.perfetto.dev) and chrome://tracing. Raises
+    RuntimeError when tracing was never enabled."""
+    t = _tracer
+    if t is None:
+        raise RuntimeError("tracing.export: tracing is not enabled")
+    with _lock:
+        # track-name metadata is synthesized from the label table at
+        # export time, NOT stored in the ring — a week-long run whose
+        # ring rotated a million times still exports every surviving
+        # event under a named track
+        names = [{"name": "thread_name", "ph": "M", "pid": t.pid,
+                  "tid": tid, "args": {"name": label}}
+                 for label, tid in sorted(t.tracks.items(),
+                                          key=lambda kv: kv[1])]
+        events = names + list(t.events)
+        dropped = t.dropped
+        meta = {"pid": t.pid, "trace_t0_wall": t.t0_wall,
+                "dropped_events": dropped}
+    trace = {"traceEvents": events, "displayTimeUnit": "ms",
+             "otherData": meta}
+    if path is None:
+        return trace
+    tmp = "%s.%d.tmp" % (path, os.getpid())
+    with open(tmp, "w") as f:
+        json.dump(trace, f)
+    os.replace(tmp, path)
+    return path

@@ -1,0 +1,266 @@
+"""The live-metrics stack (mxnet_tpu.livemetrics): the /metrics
+Prometheus endpoint (scrape parses, figures agree with
+telemetry.report() and server.stats()) and the SLO watchdog (fires
+deterministically under an injected slow-step fault plan, stays
+silent on a clean run, alerts render as the diagnose Alerts table)."""
+import json
+import re
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import fault, livemetrics, telemetry, tracing
+from mxnet_tpu.serving import InferenceServer
+
+
+@pytest.fixture(autouse=True)
+def _clean_state():
+    fault.reset()
+    telemetry.reset()
+    tracing.reset()
+    livemetrics.disable_watchdog()
+    yield
+    fault.reset()
+    telemetry.reset()
+    tracing.reset()
+    livemetrics.disable_watchdog()
+    livemetrics.stop_server()
+
+
+_LINE = re.compile(
+    r"^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^}]*\})? [-+0-9.eEinfa]+$")
+
+
+def _scrape(port):
+    return urllib.request.urlopen(
+        "http://127.0.0.1:%d/metrics" % port, timeout=10).read() \
+        .decode("utf-8")
+
+
+def _parse(text):
+    """Minimal Prometheus text parser: {(name, labels-str): value}."""
+    out = {}
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        assert _LINE.match(line), line
+        key, value = line.rsplit(" ", 1)
+        out[key] = float(value)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# /metrics endpoint
+# ---------------------------------------------------------------------------
+
+def test_metrics_scrape_parses_and_agrees_with_report():
+    telemetry.start()
+    for _ in range(5):
+        telemetry.step_begin()
+        with telemetry.span("compute"):
+            pass
+        telemetry.step_end(samples=8)
+    port = livemetrics.serve(0)
+    vals = _parse(_scrape(port))
+    rep = telemetry.report()
+    assert vals["mxnet_steps_total"] == rep["steps"] == 5
+    assert vals["mxnet_samples_total"] == rep["samples"] == 40
+    assert vals["mxnet_telemetry_run_active"] == 1
+    assert vals['mxnet_step_time_ms{quantile="p50"}'] == \
+        pytest.approx(rep["step_time_ms"]["p50"])
+    assert vals['mxnet_phase_ms_total{phase="compute"}'] == \
+        pytest.approx(rep["phases_ms"]["compute"])
+    telemetry.stop()
+    # a stopped run still scrapes (last-run semantics), flagged inactive
+    vals = _parse(_scrape(port))
+    assert vals["mxnet_telemetry_run_active"] == 0
+    assert vals["mxnet_steps_total"] == 5
+
+
+def test_metrics_serving_counters_match_server_stats():
+    srv = InferenceServer(lambda x: x * 3.0, max_batch=4, max_queue=8,
+                          batch_window_ms=0.5, name="m1")
+    port = livemetrics.serve(0)
+    try:
+        futs = [srv.submit(np.ones((2,), np.float32))
+                for _ in range(7)]
+        for f in futs:
+            f.result(timeout=30)
+        st = srv.stats()
+        text = _scrape(port)
+        vals = _parse(text)
+        lab = '{server="m1"}'
+        assert vals["mxnet_serving_completed_total" + lab] == \
+            st["completed"] == 7
+        assert vals["mxnet_serving_shed_total" + lab] == st["shed"]
+        assert vals["mxnet_serving_latency_ms"
+                    '{quantile="p99",server="m1"}'] == \
+            pytest.approx(st["latency_ms"]["p99"])
+        # histogram: cumulative le buckets, +Inf count == ring size
+        lats = srv.latency_snapshot()
+        assert vals["mxnet_serving_latency_recent_ms_bucket"
+                    '{le="+Inf",server="m1"}'] == len(lats) == 7
+        # per-le cumulative monotonicity
+        les = [(float(m.group(1)), v) for k, v in vals.items()
+               if (m := re.search(r'le="([0-9.]+)"', k))
+               and "latency_recent" in k and 'server="m1"' in k]
+        les.sort()
+        assert all(a[1] <= b[1] for a, b in zip(les, les[1:]))
+    finally:
+        srv.stop()
+
+
+def test_metrics_endpoint_404_off_path():
+    port = livemetrics.serve(0)
+    with pytest.raises(urllib.error.HTTPError):
+        urllib.request.urlopen("http://127.0.0.1:%d/nope" % port,
+                               timeout=10)
+
+
+# ---------------------------------------------------------------------------
+# SLO watchdog
+# ---------------------------------------------------------------------------
+
+def _drive_steps(n, site=None, sleep_s=0.002):
+    # steps carry a real, uniform duration: with near-zero steps the
+    # 1.5x drift threshold is a few microseconds and scheduler noise
+    # from neighboring tests' daemon threads can breach it spuriously
+    import time
+    for _ in range(n):
+        telemetry.step_begin()
+        time.sleep(sleep_s)
+        if site:
+            fault.inject(site)
+        telemetry.step_end(samples=1)
+
+
+def test_watchdog_step_drift_fires_on_injected_slow_steps(
+        tmp_path, monkeypatch, capsys):
+    """The deterministic slow-step regression: a planned ``stall`` at
+    a per-step site makes every step past the baseline window ~30 ms
+    slower; the drift detector must fire exactly once and the alert
+    must land in the sink, the summary, and the diagnose table."""
+    monkeypatch.setenv("MXNET_WATCHDOG_BASELINE", "10")
+    monkeypatch.setenv("MXNET_WATCHDOG_WINDOW", "5")
+    monkeypatch.setenv("MXNET_WATCHDOG_SUSTAIN", "3")
+    monkeypatch.setenv("MXNET_FAULT_HANG_SECONDS", "0.03")
+    # visits 1..10 clean (the baseline), 11+ stalled 30 ms each
+    fault.set_plan("wait:step=11:stall:count=inf")
+    sink = str(tmp_path / "run.jsonl")
+    wd = livemetrics.enable_watchdog()
+    telemetry.start(filename=sink)
+    with pytest.warns(UserWarning, match="step_time_drift"):
+        _drive_steps(30, site="wait")
+    summary = telemetry.stop()
+    assert wd.alerts() == {"step_time_drift": 1}
+    alerts = summary["alerts"]
+    assert len(alerts) == 1 and alerts[0]["kind"] == "step_time_drift"
+    assert alerts[0]["ratio"] > 1.5
+    with open(sink) as f:
+        kinds = [json.loads(line)["type"] for line in f]
+    assert kinds.count("alert") == 1
+    from mxnet_tpu.tools import diagnose
+    diagnose.main([sink])
+    out = capsys.readouterr().out
+    assert "----------Alerts----------" in out
+    assert "step_time_drift" in out
+    assert "1 alert(s) fired" in out
+
+
+def test_watchdog_silent_on_clean_run(tmp_path, monkeypatch):
+    monkeypatch.setenv("MXNET_WATCHDOG_BASELINE", "10")
+    monkeypatch.setenv("MXNET_WATCHDOG_WINDOW", "5")
+    monkeypatch.setenv("MXNET_WATCHDOG_SUSTAIN", "3")
+    sink = str(tmp_path / "run.jsonl")
+    wd = livemetrics.enable_watchdog()
+    telemetry.start(filename=sink)
+    _drive_steps(30)
+    summary = telemetry.stop()
+    assert wd.alerts() == {}
+    assert "alerts" not in summary
+    with open(sink) as f:
+        kinds = {json.loads(line)["type"] for line in f}
+    assert "alert" not in kinds
+
+
+def test_watchdog_shed_rate_breach_under_injected_overload(
+        monkeypatch):
+    """Overload driven deterministically: dispatch stalled by a
+    planned hang, a bounded queue of 2, a burst of submits — the
+    final serving snapshot shows a shed rate far past the threshold
+    and the watchdog alerts."""
+    monkeypatch.setenv("MXNET_FAULT_HANG_SECONDS", "0.005")
+    monkeypatch.setenv("MXNET_WATCHDOG_MIN_REQUESTS", "5")
+    wd = livemetrics.enable_watchdog()
+    telemetry.start()
+    srv = InferenceServer(lambda x: x, max_batch=2, max_queue=2,
+                          batch_window_ms=0.0)
+    # seed the watchdog's per-server baseline (a live server emits
+    # periodic snapshots from birth — the first one only seeds, so a
+    # pre-watchdog shed history can never fire a spurious alert)
+    telemetry.serving_event(srv.stats())
+    fault.set_plan("serve_dispatch:step=1:hang:count=inf")
+    try:
+        x = np.zeros((2,), np.float32)
+        shed = 0
+        for _ in range(12):
+            try:
+                srv.submit(x, deadline_ms=1)
+            except mx.serving.ServerOverloadedError:
+                shed += 1
+        assert shed >= 5
+        # the snapshot taken WHILE overloaded (queue pinned at its
+        # bound) flows through the same serving_event surface the
+        # server's periodic records use
+        with pytest.warns(UserWarning):
+            telemetry.serving_event(srv.stats())
+    finally:
+        fault.set_plan(None)
+        srv.stop(drain=False)
+    telemetry.stop()
+    fired = wd.alerts()
+    assert "serving_shed_rate" in fired
+    assert "serving_queue_full" in fired     # depth pinned at bound
+
+
+def test_watchdog_replica_skew_straggler(monkeypatch):
+    """The straggler primitive: one replica's mean service time far
+    above the replica median fires replica_skew naming the replica."""
+    telemetry.start()
+    wd = livemetrics.enable_watchdog()
+    with pytest.warns(UserWarning, match="replica_skew"):
+        wd.on_serving({"requests": 50, "shed": 0, "queue_depth": 0,
+                       "max_queue": 64,
+                       "replica_batches": [10, 10, 10],
+                       "replica_service_ms": [5.0, 5.5, 40.0]})
+    summary = telemetry.stop()
+    assert wd.alerts() == {"replica_skew": 1}
+    alert = summary["alerts"][0]
+    assert alert["replica"] == 2
+    assert alert["ratio"] > 2.0
+
+
+def test_watchdog_hysteresis_rearms_on_clear(monkeypatch):
+    """A persistent breach alerts ONCE on entry (no per-snapshot
+    spam); after the condition clears, the next breach re-fires —
+    with the warning itself emitted only on the first occurrence of
+    the kind."""
+    telemetry.start()
+    wd = livemetrics.enable_watchdog()
+    snap = {"requests": 50, "shed": 0, "queue_depth": 60,
+            "max_queue": 64, "replica_batches": [],
+            "replica_service_ms": []}
+    with pytest.warns(UserWarning, match="serving_queue_full"):
+        wd.on_serving(dict(snap))
+    import warnings as _w
+    with _w.catch_warnings():
+        _w.simplefilter("error")       # neither may warn again:
+        wd.on_serving(dict(snap, requests=60))       # still breached
+        wd.on_serving(dict(snap, requests=70, queue_depth=0))  # clear
+        wd.on_serving(dict(snap, requests=80, queue_depth=64))  # re-
+    summary = telemetry.stop()                       # breach: re-fire
+    assert wd.alerts()["serving_queue_full"] == 2
+    assert len(summary["alerts"]) == 2

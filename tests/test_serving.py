@@ -339,6 +339,46 @@ def test_no_server_keeps_sink_byte_identical(tmp_path):
     assert "serving" not in kinds
 
 
+def test_request_ids_assigned_and_in_profiler_counters(tmp_path,
+                                                       monkeypatch):
+    """Every submit gets a server-assigned request_id (returned on the
+    future, present in shed/timeout messages — test_tracing covers the
+    join against traces), and the shed/timeout/dispatch counters land
+    in profiler.counters() alongside the fused-step ones so both
+    surfaces report one set of numbers."""
+    from mxnet_tpu import profiler
+    monkeypatch.setenv("MXNET_FAULT_HANG_SECONDS", "0.01")
+    base = profiler.counters()
+    pred = _mlp_artifact(str(tmp_path / "m.mxp"), batch_sizes=[4])
+    srv = InferenceServer(pred, max_queue=2, batch_window_ms=0.0)
+    # a FINITE hang count: the ≥30 ms dispatch stall outlives every
+    # 1 ms deadline, then the plan exhausts on its own — clearing it
+    # manually would race the batcher's first pass
+    fault.set_plan("serve_dispatch:step=1:hang:count=3")
+    try:
+        x = np.zeros((12,), np.float32)
+        futs = [srv.submit(x, deadline_ms=1) for _ in range(2)]
+        assert [f.request_id for f in futs] == ["r000001", "r000002"]
+        with pytest.raises(ServerOverloadedError) as exc:
+            srv.submit(x)
+        assert "r000003" in str(exc.value)
+        for f in futs:
+            with pytest.raises(RequestTimeoutError) as texc:
+                f.result(timeout=30)
+            assert f.request_id in str(texc.value)
+        srv.predict(x, timeout=30)            # a served batch
+    finally:
+        fault.set_plan(None)
+        srv.stop()
+    ctr = profiler.counters()
+
+    def delta(name):
+        return ctr.get(name, 0) - base.get(name, 0)
+    assert delta("serving_shed") == 1
+    assert delta("serving_timeouts") == 2
+    assert delta("serving_dispatches") >= 1
+
+
 def test_stop_drain_serves_queued_requests(tmp_path):
     pred = _mlp_artifact(str(tmp_path / "m.mxp"), batch_sizes=[8])
     srv = InferenceServer(pred, max_queue=64, batch_window_ms=20.0)

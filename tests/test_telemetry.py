@@ -412,6 +412,33 @@ def test_multi_worker_sink_gets_per_worker_file(tmp_path, monkeypatch):
     assert not os.path.exists(sink)
 
 
+def test_diagnose_truncated_final_line_renders_rest(tmp_path, capsys):
+    """A run killed mid-append strands a truncated trailing line —
+    the report must warn once and render every intact record, never
+    abort (regression: a truncated prefix that parses as a bare JSON
+    scalar used to crash rec.get)."""
+    sink = str(tmp_path / "run.jsonl")
+    telemetry.start(filename=sink)
+    for _ in range(3):
+        telemetry.step_begin()
+        telemetry.step_end(samples=4)
+    telemetry.stop()
+    with open(sink) as f:
+        intact = f.read()
+    # a killed appender: one mid-record truncation, and one that
+    # happens to be valid JSON but not a record
+    with open(sink, "w") as f:
+        f.write(intact + '{"type": "step", "se\n12\n')
+    from mxnet_tpu.tools import diagnose as diag_mod
+    tel = diag_mod.read_telemetry(sink)
+    assert tel["skipped_lines"] == 2
+    assert len(tel["steps"]) == 3
+    diag_mod.main([sink])
+    out = capsys.readouterr().out
+    assert "skipped 2 unparseable line(s)" in out
+    assert "steps        : 3" in out
+
+
 def test_diagnose_missing_sink_friendly_error(capsys):
     from mxnet_tpu.tools import diagnose as diag_mod
     with pytest.raises(SystemExit) as exc:
