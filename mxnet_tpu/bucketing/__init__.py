@@ -20,6 +20,11 @@ warns about. This package bounds the program cache to a small
 - :mod:`iter` — :class:`BucketedPipeline`, grouping any ragged sample
   stream into ladder buckets under a bounded straggler window,
   pluggable into the async input pipeline;
+- :mod:`packing` — :class:`PackedPipeline` and the FFD packer:
+  several short samples share ONE bucket row (segment-id/position
+  planes, per-segment losses via :class:`PackedSoftmaxCELoss`,
+  segment-blocked attention masks), recovering the FLOPs padding
+  burns while keeping the same exactness contract;
 - :mod:`record` — the cumulative ``bucketing`` telemetry record
   (per-bucket step counts, padding-overhead share, discards) rendered
   by the diagnose Bucketing table.
@@ -34,8 +39,12 @@ from .ladder import (ShapeLadder, BucketLadder, as_ladder,
 from .padding import (pad_batch, slice_rows, pad_samples,
                       position_mask, slice_valid)
 from .masked import (MaskedSoftmaxCELoss, MaskedL2Loss,
+                     PackedSoftmaxCELoss, PackedL2Loss,
                      masked_batch_loss, MaskedMetric)
 from .iter import BucketedPipeline
+from .packing import (PackedPipeline, pack_samples, unpack,
+                      first_fit_decreasing, segment_masks,
+                      segment_gather, segment_attention_mask)
 from .record import BucketingStats
 
 __all__ = [
@@ -43,7 +52,9 @@ __all__ = [
     "bucket_site", "format_bucket",
     "pad_batch", "slice_rows", "pad_samples", "position_mask",
     "slice_valid",
-    "MaskedSoftmaxCELoss", "MaskedL2Loss", "masked_batch_loss",
-    "MaskedMetric",
+    "MaskedSoftmaxCELoss", "MaskedL2Loss", "PackedSoftmaxCELoss",
+    "PackedL2Loss", "masked_batch_loss", "MaskedMetric",
     "BucketedPipeline", "BucketingStats",
+    "PackedPipeline", "pack_samples", "unpack", "first_fit_decreasing",
+    "segment_masks", "segment_gather", "segment_attention_mask",
 ]

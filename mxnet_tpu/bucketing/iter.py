@@ -33,6 +33,8 @@ bucket.
 """
 from __future__ import annotations
 
+import warnings
+
 import numpy as np
 
 from ..base import MXNetError, get_env
@@ -108,6 +110,8 @@ class BucketedPipeline(DataIter):
         self._source = source
         self._iter = None
         self._exhausted = False
+        self._warned_discard = False
+        self._max_seen = 0        # longest sample length drawn so far
         self._pending = {}        # rung -> [(data, label), ...]
         self._age = {}            # rung -> samples drawn since first
         # peek one sample so provide_data knows the non-sequence dims
@@ -164,7 +168,8 @@ class BucketedPipeline(DataIter):
 
     def _draw(self):
         """Pull the next usable sample off the stream (discarding
-        over-long ones, counted); None at stream end."""
+        over-long ones, counted AND warned once); None at stream
+        end."""
         while True:
             try:
                 sample = next(self._iter)
@@ -172,9 +177,29 @@ class BucketedPipeline(DataIter):
                 return None
             data, label = self._split_sample(sample)
             length = int(data.shape[self.seq_axis])
+            if length > self._max_seen:
+                self._max_seen = length
             rung = self.ladder.bucket_for(length)
             if rung is None:
                 self.stats.note_discard()
+                if not self._warned_discard:
+                    # dropping data silently is how a "converging"
+                    # run quietly trains on a truncated distribution —
+                    # say it once, with the numbers needed to size a
+                    # taller ladder (the counter keeps the full tally)
+                    self._warned_discard = True
+                    top = self.ladder.max_batch
+                    warnings.warn(
+                        "%s: a length-%d sample exceeds the ladder "
+                        "top %d and was DISCARDED (largest seen so "
+                        "far: %d). Raise the ladder (e.g. a %d rung) "
+                        "or pre-truncate; the bucketing telemetry "
+                        "record counts every discard."
+                        % (self.stats.name or "BucketedPipeline",
+                           length, top, self._max_seen,
+                           self._max_seen), stacklevel=3)
+                    from .. import telemetry
+                    telemetry.note("bucketing_overladder_discard")
                 continue
             if self._sample_rest is None:
                 rest = list(data.shape)

@@ -69,13 +69,35 @@ class ShapeLadder:
         self.ndim = len(shapes[0])
 
     @classmethod
-    def geometric(cls, max_shape, min_shape=None, factor=2):
+    def geometric(cls, max_shape, min_shape=None, factor=2, cap=None):
         """Per-dim geometric rungs (min, min*factor, ... capped at and
         always including max), crossed into the bucket set. With one
-        dim this is exactly ``BucketLadder.geometric``."""
+        dim this is exactly ``BucketLadder.geometric``.
+
+        ``cap`` (an int for every dim, or a per-dim tuple) clamps the
+        top rung: geometric growth from a generous ``max_shape``
+        easily emits rungs far beyond anything the data contains, and
+        every phantom rung is a full XLA program a ``warmup()`` then
+        compiles for nothing — pass the observed maximum to stop the
+        ladder there."""
         if isinstance(max_shape, numbers.Integral):
             max_shape = (max_shape,)
         max_shape = tuple(int(d) for d in max_shape)
+        if cap is not None:
+            if isinstance(cap, numbers.Integral):
+                cap = (cap,) * len(max_shape)
+            cap = tuple(int(c) for c in cap)
+            if len(cap) != len(max_shape):
+                raise MXNetError(
+                    "ShapeLadder.geometric: cap rank %d does not "
+                    "match max_shape rank %d"
+                    % (len(cap), len(max_shape)))
+            if any(c < 1 for c in cap):
+                raise MXNetError(
+                    "ShapeLadder.geometric: cap dims must be "
+                    "positive, got %s" % (cap,))
+            max_shape = tuple(min(d, c)
+                              for d, c in zip(max_shape, cap))
         if min_shape is None:
             min_shape = (1,) * len(max_shape)
         elif isinstance(min_shape, numbers.Integral):
@@ -162,10 +184,18 @@ class BucketLadder(ShapeLadder):
         self.buckets = bs               # the public integer view
 
     @classmethod
-    def geometric(cls, max_batch, min_batch=1, factor=2):
+    def geometric(cls, max_batch, min_batch=1, factor=2, cap=None):
         """min_batch, min_batch*factor, ... capped at (and always
-        including) max_batch."""
+        including) max_batch; ``cap`` clamps the top rung (see
+        :meth:`ShapeLadder.geometric`)."""
         max_batch = int(max_batch)
+        if cap is not None:
+            cap = int(cap)
+            if cap < 1:
+                raise MXNetError(
+                    "BucketLadder.geometric: cap must be positive, "
+                    "got %s" % cap)
+            max_batch = min(max_batch, cap)
         b = int(min_batch)
         if b < 1 or max_batch < b:
             raise MXNetError(
@@ -231,7 +261,17 @@ def ladder_from_env(var="MXNET_BUCKET_LADDER", default=None):
                 "or shapes like '4x16,8x32')" % (var, tok))
     if not rungs:
         raise MXNetError("%s: no rungs in %r" % (var, raw))
-    return as_ladder(rungs)
+    try:
+        return as_ladder(rungs)
+    except MXNetError as exc:
+        # a parsed-but-invalid ladder (mixed ranks "8,4x16", a zero
+        # dim "0x8") must name the env var the operator has to fix,
+        # not just the internal constructor's complaint
+        raise MXNetError("%s=%r: %s" % (var, raw, exc))
+    except (TypeError, ValueError) as exc:
+        raise MXNetError(
+            "%s=%r is not a valid ladder (%s: %s)"
+            % (var, raw, type(exc).__name__, exc))
 
 
 def format_bucket(key):

@@ -38,7 +38,8 @@ from ..base import MXNetError
 from ..gluon.loss import Loss as _GluonLoss
 from ..metric import EvalMetric, create as _metric_create
 
-__all__ = ["MaskedSoftmaxCELoss", "MaskedL2Loss", "masked_batch_loss",
+__all__ = ["MaskedSoftmaxCELoss", "MaskedL2Loss",
+           "PackedSoftmaxCELoss", "PackedL2Loss", "masked_batch_loss",
            "MaskedMetric"]
 
 
@@ -56,7 +57,9 @@ class _MaskedLoss(_GluonLoss):
 
     def hybrid_forward(self, F, pred, label, mask):
         per_pos = self._penalty(F, pred, label)
-        mask = mask.reshape(per_pos.shape)
+        # reshape_like, not .reshape(per_pos.shape): the loss must
+        # hybridize (Symbols have no concrete .shape)
+        mask = F.reshape_like(mask, per_pos)
         per_pos = per_pos * mask
         loss = F.sum(per_pos, axis=self._batch_axis, exclude=True)
         count = F.sum(mask, axis=self._batch_axis, exclude=True)
@@ -89,8 +92,50 @@ class MaskedL2Loss(_MaskedLoss):
     convention's 0.5 factor included)."""
 
     def _penalty(self, F, pred, label):
-        label = label.reshape(pred.shape)
+        label = F.reshape_like(label, pred)
         return F.square(label - pred) * 0.5
+
+
+class _PackedLoss(_MaskedLoss):
+    """Per-SAMPLE losses out of a PACKED batch, where one row holds
+    several samples: the pointwise penalty is computed on the packed
+    layout, then ``packing.segment_gather``'s indices rearrange it to
+    the PADDED layout (sample ``s`` on row ``s`` at offset 0) before
+    the per-row masked reduction — from there the computation is
+    byte-for-byte the :class:`_MaskedLoss` pipeline, so per-sample
+    losses AND gradients equal the padded (and unpadded) values
+    bit-exactly at any bucket length (an in-place masked reduction
+    would drift by an ulp once the row reduction vectorizes: a
+    sample's terms would group by its row offset). Feed the resulting
+    vector to :func:`masked_batch_loss` with ``n_valid = n_segments``
+    exactly like the padded path."""
+
+    def hybrid_forward(self, F, pred, label, indices, mask):
+        per_pos = self._penalty(F, pred, label)      # (rows, L)
+        # to the padded layout: (n_segments, L), sample s at offset 0
+        per_pos = F.gather_nd(per_pos, indices) * mask
+        loss = F.sum(per_pos, axis=self._batch_axis, exclude=True)
+        count = F.sum(mask, axis=self._batch_axis, exclude=True)
+        # absent segments: 0 / max(0, 1) = exactly 0, never NaN
+        loss = loss / F.broadcast_maximum(count, count * 0 + 1.0)
+        if self._weight is not None:
+            loss = loss * self._weight
+        return loss
+
+
+class PackedSoftmaxCELoss(_PackedLoss, MaskedSoftmaxCELoss):
+    """Per-position sparse softmax cross-entropy over a packed batch.
+    ``pred`` is ``(rows, positions, classes)`` logits, ``label`` is
+    ``(rows, positions)`` (``invalid_label`` at pad positions is fine
+    — those positions never survive the gather's mask), and
+    ``indices``/``mask`` come from ``packing.segment_gather(
+    batch.segment_ids, batch.n_segments)``. Returns the
+    ``(n_segments,)`` per-sample loss vector."""
+
+
+class PackedL2Loss(_PackedLoss, MaskedL2Loss):
+    """Halved squared error per position over a packed batch (same
+    ``segment_gather`` contract as :class:`PackedSoftmaxCELoss`)."""
 
 
 def masked_batch_loss(per_sample_loss, n_valid):

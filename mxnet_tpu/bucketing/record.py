@@ -48,12 +48,15 @@ class BucketingStats:
             self.discarded += int(n)
 
     def note_batch(self, bucket, n_valid, rows, valid_elements,
-                   total_elements):
+                   total_elements, segments=None):
         """Account one emitted bucket batch: ``rows - n_valid`` pad
-        rows, ``total - valid`` padded elements."""
+        rows, ``total - valid`` padded elements. A PACKED batch holds
+        more samples than valid rows — ``segments`` carries the true
+        sample count (defaults to ``n_valid`` for padded batches)."""
         with self._mu:
             self.batches += 1
-            self.samples += int(n_valid)
+            self.samples += int(segments if segments is not None
+                                else n_valid)
             self.pad_rows += int(rows) - int(n_valid)
             self.padded_elements += int(total_elements) \
                 - int(valid_elements)
@@ -81,6 +84,13 @@ class BucketingStats:
                 "padding_share": round(
                     self.padded_elements / self.total_elements, 6)
                 if self.total_elements else None,
+                # the packing-efficiency figure: what fraction of the
+                # emitted batches' elements was real work (padded
+                # pipelines report it too — it is 1 - padding_share,
+                # the baseline packing is measured against)
+                "real_token_fraction": round(
+                    1.0 - self.padded_elements / self.total_elements,
+                    6) if self.total_elements else None,
                 # numeric rung order ("4" < "8" < "16", "4x8" by dims)
                 "buckets": dict(sorted(
                     self.bucket_batches.items(),

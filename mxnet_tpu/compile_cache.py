@@ -1,0 +1,439 @@
+"""Persistent on-disk XLA compilation cache (ROADMAP item 5b).
+
+Every framework program already stages compilation explicitly through
+``compile_watch.jit`` (``lower()`` + ``compile()``); this module gives
+that choke point a disk: after a fresh compile the loaded executable is
+serialized (``jax.experimental.serialize_executable`` — the same
+executable-level round trip ``deploy.py`` proves with ``jax.export``)
+and written to ``MXNET_COMPILE_CACHE_DIR``; before a compile the cache
+is consulted, and a hit deserializes the executable in milliseconds
+instead of re-paying the full XLA bill. A restarted trainer or a cold
+serving replica warms from disk: ``compile_watch.site_stats()`` shows
+**zero fresh compiles** on the second run of the same job, and
+``InferenceServer.warmup()`` becomes a file read per ladder rung.
+
+Cache key anatomy — an entry is only ever reused when ALL of these
+match (each is part of the sha256 filename, so any change is a
+natural miss, never a wrong program):
+
+- the compile-watch **site** and **statics** (the logical program and
+  its static configuration — optimizer key, bucket, fault guard);
+- the full **argument signature** (shape/dtype/weak-type/sharding of
+  every leaf — the same key the in-memory compile cache uses);
+- the staged call's **jit options** (donation, out_shardings,
+  compiler options);
+- the **jax and jaxlib versions** and the **device kind + count**
+  (an executable is an artifact of one compiler for one topology; a
+  version bump or a different chip invalidates everything, by key).
+
+Durability contract:
+
+- writes are **atomic** (tmp + ``os.replace``) and happen on a
+  background writer thread — the training/serving hot path never
+  blocks on disk;
+- a corrupt, truncated, or version-mismatched entry is a **miss**
+  (counted, the stale file removed) — the cache can never kill the
+  job it accelerates;
+- the directory is **LRU-bounded** by ``MXNET_COMPILE_CACHE_MB``
+  (default 512): after each store the oldest-used entries are evicted
+  until the total size fits; a hit refreshes its entry's mtime.
+
+Observability: hits/misses/bytes/evictions/errors flow into
+``profiler.counters()`` (and therefore the ``/metrics`` endpoint),
+each compile-watch telemetry ``compile`` record is tagged with its
+cache outcome, and ``stats()`` feeds the diagnose Compilation table's
+Compile-cache row.
+
+Off by default; always cheap when off (one module-global ``None``
+check at the staging site). Enable with ``MXNET_COMPILE_CACHE_DIR`` or
+:func:`enable`.
+"""
+from __future__ import annotations
+
+import hashlib
+import os
+import pickle
+import queue as _queue_mod
+import threading
+import time
+import warnings
+
+from .base import get_env
+
+__all__ = ["enabled", "enable", "disable", "maybe_enable", "stats",
+           "entry_key", "lookup", "store", "flush", "cache_dir"]
+
+_FORMAT = 1
+_SUFFIX = ".mxc"
+_lock = threading.Lock()
+_cache = None          # the active _Cache; module-global None check
+
+
+def _count(name, delta=1):
+    from . import profiler
+    profiler.increment_counter("compile_cache_%s" % name, delta)
+
+
+class _Cache:
+    def __init__(self, path, max_mb=None):
+        self.dir = os.path.abspath(path)
+        os.makedirs(self.dir, exist_ok=True)
+        # sweep tmp files a killed writer stranded: they are invisible
+        # to the LRU accounting (only *.mxc counts) and would grow the
+        # directory past its cap forever. Only STALE tmp files go —
+        # a fleet cold-starting against one shared directory has live
+        # writers mid-replace, and racing them would lose their stores
+        # at exactly the moment the cache is being populated.
+        now = time.time()
+        for name in os.listdir(self.dir):
+            if ".tmp." in name:
+                p = os.path.join(self.dir, name)
+                try:
+                    if now - os.stat(p).st_mtime > 3600:
+                        os.unlink(p)
+                except OSError:
+                    pass
+        if max_mb is None:
+            max_mb = get_env("MXNET_COMPILE_CACHE_MB", 512.0, float)
+        self.max_bytes = max(1, int(float(max_mb) * (1 << 20)))
+        self.hits = 0
+        self.misses = 0
+        self.errors = 0
+        self.evictions = 0
+        self.stores = 0
+        self.stores_dropped = 0
+        self.bytes_read = 0
+        self.bytes_written = 0
+        self.hit_s = 0.0
+        # bounded store queue: a burst of first compiles must not grow
+        # host memory holding executables for a slow disk — drop (and
+        # count) instead, the entry simply stays cold
+        self.pending = _queue_mod.Queue(
+            maxsize=max(1, get_env("MXNET_COMPILE_CACHE_QUEUE", 64,
+                                   int)))
+        self.writer = threading.Thread(
+            target=self._writer_loop, name="mxnet-compile-cache-writer",
+            daemon=True)
+        self.writer.start()
+
+    # -- background writer -------------------------------------------------
+    def _writer_loop(self):
+        while True:
+            item = self.pending.get()
+            try:
+                if item is None:
+                    return
+                key, compiled = item
+                self._write_entry(key, compiled)
+            except Exception:
+                with _lock:
+                    self.errors += 1
+                _count("errors")
+            finally:
+                self.pending.task_done()
+
+    def _path(self, key):
+        return os.path.join(self.dir, key + _SUFFIX)
+
+    def _write_entry(self, key, compiled):
+        from jax.experimental import serialize_executable as se
+        payload, in_tree, out_tree = se.serialize(compiled)
+        blob = pickle.dumps((_FORMAT, _version_tag(), payload,
+                             in_tree, out_tree),
+                            protocol=pickle.HIGHEST_PROTOCOL)
+        path = self._path(key)
+        tmp = path + ".tmp.%d" % os.getpid()
+        with open(tmp, "wb") as f:
+            f.write(blob)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+        with _lock:
+            self.stores += 1
+            self.bytes_written += len(blob)
+        _count("bytes_written", len(blob))
+        self._evict_lru()
+
+    def _evict_lru(self):
+        """Drop the least-recently-used entries until the directory
+        fits the byte cap (hits refresh mtime, so age == last use)."""
+        entries = []
+        total = 0
+        try:
+            for name in os.listdir(self.dir):
+                if not name.endswith(_SUFFIX):
+                    continue
+                p = os.path.join(self.dir, name)
+                try:
+                    st = os.stat(p)
+                except OSError:
+                    continue
+                entries.append((st.st_mtime, st.st_size, p))
+                total += st.st_size
+        except OSError:
+            return
+        if total <= self.max_bytes:
+            return
+        n = 0
+        for _, size, p in sorted(entries):
+            if total <= self.max_bytes:
+                break
+            try:
+                os.unlink(p)
+            except OSError:
+                continue
+            total -= size
+            n += 1
+        if n:
+            with _lock:
+                self.evictions += n
+            _count("evictions", n)
+
+
+def enabled():
+    """True while a cache directory is active."""
+    return _cache is not None
+
+
+_enable_lock = threading.Lock()
+
+
+def enable(path=None, max_mb=None):
+    """Activate the cache at ``path`` (default:
+    ``MXNET_COMPILE_CACHE_DIR``). Idempotent for the same directory;
+    re-pointing at a different directory replaces the active cache
+    (the old writer thread is stopped)."""
+    global _cache
+    if path is None:
+        path = os.environ.get("MXNET_COMPILE_CACHE_DIR", "").strip()
+        if not path:
+            raise ValueError(
+                "compile_cache.enable: pass path= or set "
+                "MXNET_COMPILE_CACHE_DIR")
+    # one construction at a time: concurrent first-wrapper creations
+    # (decode-pool threads) must share ONE cache object — a losing
+    # duplicate would leak its writer thread and strand its counters
+    with _enable_lock:
+        with _lock:
+            if _cache is not None and \
+                    _cache.dir == os.path.abspath(path):
+                if max_mb is not None:
+                    # an explicit cap re-points the live cache rather
+                    # than being silently outvoted by the auto-enable
+                    # default the first jit wrapper installed
+                    _cache.max_bytes = max(
+                        1, int(float(max_mb) * (1 << 20)))
+                return _cache
+        c = _Cache(path, max_mb=max_mb)
+        with _lock:
+            old, _cache = _cache, c
+    if old is not None:
+        old.pending.put(None)
+    return c
+
+
+def disable():
+    """Deactivate (entries stay on disk for the next enable)."""
+    global _cache, _env_failed
+    _env_failed = False
+    with _lock:
+        c, _cache = _cache, None
+    if c is not None:
+        c.pending.put(None)
+
+
+def graph_token(text):
+    """The ONE content-fingerprint rule for ``cache_token`` material
+    (a symbol graph's JSON, an artifact's bytes): every producer must
+    use this helper so the disk key's content-identity definition
+    lives in exactly one place."""
+    if not isinstance(text, bytes):
+        text = text.encode()
+    return hashlib.sha256(text).hexdigest()
+
+
+_env_failed = False
+
+
+def maybe_enable():
+    """Enable when ``MXNET_COMPILE_CACHE_DIR`` names a directory
+    (checked at every ``compile_watch.jit`` wrapper creation). Returns
+    True when active after the call."""
+    global _env_failed
+    if _cache is not None:
+        return True
+    if _env_failed:
+        return False
+    path = os.environ.get("MXNET_COMPILE_CACHE_DIR", "").strip()
+    if not path:
+        return False
+    try:
+        enable(path)
+    except OSError as exc:
+        # an unwritable cache dir degrades to no cache, never kills
+        # the job (mirrors the telemetry unwritable-sink contract).
+        # The warn-once latch is process-LOCAL — mutating os.environ
+        # would leak the failure into every child process and block
+        # an explicit in-process enable() retry
+        warnings.warn("compile_cache: cannot use %r (%s); persistent "
+                      "compile cache disabled" % (path, exc))
+        _env_failed = True
+        return False
+    return True
+
+
+def cache_dir():
+    """The active cache directory (None when off)."""
+    c = _cache
+    return c.dir if c is not None else None
+
+
+# ---------------------------------------------------------------------------
+# keys
+# ---------------------------------------------------------------------------
+
+def _version_tag():
+    """The compiler/topology fingerprint an executable is only valid
+    under: the framework version (its lowering code shapes every
+    program — an op fix must invalidate old executables), jax + jaxlib
+    versions, device kind, local device count."""
+    import jax
+    import jaxlib
+
+    from .libinfo import __version__ as mx_version
+    devices = jax.local_devices()
+    kind = devices[0].device_kind if devices else "cpu"
+    return (mx_version, jax.__version__, jaxlib.__version__,
+            str(kind), len(devices))
+
+
+def entry_key(site, statics, signature, options=None):
+    """The sha256 entry name for one (program, signature) pair. Every
+    component reprs into the hash — a changed optimizer static, a new
+    arg shape, a jax upgrade, or a different chip is a different file,
+    so a stale entry can never be loaded for the wrong program."""
+    raw = repr((site, statics, signature, options, _version_tag()))
+    return hashlib.sha256(raw.encode()).hexdigest()
+
+
+# ---------------------------------------------------------------------------
+# lookup / store
+# ---------------------------------------------------------------------------
+
+def lookup(key):
+    """The loaded executable for ``key``, or None on a miss. Corrupt,
+    truncated, unpicklable, or version-mismatched entries are misses:
+    counted, the bad file removed, never an exception."""
+    c = _cache
+    if c is None:
+        return None
+    path = c._path(key)
+    try:
+        with open(path, "rb") as f:
+            blob = f.read()
+    except OSError:
+        with _lock:
+            c.misses += 1
+        _count("misses")
+        return None
+    t0 = time.perf_counter()
+    try:
+        fmt, tag, payload, in_tree, out_tree = pickle.loads(blob)
+        if fmt != _FORMAT or tag != _version_tag():
+            raise ValueError("stale cache entry (format/version)")
+        from jax.experimental import serialize_executable as se
+        compiled = se.deserialize_and_load(payload, in_tree, out_tree)
+    except Exception:
+        # a bad entry degrades to a miss — and is removed so the next
+        # run pays the deserialize attempt at most once
+        try:
+            os.unlink(path)
+        except OSError:
+            pass
+        with _lock:
+            c.misses += 1
+            c.errors += 1
+        _count("misses")
+        _count("errors")
+        return None
+    dur = time.perf_counter() - t0
+    try:
+        os.utime(path)               # LRU: a hit is a use
+    except OSError:
+        pass
+    with _lock:
+        c.hits += 1
+        c.hit_s += dur
+        c.bytes_read += len(blob)
+    _count("hits")
+    _count("bytes_read", len(blob))
+    return compiled
+
+
+def store(key, compiled):
+    """Queue one freshly-compiled executable for the background
+    writer (atomic tmp+replace, then LRU eviction). Never blocks the
+    caller: a full queue drops the store (counted) and the entry
+    simply stays cold."""
+    c = _cache
+    if c is None:
+        return
+    try:
+        c.pending.put_nowait((key, compiled))
+    except _queue_mod.Full:
+        with _lock:
+            c.stores_dropped += 1
+        _count("stores_dropped")
+
+
+def flush(timeout=None):
+    """Block until every queued store has hit disk (tests and
+    benchmark harnesses; a serving ``warmup()`` also flushes so a
+    replica's programs persist before traffic). No-op when off."""
+    c = _cache
+    if c is None:
+        return
+    if timeout is None:
+        c.pending.join()
+        return
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if c.pending.unfinished_tasks == 0:
+            return
+        time.sleep(0.01)
+
+
+def stats():
+    """Counters + directory occupancy snapshot (None when off) — the
+    diagnose Compile-cache row and the bench oracle."""
+    c = _cache
+    if c is None:
+        return None
+    size = 0
+    entries = 0
+    try:
+        for name in os.listdir(c.dir):
+            if name.endswith(_SUFFIX):
+                try:
+                    size += os.stat(os.path.join(c.dir, name)).st_size
+                    entries += 1
+                except OSError:
+                    pass
+    except OSError:
+        pass
+    with _lock:
+        return {
+            "dir": c.dir,
+            "hits": c.hits,
+            "misses": c.misses,
+            "errors": c.errors,
+            "evictions": c.evictions,
+            "stores": c.stores,
+            "stores_dropped": c.stores_dropped,
+            "bytes_read": c.bytes_read,
+            "bytes_written": c.bytes_written,
+            "hit_s": round(c.hit_s, 6),
+            "entries": entries,
+            "size_bytes": size,
+            "max_bytes": c.max_bytes,
+        }

@@ -320,14 +320,19 @@ class Predictor:
 def load_compiled(path):
     """Load an ``export_compiled`` artifact (format 1 or 2). Needs
     only jax — not the framework's model code or parameter files."""
+    import hashlib
+
     from jax import export as jexport
+    digest = hashlib.sha256()
     with open(path, "rb") as f:
         magic = f.read(len(_MAGIC))
         if magic != _MAGIC:
             raise MXNetError("%s is not a mxnet_tpu deploy artifact"
                              % path)
         (mlen,) = struct.unpack("<I", f.read(4))
-        meta = json.loads(f.read(mlen).decode())
+        meta_bytes = f.read(mlen)
+        meta = json.loads(meta_bytes.decode())
+        digest.update(meta_bytes)
         if meta.get("format", 1) >= 2 and meta.get("programs"):
             programs = []
             for p in meta["programs"]:
@@ -336,11 +341,19 @@ def load_compiled(path):
                     raise MXNetError(
                         "%s is truncated: program for bucket %s is "
                         "short" % (path, p.get("batch")))
+                digest.update(blob)
                 programs.append((int(p["batch"]),
                                  jexport.deserialize(blob)))
         else:                              # format 1: one trailing blob
             blob = f.read()
+            digest.update(blob)
             shape0 = (meta.get("inputs") or [{}])[0].get("shape") or []
             batch = int(shape0[0]) if shape0 else 1
             programs = [(batch, jexport.deserialize(blob))]
-    return Predictor(programs, meta)
+    pred = Predictor(programs, meta)
+    # content fingerprint for the persistent compile cache: the meta
+    # records shapes, the BLOBS carry the baked weights — two exports
+    # of the same architecture with different parameters must never
+    # share a cached serving executable
+    pred.content_token = digest.hexdigest()
+    return pred

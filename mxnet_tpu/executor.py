@@ -374,6 +374,26 @@ class Executor:
             + ((1.0 - momentum) * bias).astype(out[n_out].dtype)
         return tuple(out)
 
+    @property
+    def cw_cache_token(self):
+        """Content fingerprint of the bound graph for the persistent
+        compile cache: site + statics + argument signature cannot tell
+        two different symbols with identical shapes apart — the graph
+        hash can. None when the graph will not serialize (the program
+        then opts out of the disk cache rather than risking a
+        collision) or when no cache is active (the tojson+sha256 is
+        only worth paying when something will read it)."""
+        if not hasattr(self, "_cw_token"):
+            from . import compile_cache
+            from .compile_cache import graph_token
+            if not compile_cache.enabled():
+                return None        # don't latch: cache may enable later
+            try:
+                self._cw_token = graph_token(self._symbol.tojson())
+            except Exception:
+                self._cw_token = None
+        return self._cw_token
+
     def _get_fn(self, kind, is_train, raw=False):
         """The compiled (or with ``raw=True`` the traceable, unjitted)
         forward / fwdbwd program. ``raw`` is for callers composing the
@@ -393,10 +413,16 @@ class Executor:
         fn = self._fns.get(key)
         if fn is not None:
             return fn
-        from . import compile_watch
+        from . import compile_cache, compile_watch
         from .engine import compiler_options
         copts = compiler_options(self._ctx)
         run = self._make_graph_fn(is_train)
+        # env-driven cache activation must precede the token read (the
+        # token is only computed while a cache is live); a live cache
+        # with an unhashable graph opts this program out entirely
+        compile_cache.maybe_enable()
+        ctoken = self.cw_cache_token
+        cache_ok = ctoken is not None
         site = "executor:%s:%s" % (kind, "train" if is_train else "eval")
         rep = None
         statics = None
@@ -440,12 +466,14 @@ class Executor:
                 # math on them never mixes device sets
                 fn = compile_watch.jit(
                     run, site, describe=self._cw_describe,
-                    statics=statics,
+                    statics=statics, cache=cache_ok,
+                    cache_token=ctoken,
                     out_shardings=(None, rep), compiler_options=copts)
             else:
                 fn = compile_watch.jit(run, site,
                                        describe=self._cw_describe,
-                                       statics=statics,
+                                       statics=statics, cache=cache_ok,
+                                       cache_token=ctoken,
                                        compiler_options=copts)
         else:
             gpos = self._grad_positions
@@ -473,13 +501,15 @@ class Executor:
                 # grads replicated = the in-program allreduce
                 fn = compile_watch.jit(
                     fwdbwd, site, describe=self._cw_describe,
-                    statics=statics,
+                    statics=statics, cache=cache_ok,
+                    cache_token=ctoken,
                     out_shardings=(None, rep, rep),
                     compiler_options=copts)
             else:
                 fn = compile_watch.jit(fwdbwd, site,
                                        describe=self._cw_describe,
-                                       statics=statics,
+                                       statics=statics, cache=cache_ok,
+                                       cache_token=ctoken,
                                        compiler_options=copts)
         self._fns[key] = fn
         return fn

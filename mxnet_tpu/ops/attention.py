@@ -27,7 +27,7 @@ def _is_tracer(x):
     return isinstance(x, jax.core.Tracer)
 
 
-def _attention(attrs, query, key, value):
+def _attention(attrs, query, key, value, segment_ids=None):
     import math
     causal = bool(attrs.get("causal", False))
     scale = float(attrs.get("scale", 0.0)) or \
@@ -43,6 +43,12 @@ def _attention(attrs, query, key, value):
     has_sp = mesh is not None and mesh_axes(mesh).get(axis, 1) > 1
     if impl == "auto":
         impl = "ring" if has_sp else "flash"
+    if segment_ids is not None and impl in ("ring", "ulysses"):
+        # packed batches: the sequence-sharded kernels do not take a
+        # segment plane — block the silent wrong answer
+        raise ValueError(
+            "_contrib_flash_attention: segment_ids (packed batches) "
+            "is supported by impl='flash'/'dense' only, not %r" % impl)
     if impl in ("ring", "ulysses"):
         if has_sp:
             # sequence-shard eager inputs onto the mesh (T over the sp
@@ -58,12 +64,14 @@ def _attention(attrs, query, key, value):
         return fn(query, key, value, mesh=mesh, axis=axis,
                   causal=causal, scale=scale)
     if impl == "dense":
-        return _jnp_reference(query, key, value, scale, causal)
+        return _jnp_reference(query, key, value, scale, causal,
+                              segment_ids=segment_ids)
     if impl == "flash":
         return flash_attention(query, key, value, causal=causal,
                                scale=scale,
                                block_q=int(attrs.get("block_q", 512)),
-                               block_k=int(attrs.get("block_k", 512)))
+                               block_k=int(attrs.get("block_k", 512)),
+                               segment_ids=segment_ids)
     raise ValueError("_contrib_flash_attention: unknown impl %r" % impl)
 
 
@@ -77,4 +85,9 @@ register("_contrib_flash_attention", _attention,
                     "impl": "auto|flash|dense|ring|ulysses",
                     "mesh_axis": "mesh axis carrying the sequence shards",
                     "block_q": "flash kernel query block",
-                    "block_k": "flash kernel key/value block"})
+                    "block_k": "flash kernel key/value block"},
+         description="Fused attention over (B, T, H, D); an optional "
+                     "4th input carries the (B, T) int32 segment-id "
+                     "plane of a packed batch (bucketing.packing) — "
+                     "cross-segment attention masks to exact zero "
+                     "(impl flash/dense).")

@@ -557,6 +557,10 @@ class DistributedTrainer:
             counter="fused_step_compile_ms",
             statics=(plan.signature(), shard_sig,
                      self._opt.fused_static_key()),
+            # the step closes over the USER's loss_fn — an arbitrary
+            # python callable with no stable content fingerprint, so
+            # it must stay out of the persistent disk cache
+            cache=False,
             donate_argnums=(0, 1, 2))
         self._batch_sharding = NamedSharding(mesh, P("dp"))
         if self._pending_restore is not None:

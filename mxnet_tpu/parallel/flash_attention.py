@@ -38,21 +38,34 @@ __all__ = ["flash_attention"]
 _NEG = -1e30
 
 
-def _jnp_reference(q, k, v, scale, causal):
+def _jnp_reference(q, k, v, scale, causal, segment_ids=None):
     import jax.numpy as jnp
     s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
     if causal:
         Tq, Tk = q.shape[1], k.shape[1]
         mask = jnp.tril(jnp.ones((Tq, Tk), dtype=bool))
         s = jnp.where(mask[None, None], s, _NEG)
+    if segment_ids is not None:
+        # packed rows (bucketing.packing): a position only attends
+        # inside its OWN segment — a blocked score is _NEG, its
+        # softmax weight a true IEEE zero, so the packed result at a
+        # sample's positions is bit-identical to attending that sample
+        # alone. Padding (id 0) attends to nothing and must be masked
+        # (or ignored) downstream.
+        seg = jnp.asarray(segment_ids)
+        allowed = jnp.logical_and(seg[:, :, None] == seg[:, None, :],
+                                  seg[:, :, None] > 0)
+        s = jnp.where(allowed[:, None], s, _NEG)
     p = jnp.asarray(
         jnp.exp(s - jnp.max(s, axis=-1, keepdims=True)), q.dtype)
     p = p / jnp.maximum(jnp.sum(p, axis=-1, keepdims=True), 1e-30)
     return jnp.einsum("bhqk,bkhd->bqhd", p, v)
 
 
-def _mask_scores(s, qi, kb, block_q, block_k, causal, kv_len):
-    """-inf the scores of padded k positions (and the causal triangle)."""
+def _mask_scores(s, qi, kb, block_q, block_k, causal, kv_len,
+                 qseg=None, kseg=None):
+    """-inf the scores of padded k positions (and the causal triangle,
+    and — for packed batches — every cross-segment pair)."""
     import jax
     import jax.numpy as jnp
     k_pos = kb * block_k + jax.lax.iota(jnp.int32, block_k)[None, :]
@@ -60,16 +73,28 @@ def _mask_scores(s, qi, kb, block_q, block_k, causal, kv_len):
     if causal:
         q_pos = qi * block_q + jax.lax.iota(jnp.int32, block_q)[:, None]
         live = jnp.logical_and(live, q_pos >= k_pos)
+    if qseg is not None:
+        live = jnp.logical_and(
+            live, jnp.logical_and(qseg[:, None] == kseg[None, :],
+                                  qseg[:, None] > 0))
     return jnp.where(live, s, _NEG)
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref,
-                l_ref, *, scale, causal, block_q, block_k, n_kb, kv_len):
+def _fwd_kernel(q_ref, k_ref, v_ref, *refs, scale, causal, block_q,
+                block_k, n_kb, kv_len, has_seg):
     """Grid = (batch*heads, q_blocks, k_blocks), k innermost: scratch
-    accumulators carry across the sequential k steps."""
+    accumulators carry across the sequential k steps. With ``has_seg``
+    two extra int32 refs stream each block's q/k segment ids (packed
+    batches) and cross-segment scores mask to -inf in
+    ``_mask_scores``."""
     import jax.numpy as jnp
     from jax.experimental import pallas as pl
 
+    if has_seg:
+        qseg_ref, kseg_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref = refs
+    else:
+        o_ref, lse_ref, acc_ref, m_ref, l_ref = refs
+        qseg_ref = kseg_ref = None
     qi = pl.program_id(1)
     kb = pl.program_id(2)
 
@@ -90,7 +115,9 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref,
         k = k_ref[...].astype(jnp.float32)            # (bk, d)
         v = v_ref[...].astype(jnp.float32)
         s = q @ k.T                                   # (bq, bk)
-        s = _mask_scores(s, qi, kb, block_q, block_k, causal, kv_len)
+        s = _mask_scores(s, qi, kb, block_q, block_k, causal, kv_len,
+                         qseg_ref[...] if has_seg else None,
+                         kseg_ref[...] if has_seg else None)
         m_prev = m_ref[...]
         l_prev = l_ref[...]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1))
@@ -108,13 +135,18 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref,
 
 
 def _bwd_dkdv_kernel(q_ref, do_ref, lse_ref, dcap_ref, k_ref, v_ref,
-                     dk_ref, dv_ref, dk_acc, dv_acc, *, scale, causal,
-                     block_q, block_k, n_qb, kv_len):
+                     *refs, scale, causal, block_q, block_k, n_qb,
+                     kv_len, has_seg):
     """Grid = (batch*heads, k_blocks, q_blocks), q innermost: dk/dv
     accumulate in VMEM scratch while q/do/lse/D stream through."""
     import jax.numpy as jnp
     from jax.experimental import pallas as pl
 
+    if has_seg:
+        qseg_ref, kseg_ref, dk_ref, dv_ref, dk_acc, dv_acc = refs
+    else:
+        dk_ref, dv_ref, dk_acc, dv_acc = refs
+        qseg_ref = kseg_ref = None
     kb = pl.program_id(1)
     qi = pl.program_id(2)
 
@@ -137,7 +169,9 @@ def _bwd_dkdv_kernel(q_ref, do_ref, lse_ref, dcap_ref, k_ref, v_ref,
         k = k_ref[...].astype(jnp.float32)            # (bk, d)
         v = v_ref[...].astype(jnp.float32)
         s = (q @ k.T) * scale
-        s = _mask_scores(s, qi, kb, block_q, block_k, causal, kv_len)
+        s = _mask_scores(s, qi, kb, block_q, block_k, causal, kv_len,
+                         qseg_ref[...] if has_seg else None,
+                         kseg_ref[...] if has_seg else None)
         p = jnp.exp(s - lse[:, None])                 # (bq, bk)
         dv_acc[...] += p.T @ do
         dp = do @ v.T                                 # (bq, bk)
@@ -151,13 +185,18 @@ def _bwd_dkdv_kernel(q_ref, do_ref, lse_ref, dcap_ref, k_ref, v_ref,
 
 
 def _bwd_dq_kernel(q_ref, do_ref, lse_ref, dcap_ref, k_ref, v_ref,
-                   dq_ref, dq_acc, *, scale, causal, block_q, block_k,
-                   n_kb, kv_len):
+                   *refs, scale, causal, block_q, block_k, n_kb,
+                   kv_len, has_seg):
     """Grid = (batch*heads, q_blocks, k_blocks), k innermost: dq
     accumulates in VMEM scratch while k/v stream through."""
     import jax.numpy as jnp
     from jax.experimental import pallas as pl
 
+    if has_seg:
+        qseg_ref, kseg_ref, dq_ref, dq_acc = refs
+    else:
+        dq_ref, dq_acc = refs
+        qseg_ref = kseg_ref = None
     qi = pl.program_id(1)
     kb = pl.program_id(2)
 
@@ -178,7 +217,9 @@ def _bwd_dq_kernel(q_ref, do_ref, lse_ref, dcap_ref, k_ref, v_ref,
         k = k_ref[...].astype(jnp.float32)
         v = v_ref[...].astype(jnp.float32)
         s = (q @ k.T) * scale
-        s = _mask_scores(s, qi, kb, block_q, block_k, causal, kv_len)
+        s = _mask_scores(s, qi, kb, block_q, block_k, causal, kv_len,
+                         qseg_ref[...] if has_seg else None,
+                         kseg_ref[...] if has_seg else None)
         p = jnp.exp(s - lse[:, None])
         dp = do @ v.T
         ds = p * (dp - dcap[:, None]) * scale
@@ -213,9 +254,11 @@ def _unflatten(x, B, H):
     return jnp.moveaxis(x.reshape(B, H, T, D), 1, 2)
 
 
-def _pallas_forward(q, k, v, scale, causal, block_q, block_k, kv_len,
-                    interpret):
-    """Padded/flattened forward; returns (out, lse) at PADDED length."""
+def _pallas_forward(q, k, v, seg, scale, causal, block_q, block_k,
+                    kv_len, interpret):
+    """Padded/flattened forward; returns (out, lse) at PADDED length.
+    ``seg`` is the (BH, T) int32 segment-id plane of a packed batch
+    (or None) — streamed blockwise next to q and k."""
     import jax.numpy as jnp
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
@@ -228,16 +271,24 @@ def _pallas_forward(q, k, v, scale, causal, block_q, block_k, kv_len,
                pltpu.VMEM((block_q,), jnp.float32),
                pltpu.VMEM((block_q,), jnp.float32)]
 
+    in_specs = [
+        pl.BlockSpec((None, block_q, D), lambda b, i, j: (b, i, 0)),
+        pl.BlockSpec((None, block_k, D), lambda b, i, j: (b, j, 0)),
+        pl.BlockSpec((None, block_k, D), lambda b, i, j: (b, j, 0)),
+    ]
+    inputs = [q, k, v]
+    if seg is not None:
+        in_specs += [
+            pl.BlockSpec((None, block_q), lambda b, i, j: (b, i)),
+            pl.BlockSpec((None, block_k), lambda b, i, j: (b, j)),
+        ]
+        inputs += [seg, seg]
     out, lse = pl.pallas_call(
         functools.partial(_fwd_kernel, scale=scale, causal=causal,
                           block_q=block_q, block_k=block_k, n_kb=n_kb,
-                          kv_len=kv_len),
+                          kv_len=kv_len, has_seg=seg is not None),
         grid=(BH, Tq // block_q, n_kb),
-        in_specs=[
-            pl.BlockSpec((None, block_q, D), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((None, block_k, D), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((None, block_k, D), lambda b, i, j: (b, j, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((None, block_q, D), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((None, block_q), lambda b, i, j: (b, i)),
@@ -246,11 +297,11 @@ def _pallas_forward(q, k, v, scale, causal, block_q, block_k, kv_len,
                    jax.ShapeDtypeStruct((BH, Tq), jnp.float32)],
         scratch_shapes=scratch,
         interpret=interpret,
-    )(q, k, v)
+    )(*inputs)
     return out, lse
 
 
-def _pallas_backward(q, k, v, do, o, lse, scale, causal, block_q,
+def _pallas_backward(q, k, v, do, o, lse, seg, scale, causal, block_q,
                      block_k, kv_len, interpret):
     """Padded/flattened backward; q/k/v/do/o at padded lengths."""
     import jax.numpy as jnp
@@ -261,23 +312,32 @@ def _pallas_backward(q, k, v, do, o, lse, scale, causal, block_q,
     Tk = k.shape[1]
     n_qb = Tq // block_q
     n_kb = Tk // block_k
+    has_seg = seg is not None
     # D_i = rowsum(dO * O): one cheap fused pass in XLA
     dcap = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
                    axis=-1)
 
+    in_specs = [
+        pl.BlockSpec((None, block_q, D), lambda b, j, i: (b, i, 0)),
+        pl.BlockSpec((None, block_q, D), lambda b, j, i: (b, i, 0)),
+        pl.BlockSpec((None, block_q), lambda b, j, i: (b, i)),
+        pl.BlockSpec((None, block_q), lambda b, j, i: (b, i)),
+        pl.BlockSpec((None, block_k, D), lambda b, j, i: (b, j, 0)),
+        pl.BlockSpec((None, block_k, D), lambda b, j, i: (b, j, 0)),
+    ]
+    inputs = [q, do, lse, dcap, k, v]
+    if has_seg:
+        in_specs += [
+            pl.BlockSpec((None, block_q), lambda b, j, i: (b, i)),
+            pl.BlockSpec((None, block_k), lambda b, j, i: (b, j)),
+        ]
+        inputs += [seg, seg]
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkdv_kernel, scale=scale, causal=causal,
                           block_q=block_q, block_k=block_k, n_qb=n_qb,
-                          kv_len=kv_len),
+                          kv_len=kv_len, has_seg=has_seg),
         grid=(BH, n_kb, n_qb),
-        in_specs=[
-            pl.BlockSpec((None, block_q, D), lambda b, j, i: (b, i, 0)),
-            pl.BlockSpec((None, block_q, D), lambda b, j, i: (b, i, 0)),
-            pl.BlockSpec((None, block_q), lambda b, j, i: (b, i)),
-            pl.BlockSpec((None, block_q), lambda b, j, i: (b, i)),
-            pl.BlockSpec((None, block_k, D), lambda b, j, i: (b, j, 0)),
-            pl.BlockSpec((None, block_k, D), lambda b, j, i: (b, j, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((None, block_k, D), lambda b, j, i: (b, j, 0)),
             pl.BlockSpec((None, block_k, D), lambda b, j, i: (b, j, 0)),
@@ -287,38 +347,59 @@ def _pallas_backward(q, k, v, do, o, lse, scale, causal, block_q,
         scratch_shapes=[pltpu.VMEM((block_k, D), jnp.float32),
                         pltpu.VMEM((block_k, D), jnp.float32)],
         interpret=interpret,
-    )(q, do, lse, dcap, k, v)
+    )(*inputs)
 
+    in_specs = [
+        pl.BlockSpec((None, block_q, D), lambda b, i, j: (b, i, 0)),
+        pl.BlockSpec((None, block_q, D), lambda b, i, j: (b, i, 0)),
+        pl.BlockSpec((None, block_q), lambda b, i, j: (b, i)),
+        pl.BlockSpec((None, block_q), lambda b, i, j: (b, i)),
+        pl.BlockSpec((None, block_k, D), lambda b, i, j: (b, j, 0)),
+        pl.BlockSpec((None, block_k, D), lambda b, i, j: (b, j, 0)),
+    ]
+    inputs = [q, do, lse, dcap, k, v]
+    if has_seg:
+        in_specs += [
+            pl.BlockSpec((None, block_q), lambda b, i, j: (b, i)),
+            pl.BlockSpec((None, block_k), lambda b, i, j: (b, j)),
+        ]
+        inputs += [seg, seg]
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
                           block_q=block_q, block_k=block_k, n_kb=n_kb,
-                          kv_len=kv_len),
+                          kv_len=kv_len, has_seg=has_seg),
         grid=(BH, n_qb, n_kb),
-        in_specs=[
-            pl.BlockSpec((None, block_q, D), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((None, block_q, D), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((None, block_q), lambda b, i, j: (b, i)),
-            pl.BlockSpec((None, block_q), lambda b, i, j: (b, i)),
-            pl.BlockSpec((None, block_k, D), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((None, block_k, D), lambda b, i, j: (b, j, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((None, block_q, D),
                                lambda b, i, j: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct((BH, Tq, D), q.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32)],
         interpret=interpret,
-    )(q, do, lse, dcap, k, v)
+    )(*inputs)
     return dq, dk, dv
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _flash(q, k, v, scale, causal, block_q, block_k, interpret):
-    out, _ = _flash_fwd(q, k, v, scale, causal, block_q, block_k,
+def _seg_flat(seg, H, t_pad):
+    """(B, T) int32 segment ids -> the kernels' (B*H, T_pad) plane:
+    padded tail positions get id 0 (attend to/attended by nothing),
+    rows repeat per head to match the flattened batch*heads axis."""
+    import jax.numpy as jnp
+    seg = jnp.asarray(seg, jnp.int32)
+    pad = t_pad - seg.shape[1]
+    if pad:
+        seg = jnp.pad(seg, ((0, 0), (0, pad)))
+    return jnp.repeat(seg, H, axis=0)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
+def _flash(q, k, v, seg, scale, causal, block_q, block_k, interpret):
+    out, _ = _flash_fwd(q, k, v, seg, scale, causal, block_q, block_k,
                         interpret)
     return out
 
 
-def _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret):
+def _flash_fwd(q, k, v, seg, scale, causal, block_q, block_k,
+               interpret):
     import jax.numpy as jnp
     B, Tq, H, D = q.shape
     Tk = k.shape[1]
@@ -329,21 +410,25 @@ def _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret):
     qf = _flatten(_pad_seq(q, tq_pad))
     kf = _flatten(_pad_seq(k, tk_pad))
     vf = _flatten(_pad_seq(v, tk_pad))
-    outf, lse = _pallas_forward(qf, kf, vf, scale, causal, bq, bk, Tk,
-                                interpret)
+    # self-attention: q and k index the same positions, one plane
+    # serves both sides (tq_pad == tk_pad by construction)
+    segf = None if seg is None else _seg_flat(seg, H, tq_pad)
+    outf, lse = _pallas_forward(qf, kf, vf, segf, scale, causal, bq,
+                                bk, Tk, interpret)
     out = _unflatten(outf, B, H)[:, :Tq]
-    return out, (q, k, v, outf, lse)
+    return out, (q, k, v, seg, outf, lse)
 
 
-def _flash_fwd_rule(q, k, v, scale, causal, block_q, block_k, interpret):
-    out, res = _flash_fwd(q, k, v, scale, causal, block_q, block_k,
-                          interpret)
+def _flash_fwd_rule(q, k, v, seg, scale, causal, block_q, block_k,
+                    interpret):
+    out, res = _flash_fwd(q, k, v, seg, scale, causal, block_q,
+                          block_k, interpret)
     return out, res
 
 
 def _flash_bwd(scale, causal, block_q, block_k, interpret, res, g):
     import jax.numpy as jnp
-    q, k, v, outf, lse = res
+    q, k, v, seg, outf, lse = res
     B, Tq, H, D = q.shape
     Tk = k.shape[1]
     tq_pad = outf.shape[1]
@@ -354,19 +439,21 @@ def _flash_bwd(scale, causal, block_q, block_k, interpret, res, g):
     kf = _flatten(_pad_seq(k, tk_pad))
     vf = _flatten(_pad_seq(v, tk_pad))
     dof = _flatten(_pad_seq(g, tq_pad))
-    dqf, dkf, dvf = _pallas_backward(qf, kf, vf, dof, outf, lse, scale,
-                                     causal, bq, bk, Tk, interpret)
+    segf = None if seg is None else _seg_flat(seg, H, tq_pad)
+    dqf, dkf, dvf = _pallas_backward(qf, kf, vf, dof, outf, lse, segf,
+                                     scale, causal, bq, bk, Tk,
+                                     interpret)
     dq = _unflatten(dqf, B, H)[:, :Tq]
     dk = _unflatten(dkf, B, H)[:, :Tk]
     dv = _unflatten(dvf, B, H)[:, :Tk]
-    return dq, dk, dv
+    return dq, dk, dv, None
 
 
 _flash.defvjp(_flash_fwd_rule, _flash_bwd)
 
 
 def flash_attention(q, k, v, causal=False, scale=None, block_q=512,
-                    block_k=512, force_pallas=False):
+                    block_k=512, force_pallas=False, segment_ids=None):
     """Attention over (B, T, H, D) tensors.
 
     The Pallas kernels (forward and backward) run on TPU — or under
@@ -374,10 +461,24 @@ def flash_attention(q, k, v, causal=False, scale=None, block_q=512,
     non-tiling lengths are zero-padded to the 128-lane multiple and
     masked in-kernel. The jnp composition runs elsewhere; same math,
     differentiable everywhere.
+
+    ``segment_ids`` (``(B, T)`` int32, 1-based per sample, 0 = pad —
+    ``bucketing.packing``'s plane) turns on segment-blocked attention
+    for PACKED batches: a position attends only within its own
+    segment, cross-segment softmax weights are exact IEEE zeros (in
+    the kernels AND the jnp composition), and padding attends to
+    nothing — its rows produce garbage a masked loss must (and does)
+    ignore.
     """
     scale = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
+    if segment_ids is not None and q.shape[1] != k.shape[1]:
+        raise ValueError(
+            "flash_attention: segment_ids requires self-attention "
+            "(q and k sequence lengths %d vs %d differ)"
+            % (q.shape[1], k.shape[1]))
     on_tpu = jax.devices()[0].platform in ("tpu", "axon")
     if on_tpu or force_pallas:
-        return _flash(q, k, v, scale, causal, block_q, block_k,
-                      not on_tpu)
-    return _jnp_reference(q, k, v, scale, causal)
+        return _flash(q, k, v, segment_ids, scale, causal, block_q,
+                      block_k, not on_tpu)
+    return _jnp_reference(q, k, v, scale, causal,
+                          segment_ids=segment_ids)

@@ -301,6 +301,19 @@ def format_telemetry(tel):
                 "%s=%s" % (k[len("fused_step_"):],
                            round(v, 1) if isinstance(v, float) else v)
                 for k, v in sorted(fused.items())))
+        cache = sum_compile.get("cache") or {}
+        if cache:
+            lines.append(
+                "compile-cache: %d hit(s) / %d miss(es), "
+                "%s read / %s written, %d entr%s (%s on disk), "
+                "%d evicted, %d error(s)"
+                % (cache.get("hits", 0), cache.get("misses", 0),
+                   _fmt_bytes(cache.get("bytes_read", 0)),
+                   _fmt_bytes(cache.get("bytes_written", 0)),
+                   cache.get("entries", 0),
+                   "y" if cache.get("entries", 0) == 1 else "ies",
+                   _fmt_bytes(cache.get("size_bytes", 0)),
+                   cache.get("evictions", 0), cache.get("errors", 0)))
 
     # -- hardware utilization (MFU / memory bandwidth) ------------------
     utils = tel.get("utilization") or []
@@ -471,6 +484,11 @@ def format_telemetry(tel):
                          % ("%.1f%%" % (100.0 * share)
                             if share is not None else "n/a",
                             b.get("pad_rows", 0)))
+            rtf = b.get("real_token_fraction")
+            if rtf is not None:
+                lines.append("  real tokens: %.1f%% of emitted "
+                             "elements were real work (the packing-"
+                             "efficiency figure)" % (100.0 * rtf))
             lines.append("  samples    : %d bucketed, %d discarded "
                          "(longer than the ladder top)"
                          % (b.get("samples", 0), b.get("discarded", 0)))

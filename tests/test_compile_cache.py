@@ -1,0 +1,353 @@
+"""The persistent on-disk compilation cache (mxnet_tpu.compile_cache):
+key anatomy, hit/miss/corruption/LRU behavior, the zero-fresh-compiles
+warm-restart oracle through Module.fit and InferenceServer.warmup, the
+telemetry/diagnose wiring, and cache-only (watch off) operation."""
+import json
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import compile_cache, compile_watch, telemetry
+
+
+@pytest.fixture(autouse=True)
+def _clean_state():
+    telemetry.reset()
+    compile_watch.disable()
+    compile_cache.disable()
+    yield
+    telemetry.reset()
+    compile_watch.disable()
+    compile_cache.disable()
+
+
+def _jnp():
+    import jax.numpy as jnp
+    return jnp
+
+
+def _entries(d):
+    return sorted(n for n in os.listdir(d) if n.endswith(".mxc"))
+
+
+# ---------------------------------------------------------------------------
+# keys
+# ---------------------------------------------------------------------------
+
+class TestKeys:
+    def test_every_component_changes_the_key(self):
+        base = compile_cache.entry_key("site", ("s",), (("f32", 1),),
+                                       "opts")
+        assert base == compile_cache.entry_key(
+            "site", ("s",), (("f32", 1),), "opts")
+        assert base != compile_cache.entry_key(
+            "other", ("s",), (("f32", 1),), "opts")
+        assert base != compile_cache.entry_key(
+            "site", ("t",), (("f32", 1),), "opts")
+        assert base != compile_cache.entry_key(
+            "site", ("s",), (("f32", 2),), "opts")
+        assert base != compile_cache.entry_key(
+            "site", ("s",), (("f32", 1),), "donate")
+
+    def test_version_tag_names_compiler_and_topology(self):
+        import jax
+        import jaxlib
+
+        import mxnet_tpu
+        tag = compile_cache._version_tag()
+        # the framework's own lowering code shapes every program — its
+        # version must invalidate entries exactly like a jax upgrade
+        assert tag[0] == mxnet_tpu.__version__
+        assert tag[1] == jax.__version__
+        assert tag[2] == jaxlib.__version__
+        assert tag[4] == len(jax.local_devices())
+
+
+# ---------------------------------------------------------------------------
+# hit / miss / corruption / LRU
+# ---------------------------------------------------------------------------
+
+class TestRoundTrip:
+    def _site(self, tag="demo:rt"):
+        return compile_watch.jit(lambda x: (x @ x.T).sum(), tag,
+                                 statics=("s",))
+
+    def test_cold_miss_then_warm_hit(self, tmp_path):
+        jnp = _jnp()
+        compile_cache.enable(str(tmp_path))
+        compile_watch.enable()
+        x = jnp.ones((8, 8))
+        cold = self._site()
+        want = float(cold(x))
+        compile_cache.flush()
+        st = compile_cache.stats()
+        assert st["misses"] == 1 and st["hits"] == 0
+        assert st["entries"] == 1 and st["size_bytes"] > 0
+        # "restart": a fresh wrapper has an empty in-memory cache and
+        # must load the program from disk instead of compiling
+        warm = self._site()
+        assert float(warm(x)) == want
+        st = compile_cache.stats()
+        assert st["hits"] == 1 and st["misses"] == 1
+        ss = compile_watch.site_stats("demo:rt")
+        assert ss["demo:rt"]["count"] == 1          # ONE fresh compile
+        assert ss["demo:rt"]["cache_hits"] == 1     # and one disk load
+
+    def test_counters_reach_profiler_metrics_surface(self, tmp_path):
+        jnp = _jnp()
+        from mxnet_tpu import profiler
+        before = dict(profiler.counters())
+        compile_cache.enable(str(tmp_path))
+        compile_watch.enable()
+        self._site("demo:prof")(_jnp().ones((4, 4)))
+        compile_cache.flush()
+        self._site("demo:prof")(jnp.ones((4, 4)))
+        c = profiler.counters()
+
+        def delta(name):
+            return c.get(name, 0) - before.get(name, 0)
+        assert delta("compile_cache_misses") == 1
+        assert delta("compile_cache_hits") == 1
+        assert delta("compile_cache_bytes_written") > 0
+        assert delta("compile_cache_bytes_read") > 0
+
+    def test_corrupt_entry_degrades_to_miss(self, tmp_path):
+        jnp = _jnp()
+        compile_cache.enable(str(tmp_path))
+        compile_watch.enable()
+        x = jnp.ones((6, 6))
+        want = float(self._site("demo:corrupt")(x))
+        compile_cache.flush()
+        (name,) = _entries(str(tmp_path))
+        path = os.path.join(str(tmp_path), name)
+        with open(path, "rb") as f:
+            blob = f.read()
+        for wreck in (b"garbage", blob[: len(blob) // 2]):
+            with open(path, "wb") as f:
+                f.write(wreck)
+            fresh = self._site("demo:corrupt")
+            assert float(fresh(x)) == want      # job survives, recompiles
+            compile_cache.flush()
+        st = compile_cache.stats()
+        assert st["errors"] == 2
+        assert st["hits"] == 0
+        # the rewritten (good) entry replaced the corrupt one
+        assert st["entries"] == 1
+
+    def test_version_mismatch_is_a_miss(self, tmp_path):
+        jnp = _jnp()
+        compile_cache.enable(str(tmp_path))
+        compile_watch.enable()
+        x = jnp.ones((5, 5))
+        self._site("demo:ver")(x)
+        compile_cache.flush()
+        (name,) = _entries(str(tmp_path))
+        path = os.path.join(str(tmp_path), name)
+        import pickle
+        with open(path, "rb") as f:
+            fmt, tag, payload, it, ot = pickle.loads(f.read())
+        stale = (fmt, ("jax-0.0.1",) + tuple(tag[1:]), payload, it, ot)
+        with open(path, "wb") as f:
+            f.write(pickle.dumps(stale))
+        assert float(self._site("demo:ver")(x)) == 125.0
+        st = compile_cache.stats()
+        assert st["errors"] == 1 and st["hits"] == 0
+
+    def test_lru_eviction_bounds_the_directory(self, tmp_path):
+        jnp = _jnp()
+        # ~4-6 KB per entry; cap at 16 KB so a handful must evict
+        compile_cache.enable(str(tmp_path), max_mb=16 / 1024.0)
+        compile_watch.enable()
+        for i in range(8):
+            fn = compile_watch.jit(lambda x: x + 1, "demo:lru%d" % i,
+                                   statics=(i,))
+            fn(jnp.ones((4, 4)))
+            compile_cache.flush()
+        st = compile_cache.stats()
+        assert st["evictions"] > 0
+        assert st["size_bytes"] <= st["max_bytes"]
+        assert st["entries"] < 8
+
+    def test_cache_only_mode_works_without_the_watch(self, tmp_path):
+        jnp = _jnp()
+        compile_cache.enable(str(tmp_path))
+        assert not compile_watch.enabled()
+        x = jnp.ones((3, 3))
+        assert float(self._site("demo:only")(x)) == 27.0
+        compile_cache.flush()
+        assert float(self._site("demo:only")(x)) == 27.0
+        st = compile_cache.stats()
+        assert st["hits"] == 1 and st["misses"] == 1
+        # and compile_watch recorded nothing (it was off)
+        assert compile_watch.stats() is None
+
+    def test_donation_stripped_only_when_cache_active(self, tmp_path):
+        """Donated buffers flowing between deserialized executables
+        corrupt the heap (observed on the CPU PJRT client), so a
+        wrapper created while the cache is active compiles WITHOUT
+        donation — and cache-less wrappers keep donating exactly as
+        before."""
+        import jax
+        jnp = _jnp()
+        compile_watch.enable()
+        f = compile_watch.jit(lambda x: x + 1, "demo:don",
+                              statics=("a",), donate_argnums=(0,))
+        x = jax.device_put(np.ones(4, np.float32))
+        f(x)
+        with pytest.raises(RuntimeError):
+            np.asarray(x)                 # donation honored: deleted
+        compile_cache.enable(str(tmp_path))
+        g = compile_watch.jit(lambda x: x + 1, "demo:don2",
+                              statics=("b",), donate_argnums=(0,))
+        y = jax.device_put(np.ones(4, np.float32))
+        out = g(y)
+        assert (np.asarray(y) == 1.0).all()   # alive: stripped
+        assert (np.asarray(out) == 2.0).all()
+        compile_cache.flush()
+        # and the stripped program cached + loads on "restart"
+        g2 = compile_watch.jit(lambda x: x + 1, "demo:don2",
+                               statics=("b",), donate_argnums=(0,))
+        assert (np.asarray(g2(jnp.ones(4))) == 2.0).all()
+        assert compile_cache.stats()["hits"] == 1
+
+    def test_unwritable_dir_degrades_not_kills(self, tmp_path,
+                                               monkeypatch):
+        target = tmp_path / "file-not-dir"
+        target.write_text("occupied")
+        monkeypatch.setenv("MXNET_COMPILE_CACHE_DIR", str(target))
+        with pytest.warns(UserWarning, match="persistent compile "
+                                             "cache disabled"):
+            assert not compile_cache.maybe_enable()
+        assert not compile_cache.enabled()
+
+
+# ---------------------------------------------------------------------------
+# the warm-restart oracles
+# ---------------------------------------------------------------------------
+
+def _mlp_sym():
+    data = mx.sym.var("data")
+    h = mx.sym.FullyConnected(data, num_hidden=16, name="fc1")
+    h = mx.sym.Activation(h, act_type="relu", name="relu1")
+    h = mx.sym.FullyConnected(h, num_hidden=4, name="fc2")
+    return mx.sym.SoftmaxOutput(h, mx.sym.var("softmax_label"),
+                                name="softmax")
+
+
+class TestWarmRestart:
+    def _fit_once(self):
+        rng = np.random.RandomState(0)
+        x = rng.randn(64, 8).astype(np.float32)
+        y = rng.randint(0, 4, 64).astype(np.float32)
+        it = mx.io.NDArrayIter(x, y, batch_size=32,
+                               label_name="softmax_label")
+        mod = mx.module.Module(_mlp_sym(), context=mx.cpu())
+        mod.fit(it, optimizer="sgd",
+                optimizer_params={"learning_rate": 0.1}, num_epoch=1,
+                initializer=mx.init.Xavier())
+
+    def test_module_fit_restart_compiles_nothing_fresh(self, tmp_path):
+        compile_cache.enable(str(tmp_path))
+        compile_watch.enable()
+        self._fit_once()
+        compile_cache.flush()
+        cold = compile_watch.stats()
+        assert cold["compiles"] > 0
+        hits_cold = compile_cache.stats()["hits"]
+        # "process restart": every Module/executor/fused-step wrapper
+        # is rebuilt from scratch; only the disk cache carries over
+        self._fit_once()
+        warm = compile_watch.stats()
+        assert warm["compiles"] == cold["compiles"], (
+            "warm restart compiled fresh programs: %s -> %s"
+            % (cold["compiles"], warm["compiles"]))
+        assert compile_cache.stats()["hits"] > hits_cold
+
+    def test_serving_warmup_loads_every_rung_from_disk(self, tmp_path):
+        from mxnet_tpu.serving import InferenceServer
+        d = mx.sym.var("data")
+        out = mx.sym.FullyConnected(d, name="fc", num_hidden=3)
+        art = str(tmp_path / "m.mxp")
+        mx.deploy.export_compiled(
+            out, art,
+            params={"fc_weight": mx.nd.ones((3, 4)),
+                    "fc_bias": mx.nd.zeros((3,))},
+            input_shapes={"data": (1, 4)}, batch_sizes=[1, 2, 4])
+        compile_cache.enable(str(tmp_path / "cache"))
+        compile_watch.enable()
+
+        def warm_server():
+            srv = InferenceServer(art, max_queue=8, start=False)
+            try:
+                return srv.warmup()
+            finally:
+                srv.stop()
+
+        assert warm_server() == 3
+        cold = compile_watch.site_stats("serving")
+        assert sum(s["count"] for s in cold.values()) == 3
+        # replica restart: same artifact, fresh server object
+        assert warm_server() == 3
+        warm = compile_watch.site_stats("serving")
+        assert sum(s["count"] for s in warm.values()) == 3, warm
+        assert sum(s.get("cache_hits", 0)
+                   for s in warm.values()) == 3, warm
+        st = compile_cache.stats()
+        assert st["hits"] == 3 and st["entries"] == 3
+
+
+# ---------------------------------------------------------------------------
+# telemetry & diagnose
+# ---------------------------------------------------------------------------
+
+class TestTelemetry:
+    def test_compile_records_tagged_and_diagnose_row(self, tmp_path,
+                                                     capsys):
+        jnp = _jnp()
+        sink = str(tmp_path / "run.jsonl")
+        compile_cache.enable(str(tmp_path / "cache"))
+        compile_watch.enable()
+        telemetry.start(filename=sink)
+        fn = compile_watch.jit(lambda x: x * 2, "demo:tel",
+                               statics=("t",))
+        telemetry.step_begin()
+        fn(jnp.ones((4,)))
+        telemetry.step_end()
+        compile_cache.flush()
+        fn2 = compile_watch.jit(lambda x: x * 2, "demo:tel",
+                                statics=("t",))
+        telemetry.step_begin()
+        fn2(jnp.ones((4,)))
+        telemetry.step_end()
+        summary = telemetry.stop()
+        cache_block = summary["compile"]["cache"]
+        assert cache_block["hits"] == 1
+        assert cache_block["misses"] == 1
+        tags = []
+        with open(sink) as f:
+            for line in f:
+                rec = json.loads(line)
+                if rec.get("type") == "compile":
+                    tags.append((rec.get("cause"), rec.get("cache")))
+        assert ("first_compile", "miss") in tags
+        assert ("disk_cache", "hit") in tags
+        from mxnet_tpu.tools import diagnose
+        diagnose.main([sink])
+        out = capsys.readouterr().out
+        assert "compile-cache:" in out
+        assert "1 hit(s) / 1 miss(es)" in out
+        assert "disk_cache" in out
+
+    def test_cacheless_run_keeps_summary_shape(self, tmp_path):
+        jnp = _jnp()
+        compile_watch.enable()
+        telemetry.start(filename=str(tmp_path / "run.jsonl"))
+        fn = compile_watch.jit(lambda x: x * 3, "demo:plain",
+                               statics=None)
+        telemetry.step_begin()
+        fn(jnp.ones((4,)))
+        telemetry.step_end()
+        summary = telemetry.stop()
+        assert "cache" not in summary["compile"]
