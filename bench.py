@@ -2300,5 +2300,26 @@ if __name__ == "__main__":
         # checkpointing off vs sync vs async on the MLP and convnet
         # cases, one JSON line (the BENCH_r10 artifact)
         print(json.dumps(_checkpoint_record()))
+    elif "--lint" in sys.argv:
+        # mxlint wall-time guard: the tree-wide static-analysis run
+        # is a tier-1 test, so its cost is a perf surface — this mode
+        # records it (cold parse + warm re-run) so a quadratic rule
+        # regression shows up as a number, not a slow CI mystery
+        import time as _time
+        from mxnet_tpu.tools.lint import lint_paths
+        t0 = _time.perf_counter()
+        cold = lint_paths()
+        t_cold = _time.perf_counter() - t0
+        t0 = _time.perf_counter()
+        warm = lint_paths()
+        t_warm = _time.perf_counter() - t0
+        print(json.dumps({
+            "bench": "lint", "files": cold.files,
+            "violations": len(cold.violations),
+            "baselined": len(cold.baselined),
+            "suppressed": cold.suppressed,
+            "cold_s": round(t_cold, 3), "warm_s": round(t_warm, 3),
+            "budget_s": 10.0, "within_budget": t_warm < 10.0,
+        }))
     else:
         main()

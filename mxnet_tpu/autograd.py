@@ -231,7 +231,11 @@ _bwd_cache_lock = threading.Lock()
 
 
 def _get_backward_fn(struct, instrs, head_refs):
+    import hashlib
+
     import jax
+
+    from . import compile_watch
     key = (struct, head_refs)
     fn = _bwd_cache.get(key)
     if fn is None:
@@ -242,7 +246,17 @@ def _get_backward_fn(struct, instrs, head_refs):
             outs, vjp_fn = jax.vjp(f, list(leaf_vals))
             grads, = vjp_fn(tuple(cotangents))
             return outs, grads
-        fn = jax.jit(fwd_bwd)
+        # ``struct`` (op names + attr keys + bindings) IS the program
+        # content this closure bakes in, so its digest makes the
+        # persistent compile cache safe across processes: two tapes
+        # with identical shapes but different ops cannot collide.
+        # storm=False — each distinct tape is a new program by design
+        # (specialization, not churn).
+        token = hashlib.sha256(
+            repr((struct, head_refs)).encode()).hexdigest()
+        fn = compile_watch.jit(fwd_bwd, "autograd:backward",
+                               statics=token[:16], storm=False,
+                               cache_token=token)
         with _bwd_cache_lock:
             _bwd_cache[key] = fn
     return fn

@@ -37,7 +37,8 @@ import warnings
 
 import numpy as np
 
-from ..base import MXNetError, get_env
+from .. import envs
+from ..base import MXNetError
 from ..io.io import DataBatch, DataDesc, DataIter
 from ..ndarray import array as _nd_array
 from .ladder import BucketLadder, as_ladder, ladder_from_env
@@ -82,7 +83,7 @@ class BucketedPipeline(DataIter):
         self.ladder = ladder
         self.seq_axis = int(seq_axis)
         self.window = int(window) if window is not None else max(
-            1, get_env("MXNET_BUCKET_WINDOW", 4 * int(batch_size), int))
+            1, envs.get_int("MXNET_BUCKET_WINDOW", 4 * int(batch_size)))
         self.data_name = data_name
         self.label_name = label_name
         self.pad_value = pad_value

@@ -242,7 +242,8 @@ def ladder_from_env(var="MXNET_BUCKET_LADDER", default=None):
     ``"4x16,8x16,8x32"`` -> a ShapeLadder over (batch, length)-style
     tuples. Returns ``default`` (normalized) when the variable is
     unset/empty."""
-    raw = os.environ.get(var, "").strip()
+    from .. import envs
+    raw = (envs.get_raw(var) or "").strip()
     if not raw:
         return as_ladder(default) if default is not None else None
     rungs = []

@@ -14,7 +14,7 @@ from __future__ import annotations
 
 import threading
 
-from ..base import get_env
+from .. import envs
 from .ladder import bucket_sort_key, format_bucket
 
 __all__ = ["BucketingStats"]
@@ -26,7 +26,7 @@ class BucketingStats:
     def __init__(self, name=None, record_every=None):
         self.name = name
         self._record_every = int(record_every) if record_every \
-            else get_env("MXNET_BUCKETING_RECORD_EVERY", 50, int)
+            else envs.get_int("MXNET_BUCKETING_RECORD_EVERY")
         self._mu = threading.Lock()
         self._batches_since_record = 0
         self.reset()

@@ -77,7 +77,8 @@ import time
 
 import numpy as _np
 
-from .base import MXNetError, get_env
+from . import envs
+from .base import MXNetError
 
 __all__ = ["CheckpointManager", "async_checkpoint_enabled",
            "manifest_path", "load_manifest", "validate_manifest",
@@ -91,8 +92,7 @@ MANIFEST_FORMAT = 1
 def async_checkpoint_enabled():
     """The ``MXNET_ASYNC_CHECKPOINT`` gate (default ON) — re-read per
     fit so benchmarks and tests can toggle it."""
-    return os.environ.get("MXNET_ASYNC_CHECKPOINT", "1").strip().lower() \
-        not in ("0", "false", "off")
+    return envs.get_bool("MXNET_ASYNC_CHECKPOINT")
 
 
 def _tag(prefix, epoch):
@@ -174,8 +174,7 @@ def write_bytes_async(fname, payload):
     with _bytes_lock:
         if _bytes_thread is None or not _bytes_thread.is_alive():
             _bytes_q = queue.Queue(
-                maxsize=max(1, get_env("MXNET_CHECKPOINT_INFLIGHT", 2,
-                                       int)))
+                maxsize=max(1, envs.get_int("MXNET_CHECKPOINT_INFLIGHT")))
             _bytes_thread = threading.Thread(
                 target=_bytes_writer_loop, daemon=True,
                 name="mxckpt-bytes")
@@ -524,7 +523,7 @@ class CheckpointManager:
         self.async_ = async_checkpoint_enabled() if async_ is None \
             else bool(async_)
         depth = inflight if inflight is not None \
-            else get_env("MXNET_CHECKPOINT_INFLIGHT", 2, int)
+            else envs.get_int("MXNET_CHECKPOINT_INFLIGHT")
         self._q = queue.Queue(maxsize=max(1, int(depth)))
         self._thread = None
         self._lock = threading.Lock()

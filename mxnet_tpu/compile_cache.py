@@ -58,7 +58,7 @@ import threading
 import time
 import warnings
 
-from .base import get_env
+from . import envs
 
 __all__ = ["enabled", "enable", "disable", "maybe_enable", "stats",
            "entry_key", "lookup", "store", "flush", "cache_dir"]
@@ -94,7 +94,7 @@ class _Cache:
                 except OSError:
                     pass
         if max_mb is None:
-            max_mb = get_env("MXNET_COMPILE_CACHE_MB", 512.0, float)
+            max_mb = envs.get_float("MXNET_COMPILE_CACHE_MB")
         self.max_bytes = max(1, int(float(max_mb) * (1 << 20)))
         self.hits = 0
         self.misses = 0
@@ -109,8 +109,7 @@ class _Cache:
         # host memory holding executables for a slow disk — drop (and
         # count) instead, the entry simply stays cold
         self.pending = _queue_mod.Queue(
-            maxsize=max(1, get_env("MXNET_COMPILE_CACHE_QUEUE", 64,
-                                   int)))
+            maxsize=max(1, envs.get_int("MXNET_COMPILE_CACHE_QUEUE")))
         self.writer = threading.Thread(
             target=self._writer_loop, name="mxnet-compile-cache-writer",
             daemon=True)
@@ -205,7 +204,7 @@ def enable(path=None, max_mb=None):
     (the old writer thread is stopped)."""
     global _cache
     if path is None:
-        path = os.environ.get("MXNET_COMPILE_CACHE_DIR", "").strip()
+        path = envs.get_path("MXNET_COMPILE_CACHE_DIR")
         if not path:
             raise ValueError(
                 "compile_cache.enable: pass path= or set "
@@ -264,7 +263,7 @@ def maybe_enable():
         return True
     if _env_failed:
         return False
-    path = os.environ.get("MXNET_COMPILE_CACHE_DIR", "").strip()
+    path = envs.get_path("MXNET_COMPILE_CACHE_DIR")
     if not path:
         return False
     try:

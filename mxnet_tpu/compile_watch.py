@@ -65,14 +65,12 @@ observes.
 """
 from __future__ import annotations
 
-import os
 import threading
 import time
 import warnings
 from collections import deque
 
-from . import compile_cache
-from .base import get_env
+from . import compile_cache, envs
 
 __all__ = ["enabled", "enable", "disable", "reset", "maybe_enable",
            "jit", "stats", "site_stats", "recent_mfu", "peak_table",
@@ -137,9 +135,9 @@ def peak_table():
     devices = jax.local_devices()
     kind = devices[0].device_kind if devices else "cpu"
     platform = devices[0].platform if devices else "cpu"
-    flops = get_env("MXNET_DEVICE_PEAK_FLOPS", 0.0, float) or \
+    flops = envs.get_float("MXNET_DEVICE_PEAK_FLOPS") or \
         _lookup_peak(PEAK_FLOPS, kind, platform)
-    bw = get_env("MXNET_DEVICE_PEAK_BW", 0.0, float) or \
+    bw = envs.get_float("MXNET_DEVICE_PEAK_BW") or \
         _lookup_peak(PEAK_BW, kind, platform)
     return float(flops), float(bw), kind, max(1, len(devices))
 
@@ -174,11 +172,11 @@ class _Watch:
         self.total_flops = 0.0
         self.total_bytes = 0.0
         self.mfu_ring = deque(maxlen=max(
-            1, get_env("MXNET_TELEMETRY_RING", 1024, int)))
+            1, envs.get_int("MXNET_TELEMETRY_RING")))
         self.bw_ring = deque(maxlen=self.mfu_ring.maxlen)
-        self.storm_k = max(2, get_env("MXNET_COMPILE_STORM_K", 3, int))
+        self.storm_k = max(2, envs.get_int("MXNET_COMPILE_STORM_K"))
         self.storm_steps = max(
-            1, get_env("MXNET_COMPILE_STORM_STEPS", 50, int))
+            1, envs.get_int("MXNET_COMPILE_STORM_STEPS"))
         self.peak_flops, self.peak_bw, self.device_kind, self.n_devices \
             = peak_table()
 
@@ -242,8 +240,7 @@ def maybe_enable():
     after the call."""
     if _watch is not None:
         return True
-    if os.environ.get("MXNET_COMPILE_WATCH", "").strip().lower() \
-            in ("1", "true", "on", "yes"):
+    if envs.get_bool("MXNET_COMPILE_WATCH"):
         enable()
         return True
     return False

@@ -113,8 +113,8 @@ def _initial_default_context() -> "Context":
     device. ``MXNET_DEFAULT_CONTEXT=cpu`` (or ``tpu``/``gpu``) overrides.
     Unit tests pin ``JAX_PLATFORMS=cpu`` and therefore still get cpu.
     """
-    import os
-    override = os.environ.get("MXNET_DEFAULT_CONTEXT", "").strip().lower()
+    from . import envs
+    override = envs.get_str("MXNET_DEFAULT_CONTEXT").lower()
     if override:
         return Context(override, 0)
     try:

@@ -22,7 +22,7 @@ import json
 
 import numpy as _np
 
-from ...base import MXNetError
+from ...base import MXNetError, atomic_write_bytes
 from ...ops.registry import get_op, normalize_attrs
 
 __all__ = ["symbol_to_onnx_ir", "ir_to_onnx", "export_model",
@@ -324,8 +324,9 @@ def export_model(sym, params, input_shapes, onnx_file_path,
                  for k, v in params.items()}
     ir = symbol_to_onnx_ir(sym, np_params, input_shapes)
     model = ir_to_onnx(ir)
-    with open(onnx_file_path, "wb") as f:
-        f.write(model.SerializeToString())
+    # the shared durable-write discipline: never leave a truncated
+    # .onnx on a preempted export
+    atomic_write_bytes(onnx_file_path, model.SerializeToString())
     if verbose:
         print("exported", onnx_file_path)
     return onnx_file_path

@@ -41,7 +41,7 @@ import struct
 
 import numpy as _np
 
-from .base import MXNetError
+from .base import MXNetError, atomic_write_bytes
 
 __all__ = ["export_compiled", "load_compiled", "Predictor",
            "check_cast_dtype"]
@@ -184,12 +184,12 @@ def export_compiled(model, path, input_shapes, params=None,
         "framework": "mxnet_tpu",
     }
     meta_bytes = json.dumps(meta).encode()
-    with open(path, "wb") as f:
-        f.write(_MAGIC)
-        f.write(struct.pack("<I", len(meta_bytes)))
-        f.write(meta_bytes)
-        for blob in blobs:
-            f.write(blob)
+    # atomic_write_bytes (tmp + os.replace): a preempted export must
+    # leave any previous artifact intact, never a truncated one a
+    # serving replica could load
+    atomic_write_bytes(path, b"".join(
+        [_MAGIC, struct.pack("<I", len(meta_bytes)), meta_bytes]
+        + blobs))
     return path
 
 

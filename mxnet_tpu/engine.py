@@ -17,7 +17,7 @@ from __future__ import annotations
 
 import contextlib
 
-from .base import get_env
+from . import envs
 
 __all__ = ["bulk", "set_bulk_size", "wait_for_all", "engine_type",
            "naive_engine", "compiler_options"]
@@ -41,7 +41,7 @@ def compiler_options(ctx=None):
     """
     global _compiler_options
     if _compiler_options is None:
-        env = get_env("MXNET_XLA_COMPILER_OPTIONS", None)
+        env = envs.get_str("MXNET_XLA_COMPILER_OPTIONS")
         if env == "none":
             _compiler_options = {}
         elif env:
@@ -73,7 +73,7 @@ def compiler_options(ctx=None):
 
 
 def engine_type():
-    return get_env("MXNET_ENGINE_TYPE", "ThreadedEnginePerDevice")
+    return envs.get_str("MXNET_ENGINE_TYPE")
 
 
 def set_bulk_size(size):

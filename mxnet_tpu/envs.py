@@ -1,0 +1,414 @@
+"""Typed ``MXNET_*`` environment-variable registry.
+
+Every environment knob the framework reads is DECLARED here once —
+name, type, default, one-line doc — and read everywhere else through
+the typed accessors (:func:`get_bool` / :func:`get_int` /
+:func:`get_float` / :func:`get_str` / :func:`get_path`).  This replaces
+the point-of-use ``base.get_env``/``os.environ`` reads that grew one
+per PR, and extends the ``MXNET_BUCKET_LADDER`` precedent (a malformed
+value raises :class:`MXNetError` NAMING the variable, instead of being
+silently swallowed into a default) to the whole surface:
+
+- a read of an UNDECLARED ``MXNET_*`` name raises — a typo'd knob
+  fails loudly at the read site instead of silently using defaults;
+- a value that does not parse as the declared type raises
+  ``MXNetError("MXNET_FOO='x': ...")`` — the operator is told exactly
+  which variable to fix;
+- accessors are type-checked against the declaration, so a knob
+  cannot drift between int-at-one-site / float-at-another;
+- reads stay POINT-OF-USE (nothing is cached here): tests and
+  benchmarks that flip a variable mid-process keep working.
+
+The ``env-registry`` mxlint rule (``mxnet_tpu/tools/lint``) enforces
+that no framework module reads ``MXNET_*`` any other way, and
+``python -m mxnet_tpu.tools.lint --envs`` renders the registry as the
+environment-variable reference (the auto-derived successor of the
+reference's ``docs/faq/env_var.md``).
+
+Declarations keep insertion order; :func:`render_reference` groups by
+the ``group`` tag for the generated docs table.
+"""
+from __future__ import annotations
+
+import os
+from typing import Dict, Optional
+
+from .base import MXNetError
+
+__all__ = [
+    "EnvVar", "declare", "declared", "registry", "get_bool", "get_int",
+    "get_float", "get_str", "get_path", "get_raw", "snapshot",
+    "render_reference",
+]
+
+_TRUE = ("1", "true", "yes", "on")
+_FALSE = ("0", "false", "no", "off")
+
+
+class EnvVar:
+    """One declared knob: ``name``, ``kind`` (bool/int/float/str/path),
+    ``default`` (returned when unset), ``doc`` (one line, rendered into
+    the generated reference), ``group`` (reference section)."""
+
+    __slots__ = ("name", "kind", "default", "doc", "group")
+
+    def __init__(self, name, kind, default, doc, group):
+        self.name = name
+        self.kind = kind
+        self.default = default
+        self.doc = doc
+        self.group = group
+
+    def __repr__(self):
+        return "EnvVar(%s, %s, default=%r)" % (self.name, self.kind,
+                                               self.default)
+
+
+_REGISTRY: Dict[str, EnvVar] = {}
+
+
+def declare(name, kind, default, doc, group="misc"):
+    if kind not in ("bool", "int", "float", "str", "path"):
+        raise MXNetError("envs.declare(%s): unknown kind %r"
+                         % (name, kind))
+    if name in _REGISTRY:
+        raise MXNetError("envs.declare(%s): already declared" % name)
+    var = EnvVar(name, kind, default, doc, group)
+    _REGISTRY[name] = var
+    return var
+
+
+def declared(name):
+    """True when ``name`` is a registered variable."""
+    return name in _REGISTRY
+
+
+def registry():
+    """The declarations, in declaration order (read-only view)."""
+    return dict(_REGISTRY)
+
+
+# ---------------------------------------------------------------------------
+# the declarations — one line per knob, grouped like the generated docs
+# ---------------------------------------------------------------------------
+
+_G = "execution"
+declare("MXNET_FUSED_STEP", "bool", True,
+        "Compile forward+backward+optimizer update into one XLA "
+        "program (eager fallback when off).", _G)
+declare("MXNET_ENGINE_TYPE", "str", "ThreadedEnginePerDevice",
+        "Reported execution-engine type (reference-parity knob; "
+        "informational under XLA).", _G)
+declare("MXNET_XLA_COMPILER_OPTIONS", "str", None,
+        "Comma-separated k=v XLA compiler options applied at every "
+        "compile; 'none' clears the built-in defaults.", _G)
+declare("MXNET_DEFAULT_CONTEXT", "str", "",
+        "Override the default device context: cpu / gpu / tpu.", _G)
+declare("MXNET_INT64_TENSOR_SIZE", "bool", False,
+        "Enable int64 tensor indexing (large-tensor support).", _G)
+declare("MXNET_UPDATE_ON_KVSTORE", "bool", None,
+        "Run optimizer updates on the kvstore instead of the worker "
+        "(default depends on the kvstore type).", _G)
+
+_G = "compile"
+declare("MXNET_COMPILE_WATCH", "bool", False,
+        "Stage every framework jit site explicitly: per-compile "
+        "timing, recompile causes, storms, MFU.", _G)
+declare("MXNET_COMPILE_STORM_K", "int", 3,
+        "Compiles of one program within the storm window that fire "
+        "the recompile-storm warning.", _G)
+declare("MXNET_COMPILE_STORM_STEPS", "int", 50,
+        "The recompile-storm window, in telemetry steps (watched "
+        "dispatches without a run).", _G)
+declare("MXNET_DEVICE_PEAK_FLOPS", "float", 0.0,
+        "Per-device peak FLOP/s for MFU math (0 = use the built-in "
+        "peak table).", _G)
+declare("MXNET_DEVICE_PEAK_BW", "float", 0.0,
+        "Per-device peak memory bandwidth bytes/s for BW-utilization "
+        "math (0 = built-in table).", _G)
+declare("MXNET_COMPILE_CACHE_DIR", "path", "",
+        "Directory for the persistent on-disk compile cache; empty "
+        "disables it.", _G)
+declare("MXNET_COMPILE_CACHE_MB", "float", 512.0,
+        "LRU byte cap for the on-disk compile cache, in MB.", _G)
+declare("MXNET_COMPILE_CACHE_QUEUE", "int", 64,
+        "Bounded depth of the compile-cache background store queue "
+        "(overflow drops the store, entry stays cold).", _G)
+
+_G = "telemetry"
+declare("MXNET_TELEMETRY", "bool", False,
+        "Auto-start a telemetry run at the first step.", _G)
+declare("MXNET_TELEMETRY_FILE", "path", "",
+        "JSONL sink for telemetry records; empty keeps records "
+        "in-memory only.", _G)
+declare("MXNET_TELEMETRY_RING", "int", 1024,
+        "Ring size of the per-metric latency/MFU reservoirs.", _G)
+declare("MXNET_TELEMETRY_MEM_INTERVAL", "int", 10,
+        "Steps between host/device memory samples.", _G)
+declare("MXNET_TELEMETRY_FLUSH_STEPS", "int", 50,
+        "Steps between sink flushes.", _G)
+declare("MXNET_TELEMETRY_MAX_RECORDS", "int", 100000,
+        "In-memory record cap for sink-less runs (overflow drops and "
+        "counts).", _G)
+declare("MXNET_TELEMETRY_LIVE_BUFFERS", "int", 1,
+        "Keep the last N flushed record buffers live for /metrics "
+        "scrapes.", _G)
+declare("MXNET_TRACE", "bool", False,
+        "Arm the always-on request/step tracer.", _G)
+declare("MXNET_TRACE_FILE", "path", "",
+        "Perfetto-JSON sink the tracer exports to at exit/dump.", _G)
+declare("MXNET_TRACE_RING", "int", 200000,
+        "Bounded in-memory trace-event ring (oldest dropped).", _G)
+declare("MXNET_TRACE_TRACKS", "int", 4096,
+        "Cap on distinct trace tracks (request lanes).", _G)
+declare("MXNET_PROFILER_MAX_EVENTS", "int", 1000000,
+        "Host-profiler event cap; overflow increments "
+        "profiler_events_dropped instead of growing forever.", _G)
+declare("MXNET_METRICS_PORT", "int", 0,
+        "Serve the live /metrics endpoint on this port (0 picks a "
+        "free port when started explicitly; unset disables).", _G)
+declare("MXNET_METRICS_HOST", "str", "",
+        "Bind host for the /metrics endpoint (default 127.0.0.1).",
+        _G)
+declare("MXNET_WATCHDOG", "bool", False,
+        "Arm the SLO watchdog over serving/training step health.", _G)
+declare("MXNET_WATCHDOG_DRIFT", "float", 1.5,
+        "Step-time drift factor over baseline that counts as a slow "
+        "step.", _G)
+declare("MXNET_WATCHDOG_WINDOW", "int", 20,
+        "Sliding window (steps) for watchdog drift checks.", _G)
+declare("MXNET_WATCHDOG_BASELINE", "int", 50,
+        "Steps used to establish the watchdog's baseline step "
+        "time.", _G)
+declare("MXNET_WATCHDOG_SUSTAIN", "int", 10,
+        "Consecutive slow windows before the watchdog fires.", _G)
+declare("MXNET_WATCHDOG_SHED_RATE", "float", 0.3,
+        "Fraction of low-priority serving load shed when the "
+        "watchdog trips.", _G)
+declare("MXNET_WATCHDOG_MIN_REQUESTS", "int", 20,
+        "Minimum requests in a window before serving SLO checks "
+        "apply.", _G)
+declare("MXNET_WATCHDOG_QUEUE_FRAC", "float", 0.9,
+        "Admission-queue occupancy fraction that counts as "
+        "saturation.", _G)
+declare("MXNET_WATCHDOG_SKEW", "float", 2.0,
+        "Max replica service-time skew before the watchdog flags an "
+        "unhealthy replica.", _G)
+
+_G = "fault"
+declare("MXNET_FAULT_PLAN", "str", "",
+        "Deterministic fault-injection plan, e.g. "
+        "'push:step=1:raise' (see fault.py).", _G)
+declare("MXNET_FAULT_HANG_SECONDS", "float", 0.05,
+        "Duration of an injected 'hang' fault.", _G)
+declare("MXNET_NONFINITE_GUARD", "str", "",
+        "Non-finite gradient policy: skip_step | scale_backoff | "
+        "empty (off).", _G)
+declare("MXNET_LOSS_SCALE", "float", 2.0 ** 15,
+        "Initial loss scale for the scale_backoff guard.", _G)
+declare("MXNET_LOSS_SCALE_WINDOW", "int", 2000,
+        "Good steps between loss-scale growth attempts.", _G)
+declare("MXNET_KVSTORE_TIMEOUT", "float", 60.0,
+        "Seconds a collective may retry before "
+        "CollectiveTimeoutError.", _G)
+declare("MXNET_KVSTORE_RETRY_BACKOFF", "float", 0.05,
+        "Initial collective retry backoff, seconds.", _G)
+declare("MXNET_KVSTORE_RETRY_MAX_BACKOFF", "float", 2.0,
+        "Backoff ceiling for collective retries, seconds.", _G)
+
+_G = "parallel"
+declare("MXNET_GRAD_OVERLAP", "bool", False,
+        "Bucketed backward-ordered reduce-scatter + ZeRO-1 sharded "
+        "update inside the compiled step.", _G)
+declare("MXNET_GRAD_BUCKET_MB", "float", 4.0,
+        "Gradient-bucket size cap for the overlap path, MB.", _G)
+declare("MXNET_PARAM_SHARD", "bool", False,
+        "Keep parameters FSDP-sharded at rest with just-in-time "
+        "entry gathers.", _G)
+declare("MXNET_TPU_COORDINATOR", "str", None,
+        "Multi-process coordinator address for "
+        "jax.distributed.initialize.", _G)
+declare("MXNET_TPU_WORLD", "int", None,
+        "Multi-process world size.", _G)
+declare("MXNET_TPU_RANK", "int", None,
+        "This process's rank in the multi-process world.", _G)
+
+_G = "io"
+declare("MXNET_DATA_PIPELINE", "bool", True,
+        "Route Module/Gluon fit loops through the async input "
+        "pipeline.", _G)
+declare("MXNET_DATA_WORKERS", "int", 2,
+        "Decode-pool width of the async input pipeline.", _G)
+declare("MXNET_USE_NATIVE_IO", "bool", True,
+        "Use the native record/image readers where available.", _G)
+declare("MXNET_ASYNC_CHECKPOINT", "bool", True,
+        "Write checkpoints from the bounded background writer "
+        "instead of blocking the step.", _G)
+declare("MXNET_CHECKPOINT_INFLIGHT", "int", 2,
+        "Bounded queue depth of in-flight async checkpoint "
+        "snapshots (backpressure past it).", _G)
+
+_G = "serving"
+declare("MXNET_SERVING_MAX_OUTSTANDING", "int", 2,
+        "Per-replica outstanding-dispatch bound (admission "
+        "backpressure).", _G)
+declare("MXNET_SERVING_RECORD_EVERY", "int", 50,
+        "Batches between serving telemetry records.", _G)
+declare("MXNET_SERVING_LATENCY_RING", "int", 8192,
+        "Ring size of the serving latency reservoir.", _G)
+
+_G = "bucketing"
+declare("MXNET_BUCKET_LADDER", "str", "",
+        "Process-default shape ladder: '8,16,32' or "
+        "'4x16,8x16,8x32' (parsed by bucketing.ladder).", _G)
+declare("MXNET_BUCKET_WINDOW", "int", None,
+        "Ragged-stream reorder window, samples (default "
+        "4 x batch_size).", _G)
+declare("MXNET_BUCKETING_RECORD_EVERY", "int", 50,
+        "Batches between bucketing telemetry records.", _G)
+
+_G = "test"
+declare("MXNET_TEST_SEED", "int", 0,
+        "Deterministic seed for the test suite (0 = draw one and "
+        "print it).", _G)
+declare("MXNET_TEST_DEFAULT_CTX", "str", None,
+        "Device context the test utilities bind to, e.g. 'cpu' or "
+        "'tpu:0'.", _G)
+
+
+# ---------------------------------------------------------------------------
+# accessors
+# ---------------------------------------------------------------------------
+
+_UNSET = object()
+
+
+def _var(name, kind):
+    var = _REGISTRY.get(name)
+    if var is None:
+        raise MXNetError(
+            "%s is not a registered environment variable — declare "
+            "it in mxnet_tpu/envs.py (typed, with a default and a "
+            "one-line doc)" % name)
+    if var.kind != kind:
+        raise MXNetError(
+            "%s is declared as %s in mxnet_tpu/envs.py but was read "
+            "as %s — use get_%s()" % (name, var.kind, kind, var.kind))
+    return var
+
+
+def _read(name, kind, default):
+    var = _var(name, kind)
+    raw = os.environ.get(name)
+    if raw is None:
+        return var.default if default is _UNSET else default
+    return raw
+
+
+def get_bool(name, default=_UNSET) -> Optional[bool]:
+    """Strict boolean: 1/true/yes/on or 0/false/no/off (case-
+    insensitive); VAR= (empty) means unset — the declared default,
+    like every other accessor, so an empty value can never silently
+    flip a default-ON gate off; anything else raises naming the
+    variable."""
+    raw = _read(name, "bool", default)
+    if not isinstance(raw, str):
+        return raw
+    tok = raw.strip().lower()
+    if not tok:
+        return _unset_default(name, default)
+    if tok in _TRUE:
+        return True
+    if tok in _FALSE:
+        return False
+    raise MXNetError(
+        "%s=%r is not a boolean — use one of %s / %s"
+        % (name, raw, "|".join(_TRUE), "|".join(_FALSE)))
+
+
+def _unset_default(name, default):
+    var = _REGISTRY[name]
+    return var.default if default is _UNSET else default
+
+
+def get_int(name, default=_UNSET) -> Optional[int]:
+    raw = _read(name, "int", default)
+    if not isinstance(raw, str):
+        return raw
+    if not raw.strip():
+        # VAR= (empty) is the shell/compose idiom for "unset": it
+        # means disabled/default everywhere in this tree, never a
+        # parse error (get_bool's '' -> False is the same rule)
+        return _unset_default(name, default)
+    try:
+        return int(raw.strip())
+    except ValueError:
+        raise MXNetError("%s=%r is not an integer" % (name, raw))
+
+
+def get_float(name, default=_UNSET) -> Optional[float]:
+    raw = _read(name, "float", default)
+    if not isinstance(raw, str):
+        return raw
+    if not raw.strip():
+        return _unset_default(name, default)
+    try:
+        return float(raw.strip())
+    except ValueError:
+        raise MXNetError("%s=%r is not a number" % (name, raw))
+
+
+def get_str(name, default=_UNSET) -> Optional[str]:
+    raw = _read(name, "str", default)
+    return raw.strip() if isinstance(raw, str) else raw
+
+
+def get_path(name, default=_UNSET) -> Optional[str]:
+    """A filesystem path (no existence check — creation is the
+    caller's policy); surrounding whitespace stripped."""
+    raw = _read(name, "path", default)
+    return raw.strip() if isinstance(raw, str) else raw
+
+
+def get_raw(name) -> Optional[str]:
+    """The unparsed value of a DECLARED variable (None when unset) —
+    for knobs with their own grammar (``MXNET_BUCKET_LADDER``,
+    ``MXNET_FAULT_PLAN``) whose parse lives next to their domain."""
+    if name not in _REGISTRY:
+        _var(name, "str")          # raises the not-registered error
+    return os.environ.get(name)
+
+
+def snapshot():
+    """{name: raw value} for every DECLARED variable currently set in
+    the process environment — the diagnose tool's knob table."""
+    return {name: os.environ[name] for name in _REGISTRY
+            if name in os.environ}
+
+
+# ---------------------------------------------------------------------------
+# generated reference
+# ---------------------------------------------------------------------------
+
+def render_reference():
+    """The MXNET_* environment-variable reference as markdown, derived
+    from the registry (``python -m mxnet_tpu.tools.lint --envs``)."""
+    lines = ["# MXNET_* environment variables",
+             "",
+             "Generated from `mxnet_tpu/envs.py` by "
+             "`python -m mxnet_tpu.tools.lint --envs` — do not edit "
+             "by hand.", ""]
+    groups = {}
+    for var in _REGISTRY.values():
+        groups.setdefault(var.group, []).append(var)
+    for group, entries in groups.items():
+        lines.append("## %s" % group)
+        lines.append("")
+        lines.append("| variable | type | default | description |")
+        lines.append("|---|---|---|---|")
+        for v in entries:
+            default = "" if v.default is None else repr(v.default)
+            lines.append("| `%s` | %s | `%s` | %s |"
+                         % (v.name, v.kind, default, v.doc))
+        lines.append("")
+    return "\n".join(lines)

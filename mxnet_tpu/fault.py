@@ -43,7 +43,8 @@ import random
 import threading
 import time
 
-from .base import MXNetError, get_env
+from . import envs
+from .base import MXNetError
 
 __all__ = ["FaultPlan", "InjectedFault", "InjectedHang",
            "CollectiveTimeoutError", "plan", "set_plan", "reset",
@@ -200,7 +201,7 @@ def plan():
     if not _plan_loaded:
         with _lock:
             if not _plan_loaded:
-                spec = os.environ.get("MXNET_FAULT_PLAN", "")
+                spec = envs.get_raw("MXNET_FAULT_PLAN") or ""
                 _plan = FaultPlan.parse(spec) if spec.strip() else None
                 if _plan is not None and not _plan.entries:
                     _plan = None
@@ -264,7 +265,7 @@ def guard_policy():
     a ``grad`` site, else None."""
     global _guard, _guard_loaded
     if not _guard_loaded:
-        env = os.environ.get("MXNET_NONFINITE_GUARD", "").strip()
+        env = envs.get_str("MXNET_NONFINITE_GUARD")
         if env and env != "off":
             if env not in _GUARD_POLICIES:
                 raise MXNetError(
@@ -292,7 +293,7 @@ def is_enabled():
 # ---------------------------------------------------------------------------
 
 def _hang_seconds():
-    return get_env("MXNET_FAULT_HANG_SECONDS", 0.05, float)
+    return envs.get_float("MXNET_FAULT_HANG_SECONDS")
 
 
 def _corrupt(value, kind):
@@ -380,9 +381,9 @@ def _retry_config():
     global _retry_cfg
     if _retry_cfg is None:
         _retry_cfg = (
-            get_env("MXNET_KVSTORE_TIMEOUT", 60.0, float),
-            get_env("MXNET_KVSTORE_RETRY_BACKOFF", 0.05, float),
-            get_env("MXNET_KVSTORE_RETRY_MAX_BACKOFF", 2.0, float))
+            envs.get_float("MXNET_KVSTORE_TIMEOUT"),
+            envs.get_float("MXNET_KVSTORE_RETRY_BACKOFF"),
+            envs.get_float("MXNET_KVSTORE_RETRY_MAX_BACKOFF"))
     return _retry_cfg
 
 
@@ -506,7 +507,7 @@ def loss_scale():
     if guard_policy() != "scale_backoff":
         return 1.0
     if _loss_scale_val is None:
-        _loss_scale_val = get_env("MXNET_LOSS_SCALE", 2.0 ** 15, float)
+        _loss_scale_val = envs.get_float("MXNET_LOSS_SCALE")
     return _loss_scale_val
 
 
@@ -534,7 +535,7 @@ def _close_step():
     if guard_policy() != "scale_backoff" or not _step_clean:
         return
     _good_steps += 1
-    window = get_env("MXNET_LOSS_SCALE_WINDOW", 2000, int)
+    window = envs.get_int("MXNET_LOSS_SCALE_WINDOW")
     if _good_steps >= window:
         _loss_scale_val = min(loss_scale() * 2.0, _LOSS_SCALE_MAX)
         _good_steps = 0

@@ -54,7 +54,6 @@ into the traced inputs safely.
 """
 from __future__ import annotations
 
-import os
 
 import numpy as _np
 
@@ -67,8 +66,8 @@ __all__ = ["fused_step_enabled", "FusedStepExecutor", "FusedUpdater",
 def fused_step_enabled():
     """The MXNET_FUSED_STEP gate — default ON; ``0``/``false``/``off``
     disable (re-read each step so benchmarks can toggle it)."""
-    return os.environ.get("MXNET_FUSED_STEP", "1").strip().lower() \
-        not in ("0", "false", "off")
+    from . import envs
+    return envs.get_bool("MXNET_FUSED_STEP")
 
 
 def _count(name, delta=1):

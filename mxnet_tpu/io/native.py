@@ -13,7 +13,7 @@ from __future__ import annotations
 import ctypes
 import os
 
-from ..base import get_env
+from .. import envs
 
 __all__ = ["available", "lib_path", "NativeRecordReader",
            "PrefetchingRecordReader"]
@@ -33,7 +33,7 @@ def _load():
     if _TRIED:
         return _LIB
     _TRIED = True
-    if not get_env("MXNET_USE_NATIVE_IO", True, bool):
+    if not envs.get_bool("MXNET_USE_NATIVE_IO"):
         return None
     path = lib_path()
     if not os.path.exists(path):

@@ -53,7 +53,7 @@ import queue
 import threading
 from concurrent.futures import ThreadPoolExecutor
 
-from ..base import get_env
+from .. import envs
 from .io import DataBatch, DataIter
 
 __all__ = ["AsyncInputPipeline", "data_workers", "pipeline_enabled",
@@ -83,16 +83,14 @@ def stop_aware_put(q, item, stop, tick=_PUT_TICK):
 
 def data_workers(default=2):
     """The configured decode-pool width (``MXNET_DATA_WORKERS``)."""
-    return max(1, get_env("MXNET_DATA_WORKERS", default, int))
+    return max(1, envs.get_int("MXNET_DATA_WORKERS", default))
 
 
 def pipeline_enabled():
     """The ``MXNET_DATA_PIPELINE`` gate for the fit-loop wiring —
     default ON; ``0``/``false``/``off`` fall back to the plain
     iterator (re-read each fit so benchmarks can toggle it)."""
-    import os
-    return os.environ.get("MXNET_DATA_PIPELINE", "1").strip().lower() \
-        not in ("0", "false", "off")
+    return envs.get_bool("MXNET_DATA_PIPELINE")
 
 
 # ---------------------------------------------------------------------------

@@ -43,13 +43,12 @@ from __future__ import annotations
 
 import bisect
 import itertools
-import os
 import threading
 import warnings
 import weakref
 from collections import deque
 
-from .base import get_env
+from . import envs
 
 __all__ = ["serve", "stop_server", "server_port", "render",
            "register_server", "deregister_server", "Watchdog",
@@ -105,15 +104,14 @@ def maybe_start(fresh_run=False):
     watchdog — the previous run's rolling step-time baseline belongs
     to a different workload and would fire spurious drift alerts on
     the new one."""
-    port = os.environ.get("MXNET_METRICS_PORT", "").strip()
-    if port and _http is None:
+    port = envs.get_int("MXNET_METRICS_PORT", None)
+    if port is not None and _http is None:
         try:
             serve(int(port))
         except (OSError, ValueError) as exc:
             warnings.warn("livemetrics: cannot start /metrics on port "
                           "%s (%s) — endpoint disabled" % (port, exc))
-    if os.environ.get("MXNET_WATCHDOG", "").strip().lower() \
-            in ("1", "true", "on", "yes") \
+    if envs.get_bool("MXNET_WATCHDOG") \
             and (_watchdog is None or fresh_run):
         enable_watchdog()
 
@@ -355,10 +353,9 @@ def serve(port=None, host=None):
         if _http is not None:
             return _http[0].server_address[1]
         if port is None:
-            port = get_env("MXNET_METRICS_PORT", 0, int)
+            port = envs.get_int("MXNET_METRICS_PORT")
         if host is None:
-            host = os.environ.get("MXNET_METRICS_HOST", "").strip() \
-                or "127.0.0.1"
+            host = envs.get_str("MXNET_METRICS_HOST") or "127.0.0.1"
 
         class _Handler(BaseHTTPRequestHandler):
             def do_GET(self):
@@ -444,21 +441,16 @@ class Watchdog:
     """
 
     def __init__(self):
-        self.drift = max(1.01, get_env("MXNET_WATCHDOG_DRIFT", 1.5,
-                                       float))
-        self.window = max(2, get_env("MXNET_WATCHDOG_WINDOW", 20, int))
+        self.drift = max(1.01, envs.get_float("MXNET_WATCHDOG_DRIFT"))
+        self.window = max(2, envs.get_int("MXNET_WATCHDOG_WINDOW"))
         self.baseline_n = max(
-            2, get_env("MXNET_WATCHDOG_BASELINE", 50, int))
-        self.sustain = max(1, get_env("MXNET_WATCHDOG_SUSTAIN", 10,
-                                      int))
-        self.shed_rate = get_env("MXNET_WATCHDOG_SHED_RATE", 0.3,
-                                 float)
+            2, envs.get_int("MXNET_WATCHDOG_BASELINE"))
+        self.sustain = max(1, envs.get_int("MXNET_WATCHDOG_SUSTAIN"))
+        self.shed_rate = envs.get_float("MXNET_WATCHDOG_SHED_RATE")
         self.min_requests = max(
-            1, get_env("MXNET_WATCHDOG_MIN_REQUESTS", 20, int))
-        self.queue_frac = get_env("MXNET_WATCHDOG_QUEUE_FRAC", 0.9,
-                                  float)
-        self.skew = max(1.01, get_env("MXNET_WATCHDOG_SKEW", 2.0,
-                                      float))
+            1, envs.get_int("MXNET_WATCHDOG_MIN_REQUESTS"))
+        self.queue_frac = envs.get_float("MXNET_WATCHDOG_QUEUE_FRAC")
+        self.skew = max(1.01, envs.get_float("MXNET_WATCHDOG_SKEW"))
         self._baseline = deque(maxlen=self.baseline_n)
         self._recent = deque(maxlen=self.window)
         self._breach = 0

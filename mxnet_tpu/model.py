@@ -50,9 +50,9 @@ def _create_kvstore(kvstore, num_device, arg_params):
         update_on_kvstore = False
     # worker-side update is the TPU-native default (SURVEY §5.8): the
     # optimizer fuses behind the allreduce inside the compiled step
-    import os
-    update_on_kvstore = bool(int(os.environ.get(
-        "MXNET_UPDATE_ON_KVSTORE", 1 if update_on_kvstore else 0)))
+    from . import envs
+    update_on_kvstore = envs.get_bool("MXNET_UPDATE_ON_KVSTORE",
+                                      bool(update_on_kvstore))
     if kv is None:
         update_on_kvstore = False
     return (kv, update_on_kvstore)

@@ -256,7 +256,17 @@ def make_data_parallel_step(loss_fn: Callable, mesh, optimizer_update=None,
     jit_kwargs = {}
     if donate:
         jit_kwargs["donate_argnums"] = (0,)
-    return jax.jit(step, **jit_kwargs), batch_sharding
+    # staged for compile telemetry/storm detection; cache=False
+    # because the step closes over an arbitrary user ``loss_fn`` /
+    # ``optimizer_update`` — there is no stable content fingerprint,
+    # so a persistent-cache entry could collide two different models
+    # with identical shapes (the compile_watch.jit contract)
+    from .. import compile_watch
+    return (compile_watch.jit(
+        step, "data_parallel:step",
+        statics=("overlap" if overlap else "plain",
+                 "shard" if shard_on else "rep"),
+        cache=False, **jit_kwargs), batch_sharding)
 
 
 class DistributedTrainer:

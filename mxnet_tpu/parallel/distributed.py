@@ -22,9 +22,10 @@ def init(coordinator=None, num_processes=None, process_id=None):
     import jax
     if _initialized[0]:
         return
-    coordinator = coordinator or os.environ.get("MXNET_TPU_COORDINATOR")
-    num_processes = num_processes or os.environ.get("MXNET_TPU_WORLD")
-    process_id = process_id or os.environ.get("MXNET_TPU_RANK")
+    from .. import envs
+    coordinator = coordinator or envs.get_str("MXNET_TPU_COORDINATOR")
+    num_processes = num_processes or envs.get_int("MXNET_TPU_WORLD")
+    process_id = process_id or envs.get_int("MXNET_TPU_RANK")
     if coordinator:
         jax.distributed.initialize(
             coordinator_address=coordinator,

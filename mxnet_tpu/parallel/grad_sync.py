@@ -52,11 +52,11 @@ size, so a run saved on N devices resumes on M.
 """
 from __future__ import annotations
 
-import os
 
 import numpy as _np
 
-from ..base import MXNetError, get_env
+from .. import envs
+from ..base import MXNetError
 
 __all__ = ["overlap_enabled", "bucket_cap_bytes", "GradSyncPlan",
            "make_bucketed_apply", "ShardedOptState",
@@ -67,15 +67,14 @@ def overlap_enabled():
     """The ``MXNET_GRAD_OVERLAP`` gate — default OFF; ``1``/``true``/
     ``on`` enable (re-read per build so tests and benchmarks can
     toggle it)."""
-    return os.environ.get("MXNET_GRAD_OVERLAP", "0").strip().lower() \
-        in ("1", "true", "on", "yes")
+    return envs.get_bool("MXNET_GRAD_OVERLAP")
 
 
 def bucket_cap_bytes():
     """Bucket size cap from ``MXNET_GRAD_BUCKET_MB`` (default 4 MiB —
     large enough to amortize collective launch latency, small enough
     that several buckets exist to overlap; see README for tuning)."""
-    mb = get_env("MXNET_GRAD_BUCKET_MB", 4.0, float)
+    mb = envs.get_float("MXNET_GRAD_BUCKET_MB")
     return max(1, int(mb * (1 << 20)))
 
 

@@ -44,7 +44,6 @@ gate — with it closed every training path is byte-identical to PR 7.
 """
 from __future__ import annotations
 
-import os
 
 import numpy as _np
 
@@ -56,8 +55,8 @@ def param_shard_enabled():
     """The ``MXNET_PARAM_SHARD`` gate — default OFF; ``1``/``true``/
     ``on`` enable (re-read per build so tests and benchmarks can
     toggle it)."""
-    return os.environ.get("MXNET_PARAM_SHARD", "0").strip().lower() \
-        in ("1", "true", "on", "yes")
+    from .. import envs
+    return envs.get_bool("MXNET_PARAM_SHARD")
 
 
 class SpecLayout:

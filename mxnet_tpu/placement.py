@@ -148,8 +148,24 @@ class GroupedProgram:
         # placement comes from the committed inputs: _gather_ext puts
         # every external value (and forward/forward_backward the rng
         # keys) on the segment's device, so the compiled program runs
-        # there — jit(device=...) is deprecated in this jax
-        fn = jax.jit(seg_run)
+        # there — jit(device=...) is deprecated in this jax.
+        # Staged through compile_watch so cross-group execution shows
+        # up in compile telemetry; the cache token digests the
+        # segment's op/attr/binding plan (the content this closure
+        # bakes in), and the argument signature carries the device
+        # placement, so persistent-cache entries cannot collide
+        # across different groupings.
+        import hashlib
+
+        from . import compile_watch
+        from .ops.registry import attr_key
+        token = hashlib.sha256(repr(
+            (key, [(plan[pi][0].name, attr_key(plan[pi][1]),
+                    plan[pi][2:]) for pi in idxs],
+             ext)).encode()).hexdigest()
+        fn = compile_watch.jit(seg_run, "placement:seg%d" % si,
+                               statics=token[:16], storm=False,
+                               cache_token=token)
         self._seg_fns[key] = fn
         return fn
 

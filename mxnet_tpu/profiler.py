@@ -93,8 +93,8 @@ def _max_events():
     the tracing hot path. set_state('run') re-reads."""
     global _MAX_EVENTS
     if _MAX_EVENTS is None:
-        from .base import get_env
-        _MAX_EVENTS = get_env("MXNET_PROFILER_MAX_EVENTS", 1000000, int)
+        from . import envs
+        _MAX_EVENTS = envs.get_int("MXNET_PROFILER_MAX_EVENTS")
     return _MAX_EVENTS
 
 

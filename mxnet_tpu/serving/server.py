@@ -52,7 +52,8 @@ from collections import deque
 
 import numpy as _np
 
-from ..base import MXNetError, get_env
+from .. import envs
+from ..base import MXNetError
 from .. import fault, profiler, telemetry, tracing
 from ..bucketing.padding import pad_along
 from .batcher import BucketLadder, pad_batch, slice_rows
@@ -265,13 +266,13 @@ class InferenceServer:
         # fills to its bound and sheds, instead of requests waiting
         # unboundedly in an invisible dispatch buffer.
         self._max_outstanding = max(
-            1, get_env("MXNET_SERVING_MAX_OUTSTANDING", 2, int))
+            1, envs.get_int("MXNET_SERVING_MAX_OUTSTANDING"))
         self._window = max(0.0, float(batch_window_ms)) / 1e3
         self._default_deadline = (float(default_deadline_ms) / 1e3
                                   if default_deadline_ms is not None
                                   else None)
         self._record_every = int(record_every) if record_every \
-            else get_env("MXNET_SERVING_RECORD_EVERY", 50, int)
+            else envs.get_int("MXNET_SERVING_RECORD_EVERY")
 
         self._cond = threading.Condition()
         self._queue = deque()
@@ -285,8 +286,7 @@ class InferenceServer:
         self._outstanding = [0] * replicas
         self._rid = itertools.count(1)
         self._latencies = deque(
-            maxlen=max(1, get_env("MXNET_SERVING_LATENCY_RING",
-                                  8192, int)))
+            maxlen=max(1, envs.get_int("MXNET_SERVING_LATENCY_RING")))
         self._batches_since_record = 0
         self._n_inputs = len(self._meta_inputs) \
             if self._meta_inputs else None
@@ -296,7 +296,12 @@ class InferenceServer:
         self._closed = False
         self._started = False
         self._t0 = time.perf_counter()
-        self._work = [_queue_mod.Queue() for _ in range(replicas)]
+        # depth is bounded UPSTREAM: the batcher only dispatches to
+        # replica r while _outstanding[r] < _max_outstanding, so the
+        # queue never holds more than max_outstanding batches (+ the
+        # stop sentinel); a maxsize here could deadlock stop().
+        self._work = [_queue_mod.Queue()  # mxlint: disable=thread-hygiene
+                      for _ in range(replicas)]
         self._threads = []
         # the live /metrics endpoint scrapes every registered server;
         # MXNET_METRICS_PORT/MXNET_WATCHDOG arm the live stack even

@@ -100,7 +100,7 @@ import time
 from collections import deque
 
 from . import tracing
-from .base import get_env
+from . import envs
 
 __all__ = ["PHASES", "enabled", "start", "stop", "reset", "maybe_start",
            "step_begin", "step_end", "step_tick", "span", "comm",
@@ -146,7 +146,7 @@ class _Run:
                          "time": self.t0_wall, "pid": os.getpid(),
                          "meta": dict(meta or {})}]
         self.ring = deque(
-            maxlen=max(1, get_env("MXNET_TELEMETRY_RING", 1024, int)))
+            maxlen=max(1, envs.get_int("MXNET_TELEMETRY_RING")))
         self.steps = 0
         self.samples = 0
         self.total_step_s = 0.0
@@ -177,16 +177,15 @@ class _Run:
         self._step_fault_base = dict(self.fault_counters)
         self._steps_since_flush = 0
         self._steps_since_mem = 0
-        self._mem_interval = get_env("MXNET_TELEMETRY_MEM_INTERVAL",
-                                     10, int)
+        self._mem_interval = envs.get_int("MXNET_TELEMETRY_MEM_INTERVAL")
         self._flush_steps = max(
-            1, get_env("MXNET_TELEMETRY_FLUSH_STEPS", 50, int))
+            1, envs.get_int("MXNET_TELEMETRY_FLUSH_STEPS"))
         self._sink_created = False
         self._flush_lock = threading.Lock()   # serializes sink writers
         # sink-less runs cap the in-memory record list; flushed records
         # of sink-backed runs leave memory at each flush
         self._max_records = max(
-            1, get_env("MXNET_TELEMETRY_MAX_RECORDS", 100000, int))
+            1, envs.get_int("MXNET_TELEMETRY_MAX_RECORDS"))
         self.records_dropped = 0
 
 
@@ -219,9 +218,8 @@ def _env():
     parsed once; reset() re-reads."""
     global _env_cfg
     if _env_cfg is None:
-        on = os.environ.get("MXNET_TELEMETRY", "").strip().lower() \
-            in ("1", "true", "on", "yes")
-        fname = os.environ.get("MXNET_TELEMETRY_FILE", "").strip() or None
+        on = envs.get_bool("MXNET_TELEMETRY")
+        fname = envs.get_path("MXNET_TELEMETRY_FILE") or None
         _env_cfg = (on or fname is not None, fname)
     return _env_cfg
 
@@ -812,7 +810,7 @@ def _sample_memory(run):
         peak = int(stats.get("peak_bytes_in_use", in_use) or in_use)
         _record_memory(run, str(d), in_use, peak)
     if not got_device_stats and \
-            get_env("MXNET_TELEMETRY_LIVE_BUFFERS", 1, int):
+            envs.get_int("MXNET_TELEMETRY_LIVE_BUFFERS"):
         # backends without memory_stats (CPU): total live device
         # buffer bytes is the closest available watermark signal
         try:

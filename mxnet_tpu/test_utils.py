@@ -13,7 +13,8 @@ from __future__ import annotations
 import os
 import numpy as np
 
-from .base import MXNetError, get_env
+from . import envs
+from .base import MXNetError
 from .context import Context, cpu, current_context
 
 __all__ = ["default_context", "set_default_context", "assert_almost_equal",
@@ -26,7 +27,7 @@ __all__ = ["default_context", "set_default_context", "assert_almost_equal",
 def default_context():
     """Context switched by env MXNET_TEST_DEFAULT_CTX (reference
     test_utils.py:53 uses a global; env keeps suites device-portable)."""
-    name = get_env("MXNET_TEST_DEFAULT_CTX", None)
+    name = envs.get_str("MXNET_TEST_DEFAULT_CTX")
     if name:
         dev, _, idx = name.partition(":")
         return Context(dev, int(idx or 0))

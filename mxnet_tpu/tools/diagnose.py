@@ -90,10 +90,17 @@ def diagnose_mxnet():
     import jaxlib
     print("jax          :", jax.__version__)
     print("jaxlib       :", jaxlib.__version__)
+    from .. import envs as _envs
+    declared = _envs.snapshot()
     knobs = {k: v for k, v in os.environ.items()
              if k.startswith(("MXNET_", "JAX_", "XLA_"))}
     for k in sorted(knobs):
-        print("env %-24s: %s" % (k, knobs[k]))
+        # a set-but-undeclared MXNET_* is almost always a typo'd
+        # knob nothing will ever read — this table is where the
+        # operator finds out, so it must not be hidden
+        tag = "" if not k.startswith("MXNET_") or k in declared \
+            else "  (undeclared — typo? see mxnet_tpu/envs.py)"
+        print("env %-24s: %s%s" % (k, knobs[k], tag))
 
 
 def diagnose_backend(timeout):

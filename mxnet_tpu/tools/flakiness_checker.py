@@ -7,6 +7,7 @@ tests/test_operator.py::test_optimizer_ops -n 20``.
 from __future__ import annotations
 
 import argparse
+import os
 import random
 import subprocess
 import sys
@@ -23,8 +24,9 @@ def run_test_trials(test_path, num_trials, seed=None, verbose=False):
     for trial in range(num_trials):
         s = base.randint(0, 2 ** 31 - 1)
         seeds.append(s)
-        env = dict(**__import__("os").environ,
-                   MXNET_TEST_SEED=str(s))
+        # MXNET_TEST_SEED is WRITTEN for the child process here, not
+        # read — the typed read side lives in tests/conftest.py
+        env = dict(os.environ, MXNET_TEST_SEED=str(s))
         out = subprocess.run(
             [sys.executable, "-m", "pytest", test_path, "-x", "-q"],
             capture_output=True, text=True, env=env)

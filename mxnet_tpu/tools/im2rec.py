@@ -47,9 +47,11 @@ def make_list(root, prefix, recursive=True, shuffle=False, seed=0):
     if shuffle:
         np.random.RandomState(seed).shuffle(entries)
     lst_path = prefix + ".lst"
-    with open(lst_path, "w") as out:
+    tmp = "%s.tmp.%d" % (lst_path, os.getpid())
+    with open(tmp, "w") as out:
         for i, (label, rel) in enumerate(entries):
             out.write("%d\t%f\t%s\n" % (i, float(label), rel))
+    os.replace(tmp, lst_path)
     return lst_path, classes
 
 

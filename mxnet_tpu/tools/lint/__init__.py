@@ -1,0 +1,39 @@
+"""mxlint — the framework's own static-analysis suite.
+
+Twelve PRs of this codebase turned several hard-won bug fixes into
+*conventions*: every ``jax.jit`` stages through ``compile_watch.jit``
+(else it is invisible to compile telemetry, storm detection, and the
+persistent compile cache), every durable artifact writes
+tmp+``os.replace``, every telemetry/profiler counter bump holds its
+lock, every worker thread is daemon-or-drained behind a bounded queue,
+traced functions stay pure, and every ``MXNET_*`` knob reads through
+the typed ``mxnet_tpu.envs`` registry.  Each rule here encodes one of
+those conventions as a named, individually-suppressible AST check over
+the framework's own source — the tier-1 test runs the whole suite over
+``mxnet_tpu/`` and fails on any non-baselined violation, so the
+conventions are machine-checked before ROADMAP's 4D-parallelism /
+stateful-serving / multi-host growth multiplies the surface.
+
+Usage::
+
+    python -m mxnet_tpu.tools.lint                 # lint mxnet_tpu/
+    python -m mxnet_tpu.tools.lint path/ --format json
+    python -m mxnet_tpu.tools.lint --envs          # env-var reference
+    python -m mxnet_tpu.tools.lint --list-rules
+
+Suppress one finding inline with a trailing comment naming the rule::
+
+    fn = jax.jit(fwd)   # mxlint: disable=jit-staging -- export path
+
+Grandfathered sites live in the committed ``baseline.json`` next to
+this package; every entry carries a one-line rationale and matches on
+(rule, path, source line text) so line-number drift never resurrects
+it.  The ``jit-staging`` rule additionally consults
+``jit_allowlist.json`` — per-file entries whose rationale documents
+why staging is *wrong* there, not merely unmigrated.
+"""
+from .core import (LintResult, Violation, lint_paths, lint_source,
+                   load_baseline, RULES, rule_names)
+
+__all__ = ["LintResult", "Violation", "lint_paths", "lint_source",
+           "load_baseline", "RULES", "rule_names"]

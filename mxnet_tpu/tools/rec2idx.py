@@ -11,9 +11,13 @@ from .. import recordio
 
 
 def build_index(rec_path, idx_path, sequential_keys=False):
+    import os
     reader = recordio.MXRecordIO(rec_path, "r")
     n = 0
-    with open(idx_path, "w") as fidx:
+    # tmp + os.replace: a crash mid-index must not leave a
+    # truncated .idx that silently drops records
+    tmp = "%s.tmp.%d" % (idx_path, os.getpid())
+    with open(tmp, "w") as fidx:
         while True:
             offset = reader.tell()
             payload = reader.read()
@@ -26,6 +30,7 @@ def build_index(rec_path, idx_path, sequential_keys=False):
                 key = int(header.id)
             fidx.write("%d\t%d\n" % (key, offset))
             n += 1
+    os.replace(tmp, idx_path)
     reader.close()
     return n
 

@@ -42,7 +42,7 @@ import threading
 import time
 from collections import deque
 
-from .base import get_env
+from . import envs
 
 __all__ = ["enabled", "enable", "disable", "reset", "maybe_enable",
            "now", "add", "instant", "span", "context", "track",
@@ -60,7 +60,7 @@ class _Trace:
         self.t0 = time.perf_counter()
         self.t0_wall = time.time()
         self.events = deque(
-            maxlen=max(1, get_env("MXNET_TRACE_RING", 200000, int)))
+            maxlen=max(1, envs.get_int("MXNET_TRACE_RING")))
         self.dropped = 0
         self.pid = os.getpid()
         # synthetic tracks (per-request, compile, grad_sync, ...) get
@@ -75,7 +75,7 @@ class _Trace:
         # under their bare numeric tid
         self.tracks = {}          # label -> tid (insertion-ordered)
         self.max_tracks = max(
-            16, get_env("MXNET_TRACE_TRACKS", 4096, int))
+            16, envs.get_int("MXNET_TRACE_TRACKS"))
         self.next_tid = 1
 
 
@@ -122,7 +122,7 @@ _atexit_registered = False
 def _atexit_export():
     """Export to MXNET_TRACE_FILE at interpreter exit for runs that
     never call disable()/export() themselves."""
-    fname = os.environ.get("MXNET_TRACE_FILE", "").strip()
+    fname = envs.get_path("MXNET_TRACE_FILE")
     if _tracer is not None and fname:
         try:
             export(fname)
@@ -134,7 +134,7 @@ def disable():
     """Turn tracing off. When ``MXNET_TRACE_FILE`` is set the ring is
     exported there first. Returns the export path (or None)."""
     global _tracer
-    fname = os.environ.get("MXNET_TRACE_FILE", "").strip() or None
+    fname = envs.get_path("MXNET_TRACE_FILE") or None
     out = None
     if _tracer is not None and fname:
         try:
@@ -160,9 +160,8 @@ def maybe_enable():
     when active after the call."""
     if _tracer is not None:
         return True
-    on = os.environ.get("MXNET_TRACE", "").strip().lower() \
-        in ("1", "true", "on", "yes")
-    if on or os.environ.get("MXNET_TRACE_FILE", "").strip():
+    on = envs.get_bool("MXNET_TRACE")
+    if on or envs.get_path("MXNET_TRACE_FILE"):
         enable()
         return True
     return False

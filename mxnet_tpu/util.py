@@ -29,8 +29,8 @@ def set_int64_tensor_size(enabled: bool) -> None:
 
 def int64_enabled() -> bool:
     if _INT64_FLAG[0] is None:
-        from .base import get_env
-        flag = get_env("MXNET_INT64_TENSOR_SIZE", False, bool)
+        from . import envs
+        flag = envs.get_bool("MXNET_INT64_TENSOR_SIZE")
         if flag:
             set_int64_tensor_size(True)
         else:

@@ -351,3 +351,62 @@ class TestTelemetry:
         telemetry.step_end()
         summary = telemetry.stop()
         assert "cache" not in summary["compile"]
+
+
+# ---------------------------------------------------------------------------
+# the newly-staged framework jit sites ride the cache too (mxlint
+# jit-staging rule: autograd/placement/data_parallel joined the staged
+# path; deploy.py is the allowlisted export-only exception)
+# ---------------------------------------------------------------------------
+
+class TestStagedSitesWarmRestart:
+    def _backward(self):
+        from mxnet_tpu import autograd as ag
+        x = mx.nd.array([1., 2., 3.])
+        x.attach_grad()
+        with ag.record():
+            y = (x * x + 2 * x).sum()
+        y.backward()
+        return x.grad.asnumpy()
+
+    def test_autograd_backward_warms_from_disk(self, tmp_path):
+        from mxnet_tpu import autograd as ag
+        ag._bwd_cache.clear()
+        compile_cache.enable(str(tmp_path))
+        compile_watch.enable()
+        g_cold = self._backward()
+        compile_cache.flush()
+        cold = compile_watch.site_stats("autograd")
+        assert cold, "autograd:backward never reached site_stats"
+        fresh_cold = sum(s["count"] for s in cold.values())
+        assert fresh_cold >= 1
+        # "process restart": the in-memory program cache is rebuilt
+        # from scratch; only the disk cache carries over
+        ag._bwd_cache.clear()
+        g_warm = self._backward()
+        warm = compile_watch.site_stats("autograd")
+        assert sum(s["count"] for s in warm.values()) == fresh_cold, (
+            "warm restart compiled the backward program fresh: %r"
+            % warm)
+        assert sum(s.get("cache_hits", 0)
+                   for s in warm.values()) >= 1, warm
+        np.testing.assert_array_equal(g_cold, g_warm)
+
+    def test_staged_sites_visible_without_cache(self):
+        # site_stats coverage for the staged sites that opted OUT of
+        # the disk cache (content has no stable fingerprint): the
+        # data-parallel step still joins compile telemetry
+        import jax
+        from jax.sharding import Mesh
+        from mxnet_tpu.parallel import make_data_parallel_step
+        compile_watch.enable()
+        jnp = _jnp()
+        mesh = Mesh(np.array(jax.devices()[:1]), ("dp",))
+        step, bsh = make_data_parallel_step(
+            lambda p, b: ((p["w"] * b["x"]) ** 2).sum(), mesh,
+            donate=False)
+        params = {"w": jnp.ones((4,))}
+        batch = {"x": jax.device_put(jnp.ones((4,)), bsh)}
+        step(params, batch)
+        sites = compile_watch.site_stats("data_parallel")
+        assert sites and sum(s["count"] for s in sites.values()) == 1

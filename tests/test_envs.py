@@ -1,0 +1,164 @@
+"""The typed MXNET_* environment registry (mxnet_tpu.envs): declared
+defaults, strict typed parsing with MXNetError naming the variable,
+accessor/declaration kind agreement, point-of-use read semantics, and
+the generated reference."""
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import envs
+from mxnet_tpu.base import MXNetError
+
+
+def test_registry_shape():
+    reg = envs.registry()
+    assert len(reg) >= 55
+    for name, var in reg.items():
+        assert name.startswith("MXNET_")
+        assert var.kind in ("bool", "int", "float", "str", "path")
+        assert var.doc and len(var.doc) > 10, name
+        assert var.group != "misc", name
+
+
+def test_defaults_returned_when_unset(monkeypatch):
+    monkeypatch.delenv("MXNET_TELEMETRY_RING", raising=False)
+    assert envs.get_int("MXNET_TELEMETRY_RING") == 1024
+    monkeypatch.delenv("MXNET_FUSED_STEP", raising=False)
+    assert envs.get_bool("MXNET_FUSED_STEP") is True
+    monkeypatch.delenv("MXNET_GRAD_BUCKET_MB", raising=False)
+    assert envs.get_float("MXNET_GRAD_BUCKET_MB") == 4.0
+
+
+def test_point_of_use_reads(monkeypatch):
+    monkeypatch.setenv("MXNET_TELEMETRY_RING", "8")
+    assert envs.get_int("MXNET_TELEMETRY_RING") == 8
+    monkeypatch.setenv("MXNET_TELEMETRY_RING", "16")
+    assert envs.get_int("MXNET_TELEMETRY_RING") == 16
+
+
+@pytest.mark.parametrize("name,accessor,bad", [
+    ("MXNET_TELEMETRY_RING", envs.get_int, "many"),
+    ("MXNET_GRAD_BUCKET_MB", envs.get_float, "4MB"),
+    ("MXNET_FUSED_STEP", envs.get_bool, "maybe"),
+    ("MXNET_COMPILE_STORM_K", envs.get_int, "3.5"),
+])
+def test_malformed_value_raises_naming_variable(monkeypatch, name,
+                                                accessor, bad):
+    monkeypatch.setenv(name, bad)
+    with pytest.raises(MXNetError) as exc:
+        accessor(name)
+    assert name in str(exc.value)
+    assert bad in str(exc.value)
+
+
+def test_bool_token_table(monkeypatch):
+    for tok, want in [("1", True), ("true", True), ("YES", True),
+                      ("on", True), ("0", False), ("false", False),
+                      ("off", False), ("", False), ("no", False)]:
+        monkeypatch.setenv("MXNET_TELEMETRY", tok)
+        assert envs.get_bool("MXNET_TELEMETRY") is want, tok
+
+
+def test_undeclared_variable_raises():
+    with pytest.raises(MXNetError, match="not a registered"):
+        envs.get_int("MXNET_NO_SUCH_KNOB")
+
+
+def test_kind_mismatch_raises():
+    with pytest.raises(MXNetError, match="declared as int"):
+        envs.get_bool("MXNET_TELEMETRY_RING")
+
+
+def test_default_override_allowed(monkeypatch):
+    monkeypatch.delenv("MXNET_BUCKET_WINDOW", raising=False)
+    assert envs.get_int("MXNET_BUCKET_WINDOW", 128) == 128
+    monkeypatch.setenv("MXNET_BUCKET_WINDOW", "64")
+    assert envs.get_int("MXNET_BUCKET_WINDOW", 128) == 64
+
+
+def test_snapshot_only_declared_and_set(monkeypatch):
+    monkeypatch.setenv("MXNET_TELEMETRY_RING", "32")
+    monkeypatch.setenv("MXNET_UNDECLARED_THING", "x")
+    snap = envs.snapshot()
+    assert snap.get("MXNET_TELEMETRY_RING") == "32"
+    assert "MXNET_UNDECLARED_THING" not in snap
+
+
+def test_get_raw_for_domain_grammars(monkeypatch):
+    monkeypatch.setenv("MXNET_BUCKET_LADDER", "8,16,32")
+    assert envs.get_raw("MXNET_BUCKET_LADDER") == "8,16,32"
+    with pytest.raises(MXNetError, match="not a registered"):
+        envs.get_raw("MXNET_NOPE")
+
+
+def test_render_reference_covers_registry():
+    doc = envs.render_reference()
+    for name in envs.registry():
+        assert "`%s`" % name in doc, name
+    assert "do not edit" in doc.lower()
+
+
+def test_ladder_env_error_still_names_variable(monkeypatch):
+    # the MXNET_BUCKET_LADDER precedent this registry generalizes
+    from mxnet_tpu.bucketing.ladder import ladder_from_env
+    monkeypatch.setenv("MXNET_BUCKET_LADDER", "8,oops")
+    with pytest.raises(MXNetError, match="MXNET_BUCKET_LADDER"):
+        ladder_from_env()
+
+
+def test_malformed_value_surfaces_through_a_real_reader(monkeypatch):
+    # end-to-end: a subsystem reading through the registry surfaces
+    # the naming error, not a silent default
+    monkeypatch.setenv("MXNET_COMPILE_STORM_K", "lots")
+    from mxnet_tpu import compile_watch
+    compile_watch.disable()
+    try:
+        with pytest.raises(MXNetError, match="MXNET_COMPILE_STORM_K"):
+            compile_watch.enable()
+    finally:
+        monkeypatch.delenv("MXNET_COMPILE_STORM_K")
+        compile_watch.disable()
+
+
+def test_empty_value_means_unset_for_numeric_knobs(monkeypatch):
+    # VAR= (empty) is the shell/compose idiom for "disabled" — it
+    # must behave like unset, never raise (code-review finding:
+    # maybe_start crashed telemetry.start on MXNET_METRICS_PORT=)
+    monkeypatch.setenv("MXNET_METRICS_PORT", "")
+    assert envs.get_int("MXNET_METRICS_PORT", None) is None
+    monkeypatch.setenv("MXNET_TELEMETRY_RING", " ")
+    assert envs.get_int("MXNET_TELEMETRY_RING") == 1024
+    monkeypatch.setenv("MXNET_GRAD_BUCKET_MB", "")
+    assert envs.get_float("MXNET_GRAD_BUCKET_MB") == 4.0
+
+
+def test_empty_metrics_port_keeps_livemetrics_disabled(monkeypatch):
+    from mxnet_tpu import livemetrics
+    monkeypatch.setenv("MXNET_METRICS_PORT", "")
+    monkeypatch.delenv("MXNET_WATCHDOG", raising=False)
+    livemetrics.maybe_start()          # must not raise
+    assert livemetrics.server_port() is None
+
+
+def test_committed_env_reference_is_current():
+    # ENV_VARS.md says "do not edit by hand" — this is the test that
+    # makes that true: declaring a var without regenerating the file
+    # (python -m mxnet_tpu.tools.lint --envs > ENV_VARS.md) fails here
+    import os
+    path = os.path.join(os.path.dirname(__file__), "..", "ENV_VARS.md")
+    with open(path) as f:
+        committed = f.read().strip()
+    assert committed == envs.render_reference().strip(), (
+        "ENV_VARS.md is stale — regenerate it with "
+        "`python -m mxnet_tpu.tools.lint --envs > ENV_VARS.md`")
+
+
+def test_empty_bool_means_declared_default(monkeypatch):
+    # VAR= must NOT flip a default-ON gate off (it means unset, like
+    # every other accessor) — MXNET_FUSED_STEP= used to disable the
+    # fused step silently
+    monkeypatch.setenv("MXNET_FUSED_STEP", "")
+    assert envs.get_bool("MXNET_FUSED_STEP") is True
+    from mxnet_tpu import fused_step
+    assert fused_step.fused_step_enabled() is True
+    monkeypatch.setenv("MXNET_TELEMETRY", "")
+    assert envs.get_bool("MXNET_TELEMETRY") is False

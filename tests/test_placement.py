@@ -105,3 +105,25 @@ def test_group2ctx_ignored_without_groups():
         group2ctx={"dev1": mx.cpu(1)})
     assert ex._grouped is None
     ex.forward()
+
+
+def test_segment_programs_join_compile_telemetry():
+    # the placement:segN sites were staged through compile_watch.jit
+    # (mxlint jit-staging): cross-group segment compiles must show up
+    # in site_stats like every other framework program
+    import jax
+    from mxnet_tpu import compile_watch
+    cpus = jax.devices("cpu")
+    if len(cpus) < 2:
+        pytest.skip("needs >=2 virtual cpu devices")
+    compile_watch.disable()
+    compile_watch.enable()
+    try:
+        ex = _bind(_two_group_net(),
+                   {"dev1": mx.cpu(0), "dev2": mx.cpu(1)})
+        ex.forward(is_train=False)
+        sites = compile_watch.site_stats("placement")
+        assert sites, "no placement:* sites in compile telemetry"
+        assert sum(s["count"] for s in sites.values()) >= 2, sites
+    finally:
+        compile_watch.disable()
