@@ -2112,6 +2112,114 @@ def _trace_overhead_record():
     return record
 
 
+def _bench_decode_case(n_requests=24, max_new=16, window=8):
+    """Mixed prefill/decode load over the stateful autoregressive
+    server (BENCH_r17): one request population (varied prompt lengths
+    4..63, greedy generation) served two ways on the same toy decoder
+    LM —
+
+    - ``sequential``: prefill-then-decode one request at a time
+      (window=1, submit-and-wait) — the naive serving loop;
+    - ``continuous``: all requests offered at once to the continuous
+      batcher (window=8): prefills interleave with batched decode
+      steps, so every decode dispatch amortizes across up to 8
+      requests.
+
+    Captures tokens/sec and the p99 inter-token latency under the
+    mixed load, plus the fixed-program-set oracle
+    (``compile_watch.site_stats``): the continuous server's site set
+    is exactly 1 + len(ladder) programs with zero steady-state
+    recompiles."""
+    import numpy as np
+    from mxnet_tpu import compile_watch
+    from mxnet_tpu.serving import DecodeServer, ToyDecoderLM
+
+    compile_watch.enable()
+    model = ToyDecoderLM(vocab=128, n_layers=2, n_heads=4, head_dim=16,
+                         max_len=256)
+    params = model.init_params(seed=0)
+    rs = np.random.RandomState(0)
+    prompts = [rs.randint(1, 128, size=int(n))
+               for n in rs.randint(4, 64, size=n_requests)]
+
+    def build(name, win):
+        srv = DecodeServer(model, params, seq_ladder=[16, 32, 64],
+                           max_new_tokens=max_new, window=win,
+                           page_size=16, pool_pages=256,
+                           max_queue=n_requests + 4, name=name)
+        srv.warmup()
+        return srv
+
+    out = {"requests": n_requests, "max_new_tokens": max_new,
+           "prompt_lengths": sorted({len(p) for p in prompts})}
+
+    # sequential prefill-then-decode: one request runs to completion
+    # before the next starts — every decode dispatch serves ONE token
+    srv = build("seq", 1)
+    t0 = time.perf_counter()
+    for p in prompts:
+        srv.submit(p, max_new_tokens=max_new).result(timeout=600)
+    seq_wall = time.perf_counter() - t0
+    seq_st = srv.stats()
+    srv.stop()
+    out["sequential"] = {
+        "wall_s": round(seq_wall, 3),
+        "tokens_per_sec": round(seq_st["tokens_out"] / seq_wall, 2),
+        "decode_steps": seq_st["decode_steps"],
+        "inter_token_p99_ms": (seq_st.get("inter_token_ms")
+                               or {}).get("p99"),
+    }
+
+    # continuous batching: the whole population offered at once
+    srv = build("cont", window)
+    warm = compile_watch.site_stats("decode:cont")
+    t0 = time.perf_counter()
+    reqs = [srv.submit(p, max_new_tokens=max_new) for p in prompts]
+    for r in reqs:
+        r.result(timeout=600)
+    cont_wall = time.perf_counter() - t0
+    cont_st = srv.stats()
+    steady = compile_watch.site_stats("decode:cont")
+    srv.stop()
+    out["continuous"] = {
+        "wall_s": round(cont_wall, 3),
+        "window": window,
+        "tokens_per_sec": round(cont_st["tokens_out"] / cont_wall, 2),
+        "decode_steps": cont_st["decode_steps"],
+        "prefill_fraction": cont_st.get("prefill_fraction"),
+        "inter_token_p50_ms": (cont_st.get("inter_token_ms")
+                               or {}).get("p50"),
+        "inter_token_p99_ms": (cont_st.get("inter_token_ms")
+                               or {}).get("p99"),
+        "ttft_p50_ms": (cont_st.get("ttft_ms") or {}).get("p50"),
+        "kv_peak_pages": cont_st["kv"]["peak_used"],
+    }
+    out["speedup_tokens_per_sec"] = round(
+        out["continuous"]["tokens_per_sec"]
+        / out["sequential"]["tokens_per_sec"], 3)
+    out["continuous_beats_sequential"] = bool(
+        out["continuous"]["tokens_per_sec"]
+        > out["sequential"]["tokens_per_sec"])
+    out["programs"] = {site: s["count"] for site, s in
+                       sorted((steady or {}).items())}
+    out["zero_steady_state_recompiles"] = bool(steady == warm)
+    compile_watch.disable()
+    return out
+
+
+def _decode_record():
+    """The autoregressive-serving benchmark record (BENCH_r17.json):
+    sequential prefill-then-decode vs continuous batching on a mixed
+    prompt-length population — tokens/sec, p99 inter-token latency,
+    fixed program set. CPU backend."""
+    record = {"bench": "decode_serving", "platform": "cpu"}
+    try:
+        record.update(_bench_decode_case())
+    except Exception as exc:                     # noqa: BLE001
+        record["errors"] = {"decode": _err_str(exc)}
+    return record
+
+
 def _err_str(exc):
     return "%s: %s" % (type(exc).__name__, str(exc)[:400])
 
@@ -2265,6 +2373,12 @@ if __name__ == "__main__":
                 flags + " --xla_force_host_platform_device_count=8"
             ).strip()
         print(json.dumps(_param_shard_record()))
+    elif "--decode" in sys.argv:
+        # CPU-friendly standalone mode: sequential prefill-then-decode
+        # vs continuous batching over the paged-KV DecodeServer —
+        # tokens/sec, p99 inter-token latency, fixed-program oracle,
+        # one JSON line (the BENCH_r17 artifact)
+        print(json.dumps(_decode_record()))
     elif "--serving" in sys.argv:
         # CPU-friendly standalone mode: offered-load sweep over the
         # continuous-batching inference server (arrival rate x bucket

@@ -212,6 +212,20 @@ class BucketLadder(ShapeLadder):
     def max_batch(self):
         return self.buckets[-1]
 
+    def aligned(self, multiple):
+        """A new ladder with every rung rounded UP to a multiple —
+        the decode server's prompt rungs align to the KV page size so
+        each prefill rung fills whole pages (no rung ever splits a
+        page with another rung's tokens, and the per-rung page count
+        is exactly ``rung / page_size``). Rungs that collide after
+        rounding dedupe."""
+        m = int(multiple)
+        if m < 1:
+            raise MXNetError(
+                "BucketLadder.aligned: multiple must be positive, "
+                "got %s" % multiple)
+        return BucketLadder([-(-b // m) * m for b in self.buckets])
+
     def bucket_for(self, n):
         """The smallest bucket >= n (None when n exceeds the top)."""
         b = super().bucket_for(n)

@@ -82,7 +82,8 @@ from .base import MXNetError
 
 __all__ = ["CheckpointManager", "async_checkpoint_enabled",
            "manifest_path", "load_manifest", "validate_manifest",
-           "load_arrays", "restore_params", "save_arrays",
+           "load_arrays", "load_param_arrays", "restore_params",
+           "save_arrays",
            "atomic_write_file", "write_bytes_async", "flush_async_writes"]
 
 _PIECE_SEP = "::piece"       # shard-file key suffix for partial pieces
@@ -473,6 +474,23 @@ def load_arrays(prefix, epoch, validate=True):
             full[ix] = shard_data[p["shard"]][p["key"]]
         out[key] = nd.array(full)
     out.update(_unflatten(whole))
+    return out
+
+
+def load_param_arrays(prefix, epoch, validate=True):
+    """Flat ``{name: numpy array}`` of a manifest checkpoint's ``arg``
+    parameters (``aux`` entries ride along under their plain names) —
+    the decode server's weight hot-swap source
+    (``serving.DecodeServer.swap_weights(prefix=..., epoch=...)``).
+    Values come back as plain host arrays: placement is the caller's
+    (the topology-neutral manifest makes the swap a pure placement
+    problem — save on any mesh, serve on any device)."""
+    flat = load_arrays(prefix, epoch, validate=validate)
+    out = {}
+    for key, val in flat.items():
+        name = key.split(":", 1)[1] if ":" in key else key
+        out[name] = val.asnumpy() if hasattr(val, "asnumpy") \
+            else _np.asarray(val)
     return out
 
 

@@ -256,6 +256,17 @@ declare("MXNET_SERVING_RECORD_EVERY", "int", 50,
         "Batches between serving telemetry records.", _G)
 declare("MXNET_SERVING_LATENCY_RING", "int", 8192,
         "Ring size of the serving latency reservoir.", _G)
+declare("MXNET_SERVING_PRIORITIES", "int", 3,
+        "Number of admission priority classes (0 lowest .. N-1 "
+        "highest); overload sheds the lowest class first.", _G)
+declare("MXNET_KV_PAGE_SIZE", "int", 16,
+        "Tokens per KV-cache page of the paged decode pool.", _G)
+declare("MXNET_KV_POOL_PAGES", "int", 256,
+        "Total pages in the decode KV-cache pool (page 0 is the "
+        "reserved dump page).", _G)
+declare("MXNET_DECODE_WINDOW", "int", 8,
+        "Concurrent decode slots of the continuous batcher (the "
+        "decode step's fixed batch size).", _G)
 
 _G = "bucketing"
 declare("MXNET_BUCKET_LADDER", "str", "",

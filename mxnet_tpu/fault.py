@@ -63,8 +63,14 @@ _ACTIONS = ("raise", "hang", "stall", "nan", "inf")
 # batcher pass — a planned hang at dispatch stalls batch formation so
 # queued requests age past their deadlines (deterministic shed/timeout
 # testing), a raise there is counted and survived, never fatal.
+# serve_decode fires per continuous-batcher decode step and kv_evict
+# per KV-cache page reclaim (serving/decode.py, serving/kvcache.py): a
+# planned hang at serve_decode stalls token production so a streaming
+# request ages past its deadline, proving its pages come back through
+# the counted kv_evict reclaim path.
 _SITES = ("push", "pull", "allreduce", "wait", "init", "grad",
-          "ckpt_write", "ckpt_fsync", "serve_admit", "serve_dispatch")
+          "ckpt_write", "ckpt_fsync", "serve_admit", "serve_dispatch",
+          "serve_decode", "kv_evict")
 # corruption needs a value to corrupt — only the grad site carries one
 _VALUE_SITES = ("grad",)
 _GUARD_POLICIES = ("skip_step", "scale_backoff")

@@ -51,7 +51,9 @@ from collections import deque
 from . import envs
 
 __all__ = ["serve", "stop_server", "server_port", "render",
-           "register_server", "deregister_server", "Watchdog",
+           "register_server", "deregister_server",
+           "register_decode_server", "deregister_decode_server",
+           "Watchdog",
            "enable_watchdog",
            "disable_watchdog", "watchdog_enabled", "maybe_start",
            "LATENCY_BUCKETS_MS"]
@@ -62,6 +64,7 @@ LATENCY_BUCKETS_MS = (1.0, 2.5, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0,
                       500.0, 1000.0, 2500.0, 5000.0)
 
 _servers = weakref.WeakSet()      # live InferenceServers
+_decode_servers = weakref.WeakSet()   # live DecodeServers
 _http = None                      # (HTTPServer, thread)
 _http_lock = threading.Lock()
 _watchdog = None
@@ -79,6 +82,14 @@ def deregister_server(server):
         _servers.discard(server)
 
 
+def _assign_label_locked(server, pool):
+    label = getattr(server, "name", None) or "default"
+    taken = {getattr(s, "_metrics_label", None) for s in pool}
+    if label in taken:
+        label = "%s-%d" % (label, next(_label_seq))
+    server._metrics_label = label
+
+
 def register_server(server):
     """Track one live InferenceServer for the scrape (weakref — a
     collected server drops out). Called from the server constructor.
@@ -88,12 +99,25 @@ def register_server(server):
     check-and-assign runs under a lock so concurrently constructed
     servers cannot both claim one label."""
     with _register_lock:
-        label = getattr(server, "name", None) or "default"
-        taken = {getattr(s, "_metrics_label", None) for s in _servers}
-        if label in taken:
-            label = "%s-%d" % (label, next(_label_seq))
-        server._metrics_label = label
+        _assign_label_locked(server, _servers)
         _servers.add(server)
+
+
+def register_decode_server(server):
+    """Track one live ``serving.DecodeServer`` for the scrape — its
+    own registry and ``mxnet_decode_*`` metric families (label
+    uniqueness enforced within the decode set, same rules as
+    :func:`register_server`)."""
+    with _register_lock:
+        _assign_label_locked(server, _decode_servers)
+        _decode_servers.add(server)
+
+
+def deregister_decode_server(server):
+    """Drop a decode server from the scrape (called by
+    ``DecodeServer.stop``)."""
+    with _register_lock:
+        _decode_servers.discard(server)
 
 
 def maybe_start(fresh_run=False):
@@ -327,6 +351,64 @@ def _render_serving(page):
                   "latency ring")
 
 
+def _render_decode(page):
+    for srv in list(_decode_servers):
+        try:
+            st = srv.stats()
+        except Exception:
+            continue                       # mid-shutdown server
+        lab = {"server": getattr(srv, "_metrics_label", None)
+               or "default"}
+        for key, help_ in (("requests", "generations submitted"),
+                           ("completed", ""), ("cancelled", ""),
+                           ("timeouts", ""), ("shed", ""),
+                           ("preempted", "evicted under KV-pool "
+                                         "pressure"),
+                           ("errors", ""),
+                           ("prefill_steps", ""),
+                           ("decode_steps", ""),
+                           ("tokens_out", "tokens generated")):
+            page.add("mxnet_decode_%s_total" % key, st.get(key),
+                     labels=lab, kind="counter", help_=help_)
+        page.add("mxnet_decode_queue_depth", st.get("queue_depth"),
+                 labels=lab)
+        page.add("mxnet_decode_active", st.get("active"), labels=lab,
+                 help_="requests holding decode slots now")
+        page.add("mxnet_decode_window", st.get("window"), labels=lab,
+                 help_="decode-step batch width (MXNET_DECODE_WINDOW)")
+        page.add("mxnet_decode_tokens_per_sec",
+                 st.get("tokens_per_sec"), labels=lab)
+        page.add("mxnet_decode_prefill_fraction",
+                 st.get("prefill_fraction"), labels=lab,
+                 help_="prefill share of scheduler steps (the "
+                       "continuous-batching mix)")
+        for q in ("p50", "p99"):
+            page.add("mxnet_decode_inter_token_ms",
+                     (st.get("inter_token_ms") or {}).get(q),
+                     labels=dict(lab, quantile=q),
+                     help_="inter-token latency over the recent ring")
+            page.add("mxnet_decode_ttft_ms",
+                     (st.get("ttft_ms") or {}).get(q),
+                     labels=dict(lab, quantile=q),
+                     help_="time to first token (submit -> prefill "
+                           "emit)")
+        kv = st.get("kv") or {}
+        page.add("mxnet_decode_kv_pages", kv.get("pages"), labels=lab,
+                 help_="usable pages of the paged KV pool")
+        page.add("mxnet_decode_kv_pages_used", kv.get("used"),
+                 labels=lab)
+        page.add("mxnet_decode_kv_pages_peak", kv.get("peak_used"),
+                 labels=lab)
+        page.add("mxnet_decode_kv_evicted_total", kv.get("evicted"),
+                 labels=lab, kind="counter",
+                 help_="pages reclaimed (the kv_evict path)")
+        page.add("mxnet_decode_weight_swaps_total", st.get("swaps"),
+                 labels=lab, kind="counter")
+        page.add("mxnet_decode_weight_version",
+                 st.get("weight_version"), labels=lab,
+                 help_="parameter generation serving new requests")
+
+
 def render():
     """The whole ``/metrics`` page as Prometheus text exposition."""
     page = _Page()
@@ -334,6 +416,7 @@ def render():
     _render_training(page)
     _render_counters(page)
     _render_serving(page)
+    _render_decode(page)
     return page.text()
 
 

@@ -75,6 +75,40 @@ def _attention(attrs, query, key, value, segment_ids=None):
     raise ValueError("_contrib_flash_attention: unknown impl %r" % impl)
 
 
+def _decode_attention(attrs, query, key_cache, value_cache, lengths):
+    import math
+    scale = float(attrs.get("scale", 0.0)) or \
+        1.0 / math.sqrt(query.shape[-1])
+    impl = str(attrs.get("impl", "auto"))
+    from ..parallel.flash_attention import flash_decode, _jnp_decode
+    if impl == "dense":
+        return _jnp_decode(query, key_cache, value_cache, lengths, scale)
+    if impl in ("auto", "flash"):
+        return flash_decode(query, key_cache, value_cache, lengths,
+                            scale=scale,
+                            block_k=int(attrs.get("block_k", 128)),
+                            force_pallas=impl == "flash")
+    raise ValueError(
+        "_contrib_decode_attention: unknown impl %r (auto|flash|dense)"
+        % impl)
+
+
+register("_contrib_decode_attention", _decode_attention,
+         arg_names=("query", "key_cache", "value_cache", "lengths"),
+         no_jit=True,   # dispatch (TPU kernel vs jnp) is the op's own
+         defaults={"scale": 0.0, "impl": "auto", "block_k": 128},
+         attr_docs={"scale": "score scale; 0 = 1/sqrt(head_dim)",
+                    "impl": "auto|flash|dense (flash forces the "
+                            "Pallas kernel, interpret mode off-TPU)",
+                    "block_k": "decode kernel key/value block"},
+         description="One autoregressive decode step of cached-KV "
+                     "attention: query (B, 1, H, D) against a "
+                     "gathered KV cache (B, T, H, D) with per-row "
+                     "valid-key counts (B,) — positions beyond a "
+                     "row's length carry exact-zero weight "
+                     "(serving.kvcache's paged-gather contract).")
+
+
 register("_contrib_flash_attention", _attention,
          arg_names=("query", "key", "value"),
          no_jit=True,   # shard_map placement is managed by the op body

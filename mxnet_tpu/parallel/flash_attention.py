@@ -33,7 +33,7 @@ import math
 
 import jax
 
-__all__ = ["flash_attention"]
+__all__ = ["flash_attention", "flash_decode"]
 
 _NEG = -1e30
 
@@ -450,6 +450,139 @@ def _flash_bwd(scale, causal, block_q, block_k, interpret, res, g):
 
 
 _flash.defvjp(_flash_fwd_rule, _flash_bwd)
+
+
+# ---------------------------------------------------------------------------
+# query-length-1 cached-KV decode path (autoregressive serving)
+# ---------------------------------------------------------------------------
+
+def _jnp_decode(q, k, v, lengths, scale):
+    """The decode reference: same formula as :func:`_jnp_reference`
+    with the causal triangle replaced by a per-row valid-key count —
+    position ``i`` of row ``b`` is live iff ``i < lengths[b]``. A
+    blocked key's softmax weight is an exact IEEE zero (``exp`` of
+    ``_NEG - max`` underflows), so a row's result depends only on its
+    own live keys, never on the gathered cache's garbage tail."""
+    import jax.numpy as jnp
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    T = k.shape[1]
+    live = jax.lax.iota(jnp.int32, T)[None, :] \
+        < jnp.asarray(lengths, jnp.int32)[:, None]       # (B, T)
+    s = jnp.where(live[:, None, None, :], s, _NEG)
+    p = jnp.asarray(
+        jnp.exp(s - jnp.max(s, axis=-1, keepdims=True)), q.dtype)
+    p = p / jnp.maximum(jnp.sum(p, axis=-1, keepdims=True), 1e-30)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v)
+
+
+def _decode_kernel(q_ref, k_ref, v_ref, len_ref, o_ref, acc_ref, m_ref,
+                   l_ref, *, scale, block_k, n_kb):
+    """Grid = (batch*heads, k_blocks), k innermost: one query row per
+    program instance, running max/sum accumulators in VMEM scratch —
+    the forward kernel's accumulation order for a single q row, so a
+    decode step is bit-identical to the same row of a prefill pass at
+    the same ``block_k``."""
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    kb = pl.program_id(1)
+
+    @pl.when(kb == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, -jnp.inf)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    q = q_ref[...].astype(jnp.float32) * scale        # (1, d)
+    k = k_ref[...].astype(jnp.float32)                # (bk, d)
+    v = v_ref[...].astype(jnp.float32)
+    s = q @ k.T                                       # (1, bk)
+    k_pos = kb * block_k + jax.lax.iota(jnp.int32, block_k)[None, :]
+    s = jnp.where(k_pos < len_ref[0], s, _NEG)
+    m_prev = m_ref[...]
+    l_prev = l_ref[...]
+    m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1))
+    alpha = jnp.exp(m_prev - m_new)
+    p = jnp.exp(s - m_new[:, None])
+    m_ref[...] = m_new
+    l_ref[...] = l_prev * alpha + jnp.sum(p, axis=-1)
+    acc_ref[...] = acc_ref[...] * alpha[:, None] + p @ v
+
+    @pl.when(kb == n_kb - 1)
+    def _finish():
+        l = jnp.maximum(l_ref[...], 1e-30)
+        o_ref[...] = (acc_ref[...] / l[:, None]).astype(o_ref.dtype)
+
+
+def _pallas_decode(q, k, v, lengths, scale, block_k, interpret):
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    BH, _, D = q.shape
+    Tk = k.shape[1]
+    n_kb = Tk // block_k
+    out = pl.pallas_call(
+        functools.partial(_decode_kernel, scale=scale, block_k=block_k,
+                          n_kb=n_kb),
+        grid=(BH, n_kb),
+        in_specs=[
+            pl.BlockSpec((None, 1, D), lambda b, j: (b, 0, 0)),
+            pl.BlockSpec((None, block_k, D), lambda b, j: (b, j, 0)),
+            pl.BlockSpec((None, block_k, D), lambda b, j: (b, j, 0)),
+            pl.BlockSpec((None, 1), lambda b, j: (b, 0)),
+        ],
+        out_specs=pl.BlockSpec((None, 1, D), lambda b, j: (b, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((BH, 1, D), q.dtype),
+        scratch_shapes=[pltpu.VMEM((1, D), jnp.float32),
+                        pltpu.VMEM((1,), jnp.float32),
+                        pltpu.VMEM((1,), jnp.float32)],
+        interpret=interpret,
+    )(q, k, v, lengths)
+    return out
+
+
+def flash_decode(q, k, v, lengths, scale=None, block_k=128,
+                 force_pallas=False):
+    """One autoregressive decode step of attention: a single cached-KV
+    query per sequence.
+
+    - ``q``: ``(B, 1, H, D)`` — the new token's query;
+    - ``k``/``v``: ``(B, T, H, D)`` — the KV cache gathered to a fixed
+      bucket length ``T`` (``serving.kvcache`` page gather), including
+      the new token's own key/value already written at its position;
+    - ``lengths``: ``(B,)`` int32 — per-row valid key count (the new
+      token's position + 1); positions at or beyond a row's length are
+      masked to exact-zero weight, so the cache's garbage tail (unused
+      page slots, the dump page) never leaks into the result.
+
+    Runs the Pallas kernel on TPU (or under ``force_pallas`` in
+    interpret mode), the jnp composition elsewhere. With a ``block_k``
+    matching the prefill kernel's, the decode result is bit-identical
+    to the corresponding row of a full causal forward — the contract
+    ``tests/test_decode.py`` pins on both paths. ``T`` must tile by
+    ``block_k`` on the kernel path (the page pool guarantees this when
+    the page size divides ``block_k`` or vice versa); other lengths
+    fall back to ``block_k=T``'s divisor search like the prefill
+    kernel would, or use the jnp path."""
+    import jax.numpy as jnp
+    scale = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
+    if q.shape[1] != 1:
+        raise ValueError(
+            "flash_decode: expected a single query position, got "
+            "q length %d" % q.shape[1])
+    on_tpu = jax.devices()[0].platform in ("tpu", "axon")
+    if not (on_tpu or force_pallas):
+        return _jnp_decode(q, k, v, lengths, scale)
+    B, _, H, D = q.shape
+    Tk = k.shape[1]
+    bk = block_k if Tk % block_k == 0 else math.gcd(Tk, block_k)
+    qf = _flatten(q)
+    kf = _flatten(k)
+    vf = _flatten(v)
+    lens = jnp.repeat(jnp.asarray(lengths, jnp.int32), H)[:, None]
+    out = _pallas_decode(qf, kf, vf, lens, scale, bk, not on_tpu)
+    return _unflatten(out, B, H)
 
 
 def flash_attention(q, k, v, causal=False, scale=None, block_q=512,

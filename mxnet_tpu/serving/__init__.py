@@ -19,11 +19,25 @@ Serving table).
     with serving.InferenceServer(pred, max_queue=256) as srv:
         fut = srv.submit(x)                          # one sample
         y = fut.result(timeout=1.0)
+
+Stateful autoregressive serving (token-by-token decode over a paged
+KV cache, streaming, priorities, live weight swap) lives in
+:mod:`mxnet_tpu.serving.decode`:
+
+    with serving.DecodeServer(model, params, seq_ladder=[16, 32]) as srv:
+        req = srv.submit(prompt_tokens, max_new_tokens=32, priority=2)
+        for tok in req.tokens():                     # streams live
+            ...
 """
 from .batcher import BucketLadder, pad_batch, slice_rows
 from .server import (InferenceServer, ServerOverloadedError,
-                     RequestTimeoutError, ServerClosedError)
+                     RequestTimeoutError, ServerClosedError,
+                     validate_priority)
+from .kvcache import KVCachePool
+from .decode import DecodeServer, DecodeRequest, ToyDecoderLM
 
 __all__ = ["InferenceServer", "BucketLadder", "pad_batch", "slice_rows",
            "ServerOverloadedError", "RequestTimeoutError",
-           "ServerClosedError"]
+           "ServerClosedError", "validate_priority",
+           "KVCachePool", "DecodeServer", "DecodeRequest",
+           "ToyDecoderLM"]

@@ -1,0 +1,1100 @@
+"""Stateful autoregressive serving: continuous prefill/decode batching
+over a paged KV cache.
+
+``InferenceServer`` (PR 9) serves one-shot request/response over
+stateless bucket programs; the traffic that matters at million-user
+scale is token-by-token decode, where every request carries *state*
+(its KV cache) across hundreds of steps. :class:`DecodeServer` is the
+Orca/vLLM-style answer composed from machinery this tree already has:
+
+- **Prefill/decode split, fixed program set** — a prompt runs ONE
+  prefill pass at its smallest bucketing-ladder rung (program
+  ``decode:prefill:s<rung>``), writing its K/V into the paged pool and
+  emitting the first token; every subsequent token comes from the ONE
+  decode-step program (``decode:step``): a fixed-width batch of
+  query-length-1 rows, page-table gather → cached attention
+  (``parallel.flash_attention.flash_decode``) → new-token K/V scatter,
+  all inside the compiled program. ``compile_watch.site_stats
+  ("decode")`` is the oracle: ``1 + len(ladder)`` programs under ANY
+  request mix, zero steady-state recompiles.
+- **Paged KV cache** (``serving.kvcache``) — fixed-size pages, per
+  request page tables, page 0 the masked dump page. Pages allocate on
+  demand as generation crosses page boundaries; under pool pressure
+  the scheduler preempts the newest lowest-priority active request
+  (counted, typed error) rather than stalling everyone.
+- **Continuous batching** — one scheduler loop interleaves at most one
+  prefill with every decode step, so decode steps never starve behind
+  a burst of long prefills, and a newly-admitted request starts
+  decoding in the very next step alongside requests admitted long ago.
+- **Streaming + cancellation** — ``submit`` returns a
+  :class:`DecodeRequest` future whose :meth:`DecodeRequest.tokens`
+  iterator yields tokens as steps complete; :meth:`DecodeRequest.
+  cancel` (or a passed deadline) frees the request's pages before the
+  next decode step, through the counted ``kv_evict`` reclaim path.
+- **Priorities** — admission rides the same bounded-queue semantics as
+  ``InferenceServer.submit(priority=)``: overload sheds the lowest
+  class first (``MXNET_SERVING_PRIORITIES`` classes), and the KV-pool
+  preemption picks its victims by the same ordering.
+- **Zero-downtime weight hot-swap** — :meth:`DecodeServer.
+  swap_weights` loads a new parameter tree (directly, or from a
+  topology-neutral checkpoint manifest via
+  ``checkpoint.load_param_arrays``) alongside the old one, then flips
+  atomically between steps. In-flight requests FINISH on the weights
+  they started with (decode batches group by weight version), new
+  requests use the new weights from their prefill on, and the old
+  tree frees when its last request drains. Same shapes = same
+  programs: a swap never recompiles.
+- **Faults** — ``serve_admit`` per submit, ``serve_decode`` per decode
+  step, ``kv_evict`` per page reclaim: a planned hang at
+  ``serve_decode`` deterministically ages streaming requests past
+  their deadlines, and the reclaim that follows is counted.
+- **Telemetry** — cumulative ``decode`` records (tokens/sec,
+  time-to-first-token and inter-token percentiles, KV-pool occupancy/
+  evictions, prefill-vs-decode step mix, swaps) flow to the active
+  telemetry run, render as the diagnose Decode table, and export as
+  ``/metrics`` gauges (``mxnet_tpu.livemetrics``).
+
+The model contract (see :class:`ToyDecoderLM`, the reference
+implementation):
+
+- ``model.prefill(params, tokens) -> (logits, k, v)`` — ``tokens (B,
+  L)`` int32, causal; ``logits (B, L, V)``; ``k``/``v`` ``(n_layers,
+  B, L, H, D)``. Rows at/after the true prompt length may be garbage
+  (the server routes their K/V to the dump page and never reads their
+  logits).
+- ``model.decode(params, tokens, positions, k_cache, v_cache) ->
+  (logits, k_new, v_new)`` — ``tokens (B,)``/``positions (B,)``
+  int32; caches ``(n_layers, B, T, H, D)`` gathered from the pool,
+  NOT yet containing the new token: the model inserts ``k_new``/
+  ``v_new`` at ``positions`` before attending (cache index == absolute
+  position), masking keys at or beyond ``positions + 1``. ``logits
+  (B, V)``; ``k_new``/``v_new`` ``(n_layers, B, H, D)``.
+- ``model.n_layers`` / ``model.n_heads`` / ``model.head_dim`` size the
+  pool.
+
+Sampling is greedy (argmax, in-program): deterministic by
+construction, which is what makes "prefill + stepwise cached decode
+reproduces the full-sequence forward token-for-token" a testable
+contract (``tests/test_decode.py``, on the jnp AND Pallas paths).
+"""
+from __future__ import annotations
+
+import itertools
+import queue as _queue_mod
+import threading
+import time
+from collections import deque
+
+import numpy as _np
+
+from .. import envs
+from ..base import MXNetError
+from .. import fault, profiler, telemetry
+from ..bucketing.ladder import BucketLadder
+from . import kvcache
+from .kvcache import KVCachePool
+from .server import (RequestTimeoutError, ServerClosedError,
+                     ServerOverloadedError, validate_priority,
+                     shed_lowest_locked)
+
+__all__ = ["DecodeServer", "DecodeRequest", "ToyDecoderLM"]
+
+_DONE = object()          # stream sentinel
+
+
+class _ParamsVersion:
+    """One immutable weight generation: requests pin the version they
+    prefilled with; decode batches group by it, so a hot swap never
+    mixes generations inside one step."""
+
+    __slots__ = ("version", "tree")
+
+    def __init__(self, version, tree):
+        self.version = version
+        self.tree = tree
+
+
+class DecodeRequest:
+    """One streaming generation: a future over the full token list
+    plus a per-token stream. The server appends each generated token
+    to the bounded stream queue the moment its step completes;
+    :meth:`tokens` iterates them live, :meth:`result` blocks for the
+    whole list. ``request_id`` joins log lines, shed/timeout errors,
+    and telemetry."""
+
+    __slots__ = ("prompt", "max_new", "priority", "deadline", "eos_id",
+                 "request_id", "t_submit", "pages", "generated",
+                 "params", "state", "_cancelled", "_stream", "_event",
+                 "_error", "_last_emit", "_t_first")
+
+    def __init__(self, prompt, max_new, priority, deadline, eos_id,
+                 request_id):
+        self.prompt = prompt
+        self.max_new = max_new
+        self.priority = priority
+        self.deadline = deadline
+        self.eos_id = eos_id
+        self.request_id = request_id
+        self.t_submit = time.monotonic()
+        self.pages = []
+        self.generated = []
+        self.params = None            # _ParamsVersion, set at prefill
+        self.state = "queued"         # queued|active|done|failed
+        self._cancelled = False
+        # bounded by construction: at most max_new tokens + sentinel
+        self._stream = _queue_mod.Queue(maxsize=max_new + 2)
+        self._event = threading.Event()
+        self._error = None
+        self._last_emit = None
+        self._t_first = None
+
+    def done(self):
+        return self._event.is_set()
+
+    def cancel(self):
+        """Ask the server to drop this request: it is reaped before
+        the next decode step and its KV pages are freed then (the
+        ``kv_evict`` path). A cancelled request completes WITHOUT an
+        error — the stream just ends, :meth:`result` returns the
+        tokens generated so far, and ``state == "cancelled"`` tells
+        the story. Safe from any thread; idempotent."""
+        self._cancelled = True
+
+    def result(self, timeout=None):
+        """Block for the full generation; returns an int32 array of
+        the generated tokens (the partial list, for a cancelled
+        request). Raises the request's error (timeout, shed,
+        preemption, the model's own)."""
+        if not self._event.wait(timeout):
+            raise RequestTimeoutError(
+                "request %s did not complete within %ss"
+                % (self.request_id, timeout))
+        if self._error is not None:
+            raise self._error
+        return _np.asarray(self.generated, _np.int32)
+
+    def tokens(self, timeout=None):
+        """Iterate generated tokens as they stream in. ``timeout``
+        bounds the wait per token. Ends when generation completes;
+        raises the request's error (after yielding every token that
+        landed before it)."""
+        while True:
+            item = self._stream.get(timeout=timeout)
+            if item is _DONE:
+                if self._error is not None:
+                    raise self._error
+                return
+            yield item
+
+    # -- server side -------------------------------------------------------
+    def _push(self, token):
+        try:
+            self._stream.put_nowait(int(token))
+        except _queue_mod.Full:       # unreachable by construction
+            pass
+
+    def _complete(self, error=None, state=None):
+        """Finalize: the state is set BEFORE the event fires, so a
+        woken waiter can never observe a stale one."""
+        self._error = error
+        self.state = state if state is not None \
+            else ("failed" if error is not None else "done")
+        try:
+            self._stream.put_nowait(_DONE)
+        except _queue_mod.Full:
+            pass
+        self._event.set()
+
+
+# ---------------------------------------------------------------------------
+# the reference decode model
+# ---------------------------------------------------------------------------
+
+class ToyDecoderLM:
+    """A minimal pre-LN transformer LM implementing the decode-model
+    contract — the reference the server's tests, example, and bench
+    drive. Prefill attention is ``flash_attention(causal=True)``;
+    decode attention is the query-length-1 cached-KV path
+    (``flash_decode``); ``use_pallas`` forces the Pallas kernels in
+    interpret mode off-TPU so both kernel paths are testable on CPU.
+    Parameters are a FLAT ``{name: array}`` dict, so a checkpoint
+    manifest round-trips them by name (the hot-swap recipe)."""
+
+    def __init__(self, vocab=32, n_layers=2, n_heads=2, head_dim=8,
+                 d_ff=None, max_len=256, use_pallas=False):
+        self.vocab = int(vocab)
+        self.n_layers = int(n_layers)
+        self.n_heads = int(n_heads)
+        self.head_dim = int(head_dim)
+        self.d_model = self.n_heads * self.head_dim
+        self.d_ff = int(d_ff) if d_ff else 4 * self.d_model
+        self.max_len = int(max_len)
+        self.use_pallas = bool(use_pallas)
+        self._scale = 1.0 / float(self.head_dim) ** 0.5
+
+    def init_params(self, seed=0):
+        import jax
+        import jax.numpy as jnp
+        keys = iter(jax.random.split(jax.random.PRNGKey(seed), 128))
+
+        def _w(shape, s=0.1):
+            return (jax.random.normal(next(keys), shape, jnp.float32)
+                    * s)
+
+        D, F, V = self.d_model, self.d_ff, self.vocab
+        p = {"embed": _w((V, D), 0.5), "pos": _w((self.max_len, D), 0.1),
+             "out_g": jnp.ones((D,)), "out_b": jnp.zeros((D,)),
+             "wout": _w((D, V), 0.2)}
+        for i in range(self.n_layers):
+            p.update({
+                "l%d.att_g" % i: jnp.ones((D,)),
+                "l%d.att_b" % i: jnp.zeros((D,)),
+                "l%d.wq" % i: _w((D, D)), "l%d.wk" % i: _w((D, D)),
+                "l%d.wv" % i: _w((D, D)), "l%d.wo" % i: _w((D, D)),
+                "l%d.ffn_g" % i: jnp.ones((D,)),
+                "l%d.ffn_b" % i: jnp.zeros((D,)),
+                "l%d.w1" % i: _w((D, F)), "l%d.w2" % i: _w((F, D)),
+            })
+        return p
+
+    @staticmethod
+    def _ln(x, g, b):
+        import jax.numpy as jnp
+        mu = jnp.mean(x, axis=-1, keepdims=True)
+        var = jnp.mean((x - mu) ** 2, axis=-1, keepdims=True)
+        return (x - mu) / jnp.sqrt(var + 1e-5) * g + b
+
+    def prefill(self, params, tokens):
+        import jax
+        import jax.numpy as jnp
+        from ..parallel.flash_attention import flash_attention
+        B, L = tokens.shape
+        H, Dh = self.n_heads, self.head_dim
+        h = params["embed"][tokens] + params["pos"][:L][None]
+        ks, vs = [], []
+        for i in range(self.n_layers):
+            x = self._ln(h, params["l%d.att_g" % i],
+                         params["l%d.att_b" % i])
+            q = (x @ params["l%d.wq" % i]).reshape(B, L, H, Dh)
+            k = (x @ params["l%d.wk" % i]).reshape(B, L, H, Dh)
+            v = (x @ params["l%d.wv" % i]).reshape(B, L, H, Dh)
+            a = flash_attention(q, k, v, causal=True,
+                                scale=self._scale,
+                                force_pallas=self.use_pallas)
+            h = h + a.reshape(B, L, -1) @ params["l%d.wo" % i]
+            x = self._ln(h, params["l%d.ffn_g" % i],
+                         params["l%d.ffn_b" % i])
+            h = h + jax.nn.relu(x @ params["l%d.w1" % i]) \
+                @ params["l%d.w2" % i]
+            ks.append(k)
+            vs.append(v)
+        logits = self._ln(h, params["out_g"], params["out_b"]) \
+            @ params["wout"]
+        return logits, jnp.stack(ks), jnp.stack(vs)
+
+    def decode(self, params, tokens, positions, k_cache, v_cache):
+        import jax
+        import jax.numpy as jnp
+        from ..parallel.flash_attention import flash_decode
+        B = tokens.shape[0]
+        H, Dh = self.n_heads, self.head_dim
+        rows = jnp.arange(B)
+        h = params["embed"][tokens] + params["pos"][positions]
+        k_new, v_new = [], []
+        for i in range(self.n_layers):
+            x = self._ln(h, params["l%d.att_g" % i],
+                         params["l%d.att_b" % i])
+            q = (x @ params["l%d.wq" % i]).reshape(B, 1, H, Dh)
+            k = (x @ params["l%d.wk" % i]).reshape(B, H, Dh)
+            v = (x @ params["l%d.wv" % i]).reshape(B, H, Dh)
+            # the new token's K/V joins the cache at its own position
+            # BEFORE attending — cache index == absolute position
+            kc = k_cache[i].at[rows, positions].set(k)
+            vc = v_cache[i].at[rows, positions].set(v)
+            a = flash_decode(q, kc, vc, positions + 1,
+                             scale=self._scale,
+                             force_pallas=self.use_pallas)
+            h = h + a.reshape(B, -1) @ params["l%d.wo" % i]
+            x = self._ln(h, params["l%d.ffn_g" % i],
+                         params["l%d.ffn_b" % i])
+            h = h + jax.nn.relu(x @ params["l%d.w1" % i]) \
+                @ params["l%d.w2" % i]
+            k_new.append(k)
+            v_new.append(v)
+        logits = self._ln(h, params["out_g"], params["out_b"]) \
+            @ params["wout"]
+        return logits, jnp.stack(k_new), jnp.stack(v_new)
+
+
+# ---------------------------------------------------------------------------
+# the server
+# ---------------------------------------------------------------------------
+
+class DecodeServer:
+    """Continuous-batching autoregressive server (module docstring has
+    the architecture). ``seq_ladder`` buckets PROMPT lengths (ints, a
+    :class:`BucketLadder`, or None for a geometric [16..128] default);
+    rungs are page-aligned via ``BucketLadder.aligned``, and when the
+    model declares a ``max_len`` the ladder top + ``max_new_tokens``
+    must fit it (a silently clamped positional gather would emit
+    wrong tokens with no error). ``window`` is the decode step's fixed
+    batch width (``MXNET_DECODE_WINDOW``); ``max_new_tokens`` caps any
+    request's generation budget and, with the top rung, sizes the page
+    tables. ``start=False`` leaves the scheduler unstarted so tests
+    drive :meth:`_tick` deterministically."""
+
+    def __init__(self, model, params, *, seq_ladder=None,
+                 max_new_tokens=64, window=None, page_size=None,
+                 pool_pages=None, max_queue=64,
+                 default_deadline_ms=None, record_every=None,
+                 name=None, device=None, start=True):
+        import jax
+        from .. import compile_watch
+        for attr in ("prefill", "decode", "n_layers", "n_heads",
+                     "head_dim"):
+            if not hasattr(model, attr):
+                raise MXNetError(
+                    "DecodeServer: model lacks %r — the decode-model "
+                    "contract is prefill/decode plus "
+                    "n_layers/n_heads/head_dim (see "
+                    "serving.decode.ToyDecoderLM)" % attr)
+        self._model = model
+        self.name = name
+        self._device = device if device is not None else jax.devices()[0]
+
+        if seq_ladder is None:
+            seq_ladder = BucketLadder.geometric(128, 16)
+        elif not isinstance(seq_ladder, BucketLadder):
+            seq_ladder = BucketLadder(seq_ladder)
+        self._max_new = int(max_new_tokens)
+        if self._max_new < 1:
+            raise MXNetError("DecodeServer: max_new_tokens must be "
+                             ">= 1, got %d" % max_new_tokens)
+        self._pool = KVCachePool(model.n_layers, model.n_heads,
+                                 model.head_dim, page_size=page_size,
+                                 n_pages=pool_pages,
+                                 device=self._device)
+        # prompt rungs fill whole pages; the table width covers the
+        # longest prompt plus the full generation budget, so any
+        # admitted request fits its table by construction
+        self._seq_ladder = seq_ladder.aligned(self._pool.page_size)
+        self._max_context = self._seq_ladder.max_batch + self._max_new
+        model_reach = getattr(model, "max_len", None)
+        if model_reach is not None and self._max_context > model_reach:
+            raise MXNetError(
+                "DecodeServer: ladder top %d + max_new_tokens %d = "
+                "%d positions exceeds the model's max_len %d — an "
+                "out-of-range positional gather would silently clamp "
+                "under jit and emit wrong tokens; shrink the ladder/"
+                "budget or raise the model's reach"
+                % (self._seq_ladder.max_batch, self._max_new,
+                   self._max_context, model_reach))
+        self._max_pages = self._pool.pages_for(self._max_context)
+        if self._max_pages > self._pool.usable_pages:
+            raise MXNetError(
+                "DecodeServer: one max-size request needs %d pages "
+                "but the pool only has %d usable — raise "
+                "MXNET_KV_POOL_PAGES or shrink the ladder/"
+                "max_new_tokens" % (self._max_pages,
+                                    self._pool.usable_pages))
+        self._window = max(1, int(window) if window is not None
+                           else envs.get_int("MXNET_DECODE_WINDOW"))
+        self._max_queue = max(1, int(max_queue))
+        self._levels = max(1, envs.get_int("MXNET_SERVING_PRIORITIES"))
+        self._default_deadline = (float(default_deadline_ms) / 1e3
+                                  if default_deadline_ms is not None
+                                  else None)
+        self._record_every = int(record_every) if record_every \
+            else envs.get_int("MXNET_SERVING_RECORD_EVERY")
+
+        site = "decode" if not name else "decode:%s" % name
+        self._site = site
+        # donation makes each step update the pool in place on real
+        # accelerators; the CPU PJRT client cannot donate (it would
+        # only warn per compile), and correctness never depends on it
+        donate = {}
+        if jax.default_backend() not in ("cpu",):
+            donate = {"donate_argnums": (4, 5)}
+        self._decode_prog = compile_watch.jit(
+            self._decode_fn, "%s:step" % site,
+            statics=(site, self._window, self._max_pages),
+            cache=False, **donate)
+        self._prefill_progs = {}
+        for rung in self._seq_ladder.buckets:
+            self._prefill_progs[rung] = compile_watch.jit(
+                self._prefill_fn, "%s:prefill:s%d" % (site, rung),
+                statics=(site, "prefill", rung), cache=False, **donate)
+
+        self._cond = threading.Condition()
+        self._queue = deque()
+        self._active = []
+        self._params = _ParamsVersion(
+            1, jax.device_put(params, self._device))
+        self._rid = itertools.count(1)
+        self._stats = {"requests": 0, "completed": 0, "cancelled": 0,
+                       "timeouts": 0, "shed": 0, "errors": 0,
+                       "preempted": 0, "prefill_steps": 0,
+                       "decode_steps": 0, "decode_faults": 0,
+                       "tokens_out": 0, "queue_peak": 0, "swaps": 0}
+        self._shed_by_priority = {}
+        ring = max(1, envs.get_int("MXNET_SERVING_LATENCY_RING"))
+        self._intervals = deque(maxlen=ring)    # inter-token ms
+        self._ttft = deque(maxlen=ring)         # submit -> first token
+        self._steps_since_record = 0
+        self._t0 = time.perf_counter()
+        self._stopping = False
+        self._drain = True
+        self._closed = False
+        self._started = False
+        self._warming = False
+        self._thread = None
+        from .. import livemetrics
+        livemetrics.register_decode_server(self)
+        livemetrics.maybe_start()
+        if start:
+            self.start()
+
+    # -- compiled programs -------------------------------------------------
+    def _prefill_fn(self, params, tokens, n_valid, page_table, k_pages,
+                    v_pages):
+        import jax.numpy as jnp
+        logits, k_seq, v_seq = self._model.prefill(params, tokens)
+        k_pages = kvcache.scatter_prefill(k_pages, page_table,
+                                          k_seq[:, 0], n_valid)
+        v_pages = kvcache.scatter_prefill(v_pages, page_table,
+                                          v_seq[:, 0], n_valid)
+        # greedy sampling in-program; only the token leaves the
+        # device — returning the logits too would make XLA
+        # materialize a dead (vocab,)-sized output per prefill
+        last = jnp.take(logits[0], n_valid - 1, axis=0)
+        token = jnp.argmax(last).astype(jnp.int32)
+        return token, k_pages, v_pages
+
+    def _decode_fn(self, params, tokens, positions, page_tables,
+                   k_pages, v_pages):
+        import jax.numpy as jnp
+        k_cache = kvcache.gather_pages(k_pages, page_tables)
+        v_cache = kvcache.gather_pages(v_pages, page_tables)
+        logits, k_new, v_new = self._model.decode(
+            params, tokens, positions, k_cache, v_cache)
+        k_pages = kvcache.scatter_token(k_pages, page_tables,
+                                        positions, k_new)
+        v_pages = kvcache.scatter_token(v_pages, page_tables,
+                                        positions, v_new)
+        # only the argmax tokens leave the device: a (window, vocab)
+        # logits output would be dead weight on the per-token hot path
+        tokens_out = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        return tokens_out, k_pages, v_pages
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self):
+        if self._started:
+            return self
+        if self._closed:
+            raise ServerClosedError("DecodeServer already stopped")
+        self._started = True
+        self._t0 = time.perf_counter()
+        self._thread = threading.Thread(
+            target=self._loop, name="mxnet-decode-scheduler",
+            daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self, drain=True):
+        """Stop the server. ``drain=True`` finishes every queued and
+        active generation first; ``drain=False`` fails them with
+        ServerClosedError and reclaims their pages. Emits a final
+        ``decode`` telemetry record."""
+        if self._closed:
+            return
+        with self._cond:
+            self._stopping = True
+            self._drain = drain
+            self._cond.notify_all()
+        if self._started:
+            self._thread.join()
+        elif drain:
+            while self._has_work():
+                self._tick()
+        if not drain:
+            with self._cond:
+                doomed = list(self._queue) + list(self._active)
+                self._queue.clear()
+                del self._active[:]
+            for r in doomed:
+                self._finish(r, ServerClosedError(
+                    "server stopped; request %s dropped"
+                    % r.request_id))
+        self._closed = True
+        self._emit_record()
+        from .. import livemetrics
+        livemetrics.deregister_decode_server(self)
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+    def warmup(self):
+        """Compile the whole fixed program set (every prefill rung +
+        the decode step) before taking traffic, so no live request
+        ever pays an XLA compile. Warmup traffic writes only the dump
+        page (``n_valid=0``, all-zero tables), so the pool's logical
+        content is untouched; the returned pools are adopted (the
+        programs may donate their pool inputs on real accelerators).
+        The scheduler is paused for the duration — warmup and a live
+        step must never race on the pool arrays (requests submitted
+        meanwhile just wait). Returns the number of programs
+        readied."""
+        import jax
+        with self._cond:
+            if self._closed:
+                raise ServerClosedError("DecodeServer is stopped")
+            self._warming = True
+        try:
+            n = 0
+            zeros_pt = _np.zeros((self._max_pages,), _np.int32)
+            for rung in self._seq_ladder.buckets:
+                toks = _np.zeros((1, rung), _np.int32)
+                out = self._prefill_progs[rung](
+                    self._params.tree, toks, _np.int32(0), zeros_pt,
+                    self._pool.k, self._pool.v)
+                jax.block_until_ready(out[0])
+                self._pool.k, self._pool.v = out[1], out[2]
+                n += 1
+            toks = _np.zeros((self._window,), _np.int32)
+            pos = _np.zeros((self._window,), _np.int32)
+            pts = _np.zeros((self._window, self._max_pages), _np.int32)
+            out = self._decode_prog(self._params.tree, toks, pos, pts,
+                                    self._pool.k, self._pool.v)
+            jax.block_until_ready(out[0])
+            self._pool.k, self._pool.v = out[1], out[2]
+            return n + 1
+        finally:
+            with self._cond:
+                self._warming = False
+                self._cond.notify_all()
+
+    # -- admission ---------------------------------------------------------
+    def submit(self, prompt, *, max_new_tokens=None, priority=0,
+               deadline_ms=None, eos_id=None):
+        """Admit one generation: ``prompt`` is a 1-D int token array
+        (length <= the ladder top). Returns a :class:`DecodeRequest`
+        future streaming up to ``max_new_tokens`` greedy tokens
+        (stopping early at ``eos_id``). ``priority`` (0 lowest ..
+        ``MXNET_SERVING_PRIORITIES``-1) participates in overload
+        shedding — a full queue sheds its newest lowest-class member
+        below the arrival instead of the arrival itself — and in
+        KV-pool preemption. ``deadline_ms`` bounds the WHOLE
+        generation: a request that ages past it (queued or streaming)
+        fails with RequestTimeoutError and frees its pages."""
+        if self._closed:
+            raise ServerClosedError("DecodeServer is stopped")
+        prompt = _np.asarray(prompt)
+        if prompt.ndim != 1 or prompt.size < 1:
+            raise MXNetError(
+                "DecodeServer.submit: prompt must be a non-empty 1-D "
+                "token array, got shape %s" % (prompt.shape,))
+        prompt = prompt.astype(_np.int32)
+        if len(prompt) > self._seq_ladder.max_batch:
+            raise MXNetError(
+                "DecodeServer.submit: prompt length %d exceeds the "
+                "ladder top %d" % (len(prompt),
+                                   self._seq_ladder.max_batch))
+        max_new = int(max_new_tokens) if max_new_tokens is not None \
+            else self._max_new
+        if not 1 <= max_new <= self._max_new:
+            raise MXNetError(
+                "DecodeServer.submit: max_new_tokens must be in "
+                "1..%d (the server budget), got %d"
+                % (self._max_new, max_new))
+        priority = validate_priority(priority, self._levels)
+        fault.inject("serve_admit")
+        deadline_s = (float(deadline_ms) / 1e3
+                      if deadline_ms is not None
+                      else self._default_deadline)
+        rid = "d%06d" % next(self._rid)
+        req = DecodeRequest(prompt, max_new, priority,
+                            req_deadline(deadline_s), eos_id, rid)
+        victim = None
+        shed = stopping = False
+        with self._cond:
+            if self._stopping:
+                stopping = True
+            else:
+                self._stats["requests"] += 1
+                if len(self._queue) >= self._max_queue:
+                    victim = shed_lowest_locked(self._queue, priority)
+                    if victim is None:
+                        self._stats["shed"] += 1
+                        self._note_shed_locked(priority)
+                        shed = True
+                    else:
+                        self._stats["shed"] += 1
+                        self._note_shed_locked(victim.priority)
+                if not shed:
+                    self._queue.append(req)
+                    if len(self._queue) > self._stats["queue_peak"]:
+                        self._stats["queue_peak"] = len(self._queue)
+                    self._cond.notify_all()
+        if stopping:
+            raise ServerClosedError(
+                "DecodeServer is stopping; request %s not admitted"
+                % rid)
+        if victim is not None:
+            telemetry.note("decode_shed")
+            profiler.increment_counter("decode_shed")
+            victim._complete(ServerOverloadedError(
+                "decode: request %s (priority %d) shed for a "
+                "priority-%d arrival — queue full (max_queue=%d)"
+                % (victim.request_id, victim.priority, priority,
+                   self._max_queue)))
+        if shed:
+            telemetry.note("decode_shed")
+            profiler.increment_counter("decode_shed")
+            raise ServerOverloadedError(
+                "decode: request %s (priority %d) shed — queue full "
+                "(max_queue=%d) and no lower-priority request to "
+                "displace; retry with backoff or raise max_queue"
+                % (rid, priority, self._max_queue))
+        return req
+
+    def _note_shed_locked(self, priority):
+        self._shed_by_priority[priority] = \
+            self._shed_by_priority.get(priority, 0) + 1
+
+    # -- weight hot-swap ---------------------------------------------------
+    def swap_weights(self, params=None, *, prefix=None, epoch=None,
+                     validate=True):
+        """Zero-downtime weight swap: load the new tree alongside the
+        old, flip atomically between steps. ``params`` is a tree
+        matching the serving one (same structure, shapes, dtypes — a
+        swap must never recompile); or ``prefix``/``epoch`` name a
+        checkpoint manifest (``checkpoint.load_param_arrays`` — the
+        topology-neutral format makes this pure placement). In-flight
+        requests finish on the weights they started with; requests
+        admitted after the flip use the new ones; the old tree frees
+        when its last request drains. Returns the new version
+        number."""
+        import jax
+        if (params is None) == (prefix is None):
+            raise MXNetError(
+                "swap_weights: pass exactly one of params= or "
+                "prefix=/epoch=")
+        if params is None:
+            from .. import checkpoint
+            params = checkpoint.load_param_arrays(prefix, epoch,
+                                                  validate=validate)
+        cur = self._params.tree
+        cur_leaves, cur_def = jax.tree_util.tree_flatten(cur)
+        try:
+            new_leaves, new_def = jax.tree_util.tree_flatten(params)
+        except Exception as exc:
+            raise MXNetError("swap_weights: not a parameter tree "
+                             "(%s)" % exc)
+        if new_def != cur_def:
+            raise MXNetError(
+                "swap_weights: parameter tree structure differs from "
+                "the serving one (%s vs %s) — a swap replaces values, "
+                "never architecture" % (new_def, cur_def))
+        for old, new in zip(cur_leaves, new_leaves):
+            if tuple(old.shape) != tuple(_np.shape(new)) or \
+                    _np.dtype(old.dtype) != _np.dtype(
+                        getattr(new, "dtype", _np.asarray(new).dtype)):
+                raise MXNetError(
+                    "swap_weights: leaf shape/dtype mismatch (%s/%s "
+                    "vs %s/%s) — same shapes = same programs; a swap "
+                    "must never recompile"
+                    % (tuple(_np.shape(new)),
+                       _np.dtype(getattr(new, "dtype",
+                                         _np.asarray(new).dtype)),
+                       tuple(old.shape), _np.dtype(old.dtype)))
+        new_tree = jax.device_put(params, self._device)
+        # fully materialize the new generation BEFORE the flip: the
+        # next step must never block on a half-loaded tree
+        jax.block_until_ready(jax.tree_util.tree_leaves(new_tree))
+        with self._cond:
+            new_version = self._params.version + 1
+            self._params = _ParamsVersion(new_version, new_tree)
+            self._stats["swaps"] += 1
+        telemetry.note("decode_weight_swaps")
+        profiler.increment_counter("decode_weight_swaps")
+        return new_version
+
+    # -- scheduler ---------------------------------------------------------
+    def _has_work(self):
+        with self._cond:
+            return bool(self._queue or self._active)
+
+    def _loop(self):
+        while True:
+            with self._cond:
+                # idle = no queued/active work (or warmup owns the
+                # pool): a plain long wait — submit/stop/warmup-end
+                # all notify, the 1 s belt only backstops a lost wake
+                while not self._stopping and (self._warming or
+                                              (not self._queue
+                                               and not self._active)):
+                    self._cond.wait(1.0)
+                if self._stopping and (not self._drain
+                                       or (not self._queue
+                                           and not self._active)):
+                    break
+            if not self._tick():
+                # head-of-line blocked (pool pressure) or a reap-only
+                # pass: don't spin hot
+                with self._cond:
+                    self._cond.wait(0.002)
+
+    def _tick(self):
+        """One scheduler pass: reap cancellations/deadlines, admit at
+        most ONE prefill, run ONE decode step over every active
+        request — the interleave that keeps decode from starving
+        behind prefill bursts. Returns True when any step ran."""
+        with self._cond:
+            if self._warming:          # warmup owns the pool arrays
+                return False
+        self._reap()
+        did = self._admit_one()
+        did = self._decode_once() or did
+        if did:
+            self._steps_since_record += 1
+            if self._steps_since_record >= self._record_every:
+                self._steps_since_record = 0
+                self._emit_record()
+        return did
+
+    def _reap(self):
+        now = time.monotonic()
+        doomed = []
+        with self._cond:
+            for r in list(self._queue):
+                if r._cancelled or (r.deadline is not None
+                                    and now > r.deadline):
+                    self._queue.remove(r)
+                    doomed.append(r)
+            for r in list(self._active):
+                if r._cancelled or (r.deadline is not None
+                                    and now > r.deadline):
+                    self._active.remove(r)
+                    doomed.append(r)
+        for r in doomed:
+            if r._cancelled:
+                self._finish(r, None, cancelled=True)
+            else:
+                telemetry.note("decode_timeout")
+                profiler.increment_counter("decode_timeouts")
+                self._finish(r, RequestTimeoutError(
+                    "request %s deadline passed after %.1f ms "
+                    "(%d/%d tokens generated)"
+                    % (r.request_id,
+                       (now - r.t_submit) * 1e3,
+                       len(r.generated), r.max_new)))
+
+    def _finish(self, req, error, cancelled=False):
+        """Retire one request: reclaim its pages (the counted
+        ``kv_evict`` path), account it, complete the future. A
+        cancelled request completes WITHOUT an error — its stream just
+        ends and ``result()`` returns the tokens generated so far,
+        with ``state == "cancelled"`` telling the story."""
+        if req.pages:
+            self._pool.free(req.pages)
+            req.pages = []
+        with self._cond:
+            if cancelled:
+                self._stats["cancelled"] += 1
+            elif error is None:
+                self._stats["completed"] += 1
+            elif isinstance(error, RequestTimeoutError):
+                self._stats["timeouts"] += 1
+            elif isinstance(error, ServerOverloadedError):
+                self._stats["preempted"] += 1
+            else:
+                self._stats["errors"] += 1
+            self._cond.notify_all()
+        req._complete(error, state="cancelled" if cancelled else None)
+
+    def _pick_victim(self, below, exclude=None):
+        """The preemption victim under KV-pool pressure: the NEWEST
+        member of the LOWEST priority class strictly below ``below``
+        among active requests. None when nothing qualifies."""
+        with self._cond:
+            best = None
+            for r in self._active:
+                if r is exclude or r.priority >= below:
+                    continue
+                if best is None or r.priority < best.priority:
+                    best = r
+                elif r.priority == best.priority:
+                    best = r        # later in list = newer
+            if best is not None:
+                self._active.remove(best)
+        return best
+
+    def _preempt(self, victim):
+        telemetry.note("decode_preempted")
+        profiler.increment_counter("decode_preempted")
+        self._finish(victim, ServerOverloadedError(
+            "decode: request %s (priority %d) preempted under KV-"
+            "pool pressure after %d token(s) — raise "
+            "MXNET_KV_POOL_PAGES or lower concurrency"
+            % (victim.request_id, victim.priority,
+               len(victim.generated))))
+
+    def _admit_one(self):
+        with self._cond:
+            if self._stopping and not self._drain:
+                return False
+            if not self._queue or len(self._active) >= self._window:
+                return False
+            req = self._queue[0]
+        need = self._pool.pages_for(len(req.prompt) + 1)
+        pages = self._pool.alloc(need)
+        while pages is None:
+            victim = self._pick_victim(below=req.priority)
+            if victim is None:
+                return False         # wait for pages to free
+            self._preempt(victim)
+            pages = self._pool.alloc(need)
+        with self._cond:
+            if not self._queue or self._queue[0] is not req \
+                    or req._cancelled:
+                # reaped or cancelled while we were allocating
+                pages_back = pages
+            else:
+                self._queue.popleft()
+                req.pages = pages
+                req.state = "active"
+                req.params = self._params
+                self._active.append(req)
+                pages_back = None
+        if pages_back is not None:
+            self._pool.free(pages_back)
+            return False
+        # run the prefill program at the prompt's rung
+        P = len(req.prompt)
+        rung = self._seq_ladder.bucket_for(P)
+        tokens = _np.zeros((1, rung), _np.int32)
+        tokens[0, :P] = req.prompt
+        pt = _np.zeros((self._max_pages,), _np.int32)
+        pt[:len(req.pages)] = req.pages
+        try:
+            token, k, v = self._prefill_progs[rung](
+                req.params.tree, tokens, _np.int32(P), pt,
+                self._pool.k, self._pool.v)
+        except Exception as exc:       # noqa: BLE001 — model errors
+            with self._cond:           # belong to the request
+                if req in self._active:
+                    self._active.remove(req)
+            self._finish(req, exc)
+            return True
+        self._pool.k = k
+        self._pool.v = v
+        tok = int(token)
+        now = time.perf_counter()
+        req._t_first = now
+        req._last_emit = now
+        with self._cond:
+            self._stats["prefill_steps"] += 1
+            self._stats["tokens_out"] += 1
+            self._ttft.append(
+                (time.monotonic() - req.t_submit) * 1e3)
+        req.generated.append(tok)
+        req._push(tok)
+        if len(req.generated) >= req.max_new or \
+                (req.eos_id is not None and tok == req.eos_id):
+            with self._cond:
+                if req in self._active:
+                    self._active.remove(req)
+            self._finish(req, None)
+        return True
+
+    def _ensure_pages(self, rows):
+        """Grow each row's page table to cover its next write
+        position, preempting lower-priority active requests under
+        pool pressure (the row itself fails if nothing below it can
+        be evicted). Returns the surviving rows."""
+        survivors = []
+        for r in rows:
+            if r.state != "active":
+                continue               # preempted earlier in this pass
+            p = len(r.prompt) + len(r.generated) - 1
+            needed = p // self._pool.page_size + 1
+            failed = False
+            while len(r.pages) < needed:
+                pg = self._pool.alloc(1)
+                if pg is not None:
+                    r.pages.extend(pg)
+                    continue
+                victim = self._pick_victim(below=r.priority, exclude=r)
+                if victim is None:
+                    with self._cond:
+                        if r in self._active:
+                            self._active.remove(r)
+                    self._preempt(r)
+                    failed = True
+                    break
+                self._preempt(victim)
+                if victim in survivors:
+                    survivors.remove(victim)
+            if not failed:
+                survivors.append(r)
+        return survivors
+
+    def _decode_once(self):
+        with self._cond:
+            rows = list(self._active)
+        if not rows:
+            return False
+        try:
+            fault.inject("serve_decode")
+        except fault.InjectedFault:
+            # a planned raise/hang at the decode site: count it and
+            # keep scheduling — active requests age meanwhile, which
+            # is how deadline tests drive the timeout+reclaim path
+            with self._cond:
+                self._stats["decode_faults"] += 1
+            return True
+        rows = self._ensure_pages(rows)
+        if not rows:
+            return True
+        groups = {}
+        for r in rows:
+            groups.setdefault(r.params, []).append(r)
+        for ver in sorted(groups, key=lambda v: v.version):
+            self._decode_group(ver, groups[ver])
+        return True
+
+    def _decode_group(self, ver, rows):
+        D, M = self._window, self._max_pages
+        tokens = _np.zeros((D,), _np.int32)
+        positions = _np.zeros((D,), _np.int32)
+        pts = _np.zeros((D, M), _np.int32)
+        for i, r in enumerate(rows):
+            tokens[i] = r.generated[-1]
+            positions[i] = len(r.prompt) + len(r.generated) - 1
+            pts[i, :len(r.pages)] = r.pages
+        try:
+            toks, k, v = self._decode_prog(
+                ver.tree, tokens, positions, pts, self._pool.k,
+                self._pool.v)
+        except Exception as exc:       # noqa: BLE001 — model errors
+            with self._cond:           # belong to the batch's requests
+                for r in rows:
+                    if r in self._active:
+                        self._active.remove(r)
+            for r in rows:
+                self._finish(r, exc)
+            return
+        self._pool.k = k
+        self._pool.v = v
+        toks = _np.asarray(toks)
+        now = time.perf_counter()
+        finished = []
+        with self._cond:
+            self._stats["decode_steps"] += 1
+            for i, r in enumerate(rows):
+                self._stats["tokens_out"] += 1
+                if r._last_emit is not None:
+                    self._intervals.append((now - r._last_emit) * 1e3)
+                r._last_emit = now
+        for i, r in enumerate(rows):
+            tok = int(toks[i])
+            r.generated.append(tok)
+            r._push(tok)
+            if len(r.generated) >= r.max_new or \
+                    (r.eos_id is not None and tok == r.eos_id):
+                finished.append(r)
+        if finished:
+            with self._cond:
+                for r in finished:
+                    if r in self._active:
+                        self._active.remove(r)
+            for r in finished:
+                self._finish(r, None)
+
+    # -- stats & telemetry -------------------------------------------------
+    def stats(self):
+        """Cumulative decode-serving snapshot: request counts, token
+        throughput, time-to-first-token and inter-token latency
+        percentiles, prefill-vs-decode step mix, KV-pool occupancy,
+        swap/version state — the ``decode`` telemetry record, the
+        diagnose Decode table, and the /metrics gauges all render
+        this."""
+        elapsed = max(time.perf_counter() - self._t0, 1e-9)
+        with self._cond:
+            s = dict(self._stats)
+            intervals = list(self._intervals)
+            ttft = list(self._ttft)
+            depth = len(self._queue)
+            active = len(self._active)
+            version = self._params.version
+            versions = {id(r.params) for r in self._active
+                        if r.params is not None}
+            versions.add(id(self._params))
+            shed_pri = dict(self._shed_by_priority)
+        steps = s["prefill_steps"] + s["decode_steps"]
+        out = {
+            "name": getattr(self, "_metrics_label", None)
+            or self.name or "default",
+            "kind": "decode",
+            "requests": s["requests"],
+            "completed": s["completed"],
+            "cancelled": s["cancelled"],
+            "timeouts": s["timeouts"],
+            "shed": s["shed"],
+            "errors": s["errors"],
+            "preempted": s["preempted"],
+            "queue_depth": depth,
+            "queue_peak": s["queue_peak"],
+            "max_queue": self._max_queue,
+            "active": active,
+            "window": self._window,
+            "prefill_steps": s["prefill_steps"],
+            "decode_steps": s["decode_steps"],
+            "decode_faults": s["decode_faults"],
+            "prefill_fraction": round(s["prefill_steps"] / steps, 4)
+            if steps else None,
+            "tokens_out": s["tokens_out"],
+            "tokens_per_sec": round(s["tokens_out"] / elapsed, 3),
+            "kv": self._pool.stats(),
+            "swaps": s["swaps"],
+            "weight_version": version,
+            "versions_alive": len(versions),
+            "ladder": list(self._seq_ladder.buckets),
+        }
+        if intervals:
+            out["inter_token_ms"] = {
+                "mean": round(sum(intervals) / len(intervals), 3),
+                "p50": round(telemetry.percentile(intervals, 50), 3),
+                "p99": round(telemetry.percentile(intervals, 99), 3),
+                "max": round(max(intervals), 3),
+            }
+        if ttft:
+            out["ttft_ms"] = {
+                "mean": round(sum(ttft) / len(ttft), 3),
+                "p50": round(telemetry.percentile(ttft, 50), 3),
+                "p99": round(telemetry.percentile(ttft, 99), 3),
+            }
+        if shed_pri:
+            out["shed_by_priority"] = {str(k): v for k, v
+                                       in sorted(shed_pri.items())}
+        return out
+
+    def latency_snapshot(self):
+        """Recent inter-token intervals (ms) — the /metrics decode
+        histogram source."""
+        with self._cond:
+            return list(self._intervals)
+
+    def _emit_record(self):
+        telemetry.decode_event(self.stats())
+
+
+def req_deadline(deadline_s):
+    """Absolute monotonic deadline from a relative seconds budget
+    (None disables; 0 is a real immediate deadline)."""
+    return time.monotonic() + deadline_s if deadline_s is not None \
+        else None

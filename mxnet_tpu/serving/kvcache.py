@@ -1,0 +1,194 @@
+"""Paged KV-cache pool for stateful autoregressive decode.
+
+The vLLM insight adapted to this tree's fixed-program contract: the
+server owns one device-resident pool of **fixed-size pages** per K and
+V — shape ``(n_layers, n_pages, page_size, n_heads, head_dim)`` — and
+each in-flight request holds a *page table*, a short list of page ids
+covering its token positions in order. Every compiled program then
+sees only fixed shapes:
+
+- **gather** (:func:`gather_pages`) — indexing the pool with a
+  ``(batch, max_pages)`` page table yields a ``(batch, max_pages *
+  page_size, ...)`` contiguous view per request, where a token's cache
+  index IS its absolute position. Unallocated table tail entries point
+  at the reserved **dump page 0**, whose garbage is masked to
+  exact-zero attention weight by the per-row ``lengths`` argument of
+  ``parallel.flash_attention.flash_decode``.
+- **scatter** (:func:`scatter_token` / :func:`scatter_prefill`) — new
+  K/V rows write back through the same table, functionally
+  (``.at[].set``), so the whole decode step stays one compiled
+  program: gather → attend → scatter, no host round-trip per token.
+
+Page *accounting* is host-side and lives here too: an allocate/free
+free-list under a lock, with peak/eviction counters for the ``decode``
+telemetry record and the ``/metrics`` gauges. Page reclaim visits the
+``kv_evict`` fault site once per page (``MXNET_FAULT_PLAN``), making
+"a dead request's pages provably come back" a deterministic test, and
+a planned ``raise`` there is counted and survived — a reclaim fault
+must never leak the page it was reclaiming.
+
+Sizing: ``MXNET_KV_PAGE_SIZE`` tokens per page and
+``MXNET_KV_POOL_PAGES`` pages; the decode server derives its
+page-table width from the bucketing ladder's top prompt rung plus the
+generation budget, so the program set is fixed no matter the request
+mix.
+"""
+from __future__ import annotations
+
+import threading
+
+from .. import envs, fault
+from ..base import MXNetError
+
+__all__ = ["KVCachePool", "gather_pages", "scatter_token",
+           "scatter_prefill", "pages_for"]
+
+
+def pages_for(n_tokens, page_size):
+    """Pages needed to back ``n_tokens`` positions."""
+    return -(-int(n_tokens) // int(page_size))
+
+
+# ---------------------------------------------------------------------------
+# traced pool ops (pure; called inside the server's compiled programs)
+# ---------------------------------------------------------------------------
+
+def gather_pages(pages, page_table):
+    """``pages (L, P, S, ...)`` indexed by ``page_table (B, M)`` →
+    contiguous per-request caches ``(L, B, M*S, ...)``: cache index ==
+    absolute token position. Table entries of 0 bring in the dump
+    page — finite garbage the attention mask zeroes exactly."""
+    g = pages[:, page_table]                   # (L, B, M, S, ...)
+    shape = g.shape
+    return g.reshape(shape[0], shape[1], shape[2] * shape[3],
+                     *shape[4:])
+
+
+def scatter_token(pages, page_table, positions, new):
+    """Write one decode step's new K (or V) rows into the pool:
+    ``new (L, B, H, D)`` lands at each row's absolute ``positions
+    (B,)`` through its ``page_table (B, M)`` row. Inactive batch rows
+    must carry an all-zero table row — their write lands in the dump
+    page. Functional: returns the updated pool."""
+    import jax.numpy as jnp
+    S = pages.shape[2]
+    pos = jnp.asarray(positions, jnp.int32)
+    pidx = jnp.take_along_axis(
+        jnp.asarray(page_table, jnp.int32), (pos // S)[:, None],
+        axis=1)[:, 0]                          # (B,)
+    return pages.at[:, pidx, pos % S].set(new)
+
+
+def scatter_prefill(pages, page_table_row, seq, n_valid):
+    """Write one request's prefill K (or V) sequence into the pool:
+    ``seq (L, Lr, H, D)`` at positions ``0..Lr-1`` through
+    ``page_table_row (M,)``. Positions at or beyond ``n_valid`` (the
+    true prompt length — the rest of the rung is padding whose K/V is
+    garbage) are routed to the dump page instead. Functional."""
+    import jax
+    import jax.numpy as jnp
+    S = pages.shape[2]
+    Lr = seq.shape[1]
+    pos = jax.lax.iota(jnp.int32, Lr)
+    pidx = jnp.asarray(page_table_row, jnp.int32)[pos // S]
+    pidx = jnp.where(pos < n_valid, pidx, 0)
+    return pages.at[:, pidx, pos % S].set(seq)
+
+
+# ---------------------------------------------------------------------------
+# the pool
+# ---------------------------------------------------------------------------
+
+class KVCachePool:
+    """One model's paged KV storage + host-side page accounting.
+
+    The device arrays (``.k`` / ``.v``) are owned by the decode
+    server's scheduler thread: compiled steps take them as inputs and
+    the scheduler re-points them at the returned (functionally
+    updated) arrays. Page ids are allocated lowest-first — allocation
+    order is deterministic, so tests can predict table contents. Page
+    0 is reserved as the dump page and never allocated."""
+
+    def __init__(self, n_layers, n_heads, head_dim, *, page_size=None,
+                 n_pages=None, dtype=None, device=None):
+        import jax
+        import jax.numpy as jnp
+        self.page_size = int(page_size) if page_size is not None \
+            else envs.get_int("MXNET_KV_PAGE_SIZE")
+        self.n_pages = int(n_pages) if n_pages is not None \
+            else envs.get_int("MXNET_KV_POOL_PAGES")
+        if self.page_size < 1:
+            raise MXNetError("KVCachePool: page_size must be >= 1, "
+                             "got %d" % self.page_size)
+        if self.n_pages < 2:
+            raise MXNetError(
+                "KVCachePool: need at least 2 pages (page 0 is the "
+                "reserved dump page), got %d" % self.n_pages)
+        shape = (int(n_layers), self.n_pages, self.page_size,
+                 int(n_heads), int(head_dim))
+        dtype = jnp.float32 if dtype is None else dtype
+        k = jnp.zeros(shape, dtype)
+        v = jnp.zeros(shape, dtype)
+        if device is not None:
+            k = jax.device_put(k, device)
+            v = jax.device_put(v, device)
+        self.k = k
+        self.v = v
+        self._lock = threading.Lock()
+        self._free = list(range(self.n_pages - 1, 0, -1))  # pop() -> 1
+        self._used_peak = 0
+        self._evicted = 0
+        self._alloc_failures = 0
+
+    @property
+    def usable_pages(self):
+        """Allocatable pages (the pool minus the dump page)."""
+        return self.n_pages - 1
+
+    def pages_for(self, n_tokens):
+        return pages_for(n_tokens, self.page_size)
+
+    def alloc(self, n):
+        """``n`` page ids (lowest-free-first), or None when the pool
+        cannot satisfy the request — the caller decides between
+        waiting, shedding, and preempting a lower-priority holder."""
+        n = int(n)
+        with self._lock:
+            if n > len(self._free):
+                self._alloc_failures += 1
+                return None
+            pages = [self._free.pop() for _ in range(n)]
+            used = self.usable_pages - len(self._free)
+            if used > self._used_peak:
+                self._used_peak = used
+            return pages
+
+    def free(self, pages):
+        """Return pages to the pool. Visits the ``kv_evict`` fault
+        site once per page; a planned ``raise`` there is counted and
+        the page is reclaimed anyway — a reclaim fault must never leak
+        memory. Returns the number of pages reclaimed."""
+        reclaimed = 0
+        for p in pages:
+            try:
+                fault.inject("kv_evict")
+            except fault.InjectedFault:
+                pass          # counted in fault.stats(); never a leak
+            with self._lock:
+                self._free.append(int(p))
+                self._evicted += 1
+                reclaimed += 1
+        return reclaimed
+
+    def stats(self):
+        with self._lock:
+            free = len(self._free)
+            return {
+                "page_size": self.page_size,
+                "pages": self.usable_pages,
+                "free": free,
+                "used": self.usable_pages - free,
+                "peak_used": self._used_peak,
+                "evicted": self._evicted,
+                "alloc_failures": self._alloc_failures,
+            }

@@ -59,7 +59,8 @@ from ..bucketing.padding import pad_along
 from .batcher import BucketLadder, pad_batch, slice_rows
 
 __all__ = ["InferenceServer", "ServerOverloadedError",
-           "RequestTimeoutError", "ServerClosedError"]
+           "RequestTimeoutError", "ServerClosedError",
+           "validate_priority", "shed_lowest_locked"]
 
 
 class ServerOverloadedError(MXNetError):
@@ -76,6 +77,38 @@ class ServerClosedError(MXNetError):
     """The server was stopped; the request cannot be served."""
 
 
+def validate_priority(priority, levels):
+    """A priority class in ``0 .. levels-1`` (0 lowest). ``levels``
+    comes from ``MXNET_SERVING_PRIORITIES``; a value outside the
+    declared classes raises naming the knob, so a typo'd priority
+    fails at submit instead of silently competing as something else."""
+    p = int(priority)
+    if not 0 <= p < levels:
+        raise MXNetError(
+            "priority %d outside 0..%d (MXNET_SERVING_PRIORITIES=%d; "
+            "0 is lowest, %d highest)"
+            % (p, levels - 1, levels, levels - 1))
+    return p
+
+
+def shed_lowest_locked(queue, priority):
+    """Overload shedding with priority classes: pick (and REMOVE from
+    ``queue``) the victim a ``priority``-class arrival displaces — the
+    NEWEST member of the LOWEST class strictly below it. Returns None
+    when nothing below it waits (the arrival itself sheds). The caller
+    holds the queue's lock and fails the victim's future outside it."""
+    victim = None
+    for r in queue:                    # left-to-right = oldest-first
+        p = getattr(r, "priority", 0) or 0
+        if p >= priority:
+            continue
+        if victim is None or p <= (victim.priority or 0):
+            victim = r                 # later match = newer
+    if victim is not None:
+        queue.remove(victim)
+    return victim
+
+
 class _Request:
     """One in-flight request: the per-sample input arrays, the
     server-assigned ``request_id`` (present on every shed/timeout log
@@ -83,14 +116,17 @@ class _Request:
     event. ``_tr`` holds the trace-clock stamps of the request's
     lifecycle spans — None whenever tracing is off."""
 
-    __slots__ = ("args", "t_submit", "deadline", "request_id", "_tr",
+    __slots__ = ("args", "t_submit", "deadline", "request_id",
+                 "priority", "_tr",
                  "_event", "_value", "_error", "_t_done")
 
-    def __init__(self, args, t_submit, deadline, request_id=None):
+    def __init__(self, args, t_submit, deadline, request_id=None,
+                 priority=0):
         self.args = args
         self.t_submit = t_submit
         self.deadline = deadline
         self.request_id = request_id
+        self.priority = priority
         self._tr = None
         self._event = threading.Event()
         self._value = None
@@ -280,6 +316,8 @@ class InferenceServer:
                        "timeouts": 0, "errors": 0, "dispatch_faults": 0,
                        "batches": 0, "occupancy_sum": 0.0,
                        "queue_peak": 0}
+        self._levels = max(1, envs.get_int("MXNET_SERVING_PRIORITIES"))
+        self._shed_by_priority = {}
         self._bucket_counts = {}
         self._replica_batches = [0] * replicas
         self._replica_service_s = [0.0] * replicas
@@ -467,17 +505,24 @@ class InferenceServer:
                                         who="serving"))
         return out
 
-    def submit(self, *args, deadline_ms=None, block=False):
+    def submit(self, *args, deadline_ms=None, block=False, priority=0):
         """Admit one request (one SAMPLE per input — no batch dim).
         Returns a future; ``.result(timeout)`` yields the response
-        rows. Sheds with :class:`ServerOverloadedError` when the
-        bounded queue is full (``block=True`` waits for space instead,
-        up to the request's deadline)."""
+        rows. ``priority`` (0 lowest .. ``MXNET_SERVING_PRIORITIES``-1
+        highest) governs overload: a full queue sheds its newest
+        LOWEST-class member below the arrival instead of the arrival
+        itself, so the low class degrades first and the high class
+        keeps its admission SLO. Sheds with
+        :class:`ServerOverloadedError` (the message names the shed
+        request's priority) when nothing below the arrival waits;
+        ``block=True`` waits for space instead, up to the request's
+        deadline."""
         if self._closed or not self._started:
             raise ServerClosedError("InferenceServer is not running")
         arrays = [a.asnumpy() if hasattr(a, "asnumpy")
                   else _np.asarray(a) for a in args]
         arrays = self._validate_sample(arrays)
+        priority = validate_priority(priority, self._levels)
         fault.inject("serve_admit")
         if deadline_ms is None:
             deadline_s = self._default_deadline
@@ -489,10 +534,11 @@ class InferenceServer:
         rid = "r%06d" % next(self._rid)
         req = _Request(arrays, now,
                        now + deadline_s if deadline_s is not None
-                       else None, request_id=rid)
+                       else None, request_id=rid, priority=priority)
         if tracing._tracer is not None:
             req._tr = {"submit": tracing.now()}
         shed = stopping = False
+        victim = None
         with self._cond:
             if self._stopping:
                 stopping = True
@@ -515,8 +561,19 @@ class InferenceServer:
                     self._stats["requests"] -= 1
                     stopping = True
                 elif len(self._queue) >= self._max_queue:
+                    # priority admission: displace the newest member
+                    # of the lowest class below this arrival; shed
+                    # the arrival itself only when nothing waits
+                    # below it
+                    victim = shed_lowest_locked(self._queue, priority)
                     self._stats["shed"] += 1
-                    shed = True
+                    if victim is None:
+                        self._note_shed_locked(priority)
+                        shed = True
+                    else:
+                        self._note_shed_locked(victim.priority)
+                        self._queue.append(req)
+                        self._cond.notify_all()
                 else:
                     # admit under the SAME lock hold as the bound
                     # check — the queue depth can never exceed the
@@ -530,6 +587,19 @@ class InferenceServer:
             raise ServerClosedError(
                 "InferenceServer is stopping; request %s not admitted"
                 % rid)
+        if victim is not None:
+            telemetry.note("serving_shed")
+            profiler.increment_counter("serving_shed")
+            if victim._tr is not None:
+                tracing.instant("shed", "serving",
+                                tid=tracing.track("serving"),
+                                args={"request_id": victim.request_id})
+            victim._fail(ServerOverloadedError(
+                "serving: request %s (priority %d) shed for a "
+                "priority-%d arrival — queue full (max_queue=%d); "
+                "retry with backoff, raise max_queue, or add replicas"
+                % (victim.request_id, victim.priority, priority,
+                   self._max_queue)))
         if shed:
             telemetry.note("serving_shed")
             profiler.increment_counter("serving_shed")
@@ -538,10 +608,15 @@ class InferenceServer:
                                 tid=tracing.track("serving"),
                                 args={"request_id": rid})
             raise ServerOverloadedError(
-                "serving: request %s shed — queue full (max_queue=%d); "
-                "retry with backoff, raise max_queue, or add replicas"
-                % (rid, self._max_queue))
+                "serving: request %s (priority %d) shed — queue full "
+                "(max_queue=%d); retry with backoff, raise max_queue, "
+                "or add replicas"
+                % (rid, priority, self._max_queue))
         return req
+
+    def _note_shed_locked(self, priority):
+        self._shed_by_priority[priority] = \
+            self._shed_by_priority.get(priority, 0) + 1
 
     def predict(self, *args, timeout=None, deadline_ms=None):
         """Synchronous convenience: submit + result."""
@@ -790,6 +865,7 @@ class InferenceServer:
             depth = len(self._queue)
             replica_batches = list(self._replica_batches)
             replica_service = list(self._replica_service_s)
+            shed_pri = dict(self._shed_by_priority)
         out = {
             # the /metrics registration dedups this label per process
             # — stats consumers (the watchdog's per-server baselines)
@@ -828,6 +904,12 @@ class InferenceServer:
                 "p99": round(telemetry.percentile(lats, 99), 3),
                 "max": round(max(lats), 3),
             }
+        if shed_pri:
+            # per-priority shed counts — present only once priorities
+            # actually shed, so priority-free runs keep the historical
+            # record shape (and sink bytes) exactly
+            out["shed_by_priority"] = {str(k): v for k, v
+                                       in sorted(shed_pri.items())}
         return out
 
     def latency_snapshot(self):

@@ -107,7 +107,8 @@ __all__ = ["PHASES", "enabled", "start", "stop", "reset", "maybe_start",
            "comm_span", "h2d", "note", "recent_rate", "sample_memory",
            "memory_breakdown", "flush", "report", "quick_stats",
            "percentile", "external_record", "checkpoint_event",
-           "serving_event", "bucketing_event", "alert_event"]
+           "serving_event", "decode_event", "bucketing_event",
+           "alert_event"]
 
 PHASES = ("data_wait", "compute", "optimizer", "sync", "checkpoint",
           "eval")
@@ -156,6 +157,8 @@ class _Run:
         self.comms = {}              # (kind, key) -> calls/bytes/time_ms
         self.ckpt = None             # checkpoint-save aggregates (lazy)
         self.serving = None          # latest cumulative serving stats
+        self.decode = None           # per-server cumulative decode
+                                     # (autoregressive serving) stats
         self.bucketing = None        # per-producer cumulative bucketing
         self.alerts = None           # SLO-watchdog alert list (lazy,
         self.alerts_dropped = 0      # bounded to _MAX_ALERTS)
@@ -705,6 +708,32 @@ def serving_event(fields):
         hook(fields)
 
 
+def decode_event(fields):
+    """Append one cumulative ``decode`` record from an
+    ``mxnet_tpu.serving.DecodeServer`` (token throughput,
+    time-to-first-token and inter-token percentiles, KV-pool
+    occupancy/evictions, prefill-vs-decode step mix, weight-swap
+    state — the server emits one every ``record_every`` scheduler
+    steps and at stop). Latest snapshot per server ``name`` lands in
+    the summary's ``decode`` block. No-op without a run, so a run
+    that never decodes keeps a byte-identical sink."""
+    run = _run
+    if run is None:
+        return
+    rec = {"type": "decode", "seq": run.steps,
+           "t": round(time.time() - run.t0_wall, 6)}
+    rec.update(fields)
+    with _lock:
+        if run.decode is None:
+            run.decode = {}
+        # cumulative per server name: latest wins
+        run.decode[fields.get("name") or "default"] = dict(fields)
+        run.records.append(rec)
+        # a stepless sink-less process hosting a long-lived decode
+        # server must not grow records unboundedly
+        _cap_records_locked(run)
+
+
 def bucketing_event(fields):
     """Append one cumulative ``bucketing`` record from a shape-
     bucketing producer (``mxnet_tpu.bucketing`` — per-bucket batch
@@ -963,6 +992,9 @@ def report():
             out["checkpoint"] = ck
         if run.serving is not None:
             out["serving"] = dict(run.serving)
+        if run.decode is not None:
+            out["decode"] = {k: dict(v)
+                             for k, v in run.decode.items()}
         if run.bucketing is not None:
             out["bucketing"] = {k: dict(v)
                                 for k, v in run.bucketing.items()}

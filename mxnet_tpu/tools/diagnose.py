@@ -137,8 +137,8 @@ def read_telemetry(path):
     MXNET_TELEMETRY_FILE) yields the LAST run."""
     out = {"run": None, "steps": [], "memory": [], "compiles": [],
            "utilization": [], "checkpoints": [], "serving": [],
-           "bucketing": [], "alerts": [], "breakdown": None,
-           "summary": None}
+           "decode": [], "bucketing": [], "alerts": [],
+           "breakdown": None, "summary": None}
     skipped = 0
     with open(path) as f:
         for line in f:
@@ -161,7 +161,7 @@ def read_telemetry(path):
                 out = {"run": rec, "steps": [], "memory": [],
                        "compiles": [], "utilization": [],
                        "checkpoints": [], "serving": [],
-                       "bucketing": [], "alerts": [],
+                       "decode": [], "bucketing": [], "alerts": [],
                        "breakdown": None, "summary": None}
                 skipped = 0     # earlier runs' damage is not THIS
                                 # run's — the warning describes the
@@ -180,6 +180,8 @@ def read_telemetry(path):
                 out["checkpoints"].append(rec)
             elif kind == "serving":
                 out["serving"].append(rec)
+            elif kind == "decode":
+                out["decode"].append(rec)
             elif kind == "bucketing":
                 out["bucketing"].append(rec)
             elif kind == "alert":
@@ -447,6 +449,77 @@ def format_telemetry(tel):
         if sv.get("dispatch_faults"):
             lines.append("faults       : %d injected dispatch fault(s) "
                          "survived" % sv["dispatch_faults"])
+        shed_pri = sv.get("shed_by_priority") or {}
+        if shed_pri:
+            lines.append("shed/prio    : %s (lowest class sheds "
+                         "first)"
+                         % " ".join("p%s:%s" % kv_
+                                    for kv_ in sorted(
+                                        shed_pri.items())))
+
+    # -- autoregressive decode serving (serving.decode) -----------------
+    dec_recs = tel.get("decode") or []
+    # records are cumulative per server name: keep each name's last
+    dec = {}
+    for rec in dec_recs:
+        dec[rec.get("name") or "default"] = rec
+    if not dec:
+        dec = dict(summary.get("decode") or {})
+    if dec:
+        lines.append("----------Decode----------")
+        for name in sorted(dec):
+            d = dec[name]
+            lines.append("%-12s : %d request(s) (completed %d, "
+                         "cancelled %d, timeout %d, shed %d, "
+                         "preempted %d, errors %d)"
+                         % (name[:12], d.get("requests", 0),
+                            d.get("completed", 0),
+                            d.get("cancelled", 0),
+                            d.get("timeouts", 0), d.get("shed", 0),
+                            d.get("preempted", 0), d.get("errors", 0)))
+            frac = d.get("prefill_fraction")
+            lines.append("  steps      : %d prefill + %d decode (%s "
+                         "prefill share) — the continuous-batching "
+                         "mix"
+                         % (d.get("prefill_steps", 0),
+                            d.get("decode_steps", 0),
+                            "%.1f%%" % (100.0 * frac)
+                            if frac is not None else "n/a"))
+            lines.append("  tokens     : %d out at %.1f tokens/s"
+                         % (d.get("tokens_out", 0),
+                            d.get("tokens_per_sec", 0.0)))
+            it = d.get("inter_token_ms") or {}
+            if it:
+                lines.append("  inter-token: p50 %.3f ms  p99 %.3f ms "
+                             " max %.3f ms"
+                             % (it.get("p50", 0.0), it.get("p99", 0.0),
+                                it.get("max", 0.0)))
+            tt = d.get("ttft_ms") or {}
+            if tt:
+                lines.append("  first token: p50 %.3f ms  p99 %.3f ms"
+                             % (tt.get("p50", 0.0), tt.get("p99", 0.0)))
+            kv = d.get("kv") or {}
+            if kv:
+                pages = kv.get("pages", 0) or 1
+                lines.append("  kv pool    : %d/%d pages used (peak "
+                             "%d, %.1f%%), %d evicted, page size %d"
+                             % (kv.get("used", 0), kv.get("pages", 0),
+                                kv.get("peak_used", 0),
+                                100.0 * kv.get("peak_used", 0) / pages,
+                                kv.get("evicted", 0),
+                                kv.get("page_size", 0)))
+            if d.get("swaps"):
+                lines.append("  weights    : %d hot swap(s), serving "
+                             "version %s (%d generation(s) alive)"
+                             % (d.get("swaps", 0),
+                                d.get("weight_version", "?"),
+                                d.get("versions_alive", 1)))
+            shed_pri = d.get("shed_by_priority") or {}
+            if shed_pri:
+                lines.append("  shed/prio  : %s"
+                             % " ".join("p%s:%s" % kv_
+                                        for kv_ in sorted(
+                                            shed_pri.items())))
 
     # -- SLO watchdog alerts (mxnet_tpu.livemetrics) --------------------
     alerts = tel.get("alerts") or []

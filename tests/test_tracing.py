@@ -166,7 +166,7 @@ def test_shed_and_timeout_are_joinable_against_traces(tmp_path,
         futs = [srv.submit(x, deadline_ms=1) for _ in range(2)]
         with pytest.raises(mx.serving.ServerOverloadedError) as exc:
             srv.submit(x)
-        assert "r000003 shed" in str(exc.value)   # id is in the line
+        assert "r000003 (priority 0) shed" in str(exc.value)   # id in line
         for f in futs:
             with pytest.raises(mx.serving.RequestTimeoutError) as texc:
                 f.result(timeout=30)
