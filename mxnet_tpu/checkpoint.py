@@ -82,8 +82,8 @@ from .base import MXNetError
 
 __all__ = ["CheckpointManager", "async_checkpoint_enabled",
            "manifest_path", "load_manifest", "validate_manifest",
-           "load_arrays", "load_param_arrays", "restore_params",
-           "save_arrays",
+           "latest_manifest_epoch", "load_arrays", "load_param_arrays",
+           "restore_params", "save_arrays",
            "atomic_write_file", "write_bytes_async", "flush_async_writes"]
 
 _PIECE_SEP = "::piece"       # shard-file key suffix for partial pieces
@@ -257,7 +257,19 @@ def _device_order(mesh_devices):
     return {d: i for i, d in enumerate(mesh_devices)}
 
 
-def _split_shards(flat):
+def _spans_processes(sharding):
+    """True when a sharding's device set covers more than one process
+    (a genuinely global array — only possible on backends with
+    cross-process SPMD)."""
+    try:
+        procs = {getattr(d, "process_index", 0)
+                 for d in sharding.device_set}
+        return len(procs) > 1
+    except Exception:
+        return False
+
+
+def _split_shards(flat, process_index=None):
     """Partition a snapshot into per-mesh-position piece rosters.
 
     Returns ``(shards, layout, n_shards)`` where ``shards[s]`` maps
@@ -266,21 +278,55 @@ def _split_shards(flat):
     to shard 0 under their plain key (legacy format); an entry sharded
     across devices contributes one piece per distinct index, placed in
     the shard of the device that owns it. The D2H transfer happens
-    here — on the caller (writer) thread."""
-    shards = {0: {}}
+    here — on the caller (writer) thread.
+
+    Multi-process mode (``process_index`` given): the LAYOUT covers
+    every piece — for process-spanning arrays it is derived from the
+    sharding's global ``devices_indices_map``, identical on all ranks
+    — but ``shards`` materializes only the pieces THIS process's
+    devices own; whole/replicated/host entries are owned by rank 0.
+    Each rank writes its own shard files and rank 0 writes the
+    manifest after the all-shards barrier (:func:`save_arrays`)."""
+    shards = {0: {}} if process_index in (None, 0) else {}
     layout = {}
-    n_shards = 1
     for key, data in flat.items():
         sharding = getattr(data, "sharding", None)
         addressable = getattr(data, "addressable_shards", None)
         pieces = []
         if sharding is not None and addressable is not None \
+                and process_index is not None \
+                and _spans_processes(sharding) \
+                and not getattr(data, "is_fully_replicated", True):
+            # global (cross-process) array: layout from the global
+            # index map — every rank computes the same table; only
+            # locally-owned pieces materialize bytes
+            order = _device_order(list(sharding.mesh.devices.flat)) \
+                if hasattr(sharding, "mesh") else {}
+            local = {p.device: p for p in addressable}
+            imap = sharding.devices_indices_map(tuple(data.shape))
+            devs = sorted(imap, key=lambda d: order.get(d, 1 << 30))
+            seen = {}
+            for dev in devs:
+                index = tuple(
+                    (0 if sl.start is None else int(sl.start),
+                     int(dim) if sl.stop is None else int(sl.stop))
+                    for sl, dim in zip(imap[dev], data.shape))
+                if index in seen:
+                    continue          # replicated copy of this piece
+                seen[index] = dev
+                s = order.get(dev, len(seen) - 1)
+                pkey = "%s%s%d" % (key, _PIECE_SEP, len(pieces))
+                if dev in local:
+                    shards.setdefault(s, {})[pkey] = \
+                        _np.asarray(local[dev].data)
+                pieces.append({"shard": s, "key": pkey,
+                               "index": [list(ix) for ix in index]})
+        elif sharding is not None and addressable is not None \
                 and len(addressable) > 1 \
+                and process_index in (None, 0) \
                 and not getattr(data, "is_fully_replicated", True):
             order = _device_order(list(sharding.mesh.devices.flat)) \
                 if hasattr(sharding, "mesh") else {}
-            n_shards = max(n_shards,
-                           len(order) or len(addressable))
             seen = set()
             for piece in addressable:
                 index = tuple(
@@ -296,7 +342,8 @@ def _split_shards(flat):
                 pieces.append({"shard": s, "key": pkey,
                                "index": [list(ix) for ix in index]})
         if not pieces:
-            shards[0][key] = _np.asarray(data)
+            if process_index in (None, 0):
+                shards[0][key] = _np.asarray(data)
             pieces = [{"shard": 0, "key": key, "index": None}]
         if hasattr(data, "shape"):
             layout[key] = {"shape": [int(s) for s in data.shape],
@@ -307,20 +354,35 @@ def _split_shards(flat):
     # renumber shard ids densely (sorted device order -> 0..k-1): on a
     # multi-axis mesh the distinct-piece owners need not sit at flat
     # positions 0..k-1, and the manifest shard list, piece references
-    # and file names must agree on one contiguous numbering
-    pos = {s: i for i, s in enumerate(sorted(shards))}
+    # and file names must agree on one contiguous numbering. The map
+    # derives from the LAYOUT's piece union (not the locally-
+    # materialized shards) so every rank of a multi-process save
+    # numbers — and names — its files identically.
+    used = sorted({p["shard"] for entry in layout.values()
+                   for p in entry["pieces"]} | set(shards))
+    pos = {s: i for i, s in enumerate(used)}
     if any(s != i for s, i in pos.items()):
         shards = {pos[s]: roster for s, roster in shards.items()}
         for entry in layout.values():
             for piece in entry["pieces"]:
                 piece["shard"] = pos[piece["shard"]]
-    return shards, layout, len(shards)
+    return shards, layout, len(used)
 
 
 def _npz_bytes(arrays):
     buf = _io.BytesIO()
     _np.savez(buf, **arrays)
     return buf.getvalue()
+
+
+def _process_topology():
+    """(process_index, process_count) of the running job — (0, 1) for
+    a plain single-process run."""
+    try:
+        import jax
+        return int(jax.process_index()), int(jax.process_count())
+    except Exception:
+        return 0, 1
 
 
 def save_arrays(prefix, epoch, flat, states_bytes=None, symbol=None):
@@ -330,28 +392,42 @@ def save_arrays(prefix, epoch, flat, states_bytes=None, symbol=None):
     dict the telemetry record is built from. Raises on failure (incl.
     planned ``ckpt_write``/``ckpt_fsync`` faults) — the caller decides
     whether that is fatal; the manifest is only ever written after
-    every shard it references landed and fsynced."""
+    every shard it references landed and fsynced.
+
+    **Multi-process jobs** (a jax.distributed group; every rank calls
+    this — SPMD discipline): each rank durably writes the shard files
+    its own devices own (rank 0 also owns every whole/replicated
+    entry, the symbol and the optimizer states), every rank then meets
+    an all-shards coordination barrier, and ONLY rank 0 writes the
+    manifest — last, after checksumming every referenced shard file
+    (its own from memory, its peers' from the shared filesystem). A
+    rank that died mid-epoch fails the barrier on the survivors, so
+    the save fails cleanly and the previous manifest stays the resume
+    point; a torn shard can never be referenced because the manifest
+    postdates every shard fsync."""
     t0 = time.perf_counter()
-    shards, layout, n_shards = _split_shards(flat)
+    me, world = _process_topology()
+    shards, layout, n_shards = _split_shards(
+        flat, me if world > 1 else None)
     t_snap = time.perf_counter()
     dirname = os.path.dirname(prefix)
     if dirname:
         os.makedirs(dirname, exist_ok=True)
 
-    shard_entries = []
+    local_entries = {}
     payloads = []
     total_bytes = 0
     for s in sorted(shards):
         payload = _npz_bytes(shards[s])
         fname = _shard_file(prefix, epoch, s, n_shards)
-        shard_entries.append({"file": os.path.basename(fname),
-                              "sha256": _sha256(payload),
-                              "bytes": len(payload)})
+        local_entries[s] = {"file": os.path.basename(fname),
+                            "sha256": _sha256(payload),
+                            "bytes": len(payload)}
         payloads.append((fname, payload))
         total_bytes += len(payload)
     t_ser = time.perf_counter()
 
-    if symbol is not None:
+    if symbol is not None and me == 0:
         symbol.save("%s-symbol.json" % prefix)
     # states BEFORE shards: a kill between the two strands only a
     # .states file (an epoch with no .params is never listed), whereas
@@ -359,7 +435,7 @@ def save_arrays(prefix, epoch, flat, states_bytes=None, symbol=None):
     # whose missing states the scan accepts — a resume with silently
     # fresh optimizer state
     states_entry = None
-    if states_bytes is not None:
+    if states_bytes is not None and me == 0:
         states_file = _tag(prefix, epoch) + ".states"
         atomic_write_file(states_file, states_bytes)
         states_entry = {"file": os.path.basename(states_file),
@@ -370,11 +446,49 @@ def save_arrays(prefix, epoch, flat, states_bytes=None, symbol=None):
         atomic_write_file(fname, payload)
     t_write = time.perf_counter()
 
+    if world > 1:
+        # every rank's shards are durable before anyone proceeds; a
+        # dead rank fails this barrier (bounded) on the survivors and
+        # the save fails cleanly — the old manifest stays good
+        from .parallel import multihost
+        multihost.barrier("ckpt/%s" % _tag(prefix, epoch))
+        if me != 0:
+            t_end = time.perf_counter()
+            return {"epoch": int(epoch), "bytes": total_bytes,
+                    "shards": len(payloads), "manifest": False,
+                    "snapshot_ms": round((t_snap - t0) * 1e3, 3),
+                    "serialize_ms": round((t_ser - t_snap) * 1e3, 3),
+                    "write_ms": round((t_write - t_ser) * 1e3, 3),
+                    "manifest_ms": 0.0,
+                    "total_ms": round((t_end - t0) * 1e3, 3)}
+
+    shard_entries = []
+    for s in range(n_shards):
+        entry = local_entries.get(s)
+        if entry is None:
+            # a peer's shard (shared filesystem): checksum the bytes
+            # it fsynced — the manifest must vouch for every file it
+            # references, whoever wrote it
+            fname = _shard_file(prefix, epoch, s, n_shards)
+            if not os.path.isfile(fname):
+                raise MXNetError(
+                    "checkpoint %s: peer shard %d (%s) missing after "
+                    "the all-shards barrier" % (_tag(prefix, epoch),
+                                                s, fname))
+            with open(fname, "rb") as f:
+                payload = f.read()
+            entry = {"file": os.path.basename(fname),
+                     "sha256": _sha256(payload),
+                     "bytes": len(payload)}
+        shard_entries.append(entry)
+
     manifest = {"format": MANIFEST_FORMAT, "epoch": int(epoch),
                 "time": time.time(),
                 "shards": [dict(e, shard=i)
                            for i, e in enumerate(shard_entries)],
                 "params": layout}
+    if world > 1:
+        manifest["processes"] = world
     if states_entry is not None:
         manifest["optimizer_states"] = states_entry
     atomic_write_file(manifest_path(prefix, epoch),
@@ -392,6 +506,40 @@ def save_arrays(prefix, epoch, flat, states_bytes=None, symbol=None):
 # ---------------------------------------------------------------------------
 # load / validate / elastic restore
 # ---------------------------------------------------------------------------
+
+def latest_manifest_epoch(prefix, validate=True):
+    """The newest epoch under ``prefix`` whose manifest (and, with
+    ``validate``, every artifact it references) checks out — the
+    supervised launcher's resume scan (``tools/launch.py --supervise
+    --resume-prefix``) and the workers' own restart hook. Torn or
+    corrupt epochs are skipped with a warning, exactly like the
+    training-side resume scan; returns None when nothing usable
+    exists."""
+    import glob
+    import re
+    base = os.path.basename(prefix)
+    dirname = os.path.dirname(prefix) or "."
+    # \d{4,}, not \d{4}: '%04d' grows past four digits at epoch 10000
+    # (the model.py epoch-scan precedent)
+    pat = re.compile(re.escape(base) + r"-(\d{4,})\.ckpt\.json$")
+    epochs = []
+    for path in glob.glob(os.path.join(dirname, base + "-*.ckpt.json")):
+        m = pat.match(os.path.basename(path))
+        if m:
+            epochs.append(int(m.group(1)))
+    for epoch in sorted(epochs, reverse=True):
+        try:
+            if validate:
+                validate_manifest(prefix, epoch)
+            elif load_manifest(prefix, epoch) is None:
+                continue
+            return epoch
+        except (MXNetError, ValueError, OSError) as exc:
+            logging.getLogger(__name__).warning(
+                "checkpoint scan: epoch %04d under %s is torn/corrupt "
+                "(%s) — skipping", epoch, prefix, exc)
+    return None
+
 
 def load_manifest(prefix, epoch):
     """The parsed manifest for ``(prefix, epoch)``, or None when this

@@ -68,9 +68,18 @@ _ACTIONS = ("raise", "hang", "stall", "nan", "inf")
 # planned hang at serve_decode stalls token production so a streaming
 # request ages past its deadline, proving its pages come back through
 # the counted kv_evict reclaim path.
+# proc_hb/proc_join/proc_exit are the process-boundary sites of the
+# multi-host story (parallel/multihost.py, tools/launch.py): proc_hb
+# fires on every heartbeat-writer tick (stall/hang wedge the beat so
+# PEERS detect the stale file; raise kills the beat outright),
+# proc_join at process-group join, proc_exit once per training step on
+# the training thread — `proc_exit:step=N:raise` is the deterministic
+# "host dies at exactly step N" the supervised launcher's
+# restart-the-world path is tested against.
 _SITES = ("push", "pull", "allreduce", "wait", "init", "grad",
           "ckpt_write", "ckpt_fsync", "serve_admit", "serve_dispatch",
-          "serve_decode", "kv_evict")
+          "serve_decode", "kv_evict", "proc_hb", "proc_join",
+          "proc_exit")
 # corruption needs a value to corrupt — only the grad site carries one
 _VALUE_SITES = ("grad",)
 _GUARD_POLICIES = ("skip_step", "scale_backoff")
@@ -478,6 +487,7 @@ def join_process_group():
     if n <= 1 or "DMLC_WORKER_ID" not in os.environ:
         return
     import jax
+    inject("proc_join")
     try:
         with_retries(
             lambda: jax.distributed.initialize(
@@ -490,6 +500,11 @@ def join_process_group():
             site="init")
     except RuntimeError:
         pass          # already initialized
+    # the launcher contract's failure-detection side: a heartbeat
+    # writer + peer monitor per process (MXNET_HB_DIR — set by
+    # `tools/launch.py --supervise`; no-op without it)
+    from .parallel import multihost
+    multihost.maybe_start_heartbeat()
 
 
 # ---------------------------------------------------------------------------

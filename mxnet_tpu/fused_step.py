@@ -581,7 +581,8 @@ class FusedUpdater(_FusedCore):
         self.dispatch_count += 1
         _count("fused_step_dispatches")
         _count("fused_step_sync_dispatches")
-        grad_sync.account_in_program_sync(plan)
+        grad_sync.account_in_program_sync(plan, mesh=self._sync_mesh,
+                                          axis=self._sync_axis)
         for w_nd, w in zip(weights_nd, new_ws):
             w_nd._set_data(w)
         sync_state.store(new_sts)

@@ -267,12 +267,18 @@ class KVStore:
     def _global_reduce(self, arr):
         """Cross-process allreduce for tpu_sync (SURVEY §5.8 north star).
 
-        The reduce runs IN-PROGRAM: each worker's value becomes one
-        shard of a global array over a 'worker' mesh axis and a single
-        jitted psum (XLA collective over ICI/DCN) produces the sum —
-        replacing the reference's ps-lite ZPush/ZPull round trip
-        (kvstore_dist.h:211). Falls back to a host allgather+sum if the
-        global-array path is unavailable on the running platform.
+        On backends with cross-process SPMD (TPU pods) the reduce runs
+        IN-PROGRAM: each worker's value becomes one shard of a global
+        array over a 'worker' mesh axis and a single jitted psum (XLA
+        collective over ICI/DCN) produces the sum — replacing the
+        reference's ps-lite ZPush/ZPull round trip
+        (kvstore_dist.h:211). Backends without it (jaxlib's CPU
+        backend refuses multiprocess computations) exchange through
+        the process group's coordination service
+        (``parallel.multihost.cross_host_sum``): rank-keyed gathers +
+        a deterministic rank-order fold — the same channel the ps-lite
+        server pool occupied, minus the server processes. Either way
+        the bytes land in the per-link (ici/dcn) telemetry split.
         """
         if not self._is_dist or self.num_workers == 1:
             return arr
@@ -286,12 +292,14 @@ class KVStore:
             return self._global_reduce(arr.tostype("default")) \
                 .tostype(stype)
         import jax
-        import jax.numpy as jnp
         import numpy as _np
-        from jax.experimental import multihost_utils
-        if getattr(self, "_inprogram_reduce", True):
+        from .parallel import multihost
+        if getattr(self, "_inprogram_reduce", None) is None:
+            self._inprogram_reduce = multihost.supports_global_spmd()
+        if self._inprogram_reduce:
             try:
                 from jax.sharding import Mesh, PartitionSpec as P
+                from jax.experimental import multihost_utils
                 from .parallel import collectives
 
                 # one device per process carries that worker's shard
@@ -315,12 +323,15 @@ class KVStore:
                 import warnings
                 warnings.warn(
                     "kvstore %s: in-program collective reduce failed "
-                    "(%s: %s); falling back to host allgather for all "
-                    "subsequent pushes" % (self._type,
-                                           type(exc).__name__, exc))
+                    "(%s: %s); falling back to the coordination-"
+                    "service exchange for all subsequent pushes"
+                    % (self._type, type(exc).__name__, exc))
                 self._inprogram_reduce = False
-        summed = multihost_utils.process_allgather(arr._data)
-        return NDArray(jnp.sum(summed, axis=0), ctx=arr._ctx)
+        local = _np.asarray(arr._data)[None]      # (1, ...) local row
+        total = multihost.cross_host_sum("kv_push", [local])[0]
+        telemetry.comm_links("kvstore_push", 0,
+                             int(local.nbytes) * (self.num_workers - 1))
+        return NDArray(_to_jnp(total), ctx=arr._ctx)
 
     def _global_reduce_rsp(self, arr):
         """Row-union cross-worker reduce for row_sparse values — the
@@ -334,15 +345,18 @@ class KVStore:
         value never densifies to (N, D)."""
         import numpy as _np
         import jax.numpy as jnp
-        from jax.experimental import multihost_utils
         from .ndarray.sparse import RowSparseNDArray
+        from .parallel import multihost
 
         N = int(arr.shape[0])
         row_shape = tuple(arr.shape[1:])
         idx = arr._sp_indices._data
         mask = jnp.zeros((N,), jnp.bool_).at[idx].set(True)
-        masks = multihost_utils.process_allgather(mask)     # (W, N)
-        union = _np.nonzero(_np.asarray(masks).any(axis=0))[0] \
+        # presence masks ride the coordination service (N bools per
+        # worker — control-plane-sized on every backend)
+        masks = _np.stack([m[0] for m in multihost.exchange_arrays(
+            "kv_rsp_mask", [_np.asarray(mask)])])           # (W, N)
+        union = _np.nonzero(masks.any(axis=0))[0] \
             .astype(_np.int64)                              # sorted
         dtype = arr._sp_data._data.dtype
         if union.size == 0:
@@ -484,8 +498,10 @@ class KVStore:
     # -- distributed control --------------------------------------------
     def barrier(self):
         if self.num_workers > 1:
-            from jax.experimental import multihost_utils
-            multihost_utils.sync_global_devices("kvstore_barrier")
+            # device sync where the backend can span processes,
+            # coordination-service barrier where it cannot (CPU)
+            from .parallel import distributed
+            distributed.barrier("kvstore_barrier")
 
     def _barrier(self):
         self.barrier()

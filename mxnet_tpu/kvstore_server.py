@@ -1,12 +1,18 @@
-"""KVStore server bootstrap (parity: python/mxnet/kvstore_server.py).
+"""KVStore server bootstrap — RETIRED compatibility shim (parity:
+python/mxnet/kvstore_server.py).
 
-The reference's ``dist_*`` kvstores run dedicated ps-lite server
-processes whose loop this module bootstraps when ``DMLC_ROLE=server``.
-The TPU-native ``tpu_sync`` design has NO server role: aggregation is
-an in-program psum collective over the worker mesh (SURVEY §5.8), so
-every process is a worker. This module keeps the API surface so
-reference launch scripts run unchanged — a "server" role degenerates
-to an immediate, logged no-op exit.
+The reference's ``dist_*`` kvstores ran dedicated ps-lite server
+processes whose loop this module bootstrapped when
+``DMLC_ROLE=server``. That role is fully retired behind the
+process-mesh collectives: dist KVStore types (``tpu_sync`` /
+``dist_sync`` / ...) aggregate in-program over the worker mesh on
+backends with cross-process SPMD, and over the jax.distributed
+coordination service (``parallel.multihost.cross_host_sum``) where
+XLA cannot span processes — either way every process is a worker and
+there is nothing to serve. This module keeps only the API surface so
+reference launch scripts (`-s/--num-servers`, ``DMLC_ROLE=server``)
+run unchanged: a "server" role degenerates to an immediate, logged
+no-op exit. New code should never import it.
 """
 from __future__ import annotations
 

@@ -321,6 +321,12 @@ class BaseModule:
                     next_data_batch = next(data_iter)
                 while not end_of_batch:
                     data_batch = next_data_batch
+                    # proc_exit fault site + peer-loss surfacing: the
+                    # deterministic "this host dies at step N" of the
+                    # supervised-launcher story (no-op single-process
+                    # without a plan)
+                    from ..parallel import multihost
+                    multihost.step_boundary()
                     telemetry.step_begin()
                     if monitor is not None:
                         monitor.tic()

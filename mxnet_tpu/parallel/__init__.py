@@ -23,3 +23,5 @@ from .pipeline import pipeline_apply, stack_stage_params
 from .flash_attention import flash_attention
 from .moe import moe_ffn, topk_route, load_balance_loss
 from . import distributed
+from . import multihost
+from .multihost import HostLostError

@@ -27,6 +27,23 @@ __all__ = ["all_reduce", "all_gather", "reduce_scatter", "broadcast",
 _prim_cache = {}
 
 
+def _account_links(name, mesh, axis, value=None, nbytes=None):
+    """Ledger one collective's intra-host (ici) vs cross-host (dcn)
+    byte split under its primitive name (mesh.link_split's hop model);
+    cheap no-op without a telemetry run."""
+    from .. import telemetry
+    if not telemetry.enabled():
+        return
+    if nbytes is None:
+        nbytes = int(getattr(value, "nbytes", 0) or 0)
+    from .mesh import link_split
+    try:
+        ici, dcn = link_split(mesh, axis, nbytes)
+    except ValueError:
+        return
+    telemetry.comm_links(name, ici, dcn)
+
+
 def _watched(prim, mesh, statics, build):
     """The cached, compile-watched form of one collective primitive.
     ``build()`` returns the shard_map-wrapped pure function; the
@@ -96,6 +113,7 @@ def all_reduce(x, mesh, axis="dp", op="sum"):
                                  out_specs=P()))(x)
 
     from .. import telemetry
+    _account_links("all_reduce", mesh, axis, x)
     with telemetry.comm_span("collective", "all_reduce", x):
         return fault.guard(run, "allreduce")
 
@@ -108,6 +126,7 @@ def all_gather(x, mesh, axis="dp", tiled=True):
         return jax.lax.all_gather(v, axis, tiled=tiled)
 
     from .. import telemetry
+    _account_links("all_gather", mesh, axis, x)
     with telemetry.comm_span("collective", "all_gather", x):
         return _watched(
             "all_gather", mesh, (axis, bool(tiled)),
@@ -136,6 +155,7 @@ def reduce_scatter(x, mesh, axis="dp"):
         return jax.lax.psum_scatter(v, axis, tiled=True)
 
     from .. import telemetry
+    _account_links("reduce_scatter", mesh, axis, x)
     with telemetry.comm_span("collective", "reduce_scatter", x):
         out = _watched(
             "reduce_scatter", mesh, (axis, rem),
@@ -173,6 +193,8 @@ def bucket_reduce_scatter(stacked, mesh, axis="dp", key="bucket"):
                                     tiled=True)
 
     from .. import telemetry
+    _account_links("bucket_reduce_scatter", mesh, axis,
+                   nbytes=(total + pad) * dt.itemsize)
     # ledger the LOGICAL payload — the reduced padded bucket, one
     # direction — not the (n_dev, ...) stacked operands, so the bytes
     # column is comparable with the in-program and kvstore grad_sync
@@ -199,6 +221,7 @@ def bucket_all_gather(flat, mesh, axis="dp", key="bucket"):
         return jax.lax.all_gather(v, axis, tiled=True)
 
     from .. import telemetry
+    _account_links("bucket_all_gather", mesh, axis, flat)
     with telemetry.comm_span("grad_sync", key, flat):
         return _watched(
             "bucket_all_gather", mesh, (axis,),
@@ -221,6 +244,7 @@ def ppermute(x, mesh, axis, perm):
         return jax.lax.ppermute(v, axis, perm)
 
     from .. import telemetry
+    _account_links("ppermute", mesh, axis, x)
     with telemetry.comm_span("collective", "ppermute", x):
         return _watched(
             "ppermute", mesh, (axis, tuple(map(tuple, perm))),
@@ -239,6 +263,7 @@ def broadcast(x, mesh, axis="dp", root=0):
         return jax.lax.psum(v, axis)
 
     from .. import telemetry
+    _account_links("broadcast", mesh, axis, x)
     with telemetry.comm_span("collective", "broadcast", x):
         return _watched(
             "broadcast", mesh, (axis, int(root)),

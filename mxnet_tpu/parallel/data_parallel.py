@@ -296,7 +296,7 @@ class DistributedTrainer:
     def __init__(self, net, loss_block, mesh, optimizer="sgd",
                  learning_rate=0.01, optimizer_params=None,
                  param_rules=None, grad_overlap=None, bucket_mb=None,
-                 param_shard=None):
+                 param_shard=None, multihost=None):
         from .. import optimizer as opt_mod
         self._net = net
         self._loss = loss_block
@@ -311,6 +311,11 @@ class DistributedTrainer:
         self._bucket_mb = bucket_mb
         self._param_rules = param_rules
         self._param_shard = param_shard
+        self._multihost = multihost   # None = auto (see _build)
+        self._mesh_global = None      # the full cross-process mesh
+        self._mh = False              # resolved multihost mode
+        self._mh_grad_fn = None       # stacked per-device grad program
+        self._mh_apply_fn = None      # post-exchange update program
         self._shard_rules = None      # resolved ShardingRules (fsdp on)
         self._param_plans = None      # per-roster ParamShardPlan list
         self._mem_bd = None           # cached telemetry byte split
@@ -385,12 +390,59 @@ class DistributedTrainer:
         label_sym = sym_mod.var("label")
         out_sym = net(data_sym)
         loss_sym = loss_blk(out_sym, label_sym)
+        # content fingerprint for the persistent compile cache: the
+        # symbol graph IS this trainer's program content (unlike
+        # make_data_parallel_step's arbitrary callables), so a
+        # supervised restart warms from disk instead of recompiling
+        from .. import compile_cache
+        compile_cache.maybe_enable()
+        self._cw_token = None
+        if compile_cache.enabled():
+            try:
+                self._cw_token = compile_cache.graph_token(
+                    loss_sym.tojson())
+            except Exception:
+                self._cw_token = None
         fn, arg_names, aux_names, n_rng, n_out = \
             build_graph_callable(loss_sym)
         params = {p.name: p for p in net.collect_params().values()}
         self._graph = (fn, arg_names, aux_names)
         self._params = params
+        # -- multihost resolution (the cross-host DCN leg) ----------------
+        # When the job is a jax.distributed group whose backend cannot
+        # run ONE program across processes (jaxlib's CPU backend), the
+        # step splits into a local stacked-gradient program, a
+        # coordination-service exchange (multihost.cross_host_sum:
+        # rank-major left fold == the flat global mesh's reduction
+        # grouping, bit for bit), and a local update program. Backends
+        # with cross-process SPMD keep the single fused program over
+        # the global mesh.
+        from . import multihost as mh_mod
+        world, me = 1, 0
+        try:
+            world = int(jax.process_count())
+            me = int(jax.process_index())
+        except Exception:
+            pass
+        mh = self._multihost
+        if mh is None:
+            mh = world > 1 and not mh_mod.supports_global_spmd()
+        self._mh = bool(mh)
+        # the authoritative world size for the exchange fold: the
+        # process count, NOT a mesh-size ratio — a trainer handed a
+        # local-only mesh in a multi-process job must still divide the
+        # loss by every rank's rows
+        self._mh_world = world if self._mh else 1
         mesh = self._mesh
+        if self._mh:
+            self._mesh_global = mesh
+            local = [d for d in mesh.devices.flat
+                     if getattr(d, "process_index", 0) == me]
+            if local and len(local) != int(mesh.devices.size):
+                from .mesh import create_mesh
+                local.sort(key=lambda d: d.id)
+                mesh = create_mesh({"dp": len(local)}, devices=local)
+                self._mesh = mesh
         roster = [n for n in arg_names if n in params]
         aux_roster = [n for n in aux_names if n in params]
         self._roster, self._aux_roster = roster, aux_roster
@@ -415,6 +467,19 @@ class DistributedTrainer:
         from .sharding_rules import ShardingRules, param_shard_enabled
         shard_on = param_shard_enabled() if self._param_shard is None \
             else bool(self._param_shard)
+        if shard_on and self._mh:
+            # FSDP at-rest needs the one-program entry gather; the
+            # multihost host-exchange leg feeds full params into two
+            # programs — fall back replicated, never silently
+            import logging
+            from .. import telemetry
+            logging.getLogger(__name__).warning(
+                "DistributedTrainer: FSDP param sharding is not "
+                "available on the multihost host-exchange leg — "
+                "params stay replicated (per-host FSDP needs the "
+                "global-SPMD backend path)")
+            telemetry.note("param_shard_multihost_fallback")
+            shard_on = False
         plans = None
         if shard_on:
             rules = self._param_rules
@@ -562,31 +627,149 @@ class DistributedTrainer:
                 [data_v, label_v, scalars, poisons]))
             return d
 
-        self._step_fn = compile_watch.jit(
-            step, site, describe=describe,
-            counter="fused_step_compile_ms",
-            statics=(plan.signature(), shard_sig,
-                     self._opt.fused_static_key()),
-            # the step closes over the USER's loss_fn — an arbitrary
-            # python callable with no stable content fingerprint, so
-            # it must stay out of the persistent disk cache
-            cache=False,
-            donate_argnums=(0, 1, 2))
+        if not self._mh:
+            ctoken = getattr(self, "_cw_token", None)
+            self._step_fn = compile_watch.jit(
+                step, site, describe=describe,
+                counter="fused_step_compile_ms",
+                statics=(plan.signature(), shard_sig,
+                         self._opt.fused_static_key()),
+                # the step embeds the traced symbol graph — its hash
+                # is the content fingerprint that keeps two
+                # same-shaped models apart on disk (no token = no
+                # active cache = opt out)
+                cache=ctoken is not None, cache_token=ctoken,
+                donate_argnums=(0, 1, 2))
+        else:
+            self._build_multihost(fn, arg_names, aux_names, roster,
+                                  aux_roster, roster_pos, aux_pos,
+                                  n_out, n_aux, apply_fn, plan, mesh)
         self._batch_sharding = NamedSharding(mesh, P("dp"))
         if self._pending_restore is not None:
             self._apply_restore(self._pending_restore)
             self._pending_restore = None
 
+    def _build_multihost(self, fn, arg_names, aux_names, roster,
+                         aux_roster, roster_pos, aux_pos, n_out, n_aux,
+                         apply_fn, plan, mesh):
+        """Compile the two programs of the host-exchange leg.
+
+        ``mh_grad`` shard_maps the forward/backward over the LOCAL
+        mesh and returns per-device STACKED (unreduced) losses, grads
+        and aux updates — each device's row is exactly the local
+        contribution the flat global mesh's in-program psum would
+        fold, so the host-side rank-major left fold
+        (``multihost.cross_host_sum``) reproduces the single-process
+        reduction bit for bit. ``mh_apply`` feeds the folded global
+        gradient through the SAME bucketed update machinery the fused
+        path uses (a replicated input under a dp constraint is a pure
+        reshard — no double count), so optimizer math stays partitioned
+        and bit-identical to the one-program path."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        from .collectives import _shard_map
+        from .. import compile_watch
+
+        n_states = len(self._state_vals)
+
+        def per_device(param_vals, aux_vals, data_s, label_s, rng,
+                       n_rows):
+            # loss contribution = local_sum / GLOBAL row count (the
+            # traced n_rows scalar): each device's value and gradient
+            # rows are then exactly the leaves the flat global mesh's
+            # in-program psum would fold — a per-shard mean would
+            # scale the folded gradient by the device count
+            def loss_of(pv):
+                vals = []
+                for n in arg_names:
+                    if n == "data":
+                        vals.append(data_s)
+                    elif n == "label":
+                        vals.append(label_s)
+                    else:
+                        vals.append(pv[roster_pos[n]])
+                vals.extend(aux_vals[aux_pos[n]] for n in aux_names)
+                outs = fn({"__train__": True}, *vals, rng=rng)
+                loss = outs[0].sum() / n_rows
+                new_aux = tuple(outs[n_out:n_out + n_aux])
+                return loss, new_aux
+
+            (loss, new_aux), grads = jax.value_and_grad(
+                loss_of, has_aux=True)(param_vals)
+            return (loss[None],
+                    tuple(g[None] for g in grads),
+                    tuple(a[None] for a in new_aux))
+
+        grad_stacked = _shard_map()(
+            per_device, mesh=mesh,
+            in_specs=(P(), P(), P("dp"), P("dp"), P(), P()),
+            out_specs=(P("dp"), P("dp"), P("dp")))
+
+        def describe_grad(param_vals, aux_vals, data_v, label_v, rng,
+                          n_rows):
+            from ..compile_watch import describe_arrays
+            d = describe_arrays(list(roster), param_vals)
+            d.update(describe_arrays(
+                ["aux:%s" % n for n in aux_roster], aux_vals))
+            d.update(describe_arrays(["data", "label", "n_rows"],
+                                     [data_v, label_v, n_rows]))
+            return d
+
+        ctoken = getattr(self, "_cw_token", None)
+        self._mh_grad_fn = compile_watch.jit(
+            grad_stacked, "fused_step:mh_grad",
+            describe=describe_grad,
+            counter="fused_step_compile_ms",
+            statics=(plan.signature(), self._opt.fused_static_key()),
+            # the symbol-graph hash keeps two same-shaped models apart
+            # on disk; without an active cache there is no token and
+            # the program opts out
+            cache=ctoken is not None, cache_token=ctoken)
+
+        def mh_apply(g_tot, param_vals, state_vals, scalars, poisons):
+            new_ws, new_sts, _ = apply_fn(g_tot, param_vals,
+                                          state_vals, scalars,
+                                          poisons)
+            return new_ws, new_sts
+
+        def describe_apply(g_tot, param_vals, state_vals, scalars,
+                           poisons):
+            from ..compile_watch import describe_arrays
+            d = describe_arrays(["g:%s" % n for n in roster], g_tot)
+            d.update(describe_arrays(list(roster), param_vals))
+            d.update(describe_arrays(
+                ["state%d" % i for i in range(n_states)], state_vals))
+            d.update(describe_arrays(["scalars", "poisons"],
+                                     [scalars, poisons]))
+            return d
+
+        self._mh_apply_fn = compile_watch.jit(
+            mh_apply, "fused_step:mh_apply",
+            describe=describe_apply,
+            counter="fused_step_compile_ms",
+            statics=(plan.signature(), self._opt.fused_static_key()),
+            cache=ctoken is not None, cache_token=ctoken,
+            donate_argnums=(1, 2))
+        # the built marker every property/entry point checks
+        self._step_fn = self._mh_apply_fn
+
     # -- the step ---------------------------------------------------------
     def fit_batch(self, data, label):
         """One training step — forward, backward, gradient exchange
-        and optimizer update in a single compiled dispatch; returns
-        the (host) loss value lazily."""
+        and optimizer update in a single compiled dispatch (or, on the
+        multihost host-exchange leg, a local gradient program + the
+        cross-host fold + a local update program); returns the (host)
+        loss value lazily. In a multi-process job each process feeds
+        its OWN rank's slice of the global batch."""
         from .. import random as _random
         from .. import telemetry
         from ..fused_step import pack_step_scalars
         from ..ndarray import NDArray
-        from . import grad_sync
+        from . import grad_sync, multihost
+        # the proc_exit fault site + host-loss check: the injectable
+        # "this host dies at exactly step N", and the typed surfacing
+        # of a peer loss the heartbeat monitor detected
+        multihost.step_boundary()
         if self._step_fn is None:
             # ensure params are materialized
             _ = self._net(data)
@@ -595,11 +778,15 @@ class DistributedTrainer:
         label_v = _put_unless_placed(label._data, self._batch_sharding)
         scalars = pack_step_scalars(self._opt,
                                     list(range(len(self._roster))))
-        with telemetry.span("compute"):
-            loss, new_ws, new_sts, new_aux = self._step_fn(
-                tuple(self._param_vals), tuple(self._state_vals),
-                tuple(self._aux_vals), data_v, label_v,
-                _random.new_key(), scalars, self._poisons_zero)
+        if self._mh:
+            loss, new_ws, new_sts, new_aux = self._mh_step(
+                data_v, label_v, scalars)
+        else:
+            with telemetry.span("compute"):
+                loss, new_ws, new_sts, new_aux = self._step_fn(
+                    tuple(self._param_vals), tuple(self._state_vals),
+                    tuple(self._aux_vals), data_v, label_v,
+                    _random.new_key(), scalars, self._poisons_zero)
         self._param_vals = list(new_ws)
         self._state_vals = list(new_sts)
         self._aux_vals = list(new_aux)
@@ -615,11 +802,73 @@ class DistributedTrainer:
             # only the overlap mode ledgers grad_sync records — the
             # gate-closed baseline's telemetry must look like it
             # always did (and the diagnose table is the overlap-on
-            # oracle)
-            grad_sync.account_in_program_sync(self._plan)
+            # oracle); the mesh adds the per-link (ici/dcn) split
+            grad_sync.account_in_program_sync(self._plan,
+                                              mesh=self._mesh)
         self._gluon_dirty = True
         self.dispatch_count += 1
         return NDArray(loss)
+
+    def _mh_step(self, data_v, label_v, scalars):
+        """One multihost step: local stacked-gradient program →
+        cross-host coordination-service fold (rank-major left fold ==
+        the flat mesh's reduction grouping, bit for bit) → local
+        bucketed update program. Loss is the global mean (the stacked
+        per-device means ride the same exchange)."""
+        import time as _time
+        import numpy as _np
+        import jax.numpy as jnp
+        from .. import random as _random
+        from .. import telemetry
+        from . import multihost
+        from .mesh import link_split
+        world = max(int(getattr(self, "_mh_world", 1)), 1)
+        # every process feeds its rank's equal slice of the global
+        # batch, so global rows = local rows x world — the traced
+        # divisor that makes each device's gradient rows the flat
+        # mesh's exact psum leaves
+        n_rows = _np.float32(int(data_v.shape[0]) * world)
+        with telemetry.span("compute"):
+            losses, grads, new_aux = self._mh_grad_fn(
+                tuple(self._param_vals), tuple(self._aux_vals),
+                data_v, label_v, _random.new_key(), n_rows)
+        with telemetry.span("sync"):
+            t0 = _time.perf_counter()
+            stacks = [_np.asarray(losses)] + [_np.asarray(g)
+                                              for g in grads]
+            folded = multihost.cross_host_sum("grad", stacks)
+            dt = _time.perf_counter() - t0
+            # per-device rows are local_sum/global_rows, so the fold
+            # IS the global mean
+            loss = folded[0]
+            g_tot = folded[1:]
+            if telemetry.enabled():
+                payload = sum(int(s.nbytes) for s in stacks[1:])
+                # the exchange itself: every peer's payload crossed
+                # the host boundary once (pure dcn); the local
+                # stacked fold is host arithmetic, not a link
+                telemetry.comm("grad_sync", "dcn_exchange",
+                               nbytes=payload * (world - 1),
+                               seconds=dt)
+                audit = self._mesh_global
+                if audit is not None:
+                    try:
+                        ici, dcn = link_split(audit, "dp",
+                                              2 * payload)
+                        telemetry.comm_links("grad_sync", ici, dcn)
+                    except ValueError:
+                        pass
+        with telemetry.span("optimizer"):
+            new_ws, new_sts = self._mh_apply_fn(
+                tuple(jnp.asarray(g) for g in g_tot),
+                tuple(self._param_vals), tuple(self._state_vals),
+                scalars, self._poisons_zero)
+        # aux (batchnorm stats) follow the local leader device — the
+        # host-exchange leg does not cross-sync them (documented; the
+        # global-SPMD path keeps them in-program)
+        aux_vals = tuple(jnp.asarray(_np.asarray(a)[0])
+                         for a in new_aux)
+        return jnp.asarray(loss), new_ws, new_sts, aux_vals
 
     def _memory_breakdown(self):
         """Per-device resident bytes split by kind — the telemetry

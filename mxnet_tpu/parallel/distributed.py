@@ -1,37 +1,115 @@
 """Multi-host process-group management.
 
 Replaces the reference's ps-lite scheduler/DMLC_* env contract
-(docs/faq/distributed_training.md:254-267) with jax.distributed: rank and
-world size come from the JAX runtime; barriers are global device syncs.
-Launch contract: either set MXNET_TPU_COORDINATOR/MXNET_TPU_RANK/
-MXNET_TPU_WORLD (this module wires jax.distributed.initialize), or run
-under an environment that auto-initializes (Cloud TPU pods).
+(docs/faq/distributed_training.md:254-267) with jax.distributed: rank
+and world size come from the JAX runtime; barriers are global device
+syncs (or coordination-service barriers on backends without
+cross-process SPMD — ``parallel.multihost``).
+
+Launch contract, in precedence order:
+
+- ``MXNET_TPU_COORDINATOR`` / ``MXNET_TPU_WORLD`` / ``MXNET_TPU_RANK``
+  — the explicit triple this module wires into
+  ``jax.distributed.initialize``. Setting only PART of the triple is
+  an error (``MXNetError`` naming the missing variable): a typo'd
+  partial contract must not silently train single-process.
+- the launcher's ``DMLC_*`` contract (``tools/launch.py``), joined by
+  ``fault.join_process_group`` at dist-kvstore creation / package
+  import.
+- an auto-initializing environment (Cloud TPU pods) — ``init()``
+  without a contract is a no-op there.
+
+A failed ``init()`` is retryable: nothing is latched until
+``jax.distributed.initialize`` actually succeeded.
 """
 from __future__ import annotations
 
 import os
 
+from ..base import MXNetError
+
 __all__ = ["init", "rank", "num_workers", "barrier", "is_initialized",
-           "finalize"]
+           "finalize", "local_devices", "global_devices"]
 
 _initialized = [False]
 
+_CONTRACT = ("MXNET_TPU_COORDINATOR", "MXNET_TPU_WORLD",
+             "MXNET_TPU_RANK")
+
+
+def _contract_from_env():
+    """The validated MXNET_TPU_* triple, or None when none of it is
+    set. A PARTIAL triple raises naming exactly the missing
+    variable(s) — the silent alternative is a "distributed" job that
+    trains single-process."""
+    from .. import envs
+    coordinator = envs.get_str("MXNET_TPU_COORDINATOR")
+    world = envs.get_int("MXNET_TPU_WORLD")
+    rank_ = envs.get_int("MXNET_TPU_RANK")
+    present = {"MXNET_TPU_COORDINATOR": bool(coordinator),
+               "MXNET_TPU_WORLD": world is not None,
+               "MXNET_TPU_RANK": rank_ is not None}
+    if not any(present.values()):
+        return None
+    missing = [k for k in _CONTRACT if not present[k]]
+    if missing:
+        raise MXNetError(
+            "partial multi-process launch contract: %s set but %s "
+            "missing — set the whole MXNET_TPU_COORDINATOR/"
+            "MXNET_TPU_WORLD/MXNET_TPU_RANK triple (or none of it) "
+            "so the job cannot silently train single-process"
+            % (", ".join(k for k in _CONTRACT if present[k]),
+               ", ".join(missing)))
+    return coordinator, int(world), int(rank_)
+
 
 def init(coordinator=None, num_processes=None, process_id=None):
-    """Initialize the distributed runtime (the DMLC_PS_ROOT_URI role)."""
+    """Initialize the distributed runtime (the DMLC_PS_ROOT_URI role).
+
+    Explicit arguments win; otherwise the MXNET_TPU_* triple is read
+    and validated (partial triple = MXNetError naming the missing
+    variable). Visits the ``proc_join`` fault site, starts the
+    launcher-contract heartbeat (``MXNET_HB_DIR``), and is retryable
+    after a failure — nothing latches until the join succeeded."""
     import jax
     if _initialized[0]:
         return
-    from .. import envs
-    coordinator = coordinator or envs.get_str("MXNET_TPU_COORDINATOR")
-    num_processes = num_processes or envs.get_int("MXNET_TPU_WORLD")
-    process_id = process_id or envs.get_int("MXNET_TPU_RANK")
-    if coordinator:
-        jax.distributed.initialize(
-            coordinator_address=coordinator,
-            num_processes=int(num_processes),
-            process_id=int(process_id))
+    if coordinator is None and num_processes is None \
+            and process_id is None:
+        contract = _contract_from_env()
+        if contract is not None:
+            coordinator, num_processes, process_id = contract
+    else:
+        missing = [name for name, val in
+                   (("coordinator", coordinator),
+                    ("num_processes", num_processes),
+                    ("process_id", process_id)) if val is None]
+        if coordinator is None:
+            raise MXNetError(
+                "distributed.init: explicit arguments need at least "
+                "coordinator= (got %s missing)" % ", ".join(missing))
+        if missing:
+            raise MXNetError(
+                "distributed.init(coordinator=%r): %s missing — pass "
+                "the full (coordinator, num_processes, process_id) "
+                "triple" % (coordinator, ", ".join(missing)))
+    if not coordinator:
+        # no contract anywhere: an auto-initializing environment
+        # (Cloud TPU pods) or a plain single-process run. Nothing is
+        # latched — a later init() with a real contract must still be
+        # able to join the group.
+        return
+    from .. import fault
+    fault.inject("proc_join")
+    jax.distributed.initialize(
+        coordinator_address=coordinator,
+        num_processes=int(num_processes),
+        process_id=int(process_id))
+    # latched only AFTER a successful join: a failed init (coordinator
+    # not up yet, planned proc_join fault) stays retryable
     _initialized[0] = True
+    from . import multihost
+    multihost.maybe_start_heartbeat()
 
 
 def is_initialized():
@@ -54,16 +132,42 @@ def num_workers():
         return 1
 
 
+def global_devices():
+    """Every process's devices in SUPERVISOR order: rank-major, local
+    device ids ascending — each host's devices contiguous, the order
+    ``make_mesh``'s process-aware mode lays the global mesh out in (so
+    inner mesh axes stay on the intra-host fast link)."""
+    import jax
+    return sorted(jax.devices(),
+                  key=lambda d: (d.process_index, d.id))
+
+
+def local_devices():
+    """This process's devices, id-ascending (its contiguous block of
+    :func:`global_devices`)."""
+    import jax
+    return sorted(jax.local_devices(), key=lambda d: d.id)
+
+
 def barrier(name="mxnet_tpu_barrier"):
+    """Global barrier. Backends with cross-process SPMD sync the
+    devices; the CPU backend (no multiprocess computations) rides the
+    coordination service instead of dying in a collective."""
     import jax
     if num_workers() > 1:
-        from jax.experimental import multihost_utils
-        multihost_utils.sync_global_devices(name)
+        from . import multihost
+        if multihost.supports_global_spmd():
+            from jax.experimental import multihost_utils
+            multihost_utils.sync_global_devices(name)
+        else:
+            multihost.barrier(name)
 
 
 def finalize():
     import jax
     if _initialized[0]:
+        from . import multihost
+        multihost.stop_heartbeat()
         try:
             jax.distributed.shutdown()
         except Exception:

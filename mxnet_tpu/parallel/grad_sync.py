@@ -500,12 +500,15 @@ class ShardedOptState:
 # telemetry accounting
 # ---------------------------------------------------------------------------
 
-def account_in_program_sync(plan):
+def account_in_program_sync(plan, mesh=None, axis="dp"):
     """Ledger one compiled-step dispatch's bucket traffic: per-bucket
     ``grad_sync`` comm records (reduce-scatter + updated-param
     all-gather bytes; latency 0 — the exchange is scheduled INSIDE the
     program, overlapped with backward, so there is no host-observable
-    span) plus run counters. The eager kvstore leg
+    span) plus run counters. With ``mesh`` given, the same bytes are
+    additionally split per link — intra-host ``ici`` vs cross-host
+    ``dcn`` (``mesh.link_split``) — under the ``grad_sync`` key of the
+    per-link table. The eager kvstore leg
     (:func:`bucketed_kvstore_sync`) records real host-timed spans
     under the same kind."""
     from .. import telemetry, tracing
@@ -521,11 +524,21 @@ def account_in_program_sync(plan):
                                       in_program=True))
     if not telemetry.enabled():
         return
+    total = 0
     for b, bucket in enumerate(plan.buckets):
         # RS moves (N-1)/N of the bucket in, AG the same out; account
         # the logical payload once per direction
         telemetry.comm("grad_sync", "bucket%02d" % b,
                        nbytes=2 * bucket.nbytes, seconds=0.0)
+        total += 2 * bucket.nbytes
+    if mesh is not None:
+        from .mesh import link_split
+        try:
+            ici, dcn = link_split(mesh, axis, total)
+        except ValueError:
+            ici = dcn = None
+        if ici is not None:
+            telemetry.comm_links("grad_sync", ici, dcn)
     telemetry.note("grad_sync_steps")
 
 

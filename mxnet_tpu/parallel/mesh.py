@@ -23,7 +23,8 @@ import numpy as np
 __all__ = ["create_mesh", "auto_mesh", "make_mesh", "mesh_axes",
            "local_mesh", "PartitionSpec", "NamedSharding", "replicated",
            "shard_batch", "dp_mesh", "distinct_devices", "use_mesh",
-           "current_mesh", "set_current_mesh"]
+           "current_mesh", "set_current_mesh", "axis_hosts",
+           "link_split"]
 
 _DP_MESH_CACHE = {}
 _CURRENT_MESH = [None]
@@ -131,7 +132,7 @@ def auto_mesh(n_devices: Optional[int] = None,
     return create_mesh(sizes, devices=jax.devices()[:n])
 
 
-def make_mesh(data=None, fsdp=None, tp=None, devices=None):
+def make_mesh(data=None, fsdp=None, tp=None, devices=None, hosts=None):
     """The multi-axis mesh entry point for the sharding-rules layer
     (``parallel.sharding_rules``): axes are named with the rules
     layer's own vocabulary — ``data`` carries the batch, ``fsdp`` the
@@ -144,10 +145,62 @@ def make_mesh(data=None, fsdp=None, tp=None, devices=None):
     is a ``data=1 × fsdp=4 × tp=2`` mesh; on 16 it is ``data=2``.
     Axis order is data-outermost (``data``, ``fsdp``, ``tp``), the
     GSPMD convention that keeps fsdp/tp collectives on the
-    fastest-varying (densest-ICI) device neighbors."""
+    fastest-varying (densest-ICI) device neighbors.
+
+    **Process-aware (multi-host) mode** — when the job runs as a
+    jax.distributed group with more than one process (or ``hosts=`` is
+    passed explicitly), the mesh is built over EVERY process's devices
+    (``jax.devices()``), ordered rank-major with each host's local
+    devices contiguous: the data axis (outermost) then splits on host
+    boundaries first, so the inner fsdp/tp collectives stay on the
+    intra-host fast link (ICI) and only the data-axis gradient
+    exchange crosses hosts (DCN) — :func:`link_split` is the per-link
+    accounting of exactly that layout. ``hosts=`` additionally
+    validates the topology: it must equal the process count spanned by
+    the chosen devices, and the inner ``fsdp*tp`` block must divide
+    each host's local device count (an inner axis straddling two hosts
+    would silently put every weight collective on the slow link)."""
     import jax
-    devices = list(devices) if devices is not None else jax.devices()
+    if devices is not None:
+        devices = list(devices)
+        if hosts is not None:
+            # the host-contiguity contract holds for explicit device
+            # lists too: rank-major, local ids ascending
+            devices = sorted(
+                devices,
+                key=lambda d: (getattr(d, "process_index", 0), d.id))
+    else:
+        devices = list(jax.devices())
+        try:
+            multi = jax.process_count() > 1
+        except Exception:
+            multi = False
+        if multi or hosts is not None:
+            # rank-major, local ids ascending: each host contiguous
+            devices = sorted(devices,
+                             key=lambda d: (d.process_index, d.id))
     n = len(devices)
+    if hosts is not None:
+        hosts = int(hosts)
+        actual = len({getattr(d, "process_index", 0) for d in devices})
+        if hosts != actual:
+            raise ValueError(
+                "make_mesh(hosts=%d): the %d available devices span "
+                "%d process(es) — launch contract and topology "
+                "disagree" % (hosts, n, actual))
+        if n % hosts:
+            raise ValueError(
+                "make_mesh(hosts=%d): %d devices do not split evenly "
+                "across hosts" % (hosts, n))
+        inner_block = (int(fsdp) if fsdp else 1) * (int(tp) if tp
+                                                    else 1)
+        if (n // hosts) % inner_block:
+            raise ValueError(
+                "make_mesh(hosts=%d): fsdp*tp = %d does not divide "
+                "the %d devices local to each host — an inner axis "
+                "straddling hosts would put every weight collective "
+                "on the cross-host (DCN) link" % (hosts, inner_block,
+                                                  n // hosts))
     fsdp = int(fsdp) if fsdp is not None else 1
     tp = int(tp) if tp is not None else 1
     if fsdp < 1 or tp < 1:
@@ -191,3 +244,44 @@ def shard_batch(mesh, batch_axes=("dp",)):
     """Sharding for a batch tensor: dim 0 split over given mesh axes."""
     from jax.sharding import NamedSharding, PartitionSpec as P
     return NamedSharding(mesh, P(tuple(batch_axes)))
+
+
+def axis_hosts(mesh, axis):
+    """(group_size, hosts_per_group) for one mesh axis: how many
+    devices a collective over ``axis`` spans, and how many distinct
+    hosts (process indices) each of its device groups touches. Groups
+    are the sub-axes holding every OTHER axis fixed; on the layouts
+    :func:`make_mesh` builds they all touch the same host count."""
+    import numpy as _np2
+    names = list(mesh.axis_names)
+    if axis not in names:
+        raise ValueError("mesh has no axis %r (axes: %s)"
+                         % (axis, names))
+    arr = mesh.devices
+    k = names.index(axis)
+    moved = _np2.moveaxis(arr, k, -1)
+    groups = moved.reshape(-1, arr.shape[k])
+    hosts = max(len({getattr(d, "process_index", 0) for d in row})
+                for row in groups)
+    return int(arr.shape[k]), int(hosts)
+
+
+def link_split(mesh, axis, nbytes):
+    """Split one collective's logical payload into (ici_bytes,
+    dcn_bytes): of the ``n-1`` pairwise combine hops a ring/fold
+    reduction over an ``n``-device axis performs, the ones joining two
+    devices on the SAME host ride the intra-host fast link (ICI) and
+    the ``h-1`` host-boundary hops ride the cross-host link (DCN),
+    where ``h`` is the axis's host span. Hop shares weight the payload:
+    an axis entirely inside one host is pure ICI; a 2-host x 4-local
+    axis puts 1/7 of its combine traffic on DCN. This is the
+    accounting model telemetry's per-link table renders — a layout
+    audit (is my fsdp axis really intra-host?), not a wire-byte
+    meter."""
+    n, h = axis_hosts(mesh, axis)
+    if n <= 1:
+        return 0, 0
+    hops = n - 1
+    dcn_hops = max(h - 1, 0)
+    dcn = int(round(nbytes * dcn_hops / hops))
+    return int(nbytes) - dcn, dcn

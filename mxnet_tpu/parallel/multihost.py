@@ -1,0 +1,611 @@
+"""Multi-host runtime: heartbeat failure detection and the cross-host
+exchange leg.
+
+One process per host, joined into a ``jax.distributed`` group by the
+launcher contract (``tools/launch.py`` DMLC_* env or the
+``MXNET_TPU_*`` triple — ``parallel.distributed``). This module adds
+the two things the bare process group does not give a training job:
+
+- **Failure detection** (:class:`Heartbeat`) — a daemon writer thread
+  per process touches ``$MXNET_HB_DIR/hb-<rank>`` every
+  ``MXNET_HB_INTERVAL_MS``; a daemon monitor thread watches the peers
+  it is responsible for (rank 0 watches everyone, other ranks watch
+  the coordinator) and, when a peer's file goes stale past
+  ``MXNET_HB_TIMEOUT_MS``, records a :class:`HostLostError` and exits
+  the process with :data:`HOST_LOST_EXIT` — a *wedged-but-alive* host
+  (stuck in a collective, spinning in native code) is detected by its
+  silence, and this process dies loudly for the supervisor instead of
+  hanging in the collective forever. The writer tick visits the
+  ``proc_hb`` fault site, so ``MXNET_FAULT_PLAN`` wedges
+  (``stall``/``hang``) or kills (``raise``) the beat deterministically.
+
+- **Cross-host exchange** (:func:`exchange_arrays` /
+  :func:`cross_host_sum`) — rank-keyed tensor exchange over the
+  jax.distributed *coordination service* (the gRPC key-value store +
+  barriers every process group already carries). This is the DCN leg
+  for backends whose XLA cannot run one program across processes —
+  jaxlib's CPU backend refuses multiprocess computations outright, so
+  CI's N-process jobs (and any host-side fallback on real hardware)
+  reduce gradients here: every process contributes its per-device
+  contributions, gets all of them back in **global device order**
+  (rank-major, local devices contiguous), and left-folds the sum —
+  the exact grouping XLA's flat-mesh psum/psum_scatter uses, which is
+  what makes the N-process trajectory bit-identical to the equivalent
+  single-process mesh. On backends with real cross-host SPMD (TPU
+  pods), :func:`supports_global_spmd` is True and callers keep their
+  collectives in-program over the global mesh; this leg is the
+  CI-provable contract, not the pod fast path.
+
+- **Step boundaries** (:func:`step_boundary`) — one call per training
+  step: visits the ``proc_exit`` fault site (the deterministic "host
+  dies at step N" used by the supervised-launcher tests) and raises
+  :class:`HostLostError` on the training thread when the monitor has
+  detected a peer loss but this process is still between collectives.
+
+Exchange payloads ride the coordination KV store base85-encoded; that
+service is built for metadata-sized values, which gradient blocks of
+CI/test models are. A pod-scale deployment exchanges via in-program
+DCN collectives (``supports_global_spmd()``) and uses this leg only
+for control-plane metadata (barriers, manifests, epochs).
+"""
+from __future__ import annotations
+
+import base64
+import io as _io
+import logging
+import os
+import threading
+import time
+
+import numpy as _np
+
+from .. import envs
+from ..base import MXNetError
+
+__all__ = ["HostLostError", "HOST_LOST_EXIT", "supports_global_spmd",
+           "coordination_client", "barrier", "exchange_bytes",
+           "exchange_arrays", "cross_host_sum", "Heartbeat",
+           "maybe_start_heartbeat", "stop_heartbeat", "heartbeat",
+           "host_lost", "step_boundary"]
+
+HOST_LOST_EXIT = 43     # the exit code a heartbeat-detected loss uses
+
+
+class HostLostError(MXNetError):
+    """A peer process (host) is gone or wedged: its heartbeat went
+    stale past MXNET_HB_TIMEOUT_MS, or the coordination service
+    reported it dead. Raised on the training thread at the next
+    step_boundary(); the monitor thread additionally exits the
+    process with HOST_LOST_EXIT so a job stuck inside a collective
+    still dies loudly for the supervisor."""
+
+
+def supports_global_spmd():
+    """True when XLA can execute ONE program across every process of
+    the group (TPU/GPU backends) — callers then keep collectives
+    in-program over the global mesh. The CPU backend cannot
+    ("Multiprocess computations aren't implemented"), so multi-process
+    CPU jobs route their cross-host leg through the coordination
+    service instead (:func:`cross_host_sum`)."""
+    import jax
+    try:
+        if jax.process_count() <= 1:
+            return True
+        return jax.devices()[0].platform != "cpu"
+    except Exception:
+        return True
+
+
+def coordination_client():
+    """The process group's coordination-service client (gRPC KV store
+    + barriers), or None when jax.distributed was never initialized.
+    This is jax's own control plane — the same channel
+    jax.distributed.initialize built the group over — so it stays up
+    exactly as long as the group does."""
+    try:
+        from jax._src import distributed as _dist
+        return _dist.global_state.client
+    except Exception:
+        return None
+
+
+def _timeout_ms():
+    return max(int(envs.get_int("MXNET_HB_TIMEOUT_MS")), 1)
+
+
+_barrier_lock = threading.Lock()
+_barrier_uses = {}
+
+
+def barrier(name, timeout_ms=None, one_shot=False):
+    """Block until every process reached ``name`` (coordination-service
+    barrier — works on every backend, CPU included, unlike the
+    device-sync barrier). Coordination-service barrier ids are
+    one-shot, so each use of a REUSABLE ``name`` gets a per-use suffix
+    — every process calls barriers in the same program order (SPMD
+    discipline), keeping the suffixes congruent. ``one_shot=True``
+    skips the suffix table for names that are already unique (the
+    per-exchange done-barriers — one table entry per exchange would
+    grow without bound over a long run). Raises MXNetError naming the
+    barrier on timeout — a peer that died mid-epoch surfaces here
+    instead of hanging forever."""
+    client = coordination_client()
+    if client is None:
+        return
+    import jax
+    if jax.process_count() <= 1:
+        return
+    if timeout_ms is None:
+        # progress-scale, not liveness-scale: a peer legitimately
+        # slow at a barrier (a large shard write before the ckpt
+        # barrier, a first-step compile) must not be declared lost by
+        # a heartbeat-sized window — death detection is the
+        # heartbeat's job, this bound only prevents hanging forever
+        timeout_ms = max(10 * _timeout_ms(), 60000)
+    timeout_ms = int(timeout_ms)
+    if one_shot:
+        bid = str(name)
+    else:
+        with _barrier_lock:
+            use = _barrier_uses[name] = _barrier_uses.get(name, 0) + 1
+        bid = "%s#%d" % (name, use)
+    try:
+        client.wait_at_barrier(bid, timeout_ms)
+    except Exception as exc:
+        raise MXNetError(
+            "multihost barrier %r did not complete within %dms — a "
+            "peer process is gone or wedged (%s: %s)"
+            % (bid, timeout_ms, type(exc).__name__,
+               str(exc)[:200])) from exc
+
+
+# ---------------------------------------------------------------------------
+# coordination-service tensor exchange (the CPU-provable DCN leg)
+# ---------------------------------------------------------------------------
+
+_xchg_lock = threading.Lock()
+_xchg_seq = [0]
+
+
+def _next_tag(tag):
+    """Unique-per-use exchange tag. Every process calls exchanges in
+    the same program order (SPMD discipline), so a process-local
+    counter agrees across the group."""
+    with _xchg_lock:
+        _xchg_seq[0] += 1
+        return "mxhx/%s/%d" % (tag, _xchg_seq[0])
+
+
+def exchange_bytes(tag, payload, timeout_ms=None):
+    """All-gather one bytes payload per process through the
+    coordination KV store: returns ``[bytes_rank0, .., bytes_rankN-1]``
+    on every process. The collective contract is SPMD — every process
+    of the group must call with the same ``tag`` sequence."""
+    import jax
+    n = jax.process_count()
+    me = jax.process_index()
+    if n <= 1:
+        return [bytes(payload)]
+    client = coordination_client()
+    if client is None:
+        raise MXNetError(
+            "multihost.exchange_bytes: no coordination service — the "
+            "process group was not initialized (distributed.init / "
+            "the launcher contract)")
+    timeout_ms = int(timeout_ms
+                     if timeout_ms is not None else 10 * _timeout_ms())
+    key = _next_tag(tag)
+    raw = hasattr(client, "key_value_set_bytes")
+    if raw:
+        client.key_value_set_bytes("%s/%d" % (key, me), bytes(payload))
+    else:       # older jaxlib: string-only store, base85 the payload
+        client.key_value_set("%s/%d" % (key, me),
+                             base64.b85encode(bytes(payload)).decode())
+    def _peer_alive(r):
+        """Liveness vs progress: a peer that is SLOW (long compile, a
+        big shard write) must not be declared lost while its
+        heartbeat proves it alive — only the heartbeat decides death.
+        Without a heartbeat contract there is nothing to consult, so
+        the timeout itself is the verdict."""
+        hb_dir = envs.get_path("MXNET_HB_DIR")
+        if not hb_dir:
+            return False
+        path = os.path.join(hb_dir, "hb-%d" % r)
+        if os.path.exists(path + ".done"):
+            return False       # departed cleanly without contributing
+        try:
+            age = time.time() - os.stat(path).st_mtime
+        except OSError:
+            return False
+        return age <= _timeout_ms() / 1e3
+
+    out = []
+    for r in range(n):
+        if r == me:
+            out.append(bytes(payload))
+            continue
+        while True:
+            try:
+                if raw:
+                    val = bytes(client.blocking_key_value_get_bytes(
+                        "%s/%d" % (key, r), timeout_ms))
+                else:
+                    val = base64.b85decode(
+                        client.blocking_key_value_get(
+                            "%s/%d" % (key, r), timeout_ms).encode())
+                break
+            except Exception as exc:
+                if _peer_alive(r):
+                    continue   # provably alive, just slow: keep waiting
+                raise HostLostError(
+                    "multihost exchange %r: rank %d produced nothing "
+                    "within %dms and its heartbeat is not fresh — "
+                    "host lost or wedged (%s)"
+                    % (key, r, timeout_ms, type(exc).__name__)) \
+                    from exc
+        out.append(val)
+    # nobody reads these keys again (every process holds the values);
+    # dropping them bounds the coordinator's store. The barrier makes
+    # the delete safe — all readers are done. The key is unique per
+    # exchange already (one_shot: no per-name counter entry to leak).
+    barrier(key + "/done", timeout_ms=timeout_ms, one_shot=True)
+    try:
+        client.key_value_delete("%s/%d" % (key, me))
+    except Exception:
+        pass        # best-effort GC; the coordinator dies with the job
+    return out
+
+
+def exchange_arrays(tag, arrays, timeout_ms=None):
+    """All-gather a list of numpy arrays per process. Returns
+    ``ranks[r] = [arrays...]`` for every rank, same list length and
+    dtypes as contributed (the caller's SPMD discipline guarantees
+    congruent rosters)."""
+    buf = _io.BytesIO()
+    _np.savez(buf, *[_np.asarray(a) for a in arrays])
+    blobs = exchange_bytes(tag, buf.getvalue(), timeout_ms=timeout_ms)
+    out = []
+    for blob in blobs:
+        with _np.load(_io.BytesIO(blob), allow_pickle=False) as z:
+            out.append([z["arr_%d" % i] for i in range(len(z.files))])
+    return out
+
+
+def cross_host_sum(tag, stacks, timeout_ms=None):
+    """The DCN gradient leg: ``stacks`` is this process's list of
+    per-leaf arrays whose **leading axis is the local device axis**
+    (one row per local device, unreduced). Every process's stacks are
+    exchanged and each leaf is summed by a left fold over rows in
+    global device order — rank-major, local rows in order. That
+    grouping is bit-identical to XLA's flat-mesh psum/psum_scatter
+    over the same contributions (both are sequential folds in device
+    order), which is what makes an N-process trajectory reproduce the
+    single-process mesh bit for bit. Returns the list of summed
+    leaves (leading axis folded away).
+
+    With one process this is a pure local fold — same code path, same
+    grouping — so a 1-process "multihost-mode" run is the natural
+    bit-exact baseline for an N-process one.
+    """
+    import jax
+    if jax.process_count() <= 1:
+        all_stacks = [stacks]
+    else:
+        all_stacks = exchange_arrays(tag, stacks, timeout_ms=timeout_ms)
+    out = []
+    for leaf in range(len(stacks)):
+        acc = None
+        for rank_stack in all_stacks:
+            rows = rank_stack[leaf]
+            for d in range(rows.shape[0]):
+                acc = rows[d].copy() if acc is None else acc + rows[d]
+        out.append(acc)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# heartbeat: per-process liveness over the launcher's MXNET_HB_DIR
+# ---------------------------------------------------------------------------
+
+_hb_lock = threading.Lock()
+_heartbeat = None
+_host_lost = [None]     # message set by the monitor before it exits
+_dying = [False]        # this process is exiting because of a fault
+
+
+def host_lost():
+    """The HostLostError message the monitor recorded, or None."""
+    return _host_lost[0]
+
+
+def mark_dying():
+    """Flag this process as exiting ABNORMALLY: the atexit heartbeat
+    stop will then not write the clean-departure marker, so peers
+    detect the loss at heartbeat speed."""
+    _dying[0] = True
+
+
+class Heartbeat:
+    """File-based liveness for one process of a launched job.
+
+    The *writer* daemon touches ``hb-<rank>`` every
+    ``MXNET_HB_INTERVAL_MS`` (visiting the ``proc_hb`` fault site — a
+    planned ``stall``/``hang`` stops the beat exactly like a wedged
+    host, a ``raise`` kills the writer outright). The *monitor* daemon
+    stats the peers this rank is responsible for — rank 0 (the
+    coordinator) watches every worker, other ranks watch rank 0 — and
+    on a peer older than ``MXNET_HB_TIMEOUT_MS`` logs the
+    :class:`HostLostError`, notes it for :func:`step_boundary`, and
+    hard-exits with :data:`HOST_LOST_EXIT` (``os._exit`` — the
+    training thread may be wedged inside a collective that will never
+    return, so a polite exception cannot be relied on to surface).
+
+    A peer's file must EXIST before it is monitored (a slow-starting
+    worker is not a dead one): monitoring of rank r arms on the first
+    sighting of its file, or after ``grace_factor`` timeouts pass with
+    the file still absent."""
+
+    def __init__(self, rank, world, hb_dir=None, exit_on_loss=True,
+                 grace_factor=5):
+        self.rank = int(rank)
+        self.world = int(world)
+        self.dir = hb_dir or envs.get_path("MXNET_HB_DIR")
+        if not self.dir:
+            raise MXNetError("Heartbeat needs MXNET_HB_DIR (the "
+                             "launcher contract) or hb_dir=")
+        self.exit_on_loss = exit_on_loss
+        self.grace_factor = int(grace_factor)
+        self._stop = threading.Event()
+        self._writer = None
+        self._monitor = None
+        self._seen = {}          # rank -> first time its file existed
+        self._strikes = {}       # rank -> consecutive stale sweeps
+        self._last_touch = time.time()
+        self._started = time.time()   # beats older than this are a
+                                      # PREVIOUS run's leftovers
+        self.ticks = 0
+
+    # -- paths ------------------------------------------------------------
+    def _path(self, rank):
+        return os.path.join(self.dir, "hb-%d" % rank)
+
+    def _peers(self):
+        if self.rank == 0:
+            return [r for r in range(1, self.world)]
+        return [0]
+
+    # -- lifecycle --------------------------------------------------------
+    def start(self):
+        os.makedirs(self.dir, exist_ok=True)
+        try:
+            # a previous generation's clean-departure marker must not
+            # blind peers to THIS incarnation of the rank
+            os.unlink(self._path(self.rank) + ".done")
+        except OSError:
+            pass
+        self._touch()           # exist immediately: peers arm on sight
+        self._writer = threading.Thread(
+            target=self._writer_loop, daemon=True, name="mxhb-write")
+        self._writer.start()
+        if self.world > 1:
+            self._monitor = threading.Thread(
+                target=self._monitor_loop, daemon=True,
+                name="mxhb-monitor")
+            self._monitor.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+
+    # -- writer -----------------------------------------------------------
+    def _touch(self):
+        path = self._path(self.rank)
+        with open(path + ".tmp", "w") as f:
+            f.write("%d %.6f\n" % (self.ticks, time.time()))
+        os.replace(path + ".tmp", path)
+        self._last_touch = time.time()
+
+    def _writer_loop(self):
+        from .. import fault
+        interval = max(envs.get_int("MXNET_HB_INTERVAL_MS"), 1) / 1e3
+        while not self._stop.wait(interval):
+            try:
+                # the injectable wedge: stall sleeps through beats (a
+                # wedged-but-alive host), raise/hang kill the writer —
+                # either way the FILE goes stale and peers detect it
+                fault.inject("proc_hb")
+            except fault.InjectedFault:
+                logging.getLogger(__name__).warning(
+                    "heartbeat: planned fault killed the writer "
+                    "(rank %d) — this host now looks lost to peers",
+                    self.rank)
+                return
+            self.ticks += 1
+            try:
+                self._touch()
+            except OSError as exc:
+                logging.getLogger(__name__).warning(
+                    "heartbeat: touch failed (%s); retrying", exc)
+
+    # -- monitor ----------------------------------------------------------
+    def _check_peers(self, now):
+        """One staleness sweep; returns the HostLostError message for
+        the first lost peer, or None. A peer that left a clean-
+        departure marker (``hb-<rank>.done`` — normal job completion)
+        is no longer monitored: a finished worker's stale file must
+        not read as a lost host while slower peers drain.
+
+        Self-starvation guard: when OUR OWN writer has not beaten
+        recently (cgroup CPU throttling, a swap storm — whole-machine
+        stalls hit every process of a CI box at once), this sweep
+        judges nobody: a starved judge cannot tell a dead peer from
+        its own lost time slices. Peers additionally need two
+        CONSECUTIVE stale sweeps (strikes) before they count as lost,
+        so one throttle window spanning a single sweep cannot fire a
+        false loss."""
+        timeout = _timeout_ms() / 1e3
+        if now - self._last_touch > 0.5 * timeout:
+            self._strikes.clear()
+            return None
+        for r in self._peers():
+            path = self._path(r)
+            if os.path.exists(path + ".done"):
+                self._strikes.pop(r, None)
+                continue
+            stale = None
+            try:
+                mtime = os.stat(path).st_mtime
+                if mtime < self._started:
+                    # a PREVIOUS run's leftover beat in a reused
+                    # MXNET_HB_DIR: this generation's peer has not
+                    # started yet — the never-seen grace applies, not
+                    # the staleness verdict
+                    raise OSError("stale previous-generation beat")
+                age = now - mtime
+                self._seen.setdefault(r, now)
+                if age > timeout:
+                    stale = ("rank %d heartbeat stale for %.3fs "
+                             "(timeout %.3fs) — host lost or wedged"
+                             % (r, age, timeout))
+            except OSError:
+                if self._seen.get(r) is not None:
+                    # was beating, file gone: the worker (or its dir)
+                    # was torn down under us
+                    stale = ("rank %d heartbeat file disappeared — "
+                             "host lost" % r)
+                else:
+                    # never seen: allow a slow start, then treat a
+                    # worker that never appeared as lost
+                    self._seen.setdefault("miss-%d" % r, now)
+                    first_miss = self._seen["miss-%d" % r]
+                    if now - first_miss > self.grace_factor * timeout:
+                        stale = ("rank %d heartbeat never appeared "
+                                 "within %.1fs" % (r, now - first_miss))
+            if stale is None:
+                self._strikes.pop(r, None)
+                continue
+            strikes = self._strikes.get(r, 0) + 1
+            self._strikes[r] = strikes
+            if strikes >= 2:
+                return stale
+        return None
+
+    def _monitor_loop(self):
+        interval = max(envs.get_int("MXNET_HB_INTERVAL_MS"), 1) / 1e3
+        while not self._stop.wait(interval):
+            msg = self._check_peers(time.time())
+            if msg is None:
+                continue
+            _host_lost[0] = msg
+            logging.getLogger(__name__).error(
+                "HostLostError: %s — exiting %d for the supervisor",
+                msg, HOST_LOST_EXIT)
+            from .. import telemetry
+            telemetry.note("host_lost")
+            if self.exit_on_loss:
+                # the training thread may be wedged inside a
+                # collective that will never return; flush what we
+                # can and die loudly so the supervisor restarts the
+                # world (tools/launch.py --supervise)
+                try:
+                    telemetry.stop()
+                except Exception:
+                    pass
+                os._exit(HOST_LOST_EXIT)
+            return
+
+
+def heartbeat():
+    """The process's active Heartbeat (or None)."""
+    return _heartbeat
+
+
+def maybe_start_heartbeat():
+    """Start the singleton heartbeat when the launcher contract asks
+    for one (MXNET_HB_DIR set and a multi-worker DMLC_*/MXNET_TPU_*
+    world). Idempotent; returns the Heartbeat or None."""
+    global _heartbeat
+    hb_dir = envs.get_path("MXNET_HB_DIR")
+    if not hb_dir:
+        return None
+    if "DMLC_WORKER_ID" in os.environ:
+        rank = int(os.environ["DMLC_WORKER_ID"])
+        world = int(os.environ.get("DMLC_NUM_WORKER", 1) or 1)
+    else:
+        rank = envs.get_int("MXNET_TPU_RANK") or 0
+        world = envs.get_int("MXNET_TPU_WORLD") or 1
+    if world <= 1:
+        return None
+    with _hb_lock:
+        if _heartbeat is None:
+            _heartbeat = Heartbeat(rank, world, hb_dir=hb_dir).start()
+            # stop beating the moment this process starts dying: a
+            # worker whose main thread raised can linger for seconds
+            # in jax.distributed's own atexit shutdown barrier while
+            # daemon threads keep running — without this, its still-
+            # fresh heartbeat makes a dead host look alive to peers.
+            # atexit is LIFO and jax registered its handler at
+            # initialize (before this), so ours runs FIRST.
+            import atexit
+            atexit.register(stop_heartbeat)
+            # an UNCAUGHT exception is an abnormal exit: flag it so
+            # the atexit stop skips the clean-departure marker and
+            # peers detect this host at heartbeat speed
+            import sys as _sys
+            prev_hook = _sys.excepthook
+
+            def _hb_excepthook(tp, val, tb):
+                mark_dying()
+                stop_heartbeat(clean=False)
+                prev_hook(tp, val, tb)
+
+            _sys.excepthook = _hb_excepthook
+    return _heartbeat
+
+
+def stop_heartbeat(clean=None):
+    """Stop the singleton heartbeat. ``clean`` (default: "not dying")
+    writes the ``hb-<rank>.done`` departure marker so peers stop
+    monitoring this rank — a finished worker must not read as a lost
+    host; a fatal exit skips the marker so peers detect the loss at
+    heartbeat speed."""
+    global _heartbeat
+    with _hb_lock:
+        hb, _heartbeat = _heartbeat, None
+    if hb is not None:
+        hb.stop()
+        if clean is None:
+            clean = not _dying[0]
+        if clean:
+            try:
+                path = hb._path(hb.rank) + ".done"
+                with open(path + ".tmp", "w") as f:
+                    f.write("done\n")
+                os.replace(path + ".tmp", path)
+            except OSError:
+                pass
+    _host_lost[0] = None
+
+
+def step_boundary():
+    """Once per training step on the training thread: the ``proc_exit``
+    fault site (deterministic host-death injection — a planned
+    ``raise`` here IS the test's "worker dies at step N") plus the
+    host-loss check, so a detected peer loss surfaces as a typed
+    :class:`HostLostError` at a step boundary even before the monitor
+    hard-exits."""
+    from .. import fault
+    try:
+        fault.inject("proc_exit")
+    except BaseException:
+        # dying loudly: stop advertising liveness (NO clean marker)
+        # so peers detect the loss at heartbeat speed instead of
+        # exchange-timeout speed
+        mark_dying()
+        stop_heartbeat(clean=False)
+        raise
+    msg = _host_lost[0]
+    if msg is not None:
+        mark_dying()
+        stop_heartbeat(clean=False)
+        raise HostLostError(msg)

@@ -264,6 +264,13 @@ def start(filename=None, run_id=None, meta=None):
         _atexit_registered = True
         import atexit
         atexit.register(stop)      # no-op when already stopped
+    # a supervised relaunch (tools/launch.py --supervise) stamps its
+    # restart generation into every worker's env; recording it as a
+    # run event lets diagnose reconcile supervisor restarts with the
+    # resume-rollback counters fault.stats() carries
+    gen = envs.get_int("MXNET_LAUNCH_RESTART")
+    if gen:
+        note("supervisor_restart_generation", int(gen))
     return run.run_id
 
 
@@ -611,6 +618,29 @@ def comm_span(kind, key, value=None, nbytes=None):
         return _NULL
     return _CommSpan(kind, key,
                      _nbytes(value) if nbytes is None else int(nbytes))
+
+
+def comm_links(key, ici_bytes, dcn_bytes, calls=1):
+    """Account one collective's per-link byte split: intra-host
+    (``ici``) vs cross-host (``dcn``) traffic, keyed by the collective
+    kind (``parallel.mesh.link_split`` computes the split from the
+    mesh's host layout; ``parallel.multihost.cross_host_sum``'s
+    coordination-service leg is pure dcn). Rendered as the diagnose
+    "Per-link comms" table. No-op without a run; single-host runs with
+    zero dcn bytes still ledger their ici side so the table shows the
+    layout."""
+    run = _run
+    if run is None:
+        return
+    k_ici, k_dcn = ("ici", str(key)), ("dcn", str(key))
+    with _lock:
+        for k, nbytes in ((k_ici, ici_bytes), (k_dcn, dcn_bytes)):
+            c = run.comms.get(k)
+            if c is None:
+                c = run.comms[k] = {"calls": 0, "bytes": 0,
+                                    "time_ms": 0.0}
+            c["calls"] += int(calls)
+            c["bytes"] += int(nbytes)
 
 
 def h2d(key, nbytes=0, seconds=0.0):

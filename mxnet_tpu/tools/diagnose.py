@@ -29,7 +29,12 @@ when a shape-bucketing producer ran (``mxnet_tpu.bucketing``) — the
 Bucketing table (per-bucket batch counts, padding-overhead share,
 pad-row and discarded-sample counts per producer), and — when the SLO
 watchdog fired (``mxnet_tpu.livemetrics``, ``MXNET_WATCHDOG=1``) — the
-Alerts table (step, alert kind, breach detail). A truncated trailing
+Alerts table (step, alert kind, breach detail), and — when collectives
+ran over a mesh — the Per-link comms table splitting each collective
+kind's bytes into intra-host (``ici``) vs cross-host (``dcn``) traffic
+(``parallel.mesh.link_split``), plus a Restarts goodput line
+reconciling the supervised launcher's restart generation
+(``MXNET_LAUNCH_RESTART``) with ``fault.stats()``'s resume counters. A truncated trailing
 line (a run killed mid-append) is skipped with a one-line warning;
 the rest of the report renders. This supersedes scraping the same
 facts out of log lines with ``tools/parse_log.py``.
@@ -584,6 +589,18 @@ def format_telemetry(tel):
     if n:
         lines.append("goodput      : %.1f%%" % (100.0 * productive / n))
     events = summary.get("events") or {}
+    gen = events.get("supervisor_restart_generation")
+    if gen:
+        # reconcile the supervisor's restart-the-world count with the
+        # resume accounting fault.stats() carries: a supervised
+        # restart that found a clean manifest resumes cleanly; one
+        # that rolled past torn epochs shows up in the rollback
+        # counters below
+        fstats = summary.get("fault") or {}
+        lines.append("restarts     : supervisor restart generation %d "
+                     "(resumes this run: %d clean, %d rollback)"
+                     % (gen, fstats.get("clean_resumes", 0),
+                        fstats.get("rollback_resumes", 0)))
     rollback = events.get("resume_rollback_epochs")
     if rollback:
         # reconcile lost work with the rollback the resume scan took:
@@ -647,8 +664,11 @@ def format_telemetry(tel):
     h2d = {k: v for k, v in all_comms.items() if k.startswith("h2d:")}
     sync = {k: v for k, v in all_comms.items()
             if k.startswith("grad_sync:")}
+    links = {k: v for k, v in all_comms.items()
+             if k.startswith(("ici:", "dcn:"))}
     comms = {k: v for k, v in all_comms.items()
-             if not k.startswith(("h2d:", "grad_sync:"))}
+             if not k.startswith(("h2d:", "grad_sync:", "ici:",
+                                  "dcn:"))}
 
     if sync:
         # the bucketed gradient exchange (parallel.grad_sync): one row
@@ -678,6 +698,32 @@ def format_telemetry(tel):
                          "no host sync phase)" % steps_synced)
         lines.append("sync share   : %.1f%% of accounted phase time "
                      "(%d bucket(s)/step)" % (share, len(sync)))
+
+    if links:
+        # the mesh-layout audit: how much of each collective kind's
+        # combine traffic rides the intra-host fast link (ici) vs the
+        # cross-host link (dcn) under mesh.link_split's hop model — a
+        # data axis split on host boundaries shows dcn ONLY here
+        lines.append("----------Per-link comms (ici vs dcn)----------")
+        lines.append("%-24s %8s %14s %14s %7s"
+                     % ("collective", "calls", "ici bytes",
+                        "dcn bytes", "dcn%"))
+        kinds = sorted({k.split(":", 1)[1] for k in links})
+        tot_i = tot_d = 0
+        for kind in kinds:
+            ici = links.get("ici:%s" % kind) or {}
+            dcn = links.get("dcn:%s" % kind) or {}
+            bi, bd = ici.get("bytes", 0), dcn.get("bytes", 0)
+            tot_i += bi
+            tot_d += bd
+            calls = max(ici.get("calls", 0), dcn.get("calls", 0))
+            share = 100.0 * bd / (bi + bd) if (bi + bd) else 0.0
+            lines.append("%-24s %8d %14d %14d %6.1f%%"
+                         % (kind[:24], calls, bi, bd, share))
+        tot_share = 100.0 * tot_d / (tot_i + tot_d) \
+            if (tot_i + tot_d) else 0.0
+        lines.append("%-24s %8s %14d %14d %6.1f%%"
+                     % ("TOTAL", "", tot_i, tot_d, tot_share))
 
     lines.append("----------Comms----------")
     if comms:

@@ -53,5 +53,7 @@ def test_launch_cli_validation():
         launch.main(["-n", "2", "--launcher", "ssh", "echo", "hi"])
     assert launch.main(["-n", "1", sys.executable, "-c",
                         "print('ok')"]) == 0
+    # the first-failing worker's exit code propagates verbatim (the
+    # old launcher collapsed every failure to 1)
     assert launch.main(["-n", "1", sys.executable, "-c",
-                        "import sys; sys.exit(3)"]) == 1
+                        "import sys; sys.exit(3)"]) == 3

@@ -1,0 +1,551 @@
+"""Multi-host training: process-aware meshes, the supervised launcher,
+heartbeat failure detection, and elastic pod-scale resume.
+
+The subprocess tests spawn REAL 2-process jax.distributed jobs on CPU
+(`JAX_PLATFORMS=cpu`, 4 forced host devices per process = a genuine
+2x4 global topology) through `python -m mxnet_tpu.tools.launch` — the
+exact pod contract, scheduler included. The cross-host leg rides the
+coordination service (`parallel.multihost`), because jaxlib's CPU
+backend cannot execute one XLA program across processes; the rank-major
+left-fold makes the 2-process trajectory bit-identical to the
+1-process 8-device mesh (proven here, rtol=0).
+"""
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.base import MXNetError
+
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _env(n_devices=4, **extra):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = \
+        "--xla_force_host_platform_device_count=%d" % n_devices
+    env.pop("MXNET_FAULT_PLAN", None)
+    env.pop("MXNET_HB_DIR", None)
+    env.update({k: str(v) for k, v in extra.items()})
+    return env
+
+
+# ---------------------------------------------------------------------------
+# distributed.init launch-contract validation (satellite 1)
+# ---------------------------------------------------------------------------
+
+class TestInitContract:
+    def _clean(self, monkeypatch):
+        for var in ("MXNET_TPU_COORDINATOR", "MXNET_TPU_WORLD",
+                    "MXNET_TPU_RANK"):
+            monkeypatch.delenv(var, raising=False)
+
+    def test_partial_triple_raises_naming_missing(self, monkeypatch):
+        from mxnet_tpu.parallel import distributed
+        self._clean(monkeypatch)
+        monkeypatch.setenv("MXNET_TPU_WORLD", "2")
+        assert not distributed.is_initialized()
+        with pytest.raises(MXNetError) as err:
+            distributed.init()
+        msg = str(err.value)
+        assert "MXNET_TPU_COORDINATOR" in msg
+        assert "MXNET_TPU_RANK" in msg
+        # a failed init is retryable, never latched
+        assert not distributed.is_initialized()
+        with pytest.raises(MXNetError):
+            distributed.init()
+
+    def test_partial_explicit_args_raise(self, monkeypatch):
+        from mxnet_tpu.parallel import distributed
+        self._clean(monkeypatch)
+        with pytest.raises(MXNetError) as err:
+            distributed.init(coordinator="127.0.0.1:1234")
+        assert "num_processes" in str(err.value)
+        assert not distributed.is_initialized()
+        with pytest.raises(MXNetError):
+            distributed.init(num_processes=2, process_id=0)
+
+    def test_no_contract_is_noop_and_never_latches(self, monkeypatch):
+        from mxnet_tpu.parallel import distributed
+        self._clean(monkeypatch)
+        distributed.init()          # auto-init environment: no-op
+        # nothing latched: a LATER init with a real contract must
+        # still be able to join (the silent-single-process trap)
+        assert not distributed.is_initialized()
+        assert distributed.num_workers() == 1
+
+
+# ---------------------------------------------------------------------------
+# process-aware mesh construction + per-link accounting
+# ---------------------------------------------------------------------------
+
+class _FakeDev:
+    def __init__(self, i, proc):
+        self.id = i
+        self.process_index = proc
+
+    def __repr__(self):
+        return "cpu:%d@%d" % (self.id, self.process_index)
+
+
+class _FakeMesh:
+    def __init__(self, devs, axes=("dp",), shape=None):
+        self.axis_names = tuple(axes)
+        arr = np.empty(len(devs), dtype=object)
+        arr[:] = devs
+        self.devices = arr.reshape(shape or (len(devs),))
+
+
+class TestProcessMesh:
+    def test_hosts_validation(self):
+        from mxnet_tpu.parallel import mesh as mesh_mod
+        # single-process real devices cannot satisfy hosts=2
+        with pytest.raises(ValueError) as err:
+            mesh_mod.make_mesh(data=8, hosts=2)
+        assert "span" in str(err.value)
+        # inner block straddling a host boundary is rejected
+        fakes = [_FakeDev(i, i // 4) for i in range(8)]
+        with pytest.raises(ValueError) as err:
+            mesh_mod.make_mesh(fsdp=8, hosts=2, devices=fakes)
+        assert "DCN" in str(err.value)
+
+    def test_hosts_sorts_devices_contiguously(self):
+        from mxnet_tpu.parallel import mesh as mesh_mod
+        # shuffled fake devices: validation path sorts rank-major; an
+        # inner block not dividing the local count raises, a dividing
+        # one passes validation (Mesh construction itself needs real
+        # devices, so probe via the validation error text only)
+        fakes = [_FakeDev(i, i % 2) for i in range(8)]   # interleaved
+        with pytest.raises(ValueError) as err:
+            mesh_mod.make_mesh(fsdp=8, hosts=2, devices=fakes)
+        assert "4 devices local" in str(err.value)
+
+    def test_axis_hosts_and_link_split(self):
+        from mxnet_tpu.parallel.mesh import axis_hosts, link_split
+        m = _FakeMesh([_FakeDev(i, i // 4) for i in range(8)])
+        assert axis_hosts(m, "dp") == (8, 2)
+        ici, dcn = link_split(m, "dp", 700)
+        # 7 combine hops, 1 crosses the host boundary
+        assert (ici, dcn) == (600, 100)
+        # single-host mesh: pure ici
+        m1 = _FakeMesh([_FakeDev(i, 0) for i in range(8)])
+        assert link_split(m1, "dp", 700) == (700, 0)
+        with pytest.raises(ValueError):
+            link_split(m, "tp", 100)
+
+    def test_comm_links_and_diagnose_render(self, tmp_path,
+                                            monkeypatch):
+        from mxnet_tpu import telemetry
+        from mxnet_tpu.tools.diagnose import (format_telemetry,
+                                              read_telemetry)
+        sink = str(tmp_path / "run.jsonl")
+        monkeypatch.setenv("MXNET_LAUNCH_RESTART", "2")
+        telemetry.reset()
+        telemetry.start(filename=sink)
+        telemetry.comm_links("all_reduce", 600, 100)
+        telemetry.comm_links("grad_sync", 0, 4096)
+        telemetry.stop()
+        text = format_telemetry(read_telemetry(sink))
+        assert "Per-link comms" in text
+        assert "all_reduce" in text
+        assert "grad_sync" in text
+        assert "restart generation 2" in text
+        telemetry.reset()
+
+
+# ---------------------------------------------------------------------------
+# heartbeat mechanics (synchronous — no threads, no clocks to race)
+# ---------------------------------------------------------------------------
+
+class TestHeartbeat:
+    def _hb(self, tmp_path, rank=0, world=2, monkeypatch=None):
+        from mxnet_tpu.parallel.multihost import Heartbeat
+        if monkeypatch is not None:
+            monkeypatch.setenv("MXNET_HB_TIMEOUT_MS", "1000")
+        hb = Heartbeat(rank, world, hb_dir=str(tmp_path),
+                       exit_on_loss=False)
+        # the tests craft peer files with backdated mtimes; backdate
+        # the generation mark so they count as THIS run's beats (a
+        # real monitor treats pre-start mtimes as a previous run's
+        # leftovers — covered by test_previous_generation_beat)
+        hb._started -= 120
+        return hb
+
+    def _write(self, tmp_path, rank, age=0.0):
+        path = tmp_path / ("hb-%d" % rank)
+        path.write_text("beat\n")
+        when = time.time() - age
+        os.utime(path, (when, when))
+
+    def test_fresh_peer_is_alive(self, tmp_path, monkeypatch):
+        hb = self._hb(tmp_path, monkeypatch=monkeypatch)
+        self._write(tmp_path, 1, age=0.1)
+        assert hb._check_peers(time.time()) is None
+
+    def test_stale_peer_two_strikes(self, tmp_path, monkeypatch):
+        hb = self._hb(tmp_path, monkeypatch=monkeypatch)
+        self._write(tmp_path, 1, age=5.0)
+        now = time.time()
+        hb._last_touch = now                     # we are healthy
+        assert hb._check_peers(now) is None      # strike 1
+        msg = hb._check_peers(now)               # strike 2 -> lost
+        assert msg is not None and "rank 1" in msg and "stale" in msg
+
+    def test_self_starvation_guard(self, tmp_path, monkeypatch):
+        # our own beat is old: judging peers would blame them for OUR
+        # lost time slices (cgroup throttling) — the sweep abstains
+        hb = self._hb(tmp_path, monkeypatch=monkeypatch)
+        self._write(tmp_path, 1, age=5.0)
+        now = time.time()
+        hb._last_touch = now - 10.0
+        assert hb._check_peers(now) is None
+        assert hb._check_peers(now) is None
+        assert not hb._strikes
+
+    def test_previous_generation_beat_gets_startup_grace(
+            self, tmp_path, monkeypatch):
+        # a reused MXNET_HB_DIR holds a crashed run's stale beat: the
+        # new monitor must treat it as "peer not started yet" (grace),
+        # not as an instant loss
+        from mxnet_tpu.parallel.multihost import Heartbeat
+        monkeypatch.setenv("MXNET_HB_TIMEOUT_MS", "1000")
+        self._write(tmp_path, 1, age=300.0)
+        hb = Heartbeat(0, 2, hb_dir=str(tmp_path), exit_on_loss=False)
+        now = time.time()
+        hb._last_touch = now
+        assert hb._check_peers(now) is None
+        assert hb._check_peers(now) is None
+        # a fresh beat from the new generation arms monitoring again
+        self._write(tmp_path, 1, age=0.0)
+        assert hb._check_peers(time.time()) is None
+        assert 1 in hb._seen
+
+    def test_clean_departure_marker_blinds_monitor(self, tmp_path,
+                                                   monkeypatch):
+        hb = self._hb(tmp_path, monkeypatch=monkeypatch)
+        self._write(tmp_path, 1, age=5.0)
+        (tmp_path / "hb-1.done").write_text("done\n")
+        now = time.time()
+        hb._last_touch = now
+        assert hb._check_peers(now) is None
+        assert hb._check_peers(now) is None
+
+    def test_disappeared_peer_is_lost(self, tmp_path, monkeypatch):
+        hb = self._hb(tmp_path, monkeypatch=monkeypatch)
+        self._write(tmp_path, 1, age=0.1)
+        now = time.time()
+        hb._last_touch = now
+        assert hb._check_peers(now) is None
+        os.unlink(tmp_path / "hb-1")
+        assert hb._check_peers(now) is None      # strike 1
+        msg = hb._check_peers(now)
+        assert msg is not None and "disappeared" in msg
+
+    def test_rank0_watches_all_others_watch_rank0(self, tmp_path):
+        from mxnet_tpu.parallel.multihost import Heartbeat
+        hb0 = Heartbeat(0, 4, hb_dir=str(tmp_path))
+        hb2 = Heartbeat(2, 4, hb_dir=str(tmp_path))
+        assert hb0._peers() == [1, 2, 3]
+        assert hb2._peers() == [0]
+
+    def test_step_boundary_fault_site_and_loss_surfacing(self):
+        from mxnet_tpu import fault
+        from mxnet_tpu.parallel import multihost
+        fault.set_plan("proc_exit:step=3:raise")
+        try:
+            multihost.step_boundary()
+            multihost.step_boundary()
+            with pytest.raises(fault.InjectedFault):
+                multihost.step_boundary()
+        finally:
+            fault.set_plan(None)
+            multihost._dying[0] = False
+        multihost._host_lost[0] = "rank 1 gone (test)"
+        try:
+            with pytest.raises(multihost.HostLostError):
+                multihost.step_boundary()
+        finally:
+            multihost._host_lost[0] = None
+            multihost._dying[0] = False
+
+
+# ---------------------------------------------------------------------------
+# launcher teardown semantics (satellite 2 — no jax in the workers)
+# ---------------------------------------------------------------------------
+
+def test_launch_propagates_code_and_kills_survivors(monkeypatch):
+    from mxnet_tpu.tools import launch
+    monkeypatch.setenv("MXNET_LAUNCH_GRACE", "1")
+    # rank 1 exits 7 fast; rank 0 would sleep for minutes — the
+    # launcher must return 7 quickly with rank 0 torn down
+    code = ("import os, sys, time\n"
+            "if os.environ['DMLC_WORKER_ID'] == '1':\n"
+            "    time.sleep(0.3); sys.exit(7)\n"
+            "time.sleep(300)\n")
+    t0 = time.monotonic()
+    rc = launch.launch_local(2, [sys.executable, "-c", code])
+    elapsed = time.monotonic() - t0
+    assert rc == 7
+    assert elapsed < 60, "survivors were not torn down promptly"
+
+
+def test_supervisor_gives_up_after_budget(tmp_path, monkeypatch):
+    from mxnet_tpu.tools import launch
+    monkeypatch.setenv("MXNET_LAUNCH_GRACE", "1")
+    events = str(tmp_path / "ev.jsonl")
+    code = "import sys; sys.exit(9)"
+    rc = launch.supervise(1, [sys.executable, "-c", code],
+                          events_file=events, max_restarts=1)
+    assert rc == 9
+    kinds = [json.loads(l)["kind"] for l in open(events)]
+    assert kinds.count("launch") == 2          # original + 1 restart
+    assert kinds[-1] == "give_up"
+
+
+# ---------------------------------------------------------------------------
+# torn multi-host manifest rejected on resume
+# ---------------------------------------------------------------------------
+
+def test_torn_manifest_rejected_and_scan_falls_back(tmp_path):
+    from mxnet_tpu import checkpoint as ckpt
+    prefix = str(tmp_path / "ck")
+    for epoch in (0, 1):
+        flat = ckpt.snapshot_params(
+            {"w": mx.nd.ones((4, 4)) * (epoch + 1)})
+        ckpt.save_arrays(prefix, epoch, flat)
+    assert ckpt.latest_manifest_epoch(prefix) == 1
+    # tear epoch 1's shard under its manifest
+    shard = "%s-0001.params" % prefix
+    payload = bytearray(open(shard, "rb").read())
+    payload[len(payload) // 2] ^= 0xFF
+    open(shard, "wb").write(bytes(payload))
+    with pytest.raises(MXNetError):
+        ckpt.validate_manifest(prefix, 1)
+    # the scan (the supervisor's resume source) falls back an epoch
+    assert ckpt.latest_manifest_epoch(prefix) == 0
+
+
+# ---------------------------------------------------------------------------
+# subprocess suite: real 2-process jax.distributed jobs on CPU
+# ---------------------------------------------------------------------------
+
+_TRAIN_WORKER = r'''
+import os, sys
+# rank-conditioned fault plan must land BEFORE the mxnet_tpu import
+# (package join visits fault sites, which latches the plan)
+_rank = int(os.environ.get("DMLC_WORKER_ID", "0"))
+_gen = int(os.environ.get("MXNET_LAUNCH_RESTART", "0") or 0)
+_fault = os.environ.get("TEST_FAULT_STEP", "")
+if _fault and _rank == 1 and _gen == 0:
+    os.environ["MXNET_FAULT_PLAN"] = "proc_exit:step=%s:raise" % _fault
+import numpy as np
+import mxnet_tpu as mx
+from mxnet_tpu import gluon, envs
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.parallel import mesh as mesh_mod, distributed
+from mxnet_tpu.parallel.data_parallel import DistributedTrainer
+
+out = sys.argv[1]
+opts = sys.argv[2].split(",")
+prefix = sys.argv[3] if len(sys.argv) > 3 and sys.argv[3] != "-" else None
+EPOCHS, STEPS, B = int(os.environ.get("TEST_EPOCHS", "1")), 4, 16
+kv = mx.kv.create("tpu_sync")
+rank, world = kv.rank, kv.num_workers
+devs = distributed.global_devices()
+mesh = mesh_mod.create_mesh({"dp": len(devs)}, devices=devs)
+
+result = {}
+for oi, opt in enumerate(opts):
+    np.random.seed(3)
+    net = nn.HybridSequential()
+    net.add(nn.Dense(16, activation="relu"), nn.Dense(4))
+    net.initialize(mx.init.Xavier(rnd_type="gaussian"))
+    mx.random.seed(7)
+    loss = gluon.loss.SoftmaxCrossEntropyLoss()
+    tr = DistributedTrainer(net, loss, mesh, optimizer=opt,
+                            learning_rate=0.05)
+    resume = envs.get_int("MXNET_LAUNCH_RESUME_EPOCH")
+    begin = 0
+    if prefix is not None and resume is not None:
+        tr.load_checkpoint("%s-%s" % (prefix, opt), resume)
+        begin = resume + 1
+    lo = rank * (B // world); hi = (rank + 1) * (B // world)
+    for epoch in range(begin, EPOCHS):
+        rng = np.random.RandomState(100 + epoch)
+        data = rng.randn(STEPS, B, 8).astype(np.float32)
+        lab = rng.randint(0, 4, size=(STEPS, B)).astype(np.float32)
+        losses = []
+        for s in range(STEPS):
+            l = tr.fit_batch(mx.nd.array(data[s, lo:hi]),
+                             mx.nd.array(lab[s, lo:hi]))
+            losses.append(float(l.asnumpy()))
+        if prefix is not None:
+            tr.save_checkpoint("%s-%s" % (prefix, opt), epoch)
+    tr.sync_gluon_params()
+    if rank == 0:
+        for k, v in net.collect_params().items():
+            result["%s:%d:%s" % (opt, oi, k.split("_", 1)[-1])] = \
+                v.data().asnumpy()
+        result["%s:losses" % opt] = np.array(losses)
+if _gen > 0 and envs.get_bool("MXNET_COMPILE_WATCH"):
+    # the restarted world must warm its programs from the persistent
+    # compile cache: zero fresh compiles, one disk hit per program
+    from mxnet_tpu import compile_watch
+    st = compile_watch.site_stats("fused_step:mh") or {}
+    fresh = sum(s.get("count", 0) for s in st.values())
+    hits = sum(s.get("cache_hits", 0) for s in st.values())
+    print("WARM_CACHE rank=%d fresh=%d hits=%d" % (rank, fresh, hits),
+          flush=True)
+if rank == 0:
+    np.savez(out, **result)
+print("TRAIN_WORKER_DONE", rank, flush=True)
+'''
+
+
+def _run_launch(args, env, timeout=600):
+    cmd = [sys.executable, "-m", "mxnet_tpu.tools.launch"] + args
+    return subprocess.run(cmd, env=env, cwd=REPO, capture_output=True,
+                          timeout=timeout)
+
+
+def _load_weights(path):
+    return {k: v for k, v in np.load(path).items()}
+
+
+def test_multihost_2x4_bitexact_vs_1x8(tmp_path):
+    """2 processes x 4 devices through the launcher vs 1 process x 8
+    devices — identical trajectories, rtol=0, for sgd AND adam."""
+    worker = tmp_path / "worker.py"
+    worker.write_text(_TRAIN_WORKER)
+    out2 = str(tmp_path / "w2.npz")
+    out1 = str(tmp_path / "w1.npz")
+    r = _run_launch(
+        ["-n", "2", sys.executable, str(worker), out2, "sgd,adam", "-"],
+        _env(n_devices=4, JAX_NUM_CPU_DEVICES=4))
+    assert r.returncode == 0, (r.stdout[-2000:], r.stderr[-3000:])
+    r1 = subprocess.run([sys.executable, str(worker), out1, "sgd,adam",
+                         "-"],
+                        env=_env(n_devices=8), cwd=REPO,
+                        capture_output=True, timeout=600)
+    assert r1.returncode == 0, r1.stderr[-3000:]
+    w2, w1 = _load_weights(out2), _load_weights(out1)
+    assert set(w2) == set(w1) and len(w2) > 2
+    for k in sorted(w1):
+        assert np.array_equal(w1[k], w2[k]), \
+            "%s differs between 1x8 and 2x4 (rtol=0 required)" % k
+
+
+def test_supervisor_restart_resumes_exact_trajectory(tmp_path):
+    """Kill rank 1 mid-epoch-1 via the proc_exit fault plan: the
+    supervisor detects the loss, restarts the world pointing at the
+    last good manifest epoch, and the final weights are bit-identical
+    to an uninterrupted run."""
+    worker = tmp_path / "worker.py"
+    worker.write_text(_TRAIN_WORKER)
+    sup_out = str(tmp_path / "sup.npz")
+    ref_out = str(tmp_path / "ref.npz")
+    events = str(tmp_path / "events.jsonl")
+    common = dict(n_devices=4, JAX_NUM_CPU_DEVICES=4, TEST_EPOCHS=3,
+                  MXNET_HB_TIMEOUT_MS=2000, MXNET_LAUNCH_BACKOFF="0.2",
+                  MXNET_LAUNCH_GRACE=3)
+    # supervised run: rank 1 dies at its 6th step (mid-epoch 1; epoch
+    # 0's manifest is the last good one); the compile cache + watch
+    # ride along so the restarted world's warm-up is observable
+    r = _run_launch(
+        ["-n", "2", "--supervise",
+         "--resume-prefix", str(tmp_path / "sup-adam"),
+         "--events-file", events,
+         sys.executable, str(worker), sup_out, "adam",
+         str(tmp_path / "sup")],
+        _env(TEST_FAULT_STEP=6,
+             MXNET_COMPILE_CACHE_DIR=str(tmp_path / "cc"),
+             MXNET_COMPILE_WATCH=1, **common))
+    assert r.returncode == 0, (r.stdout[-2000:], r.stderr[-4000:])
+    # acceptance: the restart warmed from the persistent compile
+    # cache — zero fresh compiles for the (unchanged) step programs
+    warm = [line for line in r.stdout.decode().splitlines()
+            if line.startswith("WARM_CACHE")]
+    assert warm, r.stdout[-2000:]
+    for line in warm:
+        fields = dict(kv.split("=") for kv in line.split()[1:])
+        assert fields["fresh"] == "0", line
+        assert int(fields["hits"]) >= 2, line
+    kinds = [json.loads(l) for l in open(events)]
+    by_kind = {}
+    for rec in kinds:
+        by_kind.setdefault(rec["kind"], []).append(rec)
+    assert "worker_failed" in by_kind, kinds
+    restart = by_kind["launch"][-1]
+    assert restart["attempt"] >= 1
+    assert restart["resume_epoch"] == 0, restart
+    # uninterrupted reference on the same 2x4 topology
+    r2 = _run_launch(
+        ["-n", "2", sys.executable, str(worker), ref_out, "adam",
+         str(tmp_path / "ref")],
+        _env(**common))
+    assert r2.returncode == 0, r2.stderr[-3000:]
+    ws, wr = _load_weights(sup_out), _load_weights(ref_out)
+    for k in sorted(wr):
+        if k.endswith(":losses"):
+            continue
+        assert np.array_equal(wr[k], ws[k]), \
+            "%s: resumed trajectory diverged from uninterrupted" % k
+    # the resumed job's manifests are multi-process saves
+    from mxnet_tpu import checkpoint as ckpt
+    epoch = ckpt.latest_manifest_epoch(str(tmp_path / "sup-adam"))
+    assert epoch == 2
+    manifest = ckpt.load_manifest(str(tmp_path / "sup-adam"), epoch)
+    assert manifest.get("processes") == 2
+
+
+_HB_WORKER = r'''
+import os, sys, time
+_rank = int(os.environ.get("DMLC_WORKER_ID", "0"))
+if _rank == 1:
+    # wedge the heartbeat writer forever: the deterministic
+    # "wedged-but-alive host" — the process keeps running, the beat
+    # stops, peers must detect it within MXNET_HB_TIMEOUT_MS
+    os.environ["MXNET_FAULT_PLAN"] = "proc_hb:step=1:stall:count=inf"
+    os.environ["MXNET_FAULT_HANG_SECONDS"] = "600"
+import mxnet_tpu as mx
+kv = mx.kv.create("tpu_sync")
+print("HB_WORKER_UP", kv.rank, time.time(), flush=True)
+time.sleep(120)   # rank 0's monitor must kill us long before this
+print("HB_WORKER_SLEPT_THROUGH", kv.rank, flush=True)
+sys.exit(0)
+'''
+
+
+def test_heartbeat_detects_wedged_host(tmp_path):
+    """A proc_hb:stall wedge on rank 1 stops its beat while the
+    process stays alive; rank 0 detects the staleness and exits
+    HOST_LOST_EXIT well inside the sleep the job would otherwise
+    spend."""
+    from mxnet_tpu.parallel.multihost import HOST_LOST_EXIT
+    worker = tmp_path / "hb_worker.py"
+    worker.write_text(_HB_WORKER)
+    hb_dir = tmp_path / "hb"
+    hb_dir.mkdir()
+    t0 = time.monotonic()
+    r = _run_launch(
+        ["-n", "2", sys.executable, str(worker)],
+        _env(n_devices=1, JAX_NUM_CPU_DEVICES=1,
+             MXNET_HB_DIR=str(hb_dir), MXNET_HB_TIMEOUT_MS=1500,
+             MXNET_LAUNCH_GRACE=2),
+        timeout=300)
+    elapsed = time.monotonic() - t0
+    text = (r.stdout + r.stderr).decode()
+    assert r.returncode == HOST_LOST_EXIT, (r.returncode, text[-3000:])
+    assert "HB_WORKER_SLEPT_THROUGH" not in text
+    assert "HostLostError" in text
+    # detection must beat the 120s sleep by a wide margin (imports
+    # dominate; the detection itself is ~2x the 1.5s timeout)
+    assert elapsed < 100, elapsed
