@@ -2220,6 +2220,107 @@ def _decode_record():
     return record
 
 
+def _bench_router_case(n_flood=18, n_light=6, max_new=12):
+    """Fleet-serving failover drill (BENCH_r19): a Router over FOUR
+    live decode replicas under a skewed two-tenant load (``flood``
+    offers 3x the sessions of ``light``; light carries a 2x WFQ
+    weight), with one replica KILLED abruptly mid-run. Captures
+    aggregate tokens/sec, per-tenant p99 session latency and the
+    fairness ratio, the failover detection-to-resume latency, and the
+    failed-stream count — which must be ZERO: every orphaned stream is
+    re-homed by re-prefill replay and finishes token-complete."""
+    import numpy as np
+    from mxnet_tpu.serving import DecodeServer, Router, ToyDecoderLM
+
+    model = ToyDecoderLM(vocab=128, n_layers=2, n_heads=4, head_dim=16,
+                         max_len=256)
+    params = model.init_params(seed=0)
+    rs = np.random.RandomState(0)
+
+    def replica(i):
+        srv = DecodeServer(model, params, seq_ladder=[32, 64],
+                           max_new_tokens=max_new, window=8,
+                           page_size=16, pool_pages=256,
+                           max_queue=n_flood + n_light,
+                           name="replica-%d" % i)
+        srv.warmup()
+        return srv
+
+    router = Router([replica(i) for i in range(4)],
+                    name="bench-fleet", probe_interval_ms=10,
+                    max_inflight=8,
+                    tenants={"light": {"weight": 2.0},
+                             "flood": {"weight": 1.0}})
+    out = {"replicas": 4, "max_new_tokens": max_new,
+           "load": {"flood": n_flood, "light": n_light}}
+    try:
+        t0 = time.perf_counter()
+        reqs = []
+        for i in range(n_flood + n_light):
+            tenant = "light" if i % 4 == 3 else "flood"
+            p = rs.randint(1, 128, size=int(rs.randint(4, 28)))
+            reqs.append(router.submit(p, max_new_tokens=max_new,
+                                      tenant=tenant))
+        # let streams get going, then kill one replica that owns work
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            bound = [q._replica for q in reqs
+                     if q._replica is not None and q.emitted]
+            if bound:
+                break
+            time.sleep(0.002)
+        victim = bound[0]
+        orphans = sum(1 for q in reqs if q._replica is victim)
+        t_kill = time.perf_counter()
+        victim.kill()
+        failed = 0
+        for q in reqs:
+            try:
+                q.result(timeout=120)
+            except Exception:               # noqa: BLE001
+                failed += 1
+        wall = time.perf_counter() - t0
+        st = router.stats()
+        tokens = sum(len(q.emitted) for q in reqs)
+        lat = {t: (st["tenants"][t].get("latency_ms") or {})
+               for t in ("flood", "light")}
+        out.update({
+            "wall_s": round(wall, 3),
+            "kill_at_s": round(t_kill - t0, 3),
+            "killed_replica": victim.name,
+            "orphaned_sessions": orphans,
+            "failed_streams": failed,            # MUST be 0
+            "zero_failed_streams": failed == 0,
+            "completed": st["completed"],
+            "failovers": st["failovers"],
+            "replay_tokens": st["replay_tokens"],
+            "tokens_per_sec": round(tokens / wall, 2),
+            "detect_to_resume_ms": st.get("failover_resume_ms"),
+            "tenant_p99_ms": {t: lat[t].get("p99") for t in lat},
+            "throttles": st["throttles"],
+        })
+        if lat["flood"].get("p99") and lat["light"].get("p99"):
+            # >1 means the weighted light tenant beat the flood
+            out["fairness_p99_ratio"] = round(
+                lat["flood"]["p99"] / lat["light"]["p99"], 3)
+    finally:
+        router.stop()
+    return out
+
+
+def _router_record():
+    """The fleet-serving benchmark record (BENCH_r19.json): 4-replica
+    router under skewed two-tenant load with one replica killed
+    mid-run — zero failed streams, detection-to-resume latency,
+    per-tenant fairness. CPU backend."""
+    record = {"bench": "router_fleet", "platform": "cpu"}
+    try:
+        record.update(_bench_router_case())
+    except Exception as exc:                     # noqa: BLE001
+        record["errors"] = {"router": _err_str(exc)}
+    return record
+
+
 _MULTIHOST_WORKER = r'''
 import os, sys, time
 _rank = int(os.environ.get("DMLC_WORKER_ID", "0"))
@@ -2539,6 +2640,12 @@ if __name__ == "__main__":
         # tokens/sec, p99 inter-token latency, fixed-program oracle,
         # one JSON line (the BENCH_r17 artifact)
         print(json.dumps(_decode_record()))
+    elif "--router" in sys.argv:
+        # CPU-friendly standalone mode: 4-replica fleet router under
+        # skewed two-tenant load with one replica killed mid-run —
+        # zero failed streams, detect-to-resume latency, fairness
+        # ratio, one JSON line (the BENCH_r19 artifact)
+        print(json.dumps(_router_record()))
     elif "--serving" in sys.argv:
         # CPU-friendly standalone mode: offered-load sweep over the
         # continuous-batching inference server (arrival rate x bucket

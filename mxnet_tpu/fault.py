@@ -68,6 +68,14 @@ _ACTIONS = ("raise", "hang", "stall", "nan", "inf")
 # planned hang at serve_decode stalls token production so a streaming
 # request ages past its deadline, proving its pages come back through
 # the counted kv_evict reclaim path.
+# serve_route/replica_lost are the fleet-router sites (serving/
+# router.py, serving/fleet.py): serve_route fires once per router
+# dispatch — a raise is counted and survived (the session stays queued
+# and routes on the next pass), a hang stalls dispatch so queued
+# sessions age deterministically; replica_lost fires once per replica
+# per health sweep — a planned raise CONFIRMS the loss of the replica
+# under probe on that exact visit, driving the failover/replay path
+# without killing anything or racing a timing window.
 # proc_hb/proc_join/proc_exit are the process-boundary sites of the
 # multi-host story (parallel/multihost.py, tools/launch.py): proc_hb
 # fires on every heartbeat-writer tick (stall/hang wedge the beat so
@@ -78,8 +86,8 @@ _ACTIONS = ("raise", "hang", "stall", "nan", "inf")
 # restart-the-world path is tested against.
 _SITES = ("push", "pull", "allreduce", "wait", "init", "grad",
           "ckpt_write", "ckpt_fsync", "serve_admit", "serve_dispatch",
-          "serve_decode", "kv_evict", "proc_hb", "proc_join",
-          "proc_exit")
+          "serve_decode", "serve_route", "kv_evict", "replica_lost",
+          "proc_hb", "proc_join", "proc_exit")
 # corruption needs a value to corrupt — only the grad site carries one
 _VALUE_SITES = ("grad",)
 _GUARD_POLICIES = ("skip_step", "scale_backoff")

@@ -53,6 +53,7 @@ from . import envs
 __all__ = ["serve", "stop_server", "server_port", "render",
            "register_server", "deregister_server",
            "register_decode_server", "deregister_decode_server",
+           "register_router", "deregister_router",
            "Watchdog",
            "enable_watchdog",
            "disable_watchdog", "watchdog_enabled", "maybe_start",
@@ -65,6 +66,7 @@ LATENCY_BUCKETS_MS = (1.0, 2.5, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0,
 
 _servers = weakref.WeakSet()      # live InferenceServers
 _decode_servers = weakref.WeakSet()   # live DecodeServers
+_routers = weakref.WeakSet()      # live serving Routers
 _http = None                      # (HTTPServer, thread)
 _http_lock = threading.Lock()
 _watchdog = None
@@ -118,6 +120,21 @@ def deregister_decode_server(server):
     ``DecodeServer.stop``)."""
     with _register_lock:
         _decode_servers.discard(server)
+
+
+def register_router(router):
+    """Track one live ``serving.Router`` for the scrape — the
+    ``mxnet_router_*`` families (label uniqueness enforced within the
+    router set, same rules as :func:`register_server`)."""
+    with _register_lock:
+        _assign_label_locked(router, _routers)
+        _routers.add(router)
+
+
+def deregister_router(router):
+    """Drop a router from the scrape (called by ``Router.stop``)."""
+    with _register_lock:
+        _routers.discard(router)
 
 
 def maybe_start(fresh_run=False):
@@ -409,6 +426,68 @@ def _render_decode(page):
                  help_="parameter generation serving new requests")
 
 
+def _render_router(page):
+    for router in list(_routers):
+        try:
+            st = router.stats()
+        except Exception:
+            continue                       # mid-shutdown router
+        lab = {"router": getattr(router, "_metrics_label", None)
+               or "default"}
+        for key, help_ in (("requests", "sessions admitted"),
+                           ("dispatched", ""), ("completed", ""),
+                           ("failed", ""), ("cancelled", ""),
+                           ("shed", ""), ("timeouts", ""),
+                           ("throttles", "dispatch rounds a tenant "
+                                         "sat out its token bucket"),
+                           ("failovers", "streaming sessions re-homed "
+                                         "after a replica loss"),
+                           ("replay_tokens", "tokens re-prefilled by "
+                                             "failover replay"),
+                           ("replicas_lost", ""), ("drains", ""),
+                           ("drain_timeouts", ""),
+                           ("route_faults", ""),
+                           ("scale_up_signals", ""),
+                           ("scale_down_signals", "")):
+            page.add("mxnet_router_%s_total" % key, st.get(key),
+                     labels=lab, kind="counter", help_=help_)
+        page.add("mxnet_router_replicas_up", st.get("replicas_up"),
+                 labels=lab, help_="replicas taking new sessions")
+        page.add("mxnet_router_queued", st.get("queued"), labels=lab,
+                 help_="sessions waiting in tenant queues")
+        page.add("mxnet_router_sessions", st.get("sessions"),
+                 labels=lab, help_="streaming sessions bound to "
+                                   "replicas now")
+        for rep in st.get("replicas") or ():
+            rlab = dict(lab, replica=rep.get("name") or "?")
+            page.add("mxnet_router_replica_outstanding_tokens",
+                     rep.get("outstanding"), labels=rlab,
+                     help_="tokens owed by sessions bound to the "
+                           "replica (the dispatch signal)")
+            page.add("mxnet_router_replica_sessions",
+                     rep.get("sessions"), labels=rlab)
+        for name, t in (st.get("tenants") or {}).items():
+            tlab = dict(lab, tenant=name)
+            page.add("mxnet_router_tenant_queued", t.get("queued"),
+                     labels=tlab)
+            page.add("mxnet_router_tenant_throttled_total",
+                     t.get("throttled"), labels=tlab, kind="counter")
+            page.add("mxnet_router_tenant_shed_total", t.get("shed"),
+                     labels=tlab, kind="counter")
+            for q in ("p50", "p99"):
+                page.add("mxnet_router_tenant_latency_ms",
+                         (t.get("latency_ms") or {}).get(q),
+                         labels=dict(tlab, quantile=q),
+                         help_="session completion latency (submit "
+                               "-> done)")
+        for q in ("p50", "p99"):
+            page.add("mxnet_router_failover_resume_ms",
+                     (st.get("failover_resume_ms") or {}).get(q),
+                     labels=dict(lab, quantile=q),
+                     help_="replica-loss detection to first resumed "
+                           "token")
+
+
 def render():
     """The whole ``/metrics`` page as Prometheus text exposition."""
     page = _Page()
@@ -417,6 +496,7 @@ def render():
     _render_counters(page)
     _render_serving(page)
     _render_decode(page)
+    _render_router(page)
     return page.text()
 
 

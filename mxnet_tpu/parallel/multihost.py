@@ -65,6 +65,7 @@ from ..base import MXNetError
 __all__ = ["HostLostError", "HOST_LOST_EXIT", "supports_global_spmd",
            "coordination_client", "barrier", "exchange_bytes",
            "exchange_arrays", "cross_host_sum", "Heartbeat",
+           "StrikeTracker",
            "maybe_start_heartbeat", "stop_heartbeat", "heartbeat",
            "host_lost", "step_boundary"]
 
@@ -325,6 +326,63 @@ def mark_dying():
     _dying[0] = True
 
 
+class StrikeTracker:
+    """The false-positive armor of peer-loss detection, factored out
+    of :meth:`Heartbeat._check_peers` so every liveness monitor in the
+    tree (the training heartbeat here, the serving fleet's replica
+    health in ``serving.fleet``) judges by the same rules:
+
+    - **Strikes** — a peer counts as lost only after ``strikes``
+      CONSECUTIVE unhealthy sweeps (:meth:`observe` returns True on
+      the confirming one); a single throttle window spanning one
+      sweep cannot fire a false loss.
+    - **Self-starvation abstention** — :meth:`abstain` clears every
+      count: a starved judge (cgroup CPU throttling, a swap storm —
+      whole-machine stalls hit every process at once) cannot tell a
+      dead peer from its own lost time slices, so it judges nobody
+      that sweep.
+    - **Clean departure** — a peer that announced normal completion
+      (:meth:`departed`) is never judged again: a finished worker's
+      silence must not read as a lost host while slower peers drain.
+
+    ``counts`` is the live per-peer strike dict (shared by reference
+    with callers that expose it, e.g. ``Heartbeat._strikes``)."""
+
+    def __init__(self, strikes=2):
+        self.strikes = max(1, int(strikes))
+        self.counts = {}
+        self._departed = set()
+
+    def departed(self, peer):
+        """Mark a clean departure: ``peer`` is exempt from judgment."""
+        self._departed.add(peer)
+        self.counts.pop(peer, None)
+
+    def is_departed(self, peer):
+        return peer in self._departed
+
+    def clear(self, peer):
+        """Forget ``peer`` entirely (it left the roster)."""
+        self.counts.pop(peer, None)
+        self._departed.discard(peer)
+
+    def abstain(self):
+        """This sweep judges nobody (the monitor itself was starved)."""
+        self.counts.clear()
+
+    def observe(self, peer, healthy):
+        """Record one sweep's verdict for ``peer``. Returns True
+        exactly when this observation CONFIRMS the loss (the strike
+        count crosses the threshold); a healthy observation resets
+        the count."""
+        if healthy or peer in self._departed:
+            self.counts.pop(peer, None)
+            return False
+        n = self.counts.get(peer, 0) + 1
+        self.counts[peer] = n
+        return n >= self.strikes
+
+
 class Heartbeat:
     """File-based liveness for one process of a launched job.
 
@@ -359,7 +417,8 @@ class Heartbeat:
         self._writer = None
         self._monitor = None
         self._seen = {}          # rank -> first time its file existed
-        self._strikes = {}       # rank -> consecutive stale sweeps
+        self._tracker = StrikeTracker(strikes=2)
+        self._strikes = self._tracker.counts   # the live strike dict
         self._last_touch = time.time()
         self._started = time.time()   # beats older than this are a
                                       # PREVIOUS run's leftovers
@@ -445,12 +504,15 @@ class Heartbeat:
         false loss."""
         timeout = _timeout_ms() / 1e3
         if now - self._last_touch > 0.5 * timeout:
-            self._strikes.clear()
+            self._tracker.abstain()
             return None
         for r in self._peers():
             path = self._path(r)
             if os.path.exists(path + ".done"):
-                self._strikes.pop(r, None)
+                # departure is re-judged per sweep from the marker
+                # file (a restarted incarnation unlinks it), so the
+                # tracker only forgets the strikes
+                self._tracker.clear(r)
                 continue
             stale = None
             try:
@@ -481,12 +543,7 @@ class Heartbeat:
                     if now - first_miss > self.grace_factor * timeout:
                         stale = ("rank %d heartbeat never appeared "
                                  "within %.1fs" % (r, now - first_miss))
-            if stale is None:
-                self._strikes.pop(r, None)
-                continue
-            strikes = self._strikes.get(r, 0) + 1
-            self._strikes[r] = strikes
-            if strikes >= 2:
+            if self._tracker.observe(r, healthy=stale is None):
                 return stale
         return None
 

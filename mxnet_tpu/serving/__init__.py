@@ -28,6 +28,16 @@ KV cache, streaming, priorities, live weight swap) lives in
         req = srv.submit(prompt_tokens, max_new_tokens=32, priority=2)
         for tok in req.tokens():                     # streams live
             ...
+
+Fleet serving — a :class:`Router` fronting N decode replicas with
+per-tenant weighted-fair quotas, graceful drain, and transparent
+session failover on replica loss (:mod:`mxnet_tpu.serving.router` /
+:mod:`mxnet_tpu.serving.fleet`):
+
+    with serving.Router([srv_a, srv_b]) as router:
+        req = router.submit(prompt_tokens, tenant="acme")
+        for tok in req.tokens():     # survives a replica dying
+            ...
 """
 from .batcher import BucketLadder, pad_batch, slice_rows
 from .server import (InferenceServer, ServerOverloadedError,
@@ -35,9 +45,12 @@ from .server import (InferenceServer, ServerOverloadedError,
                      validate_priority)
 from .kvcache import KVCachePool
 from .decode import DecodeServer, DecodeRequest, ToyDecoderLM
+from .fleet import Replica, FleetMonitor
+from .router import Router, RouterRequest
 
 __all__ = ["InferenceServer", "BucketLadder", "pad_batch", "slice_rows",
            "ServerOverloadedError", "RequestTimeoutError",
            "ServerClosedError", "validate_priority",
            "KVCachePool", "DecodeServer", "DecodeRequest",
-           "ToyDecoderLM"]
+           "ToyDecoderLM", "Router", "RouterRequest", "Replica",
+           "FleetMonitor"]

@@ -195,14 +195,29 @@ class DecodeRequest:
 
     def _complete(self, error=None, state=None):
         """Finalize: the state is set BEFORE the event fires, so a
-        woken waiter can never observe a stale one."""
+        woken waiter can never observe a stale one. First caller wins
+        — a ``stop()`` racing the scheduler (or a degraded stop whose
+        wedged scheduler later retires the same request) must not
+        overwrite the terminal state. The ``_DONE`` sentinel ALWAYS
+        lands: on a full stream (unreachable by construction, but the
+        failure mode is a consumer hung forever on the bounded queue)
+        the oldest unconsumed token is dropped to make room — losing
+        a buffered token to deliver the terminal error beats hanging
+        ``tokens()``."""
+        if self._event.is_set():
+            return
         self._error = error
         self.state = state if state is not None \
             else ("failed" if error is not None else "done")
-        try:
-            self._stream.put_nowait(_DONE)
-        except _queue_mod.Full:
-            pass
+        while True:
+            try:
+                self._stream.put_nowait(_DONE)
+                break
+            except _queue_mod.Full:
+                try:
+                    self._stream.get_nowait()
+                except _queue_mod.Empty:
+                    pass
         self._event.set()
 
 
@@ -503,7 +518,15 @@ class DecodeServer:
     def stop(self, drain=True):
         """Stop the server. ``drain=True`` finishes every queued and
         active generation first; ``drain=False`` fails them with
-        ServerClosedError and reclaims their pages. Emits a final
+        ServerClosedError and reclaims their pages. Either way every
+        outstanding stream TERMINATES — a consumer blocked in
+        ``tokens()`` sees the stream end or the typed error, never a
+        hang: the scheduler join is bounded by
+        ``MXNET_DECODE_STOP_TIMEOUT_MS``, and a scheduler wedged past
+        it (a planned ``serve_decode`` hang, a stuck model call)
+        degrades the stop to the non-draining path so in-flight
+        requests still fail with ServerClosedError and their pages
+        come back through the counted reclaim. Emits a final
         ``decode`` telemetry record."""
         if self._closed:
             return
@@ -512,7 +535,17 @@ class DecodeServer:
             self._drain = drain
             self._cond.notify_all()
         if self._started:
-            self._thread.join()
+            join_s = max(
+                envs.get_int("MXNET_DECODE_STOP_TIMEOUT_MS"), 1) / 1e3
+            self._thread.join(join_s)
+            if self._thread.is_alive():
+                # wedged scheduler: it can no longer be trusted to
+                # retire work, so the typed-error path below does —
+                # _complete is first-wins, so the scheduler waking up
+                # later and retiring the same requests is benign
+                drain = False
+                with self._cond:
+                    self._drain = False
         elif drain:
             while self._has_work():
                 self._tick()

@@ -107,7 +107,8 @@ __all__ = ["PHASES", "enabled", "start", "stop", "reset", "maybe_start",
            "comm_span", "h2d", "note", "recent_rate", "sample_memory",
            "memory_breakdown", "flush", "report", "quick_stats",
            "percentile", "external_record", "checkpoint_event",
-           "serving_event", "decode_event", "bucketing_event",
+           "serving_event", "decode_event", "router_event",
+           "bucketing_event",
            "alert_event"]
 
 PHASES = ("data_wait", "compute", "optimizer", "sync", "checkpoint",
@@ -159,6 +160,8 @@ class _Run:
         self.serving = None          # latest cumulative serving stats
         self.decode = None           # per-server cumulative decode
                                      # (autoregressive serving) stats
+        self.router = None           # per-router cumulative fleet
+                                     # (dispatch/failover) stats
         self.bucketing = None        # per-producer cumulative bucketing
         self.alerts = None           # SLO-watchdog alert list (lazy,
         self.alerts_dropped = 0      # bounded to _MAX_ALERTS)
@@ -764,6 +767,32 @@ def decode_event(fields):
         _cap_records_locked(run)
 
 
+def router_event(fields):
+    """Append one cumulative ``router`` record from an
+    ``mxnet_tpu.serving.Router`` (dispatches, failovers and replayed
+    re-prefill tokens, detection-to-resume latency, per-replica
+    outstanding tokens, per-tenant quota/latency state — the router
+    emits one every ``MXNET_ROUTER_RECORD_EVERY`` active pump rounds
+    and at stop). Latest snapshot per router ``name`` lands in the
+    summary's ``router`` block. No-op without a run, so a routerless
+    process keeps a byte-identical sink."""
+    run = _run
+    if run is None:
+        return
+    rec = {"type": "router", "seq": run.steps,
+           "t": round(time.time() - run.t0_wall, 6)}
+    rec.update(fields)
+    with _lock:
+        if run.router is None:
+            run.router = {}
+        # cumulative per router name: latest wins
+        run.router[fields.get("name") or "default"] = dict(fields)
+        run.records.append(rec)
+        # a long-lived fleet front door in a stepless process must not
+        # grow records unboundedly
+        _cap_records_locked(run)
+
+
 def bucketing_event(fields):
     """Append one cumulative ``bucketing`` record from a shape-
     bucketing producer (``mxnet_tpu.bucketing`` — per-bucket batch
@@ -1025,6 +1054,9 @@ def report():
         if run.decode is not None:
             out["decode"] = {k: dict(v)
                              for k, v in run.decode.items()}
+        if run.router is not None:
+            out["router"] = {k: dict(v)
+                             for k, v in run.router.items()}
         if run.bucketing is not None:
             out["bucketing"] = {k: dict(v)
                                 for k, v in run.bucketing.items()}

@@ -142,7 +142,7 @@ def read_telemetry(path):
     MXNET_TELEMETRY_FILE) yields the LAST run."""
     out = {"run": None, "steps": [], "memory": [], "compiles": [],
            "utilization": [], "checkpoints": [], "serving": [],
-           "decode": [], "bucketing": [], "alerts": [],
+           "decode": [], "router": [], "bucketing": [], "alerts": [],
            "breakdown": None, "summary": None}
     skipped = 0
     with open(path) as f:
@@ -166,8 +166,9 @@ def read_telemetry(path):
                 out = {"run": rec, "steps": [], "memory": [],
                        "compiles": [], "utilization": [],
                        "checkpoints": [], "serving": [],
-                       "decode": [], "bucketing": [], "alerts": [],
-                       "breakdown": None, "summary": None}
+                       "decode": [], "router": [], "bucketing": [],
+                       "alerts": [], "breakdown": None,
+                       "summary": None}
                 skipped = 0     # earlier runs' damage is not THIS
                                 # run's — the warning describes the
                                 # run being rendered
@@ -187,6 +188,8 @@ def read_telemetry(path):
                 out["serving"].append(rec)
             elif kind == "decode":
                 out["decode"].append(rec)
+            elif kind == "router":
+                out["router"].append(rec)
             elif kind == "bucketing":
                 out["bucketing"].append(rec)
             elif kind == "alert":
@@ -525,6 +528,75 @@ def format_telemetry(tel):
                              % " ".join("p%s:%s" % kv_
                                         for kv_ in sorted(
                                             shed_pri.items())))
+
+    # -- fleet serving router (serving.router) --------------------------
+    rt_recs = tel.get("router") or []
+    # records are cumulative per router name: keep each name's last
+    rt = {}
+    for rec in rt_recs:
+        rt[rec.get("name") or "default"] = rec
+    if not rt:
+        rt = dict(summary.get("router") or {})
+    if rt:
+        lines.append("----------Router----------")
+        for name in sorted(rt):
+            r = rt[name]
+            lines.append("%-12s : %d session(s) (dispatched %d, "
+                         "completed %d, failed %d, cancelled %d, "
+                         "shed %d, timeout %d)"
+                         % (name[:12], r.get("requests", 0),
+                            r.get("dispatched", 0),
+                            r.get("completed", 0), r.get("failed", 0),
+                            r.get("cancelled", 0), r.get("shed", 0),
+                            r.get("timeouts", 0)))
+            reps = r.get("replicas") or []
+            if reps:
+                lines.append("  replicas   : %d up of %d — %s"
+                             % (r.get("replicas_up", 0), len(reps),
+                                " ".join(
+                                    "%s:%s(out %s)"
+                                    % (p.get("name", "?"),
+                                       p.get("state", "?"),
+                                       p.get("outstanding", 0))
+                                    for p in reps)))
+            lines.append("  failover   : %d replica(s) lost, %d "
+                         "session(s) re-homed, %d token(s) replayed "
+                         "by re-prefill"
+                         % (r.get("replicas_lost", 0),
+                            r.get("failovers", 0),
+                            r.get("replay_tokens", 0)))
+            res = r.get("failover_resume_ms") or {}
+            if res:
+                lines.append("  resume     : p50 %.3f ms  p99 %.3f ms "
+                             " max %.3f ms (loss detection -> first "
+                             "resumed token)"
+                             % (res.get("p50", 0.0),
+                                res.get("p99", 0.0),
+                                res.get("max", 0.0)))
+            if r.get("drains") or r.get("drain_timeouts"):
+                lines.append("  drains     : %d graceful (%d timed "
+                             "out into failover)"
+                             % (r.get("drains", 0),
+                                r.get("drain_timeouts", 0)))
+            for tname in sorted(r.get("tenants") or {}):
+                t = (r.get("tenants") or {})[tname]
+                lat = t.get("latency_ms") or {}
+                lines.append("  tenant %-5s: w=%s rate=%s — %d "
+                             "submitted, %d done, %d shed, %d "
+                             "throttle(s)%s"
+                             % (tname[:5], t.get("weight", 1.0),
+                                t.get("rate", 0.0) or "inf",
+                                t.get("submitted", 0),
+                                t.get("completed", 0),
+                                t.get("shed", 0),
+                                t.get("throttled", 0),
+                                ", p99 %.1f ms" % lat["p99"]
+                                if lat else ""))
+            if r.get("scale_up_signals") or r.get("scale_down_signals"):
+                lines.append("  autoscale  : %d scale-up signal(s), "
+                             "%d scale-down"
+                             % (r.get("scale_up_signals", 0),
+                                r.get("scale_down_signals", 0)))
 
     # -- SLO watchdog alerts (mxnet_tpu.livemetrics) --------------------
     alerts = tel.get("alerts") or []

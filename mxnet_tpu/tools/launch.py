@@ -51,7 +51,25 @@ import sys
 import tempfile
 import time
 
-__all__ = ["launch_local", "supervise", "main"]
+__all__ = ["launch_local", "supervise", "worker_contract", "main"]
+
+
+def worker_contract():
+    """This process's launcher worker contract, or ``None`` outside a
+    launched worker set: ``{"rank", "world", "uri", "port"}`` read
+    from the DMLC_* environment ``_spawn_workers`` sets. Serving
+    workers use it to name their router replica ``replica-<rank>`` so
+    the router, /metrics labels, and the supervisor's event log all
+    speak the same id."""
+    if os.environ.get("DMLC_ROLE") != "worker":
+        return None
+    try:
+        return {"rank": int(os.environ["DMLC_WORKER_ID"]),
+                "world": int(os.environ["DMLC_NUM_WORKER"]),
+                "uri": os.environ.get("DMLC_PS_ROOT_URI", "127.0.0.1"),
+                "port": int(os.environ.get("DMLC_PS_ROOT_PORT", 0))}
+    except (KeyError, ValueError):
+        return None
 
 
 def _free_port():

@@ -562,3 +562,62 @@ def test_decode_attention_registered_op():
         mx.nd.array(np.asarray(lens)))
     np.testing.assert_allclose(np.asarray(got.asnumpy()),
                                np.asarray(want), rtol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# stop() mid-stream: every outstanding stream terminates with the
+# typed error — never a hang — and pages come back counted
+# ---------------------------------------------------------------------------
+
+def test_stop_nodrain_midstream_types_out_stream_reclaims_pages():
+    """A streaming request whose server is stopped mid-stream must see
+    ``tokens()`` end in ServerClosedError after the already-streamed
+    prefix — never block forever — with its pages reclaimed through
+    the counted kv_evict path."""
+    model, params = _toy()
+    srv = DecodeServer(model, params, seq_ladder=[16], max_new_tokens=16,
+                       window=2, page_size=8, pool_pages=32,
+                       start=False)
+    req = srv.submit(np.arange(1, 8), max_new_tokens=16)
+    for _ in range(5):                       # prefill + a few tokens
+        srv._tick()
+    assert len(req.generated) >= 1 and not req.done()
+    streamed = [int(t) for t in req.generated]
+    srv.stop(drain=False)
+    got = []
+    with pytest.raises(serving.ServerClosedError, match=req.request_id):
+        for t in req.tokens(timeout=1):
+            got.append(int(t))
+    assert got == streamed                   # prefix intact, then typed
+    st = srv._pool.stats()
+    assert st["used"] == 0 and st["evicted"] >= 1
+
+
+def test_stop_with_wedged_scheduler_degrades_not_hangs(monkeypatch):
+    """stop(drain=True) against a scheduler wedged in a planned
+    serve_decode hang must not hang the caller: past
+    MXNET_DECODE_STOP_TIMEOUT_MS it degrades to the non-draining path
+    and the outstanding stream still fails typed."""
+    monkeypatch.setenv("MXNET_FAULT_HANG_SECONDS", "0.4")
+    monkeypatch.setenv("MXNET_DECODE_STOP_TIMEOUT_MS", "50")
+    model, params = _toy()
+    srv = DecodeServer(model, params, seq_ladder=[16], max_new_tokens=8,
+                       window=2, page_size=8, pool_pages=16)
+    fault.set_plan("serve_decode:step=1:hang:count=inf")
+    try:
+        req = srv.submit(np.arange(1, 6), max_new_tokens=8)
+        deadline = time.monotonic() + 5
+        while not fault.stats()["injected"].get("serve_decode"):
+            assert time.monotonic() < deadline, "hang never entered"
+            time.sleep(0.005)
+        t0 = time.monotonic()
+        srv.stop()                           # drain=True, but wedged
+        assert time.monotonic() - t0 < 0.35  # bounded, not 0.4s hang
+        with pytest.raises(serving.ServerClosedError,
+                           match=req.request_id):
+            req.result(timeout=1)
+    finally:
+        fault.set_plan(None)
+        if srv._thread is not None:          # let the sleeper retire
+            srv._thread.join(2)
+    assert srv._pool.stats()["used"] == 0    # pages reclaimed anyway
