@@ -2481,6 +2481,247 @@ def _multihost_record():
     return record
 
 
+def _bench_amp_case(steps=40, warmup=5, rounds=3, batch=64,
+                    in_units=256, hidden=1024, classes=10):
+    """bf16 AMP vs plain fp32 through the SAME gluon-Trainer fused
+    step (BENCH_r20, training half): a 3-layer MLP trained with the
+    multi-precision fused step — bf16 resident weights + fp32 masters
+    and in-program loss scaling — against the fp32 baseline. Rounds
+    are interleaved so host-load noise hits both modes symmetrically.
+
+    The acceptance surface is NOT a CPU speedup claim (host XLA often
+    emulates bf16 matmuls): it is zero ``fused_step_fallbacks``, ONE
+    trace for the whole run (loss scale rides the traced scalar
+    block), and the resident-weight byte split — bf16 weights are half
+    the fp32 footprint, which is the number a TPU capacity plan is
+    built on."""
+    import numpy as np_
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd, fault, gluon, profiler
+    from mxnet_tpu.amp import DtypePolicy
+
+    prior = os.environ.get("MXNET_FUSED_STEP")
+    os.environ["MXNET_FUSED_STEP"] = "1"
+    try:
+        rng = np_.random.RandomState(0)
+        x = rng.uniform(-1, 1, (batch, in_units)).astype(np_.float32)
+        y = rng.randint(0, classes, (batch,)).astype(np_.float32)
+        loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+
+        def build(policy):
+            net = gluon.nn.HybridSequential()
+            net.add(gluon.nn.Dense(hidden, activation="relu",
+                                   in_units=in_units))
+            net.add(gluon.nn.Dense(hidden, activation="relu",
+                                   in_units=hidden))
+            net.add(gluon.nn.Dense(classes, in_units=hidden))
+            net.initialize(mx.init.Xavier())
+            if policy is not None:
+                policy.apply(net)
+            net.hybridize()
+            trainer = gluon.Trainer(
+                net.collect_params(), "sgd",
+                {"learning_rate": 0.05, "momentum": 0.9,
+                 "multi_precision": policy is not None})
+            xb = mx.nd.array(x)
+            if policy is not None:
+                xb = xb.astype("bfloat16")
+            yb = mx.nd.array(y)
+
+            def step():
+                with autograd.record():
+                    out = net(xb)
+                    loss = loss_fn(out.astype("float32"), yb)
+                loss.backward()
+                trainer.step(batch)
+                return loss
+            return net, trainer, step
+
+        fb_before = profiler.counters().get("fused_step_fallbacks", 0)
+        built = {}
+        for mode, pol in (("fp32", None),
+                          ("bf16", DtypePolicy("bfloat16"))):
+            net, trainer, step = build(pol)
+            for _ in range(warmup):
+                loss = step()
+            loss.asnumpy()
+            built[mode] = (net, trainer, step)
+
+        best = {"fp32": 0.0, "bf16": 0.0}
+        for _ in range(rounds):
+            for mode in ("fp32", "bf16"):
+                _, _, step = built[mode]
+                t0 = time.perf_counter()
+                for _ in range(steps):
+                    loss = step()
+                loss.asnumpy()
+                dt = time.perf_counter() - t0
+                best[mode] = max(best[mode], steps / dt)
+
+        width = {"float64": 8, "float32": 4, "bfloat16": 2,
+                 "float16": 2}
+
+        def weight_bytes(net):
+            tot = 0
+            for p in net.collect_params().values():
+                d = p.data()
+                tot += int(np_.prod(d.shape)) \
+                    * width.get(str(d.dtype), 4)
+            return tot
+
+        fused = built["bf16"][1]._fused_updater
+        assert fused is not None, "mp fused path did not run"
+        b_fp32 = weight_bytes(built["fp32"][0])
+        b_bf16 = weight_bytes(built["bf16"][0])
+        return {
+            "net": "mlp %d-%d-%d-%d" % (in_units, hidden, hidden,
+                                        classes),
+            "batch": batch,
+            "optimizer": "sgd_momentum_mp",
+            "fp32_steps_per_sec": round(best["fp32"], 2),
+            "bf16_steps_per_sec": round(best["bf16"], 2),
+            "bf16_vs_fp32": round(best["bf16"] / best["fp32"], 3),
+            "fused_step_fallbacks":
+                profiler.counters().get("fused_step_fallbacks", 0)
+                - fb_before,
+            "bf16_traces": fused._trace_count,
+            "bf16_dispatches": fused.dispatch_count,
+            "loss_scale_final": float(fault.loss_scale()),
+            "resident_weight_bytes_fp32": b_fp32,
+            "resident_weight_bytes_bf16": b_bf16,
+            "weight_bytes_ratio": round(b_fp32 / b_bf16, 3),
+        }
+    finally:
+        if prior is None:
+            os.environ.pop("MXNET_FUSED_STEP", None)
+        else:
+            os.environ["MXNET_FUSED_STEP"] = prior
+
+
+def _amp_record():
+    """The AMP-training benchmark record (BENCH_r20.json, training
+    half): bf16 multi-precision fused step vs fp32 on the same MLP —
+    steps/sec, zero-fallback/one-trace oracle, resident weight
+    bytes. CPU backend."""
+    record = {"bench": "amp_fused_step", "platform": "cpu"}
+    try:
+        record.update(_bench_amp_case())
+    except Exception as exc:                     # noqa: BLE001
+        record["errors"] = {"amp": _err_str(exc)}
+    return record
+
+
+def _bench_int8_kv_case(fp32_pages=13, page_size=16, prompt_len=30,
+                        max_new=18):
+    """Decode stream capacity at a FIXED KV-pool byte budget
+    (BENCH_r20, serving half): the byte budget is what a fp32 pool of
+    ``fp32_pages`` pages costs; the int8 pool (``MXNET_KV_DTYPE=int8``,
+    per-page fp32 scales riding along) buys ~4x the pages for the same
+    bytes, so ~4x the concurrent streams. Each mode runs its full
+    analytic capacity — every stream live at once (window == capacity,
+    max_new > admission ramp) — and must finish with ZERO preemptions,
+    ZERO alloc failures, and the fixed-program oracle unchanged from
+    warmup (``compile_watch.site_stats``): quantize/dequantize live
+    INSIDE the compiled programs, so int8 adds no program-set or
+    steady-state-recompile cost."""
+    import numpy as np
+    from mxnet_tpu import compile_watch
+    from mxnet_tpu.serving import DecodeServer, ToyDecoderLM
+
+    compile_watch.enable()
+    n_layers, n_heads, head_dim = 2, 4, 16
+    model = ToyDecoderLM(vocab=128, n_layers=n_layers, n_heads=n_heads,
+                         head_dim=head_dim, max_len=128)
+    params = model.init_params(seed=0)
+
+    # pool byte budget, from the array shapes kvcache.py allocates:
+    # k + v planes of (L, P, S, H, D), plus (L, P) fp32 scale planes
+    # for the quantized pool
+    plane = n_layers * page_size * n_heads * head_dim * 2     # k + v
+    budget = fp32_pages * plane * 4
+    int8_page = plane + n_layers * 2 * 4          # int8 body + scales
+    int8_pages = budget // int8_page
+    pages_per_stream = -(-(prompt_len + max_new) // page_size)
+
+    def run(dtype, pool_pages):
+        prior = os.environ.get("MXNET_KV_DTYPE")
+        os.environ["MXNET_KV_DTYPE"] = dtype
+        try:
+            cap = (pool_pages - 1) // pages_per_stream
+            name = "kv_" + dtype
+            srv = DecodeServer(model, params, seq_ladder=[32],
+                               max_new_tokens=max_new, window=cap,
+                               page_size=page_size,
+                               pool_pages=pool_pages,
+                               max_queue=cap + 4, name=name)
+            srv.warmup()
+            warm = compile_watch.site_stats("decode:" + name)
+            rs = np.random.RandomState(7)
+            t0 = time.perf_counter()
+            reqs = [srv.submit(rs.randint(1, 128, size=prompt_len),
+                               max_new_tokens=max_new)
+                    for _ in range(cap)]
+            for r in reqs:
+                r.result(timeout=600)
+            wall = time.perf_counter() - t0
+            st = srv.stats()
+            steady = compile_watch.site_stats("decode:" + name)
+            srv.stop()
+            return {
+                "pool_pages": pool_pages,
+                "pool_bytes": pool_pages
+                * (plane * 4 if dtype == "float32" else int8_page),
+                "kv_dtype": st["kv"]["dtype"],
+                "max_concurrent_streams": cap,
+                "completed": st["completed"],
+                "preempted": st["preempted"],
+                "alloc_failures": st["kv"]["alloc_failures"],
+                "kv_peak_pages": st["kv"]["peak_used"],
+                "wall_s": round(wall, 3),
+                "tokens_per_sec": round(st["tokens_out"] / wall, 2),
+                "programs": {site: s["count"] for site, s in
+                             sorted((steady or {}).items())},
+                "zero_steady_state_recompiles": bool(steady == warm),
+            }
+        finally:
+            if prior is None:
+                os.environ.pop("MXNET_KV_DTYPE", None)
+            else:
+                os.environ["MXNET_KV_DTYPE"] = prior
+
+    out = {"page_size": page_size, "prompt_len": prompt_len,
+           "max_new_tokens": max_new,
+           "pages_per_stream": pages_per_stream,
+           "pool_byte_budget": budget,
+           "fp32": run("float32", fp32_pages),
+           "int8": run("int8", int8_pages)}
+    ratio = (out["int8"]["max_concurrent_streams"]
+             / out["fp32"]["max_concurrent_streams"])
+    out["stream_capacity_ratio"] = round(ratio, 2)
+    clean = all(
+        c["completed"] == c["max_concurrent_streams"]
+        and c["preempted"] == 0 and c["alloc_failures"] == 0
+        and c["zero_steady_state_recompiles"]
+        for c in (out["fp32"], out["int8"]))
+    out["meets_1p8x_at_same_bytes"] = bool(ratio >= 1.8 and clean)
+    compile_watch.disable()
+    return out
+
+
+def _int8_kv_record():
+    """The quantized-KV-cache benchmark record (BENCH_r20.json,
+    serving half): concurrent decode-stream capacity of a fp32 vs an
+    int8 paged KV pool at the SAME byte budget — the int8 pool must
+    carry >= 1.8x the streams with zero preemptions and zero
+    steady-state recompiles. CPU backend."""
+    record = {"bench": "int8_kv_capacity", "platform": "cpu"}
+    try:
+        record.update(_bench_int8_kv_case())
+    except Exception as exc:                     # noqa: BLE001
+        record["errors"] = {"int8_kv": _err_str(exc)}
+    return record
+
+
 def _err_str(exc):
     return "%s: %s" % (type(exc).__name__, str(exc)[:400])
 
@@ -2634,6 +2875,18 @@ if __name__ == "__main__":
                 flags + " --xla_force_host_platform_device_count=8"
             ).strip()
         print(json.dumps(_param_shard_record()))
+    elif "--amp" in sys.argv:
+        # CPU-friendly standalone mode: bf16 multi-precision fused
+        # step vs fp32 on the same MLP — zero-fallback/one-trace
+        # oracle + resident weight bytes, one JSON line (the training
+        # half of the BENCH_r20 artifact)
+        print(json.dumps(_amp_record()))
+    elif "--int8-kv" in sys.argv:
+        # CPU-friendly standalone mode: fp32 vs int8 paged-KV-pool
+        # decode stream capacity at the SAME byte budget (>= 1.8x
+        # streams, zero preemptions, fixed program set), one JSON line
+        # (the serving half of the BENCH_r20 artifact)
+        print(json.dumps(_int8_kv_record()))
     elif "--decode" in sys.argv:
         # CPU-friendly standalone mode: sequential prefill-then-decode
         # vs continuous batching over the paged-KV DecodeServer —

@@ -62,6 +62,7 @@ from . import initializer
 from . import initializer as init
 from . import optimizer
 from .optimizer import Optimizer
+from . import amp
 from . import lr_scheduler
 from . import metric
 from . import kvstore as kvstore_module

@@ -1,0 +1,205 @@
+"""Per-parameter dtype policy for mixed-precision (AMP) training.
+
+Reference capability: python/mxnet/contrib/amp — cast lists, fp32
+master weights, dynamic loss scaling. TPU-native shape: bf16 is the
+MXU's native matmul dtype, so the policy's compute dtype defaults to
+``bfloat16``; fp32 master weights and optimizer state live in the
+optimizer's multi-precision layout (``optimizer.py``), the fused train
+step runs the whole mixed-precision update inside its one donated
+program (``fused_step.py``), and dynamic loss scaling is the
+``scale_backoff`` non-finite guard policy (``fault.py``) — traced, so
+scale ticks never recompile.
+
+The policy itself is a *name-rule* table, deliberately the same
+ordered substring-override machinery as
+``parallel/sharding_rules.ShardingRules``: user overrides (first match
+wins) take precedence over role heuristics; normalization statistics
+and affine terms (``gamma``/``beta``/running stats/``norm``) stay
+float32 regardless — their dynamic range does not survive bf16 and
+they are noise-sized.
+
+Checkpoint contract: :func:`master_params` snapshots the exact fp32
+masters out of a Trainer's optimizer state, ``checkpoint.save_arrays``
+records ``policy.describe()`` in the manifest, and
+:func:`seed_masters` puts loaded masters back bit-exact under any
+resume policy (``checkpoint.restore_params(policy=...)`` casts the
+fp32 arrays to each parameter's resolved dtype).
+"""
+from __future__ import annotations
+
+from .base import MXNetError
+
+__all__ = ["DtypePolicy", "parse_rules", "master_params",
+           "seed_masters"]
+
+# name fragments that stay float32 under any compute dtype:
+# normalization statistics/affine terms lose too much precision in
+# bf16/fp16 and are tiny — the same role vocabulary as
+# sharding_rules._REPLICATED_ROLES minus bias/scale/alpha (dense-layer
+# biases follow the compute dtype so a layer's FC stays one-dtype;
+# force them fp32 with a 'bias=float32' rule if wanted)
+_FP32_ROLES = ("gamma", "beta", "moving_mean", "moving_var",
+               "running_mean", "running_var", "norm")
+
+_DTYPES = ("float32", "bfloat16", "float16")
+
+
+def _check_dtype(dt):
+    if dt not in _DTYPES:
+        raise MXNetError(
+            "amp: unknown policy dtype %r (one of %s)" % (dt, list(_DTYPES)))
+    return dt
+
+
+def parse_rules(spec):
+    """Parse the ``MXNET_AMP_RULES`` grammar —
+    ``'substring=dtype,substring=dtype'`` — into the ordered override
+    mapping :class:`DtypePolicy` takes (first match wins, like
+    ``ShardingRules.overrides``)."""
+    rules = {}
+    for part in (spec or "").split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if "=" not in part:
+            raise MXNetError(
+                "amp: bad rule %r (want 'substring=dtype')" % part)
+        pat, dt = part.split("=", 1)
+        rules[pat.strip()] = _check_dtype(dt.strip())
+    return rules
+
+
+class DtypePolicy:
+    """Resolve one storage/compute dtype per parameter name.
+
+    Precedence (mirrors ``ShardingRules``): ordered user overrides
+    (substring → dtype, first match wins) → fp32 role fragments
+    (norm stats/affine) → the policy's compute dtype. ``compute``
+    ``"float32"`` makes the policy an exact no-op — every name
+    resolves float32."""
+
+    def __init__(self, compute="bfloat16", rules=None):
+        self.compute = _check_dtype(compute)
+        self.rules = dict(rules or {})
+        for dt in self.rules.values():
+            _check_dtype(dt)
+
+    @classmethod
+    def from_env(cls):
+        """The ``MXNET_AMP_POLICY`` + ``MXNET_AMP_RULES`` knobs; None
+        when the policy env is unset/empty (AMP off)."""
+        from . import envs
+        compute = envs.get_str("MXNET_AMP_POLICY")
+        if not compute:
+            return None
+        return cls(compute=compute,
+                   rules=parse_rules(envs.get_str("MXNET_AMP_RULES")))
+
+    # -- resolution -------------------------------------------------------
+    def resolve(self, name):
+        """The policy dtype (a string) for one parameter name."""
+        for pat, dt in self.rules.items():
+            if pat in name:
+                return dt
+        low = name.lower()
+        if any(r in low for r in _FP32_ROLES):
+            return "float32"
+        return self.compute
+
+    def is_mixed(self):
+        return self.compute != "float32"
+
+    # -- application ------------------------------------------------------
+    def apply(self, block):
+        """Cast a gluon Block's parameters in place, each to its
+        resolved dtype (per-parameter ``Parameter.cast``, unlike the
+        all-or-nothing ``block.cast``). Returns the block."""
+        for p in block.collect_params().values():
+            p.cast(self.resolve(p.name))
+        return block
+
+    def cast_params(self, params):
+        """Module-path form: ``{name: NDArray}`` → a new dict with
+        every value cast to its resolved dtype (no-op values are
+        passed through untouched)."""
+        out = {}
+        for name, arr in params.items():
+            dt = self.resolve(name)
+            out[name] = arr if str(arr.dtype) == dt \
+                else arr.astype(dt)
+        return out
+
+    # -- manifest interchange ---------------------------------------------
+    def describe(self):
+        """The JSON-safe manifest record ``checkpoint.save_arrays``
+        embeds: compute dtype + the ordered rule list."""
+        return {"compute": self.compute,
+                "rules": [[p, d] for p, d in self.rules.items()]}
+
+    @classmethod
+    def from_describe(cls, meta):
+        """Inverse of :meth:`describe` (None for a None/absent
+        record — a checkpoint saved with no policy)."""
+        if not meta:
+            return None
+        return cls(compute=meta.get("compute", "float32"),
+                   rules=dict(meta.get("rules") or []))
+
+    def __repr__(self):
+        return "DtypePolicy(compute=%r, rules=%r)" % (self.compute,
+                                                      self.rules)
+
+
+# ---------------------------------------------------------------------------
+# fp32 master interchange with the optimizer state
+# ---------------------------------------------------------------------------
+
+def master_params(trainer):
+    """``{name: fp32 master NDArray}`` for every multi-precision
+    parameter of a gluon Trainer — the exact arrays the optimizer
+    steps, so checkpointing THESE (not the low-dtype casts) is what
+    makes cross-policy resume bit-exact. Parameters without a master
+    (fp32 weights, or no state yet) are simply absent."""
+    optimizer = trainer._optimizer
+    updater = trainer._updaters[0]
+    if trainer._fused_updater is not None:
+        trainer._fused_updater.export_states_to_updater()
+    out = {}
+    for i, p in enumerate(trainer._params):
+        state = updater.states.get(i)
+        if state is None or p._data is None:
+            continue
+        master = optimizer.master_from_state(p.data(), state)
+        if master is not None:
+            out[p.name] = master
+    return out
+
+
+def seed_masters(trainer, masters):
+    """Seed a Trainer's optimizer state with exact fp32 masters (the
+    resume half of :func:`master_params`): for each named parameter,
+    create the multi-precision state if absent and overwrite its
+    master copy bit-for-bit — the weight itself should already carry
+    the policy-cast value (``checkpoint.restore_params(policy=...)``).
+    Names without a low-precision multi-precision layout are ignored.
+    Returns the number of masters seeded."""
+    optimizer = trainer._optimizer
+    updater = trainer._updaters[0]
+    seeded = 0
+    for i, p in enumerate(trainer._params):
+        m = masters.get(p.name)
+        if m is None or p._data is None:
+            continue
+        if i not in updater.states:
+            updater.states[i] = \
+                optimizer.create_state_multi_precision(i, p.data())
+            updater.states_synced[i] = True
+        master = optimizer.master_from_state(p.data(),
+                                             updater.states[i])
+        if master is None:
+            continue
+        master[:] = m.astype("float32")
+        seeded += 1
+    if trainer._fused_updater is not None:
+        trainer._fused_updater.invalidate_sync()
+    return seeded

@@ -83,7 +83,7 @@ from .base import MXNetError
 __all__ = ["CheckpointManager", "async_checkpoint_enabled",
            "manifest_path", "load_manifest", "validate_manifest",
            "latest_manifest_epoch", "load_arrays", "load_param_arrays",
-           "restore_params", "save_arrays",
+           "restore_params", "save_arrays", "saved_dtype_policy",
            "atomic_write_file", "write_bytes_async", "flush_async_writes"]
 
 _PIECE_SEP = "::piece"       # shard-file key suffix for partial pieces
@@ -385,7 +385,8 @@ def _process_topology():
         return 0, 1
 
 
-def save_arrays(prefix, epoch, flat, states_bytes=None, symbol=None):
+def save_arrays(prefix, epoch, flat, states_bytes=None, symbol=None,
+                meta=None):
     """Write one sharded checkpoint: shard files first, manifest last.
 
     ``flat`` is a :func:`snapshot_params` roster. Returns the stats
@@ -393,6 +394,12 @@ def save_arrays(prefix, epoch, flat, states_bytes=None, symbol=None):
     planned ``ckpt_write``/``ckpt_fsync`` faults) — the caller decides
     whether that is fatal; the manifest is only ever written after
     every shard it references landed and fsynced.
+
+    ``meta`` is an optional JSON-safe dict recorded verbatim under the
+    manifest's ``meta`` key — the AMP dtype policy rides here as
+    ``{"dtype_policy": policy.describe()}`` so a checkpoint knows what
+    precision it was trained under (loaders that predate the key
+    ignore it; the manifest format is unchanged).
 
     **Multi-process jobs** (a jax.distributed group; every rank calls
     this — SPMD discipline): each rank durably writes the shard files
@@ -491,6 +498,8 @@ def save_arrays(prefix, epoch, flat, states_bytes=None, symbol=None):
         manifest["processes"] = world
     if states_entry is not None:
         manifest["optimizer_states"] = states_entry
+    if meta:
+        manifest["meta"] = dict(meta)
     atomic_write_file(manifest_path(prefix, epoch),
                       json.dumps(manifest, sort_keys=True).encode())
     t_end = time.perf_counter()
@@ -589,6 +598,19 @@ def validate_manifest(prefix, epoch, manifest=None):
     return manifest
 
 
+def _restore_dtype(arr, entry):
+    """Give a shard-file array back its manifest dtype: npz preserves
+    extension dtypes (bf16/fp16 low-precision params) only as raw void
+    bytes, so a loaded ``|V2`` buffer is re-viewed as the dtype the
+    layout recorded — a zero-copy reinterpretation, bit-exact."""
+    want = entry.get("dtype")
+    if not want or str(arr.dtype) == want:
+        return arr
+    dt = _np.dtype(want)
+    return arr.view(dt) if arr.dtype.itemsize == dt.itemsize \
+        else arr.astype(dt)
+
+
 def load_arrays(prefix, epoch, validate=True):
     """Load a manifest checkpoint back into a flat ``{'arg:name':
     NDArray}`` host dict, re-assembling sharded entries from their
@@ -613,13 +635,15 @@ def load_arrays(prefix, epoch, validate=True):
     for key, entry in manifest["params"].items():
         pieces = entry["pieces"]
         if len(pieces) == 1 and pieces[0]["index"] is None:
-            whole[key] = shard_data[pieces[0]["shard"]][pieces[0]["key"]]
+            whole[key] = _restore_dtype(
+                shard_data[pieces[0]["shard"]][pieces[0]["key"]], entry)
             continue
         full = _np.empty(tuple(entry["shape"]),
                          _np.dtype(entry["dtype"]))
         for p in pieces:
             ix = tuple(slice(a, b) for a, b in p["index"])
-            full[ix] = shard_data[p["shard"]][p["key"]]
+            full[ix] = _restore_dtype(shard_data[p["shard"]][p["key"]],
+                                      entry)
         out[key] = nd.array(full)
     out.update(_unflatten(whole))
     return out
@@ -642,7 +666,19 @@ def load_param_arrays(prefix, epoch, validate=True):
     return out
 
 
-def restore_params(prefix, epoch, mesh=None, rules=None, validate=True):
+def saved_dtype_policy(prefix, epoch):
+    """The :class:`~mxnet_tpu.amp.DtypePolicy` a manifest checkpoint
+    was saved under (the ``meta.dtype_policy`` record), or None for a
+    checkpoint saved without one — pre-AMP manifests and plain fp32
+    runs look identical here."""
+    from .amp import DtypePolicy
+    manifest = load_manifest(prefix, epoch)
+    meta = (manifest or {}).get("meta") or {}
+    return DtypePolicy.from_describe(meta.get("dtype_policy"))
+
+
+def restore_params(prefix, epoch, mesh=None, rules=None, validate=True,
+                   policy=None):
     """Elastic resume: load ``(arg_params, aux_params)`` from a
     manifest checkpoint and, when ``mesh`` is given, re-place every
     parameter against the *current* mesh via ``jax.device_put`` with
@@ -650,12 +686,26 @@ def restore_params(prefix, epoch, mesh=None, rules=None, validate=True):
     ``rules`` maps name substrings to PartitionSpecs, default
     replicated). The save-time topology is irrelevant — values are
     re-assembled on the host first, so a 1-device save resumes sharded
-    on N devices and vice versa."""
+    on N devices and vice versa.
+
+    ``policy`` casts every parameter to its per-name resolved dtype on
+    the host, BEFORE placement: pass an ``amp.DtypePolicy`` to resume
+    under that policy (an AMP checkpoint stores fp32 masters, so any
+    resume precision is a cast of the exact master — bit-identical
+    wherever dtypes agree), or the string ``"manifest"`` to re-adopt
+    whatever policy the checkpoint was saved under (a no-op when none
+    was recorded). The save-time and resume-time policies are fully
+    decoupled: bf16-trained checkpoints resume fp32 and vice versa."""
     flat = load_arrays(prefix, epoch, validate=validate)
     arg_params, aux_params = {}, {}
     for k, v in flat.items():
         tp, name = k.split(":", 1)
         (arg_params if tp == "arg" else aux_params)[name] = v
+    if policy == "manifest":
+        policy = saved_dtype_policy(prefix, epoch)
+    if policy is not None:
+        arg_params = policy.cast_params(arg_params)
+        aux_params = policy.cast_params(aux_params)
     if mesh is not None:
         from .parallel.data_parallel import shard_params
         arg_params = shard_params(arg_params, mesh, rules=rules)
@@ -682,10 +732,11 @@ class CheckpointManager:
     untouched — checkpointing never kills the run it protects."""
 
     def __init__(self, prefix, symbol=None, async_=None, inflight=None,
-                 logger=None):
+                 logger=None, meta=None):
         self.prefix = prefix
         self._symbol = symbol
         self._symbol_saved = False
+        self.meta = dict(meta) if meta else None
         self.async_ = async_checkpoint_enabled() if async_ is None \
             else bool(async_)
         depth = inflight if inflight is not None \
@@ -805,7 +856,8 @@ class CheckpointManager:
         try:
             self._symbol_once()
             stats = save_arrays(self.prefix, epoch, flat,
-                                states_bytes=states_bytes)
+                                states_bytes=states_bytes,
+                                meta=self.meta)
             rec.update(stats, ok=True)
             with self._lock:
                 self.saves += 1

@@ -37,6 +37,11 @@ module answers the two questions a TPU-native stack lives or dies by:
    peak table (built-in numbers for TPU generations, a placeholder for
    CPU, both overridable via ``MXNET_DEVICE_PEAK_FLOPS`` /
    ``MXNET_DEVICE_PEAK_BW`` — per-device values in FLOP/s and bytes/s).
+   The peak is **dtype-aware**: each compiled program's flops are
+   normalized by its compute dtype's ``PEAK_DTYPE_FACTOR`` (narrowest
+   float in the argument signature — fp32 at half the bf16 MXU rate,
+   int8 at double), so AMP, fp32, and int8 programs all report MFU
+   against the peak they could actually reach.
 
 Everything flows into the active telemetry run: ``compile`` and
 ``utilization`` JSONL record kinds, plus ``compile``/``utilization``
@@ -74,8 +79,8 @@ from . import compile_cache, envs
 
 __all__ = ["enabled", "enable", "disable", "reset", "maybe_enable",
            "jit", "stats", "site_stats", "recent_mfu", "peak_table",
-           "describe_arrays", "step_reset", "run_reset",
-           "WatchedFunction"]
+           "dtype_peak_factor", "describe_arrays", "step_reset",
+           "run_reset", "WatchedFunction"]
 
 _lock = threading.Lock()
 _watch = None          # the active _Watch; module-global None check
@@ -103,6 +108,55 @@ PEAK_BW = {
     "TPU v6 lite": 1638e9, "TPU v6e": 1638e9,
     "cpu": 50e9,
 }
+
+# Relative achievable peak by COMPUTE dtype, against the tables' bf16
+# MXU numbers: fp32 matmuls run as multi-pass bf16 on the MXU (half
+# rate as the documented convention here), fp64 is emulated, and int8
+# rides the double-rate path newer generations expose. A program's
+# compute dtype is the NARROWEST float in its argument signature —
+# a mixed-precision program's matmuls run in its low dtype while the
+# fp32 master weights ride along element-wise (int8 only when no
+# float argument exists: a quantized graph's range scalars ride fp32
+# and must not mask wider compute). MFU is normalized per program by
+# this factor, so one bf16 AMP step and one fp32 step of the same
+# model report comparable utilization instead of the fp32 run
+# appearing to waste half the hardware it never had.
+PEAK_DTYPE_FACTOR = {
+    "float64": 0.25, "float32": 0.5,
+    "float16": 1.0, "bfloat16": 1.0,
+    "int8": 2.0,
+}
+
+
+def dtype_peak_factor(dtype):
+    """The per-dtype peak factor the MFU math uses (1.0 for unknown
+    dtypes). Importable by benchmarks — one dtype convention tree-wide."""
+    return PEAK_DTYPE_FACTOR.get(str(dtype), 1.0)
+
+
+_DTYPE_WIDTH = {"float64": 3, "float32": 2, "bfloat16": 1,
+                "float16": 1}
+
+
+def _key_compute_dtype(key):
+    """The compute dtype of one argument-signature key: the narrowest
+    float among array leaves, else int8 when only int8 arrays flow,
+    else None (integer-only programs run no MXU math worth scaling)."""
+    narrowest = None
+    saw_int8 = False
+    for sig in key:
+        if len(sig) != 4 or not isinstance(sig[1], str):
+            continue                   # python-scalar leaf
+        dt = sig[1]
+        if dt == "int8":
+            saw_int8 = True
+        elif dt in _DTYPE_WIDTH and (
+                narrowest is None
+                or _DTYPE_WIDTH[dt] < _DTYPE_WIDTH[narrowest]):
+            narrowest = dt
+    if narrowest is not None:
+        return narrowest
+    return "int8" if saw_int8 else None
 
 
 def _lookup_peak(table, kind, platform):
@@ -164,6 +218,7 @@ class _Watch:
         self.dispatches = 0     # watched compiled-call executions
         # current-step accumulators, drained by the telemetry step hook
         self.step_flops = 0.0
+        self.step_flops_norm = 0.0   # dtype-factor-normalized flops
         self.step_bytes = 0.0
         self.step_dispatches = 0
         self.step_compiles = 0
@@ -486,7 +541,8 @@ class WatchedFunction:
                 return self._jitted(*args)
         out = entry["fn"](*args)
         if w is not None:
-            _accrue(w, entry["flops"], entry["bytes"])
+            _accrue(w, entry["flops"], entry["flops_norm"],
+                    entry["bytes"])
         return out
 
     def _compile(self, w, key, args):
@@ -544,7 +600,10 @@ class WatchedFunction:
                     else _default_describe(args)
             except Exception:
                 desc = _default_describe(args)
-            entry = {"fn": compiled, "flops": flops, "bytes": nbytes}
+            cdtype = _key_compute_dtype(key)
+            factor = dtype_peak_factor(cdtype) if cdtype else 1.0
+            entry = {"fn": compiled, "flops": flops, "bytes": nbytes,
+                     "flops_norm": flops / factor, "dtype": cdtype}
             self._cache[key] = entry
         if w is None:
             # cache-only mode (no watch): the disk counters already
@@ -557,6 +616,8 @@ class WatchedFunction:
             event = _record_compile(w, self._site, self._statics,
                                     self._storm, dur, desc, flops,
                                     nbytes, mem)
+            if cdtype is not None:
+                event["compute_dtype"] = cdtype
             if ckey is not None:
                 event["cache"] = "miss"
             if self._counter:
@@ -598,7 +659,7 @@ def jit(fn, site, describe=None, counter=None, statics=None,
 # accounting
 # ---------------------------------------------------------------------------
 
-def _accrue(w, flops, nbytes):
+def _accrue(w, flops, flops_norm, nbytes):
     # run totals accrue at the step boundary (the probe), not here, so
     # they mean "work attributed to this run's steps" — backlog dropped
     # by step_reset() never counts
@@ -606,6 +667,7 @@ def _accrue(w, flops, nbytes):
         w.dispatches += 1
         w.step_dispatches += 1
         w.step_flops += flops
+        w.step_flops_norm += flops_norm
         w.step_bytes += nbytes
 
 
@@ -728,6 +790,7 @@ def step_reset():
         return
     with _lock:
         w.step_flops = 0.0
+        w.step_flops_norm = 0.0
         w.step_bytes = 0.0
         w.step_dispatches = 0
         w.step_compiles = 0
@@ -750,6 +813,7 @@ def run_reset():
         w.total_flops = 0.0
         w.total_bytes = 0.0
         w.step_flops = 0.0
+        w.step_flops_norm = 0.0
         w.step_bytes = 0.0
         w.step_dispatches = 0
         w.step_compiles = 0
@@ -766,11 +830,13 @@ def _step_probe(step_seq, dur_s):
         return None
     with _lock:
         flops = w.step_flops
+        flops_norm = w.step_flops_norm
         nbytes = w.step_bytes
         dispatches = w.step_dispatches
         compiles = w.step_compiles
         compile_s = w.step_compile_s
         w.step_flops = 0.0
+        w.step_flops_norm = 0.0
         w.step_bytes = 0.0
         w.step_dispatches = 0
         w.step_compiles = 0
@@ -781,8 +847,14 @@ def _step_probe(step_seq, dur_s):
         w.total_bytes += nbytes
         rec = {"dispatches": dispatches}
         if dur_s > 0 and flops:
-            mfu = flops / (dur_s * w.peak_flops * w.n_devices)
+            # normalized flops measure each program against ITS
+            # dtype's achievable peak (PEAK_DTYPE_FACTOR): a pure-bf16
+            # step divides by the full table peak, a pure-fp32 step by
+            # half of it, a mixed step by the flop-weighted blend
+            mfu = flops_norm / (dur_s * w.peak_flops * w.n_devices)
             rec["flops"] = flops
+            if flops_norm != flops:
+                rec["flops_norm"] = flops_norm
             # 6 SIGNIFICANT digits: CPU-scale MFUs live around 1e-5,
             # where fixed decimal rounding would destroy the value
             rec["mfu"] = float("%.6g" % mfu)

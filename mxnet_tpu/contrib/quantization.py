@@ -20,7 +20,8 @@ from ..base import MXNetError
 from .. import ndarray as nd
 from .. import symbol as sym_mod
 
-__all__ = ["quantize_model", "quantize_symbol", "calib_graph"]
+__all__ = ["quantize_model", "quantize_symbol", "calib_graph",
+           "calibrate_ranges"]
 
 _QUANTIZABLE = {"FullyConnected", "Convolution"}
 
@@ -79,6 +80,19 @@ def _collect_layer_ranges(symbol, arg_params, aux_params, ctx,
     if hasattr(calib_data, "reset"):
         calib_data.reset()
     return ranges
+
+
+def calibrate_ranges(symbol, arg_params, aux_params, calib_data,
+                     num_calib_batches=None, data_name="data"):
+    """Naive calibration as a standalone step: run ``calib_data``
+    batches through ``symbol`` eagerly and return the per-node
+    ``{name: (min, max)}`` ranges of every quantizable node's output —
+    the dict :func:`quantize_symbol` bakes into requantize nodes and
+    ``deploy.export_compiled(quantize=True)`` records in the format-3
+    artifact meta."""
+    return _collect_layer_ranges(symbol, arg_params, aux_params, None,
+                                 calib_data, num_calib_batches,
+                                 data_name)
 
 
 def quantize_symbol(symbol, excluded_symbols=(), offline_params=(),

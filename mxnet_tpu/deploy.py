@@ -33,6 +33,20 @@ Artifact format 2 (written by default; format-1 files still load):
 The on-disk layout stays backward compatible: MAGIC + meta length +
 meta JSON + the program blobs back to back (format 1 readers of a
 single-program format-2 file see exactly the old layout).
+
+Artifact format 3 (``export_compiled(..., quantize=True)``): the
+exported programs run the INT8 graph — ``contrib.quantization``
+calibrates per-node ranges on ``calib_data`` (naive min/max), rewrites
+eligible FullyConnected/Convolution nodes into
+quantize→quantized_op→requantize→dequantize chains over
+``ops.quantization`` (int8×int8→int32 on the MXU), and the meta's
+``quantization`` block records the calibration ranges plus the
+measured accuracy delta: export replays the calibration batches
+through BOTH graphs and stores ``max_abs_delta`` — pass
+``max_output_delta`` to make export FAIL when quantization moved any
+output element further than tolerated (the accuracy-delta oracle).
+Format 1/2 artifacts load unchanged; format-3 files read as format 2
+plus the extra meta block.
 """
 from __future__ import annotations
 
@@ -119,8 +133,43 @@ def check_cast_dtype(name, arr, dtype_str, who="Predictor"):
     return arr
 
 
+def _batch_arrays(batch):
+    """Numpy data arrays of one calibration batch (DataBatch-style
+    ``.data`` list, or a bare array)."""
+    datas = batch.data if hasattr(batch, "data") else [batch]
+    return [_np.asarray(d.asnumpy() if hasattr(d, "asnumpy") else d)
+            for d in datas]
+
+
+def _max_output_delta(fp32_fn, q_fn, calib_data, num_calib_batches,
+                      n_inputs):
+    """Replay calibration batches through both graphs; the largest
+    absolute elementwise output difference is the artifact's recorded
+    quantization accuracy delta."""
+    delta, batches = 0.0, 0
+    for batch in calib_data:
+        xs = _batch_arrays(batch)[:n_inputs]
+        ref = fp32_fn(*xs)
+        got = q_fn(*xs)
+        ref = ref if isinstance(ref, tuple) else (ref,)
+        got = got if isinstance(got, tuple) else (got,)
+        for r, g in zip(ref, got):
+            d = _np.max(_np.abs(_np.asarray(g, _np.float32)
+                                - _np.asarray(r, _np.float32)))
+            delta = max(delta, float(d))
+        batches += 1
+        if num_calib_batches and batches >= num_calib_batches:
+            break
+    if hasattr(calib_data, "reset"):
+        calib_data.reset()
+    return delta, batches
+
+
 def export_compiled(model, path, input_shapes, params=None,
-                    aux_params=None, dtype="float32", batch_sizes=None):
+                    aux_params=None, dtype="float32", batch_sizes=None,
+                    quantize=False, calib_data=None,
+                    num_calib_batches=None, excluded_sym_names=(),
+                    max_output_delta=None):
     """Serialize ``model`` (a hybridized Gluon block, or a Symbol plus
     ``params``/``aux_params`` dicts) into one portable StableHLO file.
     Parameters are baked in as constants — the artifact is fully
@@ -129,7 +178,19 @@ def export_compiled(model, path, input_shapes, params=None,
     ``batch_sizes`` (optional) exports one program per bucket batch
     size — a multi-signature artifact whose leading input dim is each
     bucket in turn (the serving bucket ladder). Without it, one
-    program with exactly ``input_shapes`` is exported."""
+    program with exactly ``input_shapes`` is exported.
+
+    ``quantize=True`` writes a **format-3 int8 artifact**: the graph
+    is calibrated on ``calib_data`` (required; naive min/max over
+    ``num_calib_batches``), rewritten through
+    ``contrib.quantization.quantize_symbol`` (int8 MXU compute with
+    per-node calibrated requantize ranges; ``excluded_sym_names``
+    opts nodes out), and the exported programs ARE the quantized
+    graph. The meta's ``quantization`` block records the ranges and
+    the measured ``max_abs_delta`` between fp32 and int8 outputs over
+    the calibration batches; with ``max_output_delta`` set, export
+    raises :class:`MXNetError` instead of silently shipping an
+    artifact whose quantization error exceeds the tolerance."""
     import jax
     from jax import export as jexport
     from . import symbol as sym_mod
@@ -155,6 +216,48 @@ def export_compiled(model, path, input_shapes, params=None,
 
     forward, data_names = _graph_fn(symbol, arg_params, aux,
                                     input_shapes, dtype)
+    quant_meta = None
+    if quantize:
+        from .contrib import quantization as _quant
+        if calib_data is None:
+            raise MXNetError(
+                "export_compiled: quantize=True requires calib_data "
+                "(a re-iterable batch source) for range calibration "
+                "and the accuracy-delta oracle")
+        ranges = _quant.calibrate_ranges(
+            symbol, arg_params, aux, calib_data,
+            num_calib_batches=num_calib_batches,
+            data_name=data_names[0])
+        qsym = _quant.quantize_symbol(
+            symbol, excluded_symbols=set(excluded_sym_names),
+            calib_ranges=ranges)
+        q_forward, q_names = _graph_fn(qsym, arg_params, aux,
+                                       input_shapes, dtype)
+        if q_names != data_names:
+            raise MXNetError(
+                "export_compiled: quantized graph changed the data "
+                "inputs %s -> %s" % (data_names, q_names))
+        delta, batches = _max_output_delta(
+            jax.jit(forward), jax.jit(q_forward), calib_data,
+            num_calib_batches, len(data_names))
+        if max_output_delta is not None and delta > max_output_delta:
+            raise MXNetError(
+                "export_compiled: int8 quantization moved an output "
+                "element by %.6g — beyond the max_output_delta %.6g "
+                "tolerance; widen the tolerance, exclude the worst "
+                "layers (excluded_sym_names), or calibrate on more "
+                "representative data" % (delta, max_output_delta))
+        quant_meta = {
+            "dtype": "int8",
+            "calib_mode": "naive",
+            "calib_batches": batches,
+            "ranges": {n: [float(lo), float(hi)]
+                       for n, (lo, hi) in sorted(ranges.items())},
+            "excluded": sorted(excluded_sym_names),
+            "max_abs_delta": delta,
+            "tolerance": max_output_delta,
+        }
+        forward = q_forward
     jitted = jax.jit(forward)
     if batch_sizes is not None:
         buckets = sorted({int(b) for b in batch_sizes})
@@ -174,7 +277,7 @@ def export_compiled(model, path, input_shapes, params=None,
         programs.append((int(b), exported))
     blobs = [e.serialize() for _, e in programs]
     meta = {
-        "format": 2,
+        "format": 3 if quant_meta else 2,
         "inputs": [{"name": n, "shape": list(input_shapes[n]),
                     "dtype": str(dtype)} for n in data_names],
         "outputs": _out_meta(programs[0][1]),
@@ -183,6 +286,8 @@ def export_compiled(model, path, input_shapes, params=None,
                      for (b, e), blob in zip(programs, blobs)],
         "framework": "mxnet_tpu",
     }
+    if quant_meta:
+        meta["quantization"] = quant_meta
     meta_bytes = json.dumps(meta).encode()
     # atomic_write_bytes (tmp + os.replace): a preempted export must
     # leave any previous artifact intact, never a truncated one a
@@ -226,6 +331,13 @@ class Predictor:
         """Recorded output shapes/dtypes (format 2; None on format-1
         artifacts that predate the field)."""
         return self.meta.get("outputs")
+
+    @property
+    def quantization(self):
+        """The format-3 quantization block — calibration ranges,
+        measured ``max_abs_delta``, exclusions — or None on an fp32
+        artifact."""
+        return self.meta.get("quantization")
 
     # -- validation --------------------------------------------------------
     def _validate(self, arrays):
@@ -318,8 +430,10 @@ class Predictor:
 
 
 def load_compiled(path):
-    """Load an ``export_compiled`` artifact (format 1 or 2). Needs
-    only jax — not the framework's model code or parameter files."""
+    """Load an ``export_compiled`` artifact (format 1, 2, or 3 — a
+    format-3 file reads as format 2 whose programs happen to run the
+    int8 graph). Needs only jax — not the framework's model code or
+    parameter files."""
     import hashlib
 
     from jax import export as jexport

@@ -208,6 +208,12 @@ declare("MXNET_LOSS_SCALE", "float", 2.0 ** 15,
         "Initial loss scale for the scale_backoff guard.", _G)
 declare("MXNET_LOSS_SCALE_WINDOW", "int", 2000,
         "Good steps between loss-scale growth attempts.", _G)
+declare("MXNET_AMP_POLICY", "str", "",
+        "Default AMP compute dtype for amp.DtypePolicy.from_env: "
+        "bfloat16 | float16 | empty (off).", _G)
+declare("MXNET_AMP_RULES", "str", "",
+        "Ordered per-parameter dtype overrides for the AMP policy, "
+        "'substring=dtype,...' — first match wins (see amp.py).", _G)
 declare("MXNET_KVSTORE_TIMEOUT", "float", 60.0,
         "Seconds a collective may retry before "
         "CollectiveTimeoutError.", _G)
@@ -292,6 +298,10 @@ declare("MXNET_KV_PAGE_SIZE", "int", 16,
 declare("MXNET_KV_POOL_PAGES", "int", 256,
         "Total pages in the decode KV-cache pool (page 0 is the "
         "reserved dump page).", _G)
+declare("MXNET_KV_DTYPE", "str", "float32",
+        "Storage dtype of the paged KV-cache pool: float32 | "
+        "bfloat16 | int8 (int8 adds per-page scales and dequantizes "
+        "on gather).", _G)
 declare("MXNET_DECODE_WINDOW", "int", 8,
         "Concurrent decode slots of the continuous batcher (the "
         "decode step's fixed batch size).", _G)

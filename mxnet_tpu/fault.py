@@ -540,11 +540,23 @@ def loss_scale():
     return _loss_scale_val
 
 
+def _emit_scale_record(prev, cur, cause):
+    """One ``loss_scale`` telemetry record per scale CHANGE — the
+    trajectory ``tools.diagnose`` renders (a healthy AMP run shows a
+    few early backoffs then a slow regrow staircase; a run whose scale
+    pins at 1.0 has a numerics problem, not an overflow problem)."""
+    from . import telemetry
+    telemetry.external_record({"type": "loss_scale", "prev": prev,
+                               "scale": cur, "cause": cause})
+
+
 def _backoff_scale():
     global _loss_scale_val, _good_steps
     prev = loss_scale()
     _loss_scale_val = max(prev * 0.5, 1.0)
     _good_steps = 0
+    if _loss_scale_val != prev:
+        _emit_scale_record(prev, _loss_scale_val, "backoff")
     return prev, _loss_scale_val
 
 
@@ -566,8 +578,11 @@ def _close_step():
     _good_steps += 1
     window = envs.get_int("MXNET_LOSS_SCALE_WINDOW")
     if _good_steps >= window:
-        _loss_scale_val = min(loss_scale() * 2.0, _LOSS_SCALE_MAX)
+        prev = loss_scale()
+        _loss_scale_val = min(prev * 2.0, _LOSS_SCALE_MAX)
         _good_steps = 0
+        if _loss_scale_val != prev:
+            _emit_scale_record(prev, _loss_scale_val, "regrow")
 
 
 def _note_step_boundary(index):

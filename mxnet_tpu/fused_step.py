@@ -38,9 +38,13 @@ faults are spliced in as per-parameter poison scalars
 Fallback matrix (→ eager loop, counted in
 ``profiler.counters()['fused_step_fallbacks']``): ``MXNET_FUSED_STEP=0``,
 sparse (row_sparse) gradients, kvstore-hosted or dist updates,
-multi-precision low-dtype weights, optimizers without a
-``fused_step_fn``, monitors/``inputs_need_grad``/``grad_req='add'`` on
-the Module path, and multi-device (mesh) binds.
+optimizers without a ``fused_step_fn``,
+monitors/``inputs_need_grad``/``grad_req='add'`` on the Module path,
+and multi-device (mesh) binds. Multi-precision low-dtype weights are
+NOT a fallback: SGD/Adam/AdaGrad/RMSProp ship mp step fns (f32 master
+math inside the donated program, ``scalar_dtype``-marked so traced
+scalars stay f32), with in-program dynamic loss scaling on the Module
+path fused into the non-finite guard's scale-backoff policy.
 
 Donation caveat: after a fused step the OLD parameter buffers are
 donated to XLA. NDArray handles tracked by the executor/trainer are
@@ -102,36 +106,48 @@ def _sig(arrays):
 
 def pack_step_scalars(optimizer, indices):
     """The per-step scalar block as ONE host f32 vector
-    ``[lr_0..lr_n-1, wd_0..wd_n-1, rescale]`` — handed to the compiled
-    call as a plain numpy array so pjit's own argument path does the
-    single transfer. LR schedules, per-param multipliers, and
-    loss-scale-driven rescale changes tick per step WITHOUT
-    recompiling. Advances the optimizer's update counters exactly like
-    the eager ``_step_inputs``. Shared by the fused executors here and
-    ``parallel.data_parallel.DistributedTrainer``."""
+    ``[lr_0..lr_n-1, wd_0..wd_n-1, rescale, loss_scale]`` — handed to
+    the compiled call as a plain numpy array so pjit's own argument
+    path does the single transfer. LR schedules, per-param
+    multipliers, rescale changes AND dynamic loss-scale ticks
+    (slot ``2n+1``, read by the in-program AMP loss scaling) land per
+    step WITHOUT recompiling. Advances the optimizer's update counters
+    exactly like the eager ``_step_inputs``. Shared by the fused
+    executors here and ``parallel.data_parallel.DistributedTrainer``
+    (which, like the bucketed apply, reads only slots ``..2n``)."""
+    from . import fault
     n = len(indices)
-    block = _np.empty((2 * n + 1,), _np.float32)
+    block = _np.empty((2 * n + 2,), _np.float32)
     for k, i in enumerate(indices):
         lr, wd = optimizer.fused_step_scalars(i)
         block[k] = lr
         block[n + k] = wd
     block[2 * n] = optimizer.rescale_grad
+    block[2 * n + 1] = fault.loss_scale()
     return block
 
 
-def make_apply(step_fns, state_counts, guard, inject):
+def make_apply(step_fns, state_counts, guard, inject, unscale=False):
     """The traceable all-parameter update shared by every fused path:
     splice in poison, test finiteness, run each param's step fn, and
     (under the guard) keep the old weight/state via jnp.where for
     non-finite grads — the compiled-step equivalent of
     filter_gradient's skip. ``parallel.grad_sync.make_bucketed_apply``
-    is the drop-in bucketed/sharded form of this contract."""
+    is the drop-in bucketed/sharded form of this contract.
+
+    ``unscale=True`` (the Module path's in-program AMP loss scaling):
+    gradients arrive multiplied by the dynamic loss scale (scalar slot
+    ``2n+1``), so the effective rescale is ``rescale / loss_scale`` —
+    the finiteness test still sees the SCALED gradient, which is the
+    overflow signal the scale-backoff policy keys on."""
     import jax.numpy as jnp
     n = len(step_fns)
 
     def apply(grads, weights, states, scalars, poisons):
-        # scalars = [lr_0..lr_n-1, wd_0..wd_n-1, rescale]
+        # scalars = [lr_0..lr_n-1, wd_0..wd_n-1, rescale, loss_scale]
         rescale = scalars[2 * n]
+        if unscale:
+            rescale = rescale / scalars[2 * n + 1]
         new_ws, new_sts, oks = [], [], []
         si = 0
         for i, fn in enumerate(step_fns):
@@ -147,10 +163,14 @@ def make_apply(step_fns, state_counts, guard, inject):
             # cast the traced scalars to the grad dtype: the eager
             # ops see python floats, which JAX weak-types (f64 →
             # weak f32 → operand dtype) — an uncast strong-f32
-            # scalar would PROMOTE low-precision weights to f32
-            nw, nst = fn(g, w, st, scalars[i].astype(g.dtype),
-                         scalars[n + i].astype(g.dtype),
-                         rescale.astype(g.dtype))
+            # scalar would PROMOTE low-precision weights to f32.
+            # Multi-precision step fns declare scalar_dtype=f32
+            # instead: their master math is f32 and a bf16-cast lr
+            # would break bit-identity with the eager mp ops.
+            sdt = getattr(fn, "scalar_dtype", None) or g.dtype
+            nw, nst = fn(g, w, st, scalars[i].astype(sdt),
+                         scalars[n + i].astype(sdt),
+                         rescale.astype(sdt))
             if guard:
                 nw = jnp.where(ok, nw, w)
                 nst = tuple(jnp.where(ok, new_s, old_s)
@@ -241,11 +261,24 @@ class _FusedCore:
         from . import fault
         return fault.guard_policy() is not None
 
+    def _loss_scaling_active(self, fns):
+        """In-program dynamic loss scaling (Module path): on exactly
+        when the scale-backoff guard owns a live scale AND the roster
+        is multi-precision (scalar_dtype-marked step fns). Full-f32
+        rosters keep their ogs untouched so existing trajectories stay
+        bit-identical."""
+        from . import fault
+        return fault.guard_policy() == "scale_backoff" and \
+            any(getattr(fn, "scalar_dtype", None) is not None
+                for fn in fns)
+
     # -- traced composition ----------------------------------------------
-    def _make_apply(self, step_fns, state_counts, guard, inject):
+    def _make_apply(self, step_fns, state_counts, guard, inject,
+                    unscale=False):
         """See :func:`make_apply` (module-level so the data-parallel
         trainer composes the identical update without an executor)."""
-        return make_apply(step_fns, state_counts, guard, inject)
+        return make_apply(step_fns, state_counts, guard, inject,
+                          unscale=unscale)
 
     # -- host-side guard accounting --------------------------------------
     def _post_step(self, indices, mask, guard):
@@ -314,9 +347,10 @@ class FusedStepExecutor(_FusedCore):
         poisons = self._poisons(self._indices)
         guard = self._guard_active()
         inject = poisons is not None
+        scale_loss = self._loss_scaling_active(fns)
         scalars = self._scalars(self._indices)
         fn = self._compiled(weights, states, others, aux, counts, fns,
-                            guard, inject)
+                            guard, inject, scale_loss)
         if poisons is None:
             poisons = self._zero_poisons(len(fns))
         from . import telemetry, tracing
@@ -344,9 +378,10 @@ class FusedStepExecutor(_FusedCore):
         return ex.outputs
 
     def _compiled(self, weights, states, others, aux, counts, fns,
-                  guard, inject):
+                  guard, inject, scale_loss=False):
         key = (_sig(weights), _sig(states), _sig(others), _sig(aux),
-               counts, guard, inject, self._opt.fused_static_key())
+               counts, guard, inject, scale_loss,
+               self._opt.fused_static_key())
         cached = self._cache.get(key)
         if cached is not None:
             _count("fused_step_cache_hits")
@@ -354,10 +389,12 @@ class FusedStepExecutor(_FusedCore):
         _count("fused_step_cache_misses")
         import jax.numpy as jnp
         fwdbwd, gpos, out_structs = self._ex.fused_plan()
-        apply_fn = self._make_apply(fns, counts, guard, inject)
+        apply_fn = self._make_apply(fns, counts, guard, inject,
+                                    unscale=scale_loss)
         n_args = len(self._ex.arg_names)
         other_pos = list(self._other_pos)
         ostructs = [(tuple(s.shape), s.dtype) for s in out_structs]
+        n_params = len(fns)
 
         def program(weights, states, others, aux_vals, rng_keys,
                     scalars, poisons):
@@ -368,6 +405,15 @@ class FusedStepExecutor(_FusedCore):
             for p, o in zip(other_pos, others):
                 full[p] = o
             ogs = tuple(jnp.ones(s, d) for s, d in ostructs)
+            if scale_loss:
+                # in-program dynamic loss scaling: the backward seeds
+                # carry the traced loss scale (slot 2n+1), so low-
+                # precision grads overflow-signal at the scale the
+                # backoff policy manages; make_apply(unscale=True)
+                # divides it back out of the master update
+                ls = scalars[2 * n_params + 1]
+                ogs = tuple(o * ls.astype(d) for o, (_, d)
+                            in zip(ogs, ostructs))
             outs, new_aux, grads = fwdbwd(tuple(full), aux_vals,
                                           rng_keys, ogs)
             new_ws, new_sts, mask = apply_fn(grads, weights, states,
@@ -394,7 +440,8 @@ class FusedStepExecutor(_FusedCore):
         from . import compile_watch
         from .engine import compiler_options
         site = "fused_step:module"
-        statics = (counts, guard, inject, self._opt.fused_static_key())
+        statics = (counts, guard, inject, scale_loss,
+                   self._opt.fused_static_key())
         bucket = getattr(self._ex, "_cw_bucket", None)
         if bucket is not None:
             # one bucket of a shape ladder: the fused program IS this
@@ -593,6 +640,7 @@ class FusedUpdater(_FusedCore):
             bd_key = (tuple(indices), mode)
             if getattr(self, "_mem_bd_key", None) != bd_key:
                 sharded = replicated = 0
+                by_dtype = {}
                 for w_nd in weights_nd:
                     v = w_nd._data
                     shards = getattr(v, "addressable_shards", None)
@@ -602,11 +650,19 @@ class FusedUpdater(_FusedCore):
                         replicated += b
                     else:
                         sharded += b
+                    dt = str(getattr(v, "dtype", "?"))
+                    by_dtype[dt] = by_dtype.get(dt, 0) + b
                 self._mem_bd_key = bd_key
                 self._mem_bd = {
                     "params_sharded": sharded,
                     "params_replicated": replicated,
                     "opt_state": sync_state.state_bytes_per_device()}
+                if len(by_dtype) > 1:
+                    # mixed precision: the per-dtype split is what a
+                    # capacity planner actually reasons about (bf16
+                    # weights vs the fp32 masters hiding in opt_state)
+                    for dt, b in sorted(by_dtype.items()):
+                        self._mem_bd["params_" + dt] = b
             telemetry.memory_breakdown(**self._mem_bd)
         self._post_step(indices, mask, guard)
         return True
@@ -696,9 +752,15 @@ class FusedUpdater(_FusedCore):
         if fns is None:
             _count("fused_step_fallbacks")
             return False
+        # multi-precision rosters (scalar_dtype-marked fns) carry
+        # mixed-dtype [.., master] state layouts the flat-sharded
+        # bucket planner does not model — run them through the plain
+        # fused program (still ONE donated dispatch, no fallback)
+        mp_roster = any(getattr(fn, "scalar_dtype", None) is not None
+                        for fn in fns)
         mode = self._sync_eligible(weights_nd,
                                    [g for _, _, g in items]) \
-            if self._sync_mesh is not None else False
+            if self._sync_mesh is not None and not mp_roster else False
         if mode:
             ran = self._update_sync(items, indices, weights_nd, fns,
                                     mode)

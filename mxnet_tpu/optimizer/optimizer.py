@@ -99,6 +99,18 @@ class Optimizer:
         else:
             self.update(index, weight, grad, state)
 
+    def master_from_state(self, weight, state):
+        """The fp32 master NDArray inside one parameter's
+        multi-precision state (the base-class ``(master, inner)``
+        layout), or None when this weight has no master — the AMP
+        checkpoint path (``amp.master_params``/``seed_masters``)
+        reads and seeds masters through this accessor so it never
+        hard-codes a state layout."""
+        if self.multi_precision and _is_low_precision(weight.dtype) \
+                and isinstance(state, tuple) and len(state) == 2:
+            return state[0]
+        return None
+
     # -- hyperparameter plumbing ------------------------------------------
     def set_learning_rate(self, lr):
         if self.lr_scheduler is not None:
@@ -188,12 +200,16 @@ class Optimizer:
         ``fn(grad, weight, states, lr, wd, rescale) ->
         (new_weight, new_states)`` over raw jax arrays, where ``states``
         is the flat tuple of this index's state arrays and lr/wd/rescale
-        arrive as traced scalars. Returns None when this optimizer (or
-        this configuration — e.g. multi-precision low-dtype weights)
-        has no compiled path; the executor then falls back to the
-        eager loop. Implementations must mirror the registered eager
-        update ops operation-for-operation so fused and eager steps are
-        bit-identical."""
+        arrive as traced scalars. Returns None when this optimizer has
+        no compiled path; the executor then falls back to the eager
+        loop. Implementations must mirror the registered eager update
+        ops operation-for-operation so fused and eager steps are
+        bit-identical. Multi-precision implementations (f32 master
+        math under low-dtype weights) set ``fn.scalar_dtype =
+        jnp.float32`` so the executor feeds them f32 scalars instead
+        of grad-dtype casts — the eager mp ops apply python-float
+        scalars to f32 arrays, and a bf16-cast lr would break the
+        bit-identity contract."""
         return None
 
     def fused_step_scalars(self, index):
@@ -295,6 +311,13 @@ class SGD(Optimizer):
             return (self.create_state(index, master), master)
         return self.create_state(index, weight)
 
+    def master_from_state(self, weight, state):
+        # SGD's mp layout is (mom_or_None, master) — master LAST
+        if self.multi_precision and _is_low_precision(weight.dtype) \
+                and isinstance(state, tuple) and len(state) == 2:
+            return state[1]
+        return None
+
     def update(self, index, weight, grad, state):
         _, _, kw = self._step_inputs(index)
         rsp = _rsp_grad(grad)
@@ -326,11 +349,28 @@ class SGD(Optimizer):
                       out=weight)
 
     def fused_step_fn(self, index, weight):
-        """Mirrors ops/optimizer_ops.py sgd_update / sgd_mom_update."""
-        if self.multi_precision and _is_low_precision(weight.dtype):
-            return None
+        """Mirrors ops/optimizer_ops.py sgd_update / sgd_mom_update
+        (mp_sgd_update / mp_sgd_mom_update for multi-precision
+        low-dtype weights: f32 master math, the low-dtype weight is a
+        cast of the new master — states flat as [mom?, master], the
+        :meth:`create_state_multi_precision` layout)."""
         import jax.numpy as jnp
         mu, clip = self.momentum, self.clip_gradient
+        if self.multi_precision and _is_low_precision(weight.dtype):
+            def fn(grad, weight, states, lr, wd, rescale):
+                g = grad.astype(jnp.float32) * rescale
+                if clip is not None and clip > 0:
+                    g = jnp.clip(g, -clip, clip)
+                if mu == 0.0:
+                    (master,) = states
+                    new_w32 = master - lr * (g + wd * master)
+                    return new_w32.astype(weight.dtype), (new_w32,)
+                mom, master = states
+                new_mom = mu * mom - lr * (g + wd * master)
+                new_w32 = master + new_mom
+                return new_w32.astype(weight.dtype), (new_mom, new_w32)
+            fn.scalar_dtype = jnp.float32
+            return fn
 
         def fn(grad, weight, states, lr, wd, rescale):
             g = grad * rescale
@@ -441,12 +481,27 @@ class Adam(Optimizer):
     def fused_step_fn(self, index, weight):
         """Mirrors ops/optimizer_ops.py adam_update (wd folded into the
         gradient BEFORE the clip); ``lr`` arrives bias-corrected from
-        :meth:`fused_step_scalars`."""
-        if self.multi_precision and _is_low_precision(weight.dtype):
-            return None
+        :meth:`fused_step_scalars`. Multi-precision low-dtype weights
+        run the base-class mp layout [master, mean, var]: the eager
+        path's ``update(index, master, grad.astype(f32), inner)``
+        operation-for-operation, weight = cast of the new master."""
         import jax.numpy as jnp
         b1, b2, eps = self.beta1, self.beta2, self.epsilon
         clip = self.clip_gradient
+        if self.multi_precision and _is_low_precision(weight.dtype):
+            def fn(grad, weight, states, lr, wd, rescale):
+                master, mean, var = states
+                g = grad.astype(jnp.float32) * rescale + wd * master
+                if clip is not None and clip > 0:
+                    g = jnp.clip(g, -clip, clip)
+                new_mean = b1 * mean + (1 - b1) * g
+                new_var = b2 * var + (1 - b2) * jnp.square(g)
+                new_w32 = master - lr * new_mean / (jnp.sqrt(new_var)
+                                                    + eps)
+                return new_w32.astype(weight.dtype), \
+                    (new_w32, new_mean, new_var)
+            fn.scalar_dtype = jnp.float32
+            return fn
 
         def fn(grad, weight, states, lr, wd, rescale):
             g = grad * rescale + wd * weight
@@ -492,12 +547,23 @@ class AdaGrad(Optimizer):
         invoke_nd("adagrad_update", [weight, grad, state], kw, out=weight)
 
     def fused_step_fn(self, index, weight):
-        """Mirrors ops/optimizer_ops.py adagrad_update."""
-        if self.multi_precision and _is_low_precision(weight.dtype):
-            return None
+        """Mirrors ops/optimizer_ops.py adagrad_update (mp low-dtype:
+        base-class layout [master, history], f32 master math)."""
         import jax.numpy as jnp
         from ..ops.optimizer_ops import stable_sqrt
         eps, clip = self.float_stable_eps, self.clip_gradient
+        if self.multi_precision and _is_low_precision(weight.dtype):
+            def fn(grad, weight, states, lr, wd, rescale):
+                master, history = states
+                g = grad.astype(jnp.float32) * rescale
+                if clip is not None and clip > 0:
+                    g = jnp.clip(g, -clip, clip)
+                new_h = history + jnp.square(g)
+                new_w32 = master - lr * (g / stable_sqrt(new_h + eps)
+                                         + wd * master)
+                return new_w32.astype(weight.dtype), (new_w32, new_h)
+            fn.scalar_dtype = jnp.float32
+            return fn
 
         def fn(grad, weight, states, lr, wd, rescale):
             g = grad * rescale
@@ -550,14 +616,38 @@ class RMSProp(Optimizer):
     def fused_step_fn(self, index, weight):
         """Mirrors ops/optimizer_ops.py rmsprop_update /
         rmspropalex_update (wd folded pre-clip), plus the host-side
-        clip_weights pass."""
-        if self.multi_precision and _is_low_precision(weight.dtype):
-            return None
+        clip_weights pass (mp low-dtype: base-class layout
+        [master, n] / [master, n, g, delta], f32 master math)."""
         import jax.numpy as jnp
         from ..ops.optimizer_ops import stable_sqrt
         rho, mu, eps = self.gamma1, self.gamma2, self.epsilon
         clip, cw = self.clip_gradient, self.clip_weights
         centered = self.centered
+        if self.multi_precision and _is_low_precision(weight.dtype):
+            def fn(grad, weight, states, lr, wd, rescale):
+                master = states[0]
+                g = grad.astype(jnp.float32) * rescale + wd * master
+                if clip is not None and clip > 0:
+                    g = jnp.clip(g, -clip, clip)
+                if not centered:
+                    (n,) = states[1:]
+                    new_n = rho * n + (1 - rho) * jnp.square(g)
+                    new_w32 = master - lr * g / stable_sqrt(new_n + eps)
+                    new_states = (new_n,)
+                else:
+                    n, g_acc, delta = states[1:]
+                    new_n = rho * n + (1 - rho) * jnp.square(g)
+                    new_g = rho * g_acc + (1 - rho) * g
+                    new_delta = mu * delta - lr * g / stable_sqrt(
+                        new_n - jnp.square(new_g) + eps)
+                    new_w32 = master + new_delta
+                    new_states = (new_n, new_g, new_delta)
+                if cw:
+                    new_w32 = jnp.clip(new_w32, -cw, cw)
+                return new_w32.astype(weight.dtype), \
+                    (new_w32,) + new_states
+            fn.scalar_dtype = jnp.float32
+            return fn
 
         def fn(grad, weight, states, lr, wd, rescale):
             g = grad * rescale + wd * weight

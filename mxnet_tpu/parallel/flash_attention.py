@@ -475,13 +475,15 @@ def _jnp_decode(q, k, v, lengths, scale):
     return jnp.einsum("bhqk,bkhd->bqhd", p, v)
 
 
-def _decode_kernel(q_ref, k_ref, v_ref, len_ref, o_ref, acc_ref, m_ref,
-                   l_ref, *, scale, block_k, n_kb):
-    """Grid = (batch*heads, k_blocks), k innermost: one query row per
-    program instance, running max/sum accumulators in VMEM scratch —
-    the forward kernel's accumulation order for a single q row, so a
-    decode step is bit-identical to the same row of a prefill pass at
-    the same ``block_k``."""
+def _decode_accumulate(q, k, v, len_ref, o_ref, acc_ref, m_ref, l_ref,
+                       scale, block_k, n_kb):
+    """The shared streaming-softmax step for one (q-row, k-block)
+    program instance — ``q``/``k``/``v`` are the block's fp32 values
+    (the q8 kernel dequantizes before calling in). Running max/sum
+    accumulators live in VMEM scratch; the accumulation order matches
+    the forward kernel's for a single q row, so a decode step is
+    bit-identical to the same row of a prefill pass at the same
+    ``block_k``."""
     import jax.numpy as jnp
     from jax.experimental import pallas as pl
 
@@ -493,10 +495,7 @@ def _decode_kernel(q_ref, k_ref, v_ref, len_ref, o_ref, acc_ref, m_ref,
         m_ref[...] = jnp.full_like(m_ref, -jnp.inf)
         l_ref[...] = jnp.zeros_like(l_ref)
 
-    q = q_ref[...].astype(jnp.float32) * scale        # (1, d)
-    k = k_ref[...].astype(jnp.float32)                # (bk, d)
-    v = v_ref[...].astype(jnp.float32)
-    s = q @ k.T                                       # (1, bk)
+    s = (q * scale) @ k.T                             # (1, bk)
     k_pos = kb * block_k + jax.lax.iota(jnp.int32, block_k)[None, :]
     s = jnp.where(k_pos < len_ref[0], s, _NEG)
     m_prev = m_ref[...]
@@ -514,7 +513,38 @@ def _decode_kernel(q_ref, k_ref, v_ref, len_ref, o_ref, acc_ref, m_ref,
         o_ref[...] = (acc_ref[...] / l[:, None]).astype(o_ref.dtype)
 
 
-def _pallas_decode(q, k, v, lengths, scale, block_k, interpret):
+def _decode_kernel(q_ref, k_ref, v_ref, len_ref, o_ref, acc_ref, m_ref,
+                   l_ref, *, scale, block_k, n_kb):
+    """Grid = (batch*heads, k_blocks), k innermost: one query row per
+    program instance (see :func:`_decode_accumulate`)."""
+    import jax.numpy as jnp
+    _decode_accumulate(q_ref[...].astype(jnp.float32),
+                       k_ref[...].astype(jnp.float32),
+                       v_ref[...].astype(jnp.float32),
+                       len_ref, o_ref, acc_ref, m_ref, l_ref,
+                       scale, block_k, n_kb)
+
+
+def _decode_kernel_q8(q_ref, k_ref, v_ref, ks_ref, vs_ref, len_ref,
+                      o_ref, acc_ref, m_ref, l_ref, *, scale, block_k,
+                      n_kb):
+    """The int8-cache decode kernel: K/V blocks arrive quantized and
+    dequantize INSIDE the block stream — ``int8 → fp32 × per-position
+    scale`` right after the block lands in VMEM, so HBM traffic for
+    the cache is a quarter of the fp32 kernel's and the accumulation
+    math is unchanged (:func:`_decode_accumulate`)."""
+    import jax.numpy as jnp
+    _decode_accumulate(q_ref[...].astype(jnp.float32),
+                       k_ref[...].astype(jnp.float32)
+                       * ks_ref[...][:, None],
+                       v_ref[...].astype(jnp.float32)
+                       * vs_ref[...][:, None],
+                       len_ref, o_ref, acc_ref, m_ref, l_ref,
+                       scale, block_k, n_kb)
+
+
+def _pallas_decode(q, k, v, lengths, scale, block_k, interpret,
+                   k_scale=None, v_scale=None):
     import jax.numpy as jnp
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
@@ -522,14 +552,19 @@ def _pallas_decode(q, k, v, lengths, scale, block_k, interpret):
     BH, _, D = q.shape
     Tk = k.shape[1]
     n_kb = Tk // block_k
+    quant = k_scale is not None
+    scale_spec = pl.BlockSpec((None, block_k), lambda b, j: (b, j))
+    kern = functools.partial(
+        _decode_kernel_q8 if quant else _decode_kernel,
+        scale=scale, block_k=block_k, n_kb=n_kb)
     out = pl.pallas_call(
-        functools.partial(_decode_kernel, scale=scale, block_k=block_k,
-                          n_kb=n_kb),
+        kern,
         grid=(BH, n_kb),
         in_specs=[
             pl.BlockSpec((None, 1, D), lambda b, j: (b, 0, 0)),
             pl.BlockSpec((None, block_k, D), lambda b, j: (b, j, 0)),
             pl.BlockSpec((None, block_k, D), lambda b, j: (b, j, 0)),
+        ] + ([scale_spec, scale_spec] if quant else []) + [
             pl.BlockSpec((None, 1), lambda b, j: (b, 0)),
         ],
         out_specs=pl.BlockSpec((None, 1, D), lambda b, j: (b, 0, 0)),
@@ -538,12 +573,13 @@ def _pallas_decode(q, k, v, lengths, scale, block_k, interpret):
                         pltpu.VMEM((1,), jnp.float32),
                         pltpu.VMEM((1,), jnp.float32)],
         interpret=interpret,
-    )(q, k, v, lengths)
+    )(*((q, k, v) + ((k_scale, v_scale) if quant else ())
+        + (lengths,)))
     return out
 
 
 def flash_decode(q, k, v, lengths, scale=None, block_k=128,
-                 force_pallas=False):
+                 force_pallas=False, k_scale=None, v_scale=None):
     """One autoregressive decode step of attention: a single cached-KV
     query per sequence.
 
@@ -564,15 +600,33 @@ def flash_decode(q, k, v, lengths, scale=None, block_k=128,
     ``block_k`` on the kernel path (the page pool guarantees this when
     the page size divides ``block_k`` or vice versa); other lengths
     fall back to ``block_k=T``'s divisor search like the prefill
-    kernel would, or use the jnp path."""
+    kernel would, or use the jnp path.
+
+    **Quantized caches**: with int8 ``k``/``v`` plus ``k_scale``/
+    ``v_scale`` — ``(B, T)`` fp32 per-position dequantization scales
+    (a paged pool's per-page scales repeated over each page's slots;
+    ``serving.kvcache``'s int8 mode) — the kernel path dequantizes
+    INSIDE the block stream, so the cache crosses HBM→VMEM at a
+    quarter of the fp32 bytes; the jnp path dequantizes up front.
+    Both scales must be given together."""
     import jax.numpy as jnp
     scale = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
     if q.shape[1] != 1:
         raise ValueError(
             "flash_decode: expected a single query position, got "
             "q length %d" % q.shape[1])
+    quant = k_scale is not None or v_scale is not None
+    if quant and (k_scale is None or v_scale is None):
+        raise ValueError(
+            "flash_decode: quantized caches need BOTH k_scale and "
+            "v_scale (B, T)")
     on_tpu = jax.devices()[0].platform in ("tpu", "axon")
     if not (on_tpu or force_pallas):
+        if quant:
+            k = k.astype(jnp.float32) \
+                * jnp.asarray(k_scale, jnp.float32)[:, :, None, None]
+            v = v.astype(jnp.float32) \
+                * jnp.asarray(v_scale, jnp.float32)[:, :, None, None]
         return _jnp_decode(q, k, v, lengths, scale)
     B, _, H, D = q.shape
     Tk = k.shape[1]
@@ -581,7 +635,14 @@ def flash_decode(q, k, v, lengths, scale=None, block_k=128,
     kf = _flatten(k)
     vf = _flatten(v)
     lens = jnp.repeat(jnp.asarray(lengths, jnp.int32), H)[:, None]
-    out = _pallas_decode(qf, kf, vf, lens, scale, bk, not on_tpu)
+    ksf = vsf = None
+    if quant:
+        # per-(row, position) planes repeat per head, matching the
+        # kernels' flattened batch*heads axis (the _seg_flat layout)
+        ksf = jnp.repeat(jnp.asarray(k_scale, jnp.float32), H, axis=0)
+        vsf = jnp.repeat(jnp.asarray(v_scale, jnp.float32), H, axis=0)
+    out = _pallas_decode(qf, kf, vf, lens, scale, bk, not on_tpu,
+                         k_scale=ksf, v_scale=vsf)
     return _unflatten(out, B, H)
 
 

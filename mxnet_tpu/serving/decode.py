@@ -429,15 +429,20 @@ class DecodeServer:
         # only warn per compile), and correctness never depends on it
         donate = {}
         if jax.default_backend() not in ("cpu",):
-            donate = {"donate_argnums": (4, 5)}
+            donate = {"donate_argnums": (4, 5, 6, 7)
+                      if self._pool.quantized else (4, 5)}
+        decode_fn = self._decode_fn_q8 if self._pool.quantized \
+            else self._decode_fn
+        prefill_fn = self._prefill_fn_q8 if self._pool.quantized \
+            else self._prefill_fn
         self._decode_prog = compile_watch.jit(
-            self._decode_fn, "%s:step" % site,
+            decode_fn, "%s:step" % site,
             statics=(site, self._window, self._max_pages),
             cache=False, **donate)
         self._prefill_progs = {}
         for rung in self._seq_ladder.buckets:
             self._prefill_progs[rung] = compile_watch.jit(
-                self._prefill_fn, "%s:prefill:s%d" % (site, rung),
+                prefill_fn, "%s:prefill:s%d" % (site, rung),
                 statics=(site, "prefill", rung), cache=False, **donate)
 
         self._cond = threading.Condition()
@@ -500,6 +505,57 @@ class DecodeServer:
         # logits output would be dead weight on the per-token hot path
         tokens_out = jnp.argmax(logits, axis=-1).astype(jnp.int32)
         return tokens_out, k_pages, v_pages
+
+    # int8-pool variants: same program shape, with per-page fp32
+    # scales riding alongside the pages. Gather DEQUANTIZES (the model
+    # contract stays fp32 caches), scatter quantizes — both inside the
+    # one compiled program, so the fixed-program-set oracle
+    # (site_stats("decode")) is identical to the fp32 pool's.
+    def _prefill_fn_q8(self, params, tokens, n_valid, page_table,
+                       k_pages, v_pages, k_scales, v_scales):
+        import jax.numpy as jnp
+        logits, k_seq, v_seq = self._model.prefill(params, tokens)
+        k_pages, k_scales = kvcache.scatter_prefill_q8(
+            k_pages, k_scales, page_table, k_seq[:, 0], n_valid)
+        v_pages, v_scales = kvcache.scatter_prefill_q8(
+            v_pages, v_scales, page_table, v_seq[:, 0], n_valid)
+        last = jnp.take(logits[0], n_valid - 1, axis=0)
+        token = jnp.argmax(last).astype(jnp.int32)
+        return token, k_pages, v_pages, k_scales, v_scales
+
+    def _decode_fn_q8(self, params, tokens, positions, page_tables,
+                      k_pages, v_pages, k_scales, v_scales):
+        import jax.numpy as jnp
+        k_cache = kvcache.gather_pages_q8(k_pages, k_scales,
+                                          page_tables)
+        v_cache = kvcache.gather_pages_q8(v_pages, v_scales,
+                                          page_tables)
+        logits, k_new, v_new = self._model.decode(
+            params, tokens, positions, k_cache, v_cache)
+        k_pages, k_scales = kvcache.scatter_token_q8(
+            k_pages, k_scales, page_tables, positions, k_new)
+        v_pages, v_scales = kvcache.scatter_token_q8(
+            v_pages, v_scales, page_tables, positions, v_new)
+        tokens_out = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        return tokens_out, k_pages, v_pages, k_scales, v_scales
+
+    def _pool_args(self):
+        """The pool arrays a step program takes (and returns): pages,
+        plus the per-page scales in quantized mode."""
+        if self._pool.quantized:
+            return (self._pool.k, self._pool.v, self._pool.k_scale,
+                    self._pool.v_scale)
+        return (self._pool.k, self._pool.v)
+
+    def _adopt_pool(self, out):
+        """Re-point the pool at a step program's functionally-updated
+        arrays; returns the program's remaining (token) outputs."""
+        if self._pool.quantized:
+            (self._pool.k, self._pool.v, self._pool.k_scale,
+             self._pool.v_scale) = out[-4:]
+            return out[:-4]
+        self._pool.k, self._pool.v = out[-2:]
+        return out[:-2]
 
     # -- lifecycle ---------------------------------------------------------
     def start(self):
@@ -593,17 +649,17 @@ class DecodeServer:
                 toks = _np.zeros((1, rung), _np.int32)
                 out = self._prefill_progs[rung](
                     self._params.tree, toks, _np.int32(0), zeros_pt,
-                    self._pool.k, self._pool.v)
+                    *self._pool_args())
                 jax.block_until_ready(out[0])
-                self._pool.k, self._pool.v = out[1], out[2]
+                self._adopt_pool(out)
                 n += 1
             toks = _np.zeros((self._window,), _np.int32)
             pos = _np.zeros((self._window,), _np.int32)
             pts = _np.zeros((self._window, self._max_pages), _np.int32)
             out = self._decode_prog(self._params.tree, toks, pos, pts,
-                                    self._pool.k, self._pool.v)
+                                    *self._pool_args())
             jax.block_until_ready(out[0])
-            self._pool.k, self._pool.v = out[1], out[2]
+            self._adopt_pool(out)
             return n + 1
         finally:
             with self._cond:
@@ -914,17 +970,16 @@ class DecodeServer:
         pt = _np.zeros((self._max_pages,), _np.int32)
         pt[:len(req.pages)] = req.pages
         try:
-            token, k, v = self._prefill_progs[rung](
+            out = self._prefill_progs[rung](
                 req.params.tree, tokens, _np.int32(P), pt,
-                self._pool.k, self._pool.v)
+                *self._pool_args())
         except Exception as exc:       # noqa: BLE001 — model errors
             with self._cond:           # belong to the request
                 if req in self._active:
                     self._active.remove(req)
             self._finish(req, exc)
             return True
-        self._pool.k = k
-        self._pool.v = v
+        (token,) = self._adopt_pool(out)
         tok = int(token)
         now = time.perf_counter()
         req._t_first = now
@@ -1010,9 +1065,8 @@ class DecodeServer:
             positions[i] = len(r.prompt) + len(r.generated) - 1
             pts[i, :len(r.pages)] = r.pages
         try:
-            toks, k, v = self._decode_prog(
-                ver.tree, tokens, positions, pts, self._pool.k,
-                self._pool.v)
+            out = self._decode_prog(
+                ver.tree, tokens, positions, pts, *self._pool_args())
         except Exception as exc:       # noqa: BLE001 — model errors
             with self._cond:           # belong to the batch's requests
                 for r in rows:
@@ -1021,8 +1075,7 @@ class DecodeServer:
             for r in rows:
                 self._finish(r, exc)
             return
-        self._pool.k = k
-        self._pool.v = v
+        (toks,) = self._adopt_pool(out)
         toks = _np.asarray(toks)
         now = time.perf_counter()
         finished = []

@@ -32,6 +32,29 @@ Sizing: ``MXNET_KV_PAGE_SIZE`` tokens per page and
 page-table width from the bucketing ladder's top prompt rung plus the
 generation budget, so the program set is fixed no matter the request
 mix.
+
+**Quantized storage** (``MXNET_KV_DTYPE=int8``, or ``dtype=`` on the
+pool): K/V pages store int8 with one fp32 scale per ``(layer, page)``
+(``.k_scale``/``.v_scale``, shape ``(L, P)``). The quantized ops are
+the same traced, functional shapes as the fp32 ones, so the decode
+server's program set stays fixed:
+
+- :func:`gather_pages_q8` dequantizes on gather — the per-page scale
+  broadcasts across its page's token slots;
+- :func:`scatter_token_q8` grows a page's scale monotonically as
+  tokens land (``max(old, |new|/127)``) and REQUANTIZES the page body
+  under the grown scale in-program — except on a page's FIRST slot,
+  where the scale is set fresh (a reallocated page's stale scale and
+  garbage from its prior tenant must not leak in);
+- :func:`scatter_prefill_q8` sets each covered page's scale from its
+  own token chunk (padding rows beyond ``n_valid`` are zeroed first so
+  prefill garbage never inflates a scale).
+
+Scale semantics make correctness independent of page history: a slot's
+dequantized value is always ``q * scale_at_last_write``, and positions
+at/after a row's ``lengths`` are masked by the attention anyway. bf16
+storage (``MXNET_KV_DTYPE=bfloat16``) needs no scales — it is a plain
+dtype choice on the pool arrays.
 """
 from __future__ import annotations
 
@@ -41,7 +64,11 @@ from .. import envs, fault
 from ..base import MXNetError
 
 __all__ = ["KVCachePool", "gather_pages", "scatter_token",
-           "scatter_prefill", "pages_for"]
+           "scatter_prefill", "pages_for", "gather_pages_q8",
+           "scatter_token_q8", "scatter_prefill_q8"]
+
+_INT8_MAX = 127.0
+_EPS = 1e-8          # scale floor: an all-zero chunk still divides
 
 
 def pages_for(n_tokens, page_size):
@@ -96,6 +123,94 @@ def scatter_prefill(pages, page_table_row, seq, n_valid):
 
 
 # ---------------------------------------------------------------------------
+# quantized (int8 + per-page fp32 scale) variants — same traced shapes
+# ---------------------------------------------------------------------------
+
+def gather_pages_q8(pages, scales, page_table):
+    """:func:`gather_pages` for an int8 pool: ``pages (L, P, S, ...)``
+    int8 + ``scales (L, P)`` fp32, indexed by ``page_table (B, M)`` →
+    DEQUANTIZED fp32 caches ``(L, B, M*S, ...)`` — each page's scale
+    broadcasts over its token slots, so the gathered cache feeds the
+    unchanged decode-model contract."""
+    import jax.numpy as jnp
+    g = pages[:, page_table]                   # (L, B, M, S, ...)
+    s = scales[:, page_table]                  # (L, B, M)
+    extra = (1,) * (g.ndim - s.ndim)
+    out = g.astype(jnp.float32) * s.reshape(s.shape + extra)
+    shape = out.shape
+    return out.reshape(shape[0], shape[1], shape[2] * shape[3],
+                       *shape[4:])
+
+
+def scatter_token_q8(pages, scales, page_table, positions, new):
+    """:func:`scatter_token` for an int8 pool: quantize the step's new
+    fp32 rows ``new (L, B, H, D)`` into their pages and grow each
+    touched page's scale monotonically — ``max(old, amax/127)`` — with
+    the page body requantized in-program under the grown scale, so
+    earlier tokens keep dequantizing to (within one rounding step of)
+    their stored values. A write landing on a page's FIRST slot
+    instead sets the scale fresh and zeroes the body: pages are filled
+    in position order, so slot 0 means a newly (re)allocated page
+    whose stale scale/content belong to a prior tenant. Returns the
+    updated ``(pages, scales)``."""
+    import jax.numpy as jnp
+    S = pages.shape[2]
+    B = new.shape[1]
+    pos = jnp.asarray(positions, jnp.int32)
+    pidx = jnp.take_along_axis(
+        jnp.asarray(page_table, jnp.int32), (pos // S)[:, None],
+        axis=1)[:, 0]                          # (B,)
+    slot = pos % S
+    amax = jnp.max(jnp.abs(new), axis=(2, 3))  # (L, B)
+    need = jnp.maximum(amax, _EPS) / _INT8_MAX
+    old = scales[:, pidx]                      # (L, B)
+    first = (slot == 0)[None, :]
+    new_scale = jnp.where(first, need, jnp.maximum(old, need))
+    ratio = jnp.where(first, 0.0, old / new_scale)
+    body = pages[:, pidx].astype(jnp.float32) \
+        * ratio[:, :, None, None, None]        # (L, B, S, H, D)
+    body = body.at[:, jnp.arange(B), slot].set(
+        new / new_scale[:, :, None, None])
+    body = jnp.clip(jnp.round(body), -_INT8_MAX, _INT8_MAX) \
+        .astype(pages.dtype)
+    return (pages.at[:, pidx].set(body),
+            scales.at[:, pidx].set(new_scale))
+
+
+def scatter_prefill_q8(pages, scales, page_table_row, seq, n_valid):
+    """:func:`scatter_prefill` for an int8 pool: one request's prefill
+    K (or V) rows ``seq (L, Lr, H, D)`` quantize page-chunk-wise —
+    each covered page's scale comes from its own ``page_size``-token
+    chunk's amax (rows at/after ``n_valid`` are zeroed first, so rung
+    padding garbage neither lands in a page nor inflates a scale).
+    Scales are SET, not grown: prefill is always a page's first
+    tenant. Returns the updated ``(pages, scales)``."""
+    import jax
+    import jax.numpy as jnp
+    S = pages.shape[2]
+    L, Lr = seq.shape[0], seq.shape[1]
+    pos = jax.lax.iota(jnp.int32, Lr)
+    valid = pos < n_valid
+    seq = jnp.where(valid[None, :, None, None], seq, 0.0)
+    table = jnp.asarray(page_table_row, jnp.int32)
+    pidx = jnp.where(valid, table[pos // S], 0)
+    Lp = -(-Lr // S) * S
+    seq_p = seq if Lp == Lr else jnp.pad(
+        seq, ((0, 0), (0, Lp - Lr)) + ((0, 0),) * (seq.ndim - 2))
+    chunks = seq_p.reshape(L, Lp // S, S, *seq.shape[2:])
+    red = tuple(range(2, chunks.ndim))
+    pscale = jnp.maximum(jnp.max(jnp.abs(chunks), axis=red), _EPS) \
+        / _INT8_MAX                            # (L, n_chunks)
+    rscale = jnp.repeat(pscale, S, axis=1)[:, :Lr]
+    q = jnp.clip(jnp.round(seq / rscale[:, :, None, None]),
+                 -_INT8_MAX, _INT8_MAX).astype(pages.dtype)
+    pages = pages.at[:, pidx, pos % S].set(q)
+    cpos = jax.lax.iota(jnp.int32, Lp // S) * S
+    cpidx = jnp.where(cpos < n_valid, table[cpos // S], 0)
+    return pages, scales.at[:, cpidx].set(pscale)
+
+
+# ---------------------------------------------------------------------------
 # the pool
 # ---------------------------------------------------------------------------
 
@@ -126,14 +241,33 @@ class KVCachePool:
                 "reserved dump page), got %d" % self.n_pages)
         shape = (int(n_layers), self.n_pages, self.page_size,
                  int(n_heads), int(head_dim))
-        dtype = jnp.float32 if dtype is None else dtype
+        if dtype is None:
+            name = envs.get_str("MXNET_KV_DTYPE") or "float32"
+            try:
+                dtype = jnp.dtype(name)
+            except TypeError:
+                raise MXNetError(
+                    "KVCachePool: unknown MXNET_KV_DTYPE %r (one of "
+                    "float32 | bfloat16 | int8)" % name)
+        dtype = jnp.dtype(dtype)
+        self.dtype = dtype
+        self.quantized = dtype == jnp.int8
         k = jnp.zeros(shape, dtype)
         v = jnp.zeros(shape, dtype)
+        k_scale = v_scale = None
+        if self.quantized:
+            k_scale = jnp.zeros(shape[:2], jnp.float32)
+            v_scale = jnp.zeros(shape[:2], jnp.float32)
         if device is not None:
             k = jax.device_put(k, device)
             v = jax.device_put(v, device)
+            if self.quantized:
+                k_scale = jax.device_put(k_scale, device)
+                v_scale = jax.device_put(v_scale, device)
         self.k = k
         self.v = v
+        self.k_scale = k_scale
+        self.v_scale = v_scale
         self._lock = threading.Lock()
         self._free = list(range(self.n_pages - 1, 0, -1))  # pop() -> 1
         self._used_peak = 0
@@ -186,6 +320,7 @@ class KVCachePool:
             return {
                 "page_size": self.page_size,
                 "pages": self.usable_pages,
+                "dtype": str(self.dtype),
                 "free": free,
                 "used": self.usable_pages - free,
                 "peak_used": self._used_peak,

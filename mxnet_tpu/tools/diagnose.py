@@ -143,7 +143,7 @@ def read_telemetry(path):
     out = {"run": None, "steps": [], "memory": [], "compiles": [],
            "utilization": [], "checkpoints": [], "serving": [],
            "decode": [], "router": [], "bucketing": [], "alerts": [],
-           "breakdown": None, "summary": None}
+           "loss_scale": [], "breakdown": None, "summary": None}
     skipped = 0
     with open(path) as f:
         for line in f:
@@ -167,8 +167,8 @@ def read_telemetry(path):
                        "compiles": [], "utilization": [],
                        "checkpoints": [], "serving": [],
                        "decode": [], "router": [], "bucketing": [],
-                       "alerts": [], "breakdown": None,
-                       "summary": None}
+                       "alerts": [], "loss_scale": [],
+                       "breakdown": None, "summary": None}
                 skipped = 0     # earlier runs' damage is not THIS
                                 # run's — the warning describes the
                                 # run being rendered
@@ -194,6 +194,8 @@ def read_telemetry(path):
                 out["bucketing"].append(rec)
             elif kind == "alert":
                 out["alerts"].append(rec)
+            elif kind == "loss_scale":
+                out["loss_scale"].append(rec)
             elif kind == "summary":
                 out["summary"] = rec
     out["skipped_lines"] = skipped
@@ -465,6 +467,26 @@ def format_telemetry(tel):
                                     for kv_ in sorted(
                                         shed_pri.items())))
 
+    # -- dynamic loss scale (fault.scale_backoff under AMP) --------------
+    ls_recs = tel.get("loss_scale") or []
+    if ls_recs:
+        lines.append("----------Loss Scale----------")
+        shown = ls_recs[-12:]
+        traj = "%g" % shown[0].get("prev", 0)
+        for r in shown:
+            traj += " -> %g (%s)" % (r.get("scale", 0),
+                                     r.get("cause") or "?")
+        prefix = "(+%d earlier) " % (len(ls_recs) - len(shown)) \
+            if len(ls_recs) > len(shown) else ""
+        lines.append("trajectory   : %s%s" % (prefix, traj))
+        n_back = sum(1 for r in ls_recs
+                     if r.get("cause") == "backoff")
+        lines.append("changes      : %d backoff(s), %d regrow(s); "
+                     "final scale %g — a scale pinned at 1.0 means a "
+                     "numerics problem, not an overflow problem"
+                     % (n_back, len(ls_recs) - n_back,
+                        ls_recs[-1].get("scale", 0)))
+
     # -- autoregressive decode serving (serving.decode) -----------------
     dec_recs = tel.get("decode") or []
     # records are cumulative per server name: keep each name's last
@@ -509,13 +531,15 @@ def format_telemetry(tel):
             kv = d.get("kv") or {}
             if kv:
                 pages = kv.get("pages", 0) or 1
+                dtype = kv.get("dtype") or "float32"
                 lines.append("  kv pool    : %d/%d pages used (peak "
-                             "%d, %.1f%%), %d evicted, page size %d"
+                             "%d, %.1f%%), %d evicted, page size %d, "
+                             "dtype %s"
                              % (kv.get("used", 0), kv.get("pages", 0),
                                 kv.get("peak_used", 0),
                                 100.0 * kv.get("peak_used", 0) / pages,
                                 kv.get("evicted", 0),
-                                kv.get("page_size", 0)))
+                                kv.get("page_size", 0), dtype))
             if d.get("swaps"):
                 lines.append("  weights    : %d hot swap(s), serving "
                              "version %s (%d generation(s) alive)"

@@ -244,8 +244,9 @@ def test_one_time_zeros_specializations_do_not_storm():
 def test_mfu_against_hand_computed_matmul_flops(monkeypatch):
     """A (8,16)@(16,4) matmul is exactly 2*8*16*4 = 1024 flops in
     XLA's cost model; the utilization record's MFU must equal
-    flops / (step_seconds * peak * n_devices) for the overridden
-    peak."""
+    flops / (step_seconds * dtype_peak * n_devices) for the
+    overridden peak, where an fp32 program's achievable peak is the
+    table peak times PEAK_DTYPE_FACTOR["float32"]."""
     peak = 1e9
     monkeypatch.setenv("MXNET_DEVICE_PEAK_FLOPS", str(peak))
     compile_watch.enable()
@@ -264,10 +265,59 @@ def test_mfu_against_hand_computed_matmul_flops(monkeypatch):
     util = run_records[0]
     assert util["flops"] == 2 * 8 * 16 * 4
     n_dev = compile_watch.stats()["n_devices"]
-    expect = util["flops"] / ((rec["dur_ms"] / 1e3) * peak * n_dev)
+    f32_peak = peak * compile_watch.dtype_peak_factor("float32")
+    expect = util["flops"] / ((rec["dur_ms"] / 1e3) * f32_peak * n_dev)
     assert util["mfu"] == pytest.approx(expect, rel=1e-3)
     assert summary["utilization"]["mfu"]["samples"] == 1
     assert summary["utilization"]["peak_flops"] == peak
+
+
+def test_mfu_dtype_aware_peak(monkeypatch):
+    """The SAME matmul in bf16 reports half the MFU of its fp32 twin
+    for equal step time: bf16 flops count against the FULL table peak
+    (factor 1.0) where fp32 counts against half of it — the dtype-
+    aware normalization that keeps AMP and fp32 runs comparable. The
+    compile record names each program's compute dtype."""
+    monkeypatch.setenv("MXNET_DEVICE_PEAK_FLOPS", "1e9")
+    compile_watch.enable()
+    assert compile_watch.dtype_peak_factor("bfloat16") == 1.0
+    assert compile_watch.dtype_peak_factor("float32") == 0.5
+    assert compile_watch.dtype_peak_factor("int8") == 2.0
+    assert compile_watch.dtype_peak_factor("weird") == 1.0
+
+    n_dev = None
+    for dt in ("float32", "bfloat16"):
+        # a shape no other test uses: the eager-op wrapper keeps its
+        # compiled cache across tests by design, and this test needs
+        # the compile RECORD (for compute_dtype), not just dispatches
+        a = mx.nd.array(np.ones((8, 48))).astype(dt)
+        b = mx.nd.array(np.ones((48, 4))).astype(dt)
+        telemetry.start()
+        telemetry.step_begin()
+        mx.nd.dot(a, b).asnumpy()
+        rec = telemetry.step_end()
+        telemetry.stop()
+        utils = [r for r in (telemetry._last_run.records or [])
+                 if r.get("type") == "utilization"]
+        compiles = [r for r in (telemetry._last_run.records or [])
+                    if r.get("type") == "compile"]
+        assert len(utils) == 1
+        util = utils[0]
+        assert dt in [c.get("compute_dtype") for c in compiles]
+        n_dev = n_dev or compile_watch.stats()["n_devices"]
+        dur_s = rec["dur_ms"] / 1e3
+        if dt == "float32":
+            # fp32 work is normalized UP by 1/factor before dividing
+            # by the (bf16) table peak — i.e. measured against half
+            # the peak — and the record shows the normalized figure
+            assert util["flops_norm"] == 2 * util["flops"]
+            expect = util["flops_norm"] / (dur_s * 1e9 * n_dev)
+        else:
+            # bf16 IS the table's native dtype: no normalization, and
+            # no redundant flops_norm field in the record
+            assert "flops_norm" not in util
+            expect = util["flops"] / (dur_s * 1e9 * n_dev)
+        assert util["mfu"] == pytest.approx(expect, rel=1e-3)
 
 
 def test_step_without_watched_dispatch_emits_no_utilization():

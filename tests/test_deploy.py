@@ -170,3 +170,114 @@ def test_format1_artifact_still_loads(tmp_path):
                                rtol=1e-5, atol=1e-6)
     # sanity: the raw blob really is format-1 era jax.export output
     assert jexport.deserialize(blob) is not None
+
+
+# ---------------------------------------------------------------------------
+# format 3: int8-quantized artifacts
+# ---------------------------------------------------------------------------
+
+def _mlp_and_calib(seed=0, batch=4):
+    rng = np.random.RandomState(seed)
+    data = mx.sym.var("data")
+    fc1 = mx.sym.FullyConnected(data, num_hidden=16, name="fc1")
+    act = mx.sym.Activation(fc1, act_type="relu")
+    fc2 = mx.sym.FullyConnected(act, num_hidden=4, name="fc2")
+    params = {"fc1_weight": mx.nd.array(
+                  rng.randn(16, 8).astype(np.float32) * 0.3),
+              "fc1_bias": mx.nd.zeros((16,)),
+              "fc2_weight": mx.nd.array(
+                  rng.randn(4, 16).astype(np.float32) * 0.3),
+              "fc2_bias": mx.nd.zeros((4,))}
+    xs = [mx.nd.array(rng.randn(batch, 8).astype(np.float32))
+          for _ in range(3)]
+
+    class Batches:
+        def __iter__(self):
+            return iter([type("B", (), {"data": [x]})() for x in xs])
+
+        def reset(self):
+            pass
+
+    return fc2, params, xs, Batches()
+
+
+def test_int8_export_format3_roundtrip(tmp_path):
+    sym, params, xs, calib = _mlp_and_calib()
+    path = str(tmp_path / "q.mxp")
+    mx.deploy.export_compiled(sym, path, params=params,
+                              input_shapes={"data": (4, 8)},
+                              quantize=True, calib_data=calib)
+    pred = mx.deploy.load_compiled(path)
+    assert pred.meta["format"] == 3
+    q = pred.quantization
+    assert q["dtype"] == "int8" and q["calib_mode"] == "naive"
+    assert q["calib_batches"] == 3
+    assert set(q["ranges"]) == {"fc1", "fc2"}
+    assert all(lo < hi for lo, hi in q["ranges"].values())
+    assert q["max_abs_delta"] >= 0.0
+
+    # the artifact predicts within the RECORDED delta of the fp32 ref
+    ex = sym.bind(mx.cpu(), dict(params, data=xs[0]))
+    want = ex.forward()[0].asnumpy()
+    got = np.asarray(pred(xs[0].asnumpy()))
+    assert np.max(np.abs(got - want)) <= q["max_abs_delta"] + 1e-6
+
+
+def test_int8_export_accuracy_oracle_gates(tmp_path):
+    sym, params, xs, calib = _mlp_and_calib()
+    path = str(tmp_path / "q.mxp")
+    with pytest.raises(mx.base.MXNetError, match="max_output_delta"):
+        mx.deploy.export_compiled(sym, path, params=params,
+                                  input_shapes={"data": (4, 8)},
+                                  quantize=True, calib_data=calib,
+                                  max_output_delta=1e-9)
+    # a generous tolerance exports fine and records it
+    mx.deploy.export_compiled(sym, path, params=params,
+                              input_shapes={"data": (4, 8)},
+                              quantize=True, calib_data=calib,
+                              max_output_delta=10.0)
+    pred = mx.deploy.load_compiled(path)
+    assert pred.quantization["tolerance"] == 10.0
+    assert pred.quantization["max_abs_delta"] <= 10.0
+
+
+def test_int8_export_requires_calib_and_excludes(tmp_path):
+    sym, params, xs, calib = _mlp_and_calib()
+    path = str(tmp_path / "q.mxp")
+    with pytest.raises(mx.base.MXNetError, match="calib_data"):
+        mx.deploy.export_compiled(sym, path, params=params,
+                                  input_shapes={"data": (4, 8)},
+                                  quantize=True)
+    # excluding every eligible node leaves ranges for none of them
+    mx.deploy.export_compiled(sym, path, params=params,
+                              input_shapes={"data": (4, 8)},
+                              quantize=True, calib_data=calib,
+                              excluded_sym_names=("fc1", "fc2"))
+    pred = mx.deploy.load_compiled(path)
+    assert pred.quantization["excluded"] == ["fc1", "fc2"]
+    # fully-excluded graph == fp32 graph: delta is (near) zero
+    assert pred.quantization["max_abs_delta"] <= 1e-5
+    ex = sym.bind(mx.cpu(), dict(params, data=xs[0]))
+    np.testing.assert_allclose(np.asarray(pred(xs[0].asnumpy())),
+                               ex.forward()[0].asnumpy(),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_int8_export_multi_signature_buckets(tmp_path):
+    """quantize=True composes with batch_sizes: every bucket program
+    runs the int8 graph, pad/slice dispatch is unchanged."""
+    sym, params, xs, calib = _mlp_and_calib()
+    path = str(tmp_path / "q.mxp")
+    mx.deploy.export_compiled(sym, path, params=params,
+                              input_shapes={"data": (4, 8)},
+                              batch_sizes=[2, 4, 8],
+                              quantize=True, calib_data=calib)
+    pred = mx.deploy.load_compiled(path)
+    assert pred.meta["format"] == 3
+    assert pred.batch_sizes == [2, 4, 8]
+    tol = pred.quantization["max_abs_delta"] + 1e-6
+    ex = sym.bind(mx.cpu(), dict(params, data=xs[0]))
+    want = ex.forward()[0].asnumpy()
+    # batch 3 pads onto the 4-bucket; rows must match the exact call
+    got3 = np.asarray(pred(xs[0].asnumpy()[:3]))
+    assert np.max(np.abs(got3 - want[:3])) <= tol

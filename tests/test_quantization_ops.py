@@ -1,0 +1,156 @@
+"""INT8 quantization operator properties (mxnet_tpu.ops.quantization).
+
+Beyond the breadth suite's smoke coverage, this pins the three
+contracts the deploy quantization path leans on: the quantize →
+dequantize round-trip error is bounded by half a quantization step;
+the quantized FC/conv kernels really accumulate in int32 on the MXU
+path (``preferred_element_type``) and agree with the fp32 reference;
+and calibration ranges ride as TRACED operands — changing range
+values never recompiles the program.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.ndarray.ndarray import invoke_nd
+from mxnet_tpu.ops import quantization as qops
+
+
+def nd(x, dtype=np.float32):
+    return mx.nd.array(np.asarray(x, dtype))
+
+
+def run(name, inputs, attrs):
+    out = invoke_nd(name, [i if isinstance(i, mx.nd.NDArray) else nd(i)
+                           for i in inputs], attrs)
+    if isinstance(out, (list, tuple)):
+        return [o.asnumpy() for o in out]
+    return out.asnumpy()
+
+
+def test_roundtrip_error_bounded_by_half_step():
+    """|x - dequantize(quantize(x))| <= scale/2 elementwise, scale =
+    amax/127 — the symmetric-quantization error bound every tolerance
+    in the deploy tests derives from."""
+    rng = np.random.RandomState(1)
+    for shape, lo, hi in [((64,), -3, 3), ((4, 8), -0.01, 0.01),
+                          ((2, 3, 5), -100, 40)]:
+        x = rng.uniform(lo, hi, shape).astype(np.float32)
+        q, qmin, qmax = run("_contrib_quantize_v2", [x], {})
+        assert q.dtype == np.int8
+        back = run("_contrib_dequantize",
+                   [nd(q, np.int8), nd(qmin), nd(qmax)], {})
+        step = max(abs(float(x.min())), abs(float(x.max()))) / 127.0
+        assert np.max(np.abs(back - x)) <= step / 2 + 1e-7
+
+
+def test_int8_dot_accumulates_in_int32():
+    """A (2,256)x(256,) all-127 contraction needs 256*127*127 ≈ 4.1e6
+    per output element — far past int16. The quantized FC must return
+    the EXACT int32 accumulator."""
+    import jax.numpy as jnp
+    data = np.full((2, 256), 127, np.int8)
+    weight = np.full((4, 256), 127, np.int8)
+    one = np.float32(1.0)
+    acc, omin, omax = run(
+        "_contrib_quantized_fully_connected",
+        [nd(data, np.int8), nd(weight, np.int8),
+         nd(-one), nd(one), nd(-one), nd(one)],
+        {"num_hidden": 4, "no_bias": True})
+    assert acc.dtype == np.int32
+    assert np.all(acc == 256 * 127 * 127)
+    # and the declared range maps the accumulator back to real units:
+    # data scale = weight scale = 1/127, so one acc unit = 1/127^2
+    real = acc.astype(np.float64) * (float(omax) / 2147483647.0)
+    want = (data.astype(np.float64) / 127.0) @ \
+        (weight.astype(np.float64) / 127.0).T
+    np.testing.assert_allclose(real, want, rtol=1e-6)
+
+
+def test_int8_fc_matches_fp32_reference():
+    rng = np.random.RandomState(3)
+    x = rng.uniform(-2, 2, (5, 16)).astype(np.float32)
+    w = rng.uniform(-1, 1, (6, 16)).astype(np.float32)
+    b = rng.uniform(-1, 1, (6,)).astype(np.float32)
+    qx, xmin, xmax = run("_contrib_quantize_v2", [x], {})
+    qw, wmin, wmax = run("_contrib_quantize_v2", [w], {})
+    qb, bmin, bmax = run("_contrib_quantize_v2", [b], {})
+    acc, omin, omax = run(
+        "_contrib_quantized_fully_connected",
+        [nd(qx, np.int8), nd(qw, np.int8), nd(qb, np.int8),
+         nd(xmin), nd(xmax), nd(wmin), nd(wmax), nd(bmin), nd(bmax)],
+        {"num_hidden": 6, "no_bias": False})
+    assert acc.dtype == np.int32
+    real = acc.astype(np.float64) * (float(omax) / 2147483647.0)
+    want = x @ w.T + b
+    scale = np.abs(want).max()
+    np.testing.assert_allclose(real, want, atol=0.05 * scale + 0.02)
+
+
+def test_int8_conv_matches_fp32_reference():
+    rng = np.random.RandomState(4)
+    x = rng.uniform(-1, 1, (2, 3, 8, 8)).astype(np.float32)
+    w = rng.uniform(-1, 1, (4, 3, 3, 3)).astype(np.float32)
+    qx, xmin, xmax = run("_contrib_quantize_v2", [x], {})
+    qw, wmin, wmax = run("_contrib_quantize_v2", [w], {})
+    acc, omin, omax = run(
+        "_contrib_quantized_conv",
+        [nd(qx, np.int8), nd(qw, np.int8),
+         nd(xmin), nd(xmax), nd(wmin), nd(wmax)],
+        {"kernel": (3, 3), "num_filter": 4, "no_bias": True,
+         "pad": (1, 1)})
+    assert acc.dtype == np.int32
+    real = acc.astype(np.float64) * (float(omax) / 2147483647.0)
+    want = np.asarray(mx.nd.Convolution(
+        nd(x), nd(w), kernel=(3, 3), num_filter=4, no_bias=True,
+        pad=(1, 1)).asnumpy())
+    scale = np.abs(want).max()
+    np.testing.assert_allclose(real, want, atol=0.05 * scale + 0.02)
+
+
+def test_ranges_are_traced_not_static():
+    """Calibration ranges flow as array operands through the quantize/
+    requantize/dequantize chain: one trace serves EVERY range value —
+    recalibrating never recompiles the serving program."""
+    import jax
+    import jax.numpy as jnp
+    traces = [0]
+
+    def chain(x, mn, mx_):
+        traces[0] += 1
+        q, qmin, qmax = qops._quantize({}, x, mn, mx_)
+        return qops._dequantize({}, q, qmin, qmax)
+
+    f = jax.jit(chain)
+    rng = np.random.RandomState(5)
+    x = jnp.asarray(rng.uniform(-2, 2, (4, 8)).astype(np.float32))
+    outs = []
+    for r in (0.5, 1.0, 2.0, 3.7):
+        outs.append(np.asarray(
+            f(x, jnp.float32(-r), jnp.float32(r))))
+    assert traces[0] == 1
+    # the range genuinely took effect each call: a tight range clips
+    np.testing.assert_allclose(np.max(np.abs(outs[0])), 0.5, atol=1e-6)
+    assert np.max(np.abs(outs[3] - np.asarray(x))) <= 3.7 / 127 / 2 + 1e-6
+
+
+def test_requantize_calibrated_vs_traced_minmax():
+    """_contrib_requantize narrows int32 → int8 either by calibrated
+    attr ranges (static floats baked at trace time) or by the traced
+    data min/max — both paths must agree when the calibration matches
+    the data's actual range."""
+    rng = np.random.RandomState(6)
+    acc = rng.randint(-10_000_000, 10_000_000,
+                      size=(4, 8)).astype(np.int32)
+    rmax = np.float32(1.0)
+    out_t, tmin, tmax = run(
+        "_contrib_requantize", [nd(acc, np.int32), nd(-rmax), nd(rmax)],
+        {})
+    real = acc.astype(np.float64) * (1.0 / 2147483647.0)
+    amax = np.abs(real).max()
+    out_c, cmin, cmax = run(
+        "_contrib_requantize", [nd(acc, np.int32), nd(-rmax), nd(rmax)],
+        {"min_calib_range": -amax, "max_calib_range": amax})
+    assert out_t.dtype == np.int8 and out_c.dtype == np.int8
+    np.testing.assert_allclose(float(tmax), float(cmax), rtol=1e-5)
+    np.testing.assert_array_equal(out_t, out_c)
