@@ -87,6 +87,7 @@ from . import tracing
 from . import telemetry
 from . import compile_watch
 from . import livemetrics
+from . import flightrec
 from . import checkpoint
 from . import model
 from . import rnn

@@ -161,6 +161,27 @@ declare("MXNET_TRACE_RING", "int", 200000,
         "Bounded in-memory trace-event ring (oldest dropped).", _G)
 declare("MXNET_TRACE_TRACKS", "int", 4096,
         "Cap on distinct trace tracks (request lanes).", _G)
+declare("MXNET_TRACE_WIRE", "bool", True,
+        "Propagate the serializable trace context across process "
+        "boundaries (router dispatch, multihost exchange) while "
+        "tracing is on; off keeps every wire payload byte-identical "
+        "even with a local tracer armed.", _G)
+declare("MXNET_FLIGHTREC_DIR", "path", "",
+        "Arm the flight recorder: post-mortem bundles (trace ring, "
+        "recent telemetry, env/compile/serving state, the triggering "
+        "alert) land here on watchdog alerts and crash paths.", _G)
+declare("MXNET_FLIGHTREC_MAX_BUNDLES", "int", 8,
+        "Keep at most this many flight-recorder bundles (oldest "
+        "deleted first).", _G)
+declare("MXNET_FLIGHTREC_MAX_BYTES", "int", 16 << 20,
+        "Total on-disk budget for flight-recorder bundles; oldest "
+        "bundles are deleted until a new one fits.", _G)
+declare("MXNET_FLIGHTREC_INTERVAL_MS", "int", 5000,
+        "Rate limit between flight-recorder dumps; triggers inside "
+        "the window are counted as suppressed, never stacked.", _G)
+declare("MXNET_FLIGHTREC_RECORDS", "int", 256,
+        "Last K telemetry records the flight recorder keeps in its "
+        "bounded shadow ring for bundles.", _G)
 declare("MXNET_PROFILER_MAX_EVENTS", "int", 1000000,
         "Host-profiler event cap; overflow increments "
         "profiler_events_dropped instead of growing forever.", _G)

@@ -84,10 +84,13 @@ _ACTIONS = ("raise", "hang", "stall", "nan", "inf")
 # the training thread — `proc_exit:step=N:raise` is the deterministic
 # "host dies at exactly step N" the supervised launcher's
 # restart-the-world path is tested against.
+# flightrec fires once per flight-recorder dump attempt (flightrec.py)
+# — a planned raise proves a failing dumper is counted and swallowed,
+# never fatal to the process it is post-morteming.
 _SITES = ("push", "pull", "allreduce", "wait", "init", "grad",
           "ckpt_write", "ckpt_fsync", "serve_admit", "serve_dispatch",
           "serve_decode", "serve_route", "kv_evict", "replica_lost",
-          "proc_hb", "proc_join", "proc_exit")
+          "proc_hb", "proc_join", "proc_exit", "flightrec")
 # corruption needs a value to corrupt — only the grad site carries one
 _VALUE_SITES = ("grad",)
 _GUARD_POLICIES = ("skip_step", "scale_backoff")

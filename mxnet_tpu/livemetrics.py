@@ -488,10 +488,31 @@ def _render_router(page):
                            "token")
 
 
+def _render_identity(page):
+    """The fleet-join info gauge: constant 1 whose labels say WHO this
+    process is — run id, rank, restart generation, jax/jaxlib versions
+    — so any series scraped from this endpoint joins to its fleet
+    coordinates with one ``group_left`` instead of per-series labels."""
+    from . import telemetry, tracing
+    import jax
+    import jaxlib
+    ident = tracing.process_identity()
+    rep = telemetry.report()
+    page.add("mxnet_identity_info", 1,
+             labels={"run": (rep or {}).get("run_id") or "",
+                     "rank": ident["rank"],
+                     "generation": ident["gen"],
+                     "jax": jax.__version__,
+                     "jaxlib": jaxlib.__version__},
+             help_="constant 1; the labels identify this process "
+                   "(run id, rank, restart generation, jax versions)")
+
+
 def render():
     """The whole ``/metrics`` page as Prometheus text exposition."""
     page = _Page()
     page.add("mxnet_up", 1, help_="the mxnet_tpu process is alive")
+    _render_identity(page)
     _render_training(page)
     _render_counters(page)
     _render_serving(page)

@@ -167,6 +167,45 @@ def barrier(name, timeout_ms=None, one_shot=False):
 _xchg_lock = threading.Lock()
 _xchg_seq = [0]
 
+# wire-context framing for the exchange leg: when tracing is armed a
+# sender prepends MAGIC + 4-byte big-endian length + context JSON to
+# its payload; a receiver ALWAYS strips the frame when the magic is
+# present (a traced sender and an untraced receiver must still agree
+# on payload bytes). With tracing off nothing is prepended — the KV
+# values stay byte-identical to the pre-wire-context contract.
+_WIRE_MAGIC = b"\x00MXWC1\x00"
+
+
+def _wire_wrap(tag, payload):
+    from .. import tracing
+    ctx = tracing.wire_context(tag=tag)
+    if ctx is None:
+        return bytes(payload)
+    import json as _json
+    blob = _json.dumps(ctx).encode()
+    return (_WIRE_MAGIC + len(blob).to_bytes(4, "big") + blob
+            + bytes(payload))
+
+
+def _wire_unwrap(blob):
+    """Strip (and adopt) a peer's wire-context frame. Returns the
+    bare payload; a malformed frame falls back to the raw bytes (the
+    magic is 8 NUL-bracketed bytes no savez/base85 payload starts
+    with)."""
+    if not blob.startswith(_WIRE_MAGIC):
+        return blob
+    try:
+        off = len(_WIRE_MAGIC)
+        n = int.from_bytes(blob[off:off + 4], "big")
+        import json as _json
+        ctx = _json.loads(blob[off + 4:off + 4 + n].decode())
+        payload = blob[off + 4 + n:]
+    except (ValueError, UnicodeDecodeError):
+        return blob
+    from .. import tracing
+    tracing.adopt_context(ctx, name="ctx:exchange", cat="wire")
+    return payload
+
 
 def _next_tag(tag):
     """Unique-per-use exchange tag. Every process calls exchanges in
@@ -196,12 +235,13 @@ def exchange_bytes(tag, payload, timeout_ms=None):
     timeout_ms = int(timeout_ms
                      if timeout_ms is not None else 10 * _timeout_ms())
     key = _next_tag(tag)
+    wire = _wire_wrap(key, payload)     # tracing off: payload verbatim
     raw = hasattr(client, "key_value_set_bytes")
     if raw:
-        client.key_value_set_bytes("%s/%d" % (key, me), bytes(payload))
+        client.key_value_set_bytes("%s/%d" % (key, me), wire)
     else:       # older jaxlib: string-only store, base85 the payload
         client.key_value_set("%s/%d" % (key, me),
-                             base64.b85encode(bytes(payload)).decode())
+                             base64.b85encode(wire).decode())
     def _peer_alive(r):
         """Liveness vs progress: a peer that is SLOW (long compile, a
         big shard write) must not be declared lost while its
@@ -244,7 +284,7 @@ def exchange_bytes(tag, payload, timeout_ms=None):
                     "host lost or wedged (%s)"
                     % (key, r, timeout_ms, type(exc).__name__)) \
                     from exc
-        out.append(val)
+        out.append(_wire_unwrap(val))
     # nobody reads these keys again (every process holds the values);
     # dropping them bounds the coordinator's store. The barrier makes
     # the delete safe — all readers are done. The key is unique per
@@ -557,8 +597,11 @@ class Heartbeat:
             logging.getLogger(__name__).error(
                 "HostLostError: %s — exiting %d for the supervisor",
                 msg, HOST_LOST_EXIT)
-            from .. import telemetry
+            from .. import flightrec, telemetry
             telemetry.note("host_lost")
+            # last words before os._exit: the surviving rank's view of
+            # the loss (never raises; one None check when disarmed)
+            flightrec.crash_dump("host_lost", detail=msg)
             if self.exit_on_loss:
                 # the training thread may be wedged inside a
                 # collective that will never return; flush what we
@@ -633,6 +676,12 @@ def stop_heartbeat(clean=None):
         hb.stop()
         if clean is None:
             clean = not _dying[0]
+        if not clean:
+            # the dying rank's own last words (excepthook, fatal step
+            # boundary): bundle before the interpreter unwinds — the
+            # atexit trace export may never run if peers exit us first
+            from .. import flightrec
+            flightrec.crash_dump("host_dying")
         if clean:
             try:
                 path = hb._path(hb.rank) + ".done"

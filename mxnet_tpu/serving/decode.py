@@ -89,7 +89,7 @@ import numpy as _np
 
 from .. import envs
 from ..base import MXNetError
-from .. import fault, profiler, telemetry
+from .. import fault, profiler, telemetry, tracing
 from ..bucketing.ladder import BucketLadder
 from . import kvcache
 from .kvcache import KVCachePool
@@ -125,7 +125,8 @@ class DecodeRequest:
     __slots__ = ("prompt", "max_new", "priority", "deadline", "eos_id",
                  "request_id", "t_submit", "pages", "generated",
                  "params", "state", "_cancelled", "_stream", "_event",
-                 "_error", "_last_emit", "_t_first")
+                 "_error", "_last_emit", "_t_first", "trace_args",
+                 "_t_trace")
 
     def __init__(self, prompt, max_new, priority, deadline, eos_id,
                  request_id):
@@ -147,6 +148,9 @@ class DecodeRequest:
         self._error = None
         self._last_emit = None
         self._t_first = None
+        self.trace_args = None    # span args while traced (carries an
+                                  # adopted router request_id, if any)
+        self._t_trace = None      # trace-clock submit stamp
 
     def done(self):
         return self._event.is_set()
@@ -668,7 +672,7 @@ class DecodeServer:
 
     # -- admission ---------------------------------------------------------
     def submit(self, prompt, *, max_new_tokens=None, priority=0,
-               deadline_ms=None, eos_id=None):
+               deadline_ms=None, eos_id=None, trace_ctx=None):
         """Admit one generation: ``prompt`` is a 1-D int token array
         (length <= the ladder top). Returns a :class:`DecodeRequest`
         future streaming up to ``max_new_tokens`` greedy tokens
@@ -678,7 +682,12 @@ class DecodeServer:
         below the arrival instead of the arrival itself — and in
         KV-pool preemption. ``deadline_ms`` bounds the WHOLE
         generation: a request that ages past it (queued or streaming)
-        fails with RequestTimeoutError and frees its pages."""
+        fails with RequestTimeoutError and frees its pages.
+        ``trace_ctx`` is an optional :func:`tracing.wire_context` dict
+        from the submitting process (the fleet router passes one) —
+        when tracing is on here too, it is adopted so the request's
+        queue/prefill/decode spans carry the ORIGIN request_id and
+        merge causally with the submitter's trace."""
         if self._closed:
             raise ServerClosedError("DecodeServer is stopped")
         prompt = _np.asarray(prompt)
@@ -707,6 +716,19 @@ class DecodeServer:
         rid = "d%06d" % next(self._rid)
         req = DecodeRequest(prompt, max_new, priority,
                             req_deadline(deadline_s), eos_id, rid)
+        if tracing.enabled():
+            joined = rid
+            args = {"server_request_id": rid}
+            if trace_ctx:
+                adopted = tracing.adopt_context(
+                    trace_ctx, name="ctx:submit", cat="wire",
+                    tid=tracing.track("req %s"
+                                      % trace_ctx.get("request_id", rid)))
+                if adopted and adopted.get("request_id"):
+                    joined = adopted["request_id"]
+            args["request_id"] = joined
+            req.trace_args = args
+            req._t_trace = tracing.now()
         victim = None
         shed = stopping = False
         with self._cond:
@@ -888,6 +910,17 @@ class DecodeServer:
         cancelled request completes WITHOUT an error — its stream just
         ends and ``result()`` returns the tokens generated so far,
         with ``state == "cancelled"`` telling the story."""
+        if req.trace_args is not None and req._t_trace is not None:
+            tracing.add(
+                "decode", "decode", req._t_trace,
+                tracing.now() - req._t_trace,
+                tid=tracing.track("req %s" % req.trace_args["request_id"]),
+                args=dict(req.trace_args,
+                          tokens=len(req.generated),
+                          outcome=("cancelled" if cancelled
+                                   else "ok" if error is None
+                                   else type(error).__name__)))
+            req._t_trace = None
         if req.pages:
             self._pool.free(req.pages)
             req.pages = []
@@ -963,6 +996,7 @@ class DecodeServer:
             self._pool.free(pages_back)
             return False
         # run the prefill program at the prompt's rung
+        t_pre = tracing.now() if req.trace_args is not None else None
         P = len(req.prompt)
         rung = self._seq_ladder.bucket_for(P)
         tokens = _np.zeros((1, rung), _np.int32)
@@ -984,6 +1018,16 @@ class DecodeServer:
         now = time.perf_counter()
         req._t_first = now
         req._last_emit = now
+        if req.trace_args is not None and t_pre is not None:
+            rtid = tracing.track("req %s" % req.trace_args["request_id"])
+            if req._t_trace is not None:
+                tracing.add("queue", "decode", req._t_trace,
+                            t_pre - req._t_trace, tid=rtid,
+                            args=req.trace_args)
+            tracing.add("prefill", "decode", t_pre,
+                        tracing.now() - t_pre, tid=rtid,
+                        args=dict(req.trace_args, rung=rung))
+            req._t_trace = tracing.now()
         with self._cond:
             self._stats["prefill_steps"] += 1
             self._stats["tokens_out"] += 1

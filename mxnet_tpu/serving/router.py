@@ -84,7 +84,7 @@ from collections import deque
 
 import numpy as _np
 
-from .. import envs, fault, telemetry
+from .. import envs, fault, telemetry, tracing
 from ..base import MXNetError
 from . import fleet
 from .decode import req_deadline
@@ -110,7 +110,7 @@ class RouterRequest:
                  "eos_id", "request_id", "t_submit", "state",
                  "failovers", "_emitted", "_out", "_event", "_error",
                  "_cancelled", "_replica", "_inner", "_inner_fwd",
-                 "_failover", "_t_lost", "_resume_pending")
+                 "_failover", "_t_lost", "_resume_pending", "_t_trace")
 
     def __init__(self, prompt, tenant, max_new, priority, deadline,
                  eos_id, request_id):
@@ -136,6 +136,8 @@ class RouterRequest:
         self._failover = False   # queued for re-dispatch after a loss
         self._t_lost = None      # loss-detection time (resume clock)
         self._resume_pending = False
+        self._t_trace = None     # trace-clock submit stamp (None when
+                                 # tracing is off — the queue span)
 
     @property
     def emitted(self):
@@ -466,6 +468,8 @@ class Router:
                                          if deadline_ms is not None
                                          else None),
                             eos_id, rid)
+        if tracing.enabled():
+            req._t_trace = tracing.now()     # the queue span's start
         victim = None
         shed = False
         with self._lock:
@@ -481,12 +485,22 @@ class Router:
             if not shed:
                 t.queue.append(req)
         if victim is not None:
+            tracing.instant(
+                "router:shed", "router",
+                args={"request_id": victim.request_id,
+                      "tenant": victim.tenant,
+                      "priority": victim.priority,
+                      "displaced_by": rid})
             victim._complete(ServerOverloadedError(
                 "router: session %s (priority %d, tenant %s) shed for "
                 "a priority-%d arrival — tenant queue full (bound %d)"
                 % (victim.request_id, victim.priority, victim.tenant,
                    priority, self._tenant_bound)))
         if shed:
+            tracing.instant(
+                "router:shed", "router",
+                args={"request_id": rid, "tenant": req.tenant,
+                      "priority": priority})
             raise ServerOverloadedError(
                 "router: session %s (priority %d, tenant %s) shed — "
                 "tenant queue full (bound %d) and no lower-priority "
@@ -569,6 +583,22 @@ class Router:
             "streaming session(s) by re-prefill replay"
             % (rep.name, len(affected)))
         telemetry.note("router_replica_lost")
+        tracing.instant("router:replica_lost", "router",
+                        args={"replica": rep.name,
+                              "sessions": len(affected)})
+        # a replica loss is alert-grade: the record joins the watchdog
+        # alert stream, and the flight recorder (when armed) dumps one
+        # bundle on this edge — failover count == bundle count is the
+        # fleet-diagnose reconciliation invariant. A fresh stats
+        # snapshot goes out FIRST so the bundle captures the router
+        # state at the alert, not a stale periodic record.
+        self._emit_record()
+        telemetry.alert_event({
+            "kind": "replica_lost",
+            "message": "replica %s confirmed lost; re-homing %d "
+                       "session(s)" % (rep.name, len(affected)),
+            "router": self.name, "replica": rep.name,
+            "sessions": len(affected)})
         for req in affected:
             self._failover_session(req, detect)
 
@@ -627,6 +657,15 @@ class Router:
             req._resume_pending = True
             self._tenant_state(req.tenant).queue.appendleft(req)
             self._stats["failovers"] += 1
+        if tracing.enabled():
+            req._t_trace = tracing.now()    # the replay queue span
+            tracing.instant(
+                "router:failover", "router",
+                tid=tracing.track("req %s" % req.request_id),
+                args={"request_id": req.request_id,
+                      "tenant": req.tenant,
+                      "replica": rep.name if rep is not None else None,
+                      "emitted": len(req._emitted)})
 
     # -- dispatch ----------------------------------------------------------
     def _reap_queued_locked(self, now):
@@ -715,7 +754,21 @@ class Router:
         with self._lock:
             for name in throttled:
                 self._tenants[name].throttled += 1
+        if throttled and tracing.enabled():
+            for name in throttled:
+                tracing.instant("router:throttle", "router",
+                                args={"tenant": name,
+                                      "request_id":
+                                          self._throttled_head(name)})
         return did
+
+    def _throttled_head(self, tenant):
+        """The request_id waiting at a throttled tenant's head (the
+        session the bucket is holding back), for the throttle trace
+        instant. Advisory read."""
+        t = self._tenants.get(tenant)
+        return t.queue[0].request_id if t is not None and t.queue \
+            else None
 
     def _dispatch_one(self, t, req, rep, now):
         """Bind one queued session to one replica (possibly a replay
@@ -736,11 +789,17 @@ class Router:
                     % req.request_id))
                 return True
             deadline_ms = left
+        # the wire context rides the dispatch so the replica's
+        # prefill/decode spans join this session's router spans under
+        # one request_id (None when tracing is off — one None check
+        # on the replica side)
+        ctx = tracing.wire_context(request_id=req.request_id,
+                                   tenant=req.tenant)
         try:
             inner = rep.server.submit(
                 prompt, max_new_tokens=remaining,
                 priority=req.priority, deadline_ms=deadline_ms,
-                eos_id=req.eos_id)
+                eos_id=req.eos_id, trace_ctx=ctx)
         except ServerOverloadedError as exc:
             # the replica shed it at ITS bounded queue — a real
             # overload verdict; propagate the typed error
@@ -782,6 +841,22 @@ class Router:
                 start = max(t.finish, self._vtime)
                 t.finish = start + cost / t.weight
                 self._vtime = start
+        if req._t_trace is not None:
+            # close the router-side queue span and mark the dispatch
+            # edge on the session's own track; a failover requeue
+            # restamps _t_trace so its SECOND queue wait records too
+            t_now = tracing.now()
+            rtid = tracing.track("req %s" % req.request_id)
+            tracing.add("queue", "router", req._t_trace,
+                        t_now - req._t_trace, tid=rtid,
+                        args={"request_id": req.request_id,
+                              "tenant": req.tenant})
+            tracing.instant("router:dispatch", "router", tid=rtid,
+                            args={"request_id": req.request_id,
+                                  "tenant": req.tenant,
+                                  "replica": rep.name,
+                                  "replay": bool(replay)})
+            req._t_trace = None
         return True
 
     # -- relay -------------------------------------------------------------
@@ -885,6 +960,8 @@ class Router:
                 1) / 1e3
             self._stats["drains"] += 1
         telemetry.note("router_drains")
+        tracing.instant("router:drain", "router",
+                        args={"replica": rep.name})
         self._wake.set()
         if wait:
             limit = rep.drain_deadline + max(self._drain_timeout, 1.0)
@@ -908,11 +985,16 @@ class Router:
                 with self._lock:
                     rep.state = "drained"
                 self._monitor.tracker.departed(rep.name)
+                tracing.instant("router:drained", "router",
+                                args={"replica": rep.name})
                 continue
             if rep.drain_deadline is not None \
                     and now > rep.drain_deadline:
                 with self._lock:
                     self._stats["drain_timeouts"] += 1
+                tracing.instant("router:drain_timeout", "router",
+                                args={"replica": rep.name,
+                                      "sessions": len(bound)})
                 for req in bound:
                     inner = req._inner
                     if inner is not None:

@@ -133,6 +133,22 @@ _util_reset = None
 # One global None check each when the watchdog is off.
 _watch_step = None
 _watch_serving = None
+# flight-recorder hooks, installed by flightrec.enable(): _recent is
+# the recorder's own bounded deque shadowing every record the run
+# appends (records leave run.records at flush, so a post-mortem needs
+# its own tail); _flight_alert receives each alert's fields at the
+# alert edge. One global None check each when the recorder is off.
+_recent = None
+_flight_alert = None
+
+
+def _remember(rec):
+    """Shadow one record into the flight recorder's bounded ring.
+    One None check when no recorder is armed; deque appends are
+    thread-safe, so callers may hold the lock or not."""
+    r = _recent
+    if r is not None:
+        r.append(rec)
 
 
 class _Run:
@@ -246,6 +262,8 @@ def start(filename=None, run_id=None, meta=None):
     compile_watch.maybe_enable()   # MXNET_COMPILE_WATCH rides the run
     compile_watch.run_reset()      # utilization is scoped to THIS run
     tracing.maybe_enable()         # MXNET_TRACE rides the run too
+    from . import flightrec
+    flightrec.maybe_enable()       # MXNET_FLIGHTREC_DIR rides the run
     from . import livemetrics
     # MXNET_METRICS_PORT / MXNET_WATCHDOG; a new run gets a FRESH
     # watchdog so the drift baseline never spans workloads
@@ -328,6 +346,7 @@ def stop():
     summary = report()
     with _lock:
         run.records.append(dict(summary, type="summary"))
+        _remember({"type": "summary", "run_id": run.run_id})
         _last_run = run
         _run = None
     _flush_run(run)
@@ -386,6 +405,7 @@ def _close_step_locked(run, now, samples):
     run._step_fault_base = dict(run.fault_counters)
     run.ring.append(rec)
     run.records.append(rec)
+    _remember(rec)
     if tracing._tracer is not None:
         # the step's own trace span on the accounting thread's track;
         # phase spans recorded by _Span nest inside it by containment
@@ -399,6 +419,7 @@ def _close_step_locked(run, now, samples):
                     "t": rec["t"], "dur_ms": rec["dur_ms"]}
             urec.update(util)
             run.records.append(urec)
+            _remember(urec)
     _cap_records_locked(run)
     run._steps_since_flush += 1
     run._steps_since_mem += 1
@@ -675,7 +696,9 @@ def external_record(rec):
     if run is None:
         return
     with _lock:
-        run.records.append(dict(rec))
+        rec = dict(rec)
+        run.records.append(rec)
+    _remember(rec)
 
 
 def checkpoint_event(fields):
@@ -711,6 +734,7 @@ def checkpoint_event(fields):
             agg["last_good_epoch"] = last if prev is None \
                 else max(prev, last)
         run.records.append(rec)
+    _remember(rec)
 
 
 def serving_event(fields):
@@ -728,6 +752,7 @@ def serving_event(fields):
         with _lock:
             run.serving = dict(fields)     # cumulative: latest wins
             run.records.append(rec)
+            _remember(rec)
             # a stepless sink-less process hosting a long-lived server
             # would otherwise grow records unboundedly (steps cap
             # them, but a pure serving process never steps)
@@ -762,6 +787,7 @@ def decode_event(fields):
         # cumulative per server name: latest wins
         run.decode[fields.get("name") or "default"] = dict(fields)
         run.records.append(rec)
+        _remember(rec)
         # a stepless sink-less process hosting a long-lived decode
         # server must not grow records unboundedly
         _cap_records_locked(run)
@@ -788,6 +814,7 @@ def router_event(fields):
         # cumulative per router name: latest wins
         run.router[fields.get("name") or "default"] = dict(fields)
         run.records.append(rec)
+        _remember(rec)
         # a long-lived fleet front door in a stepless process must not
         # grow records unboundedly
         _cap_records_locked(run)
@@ -813,6 +840,7 @@ def bucketing_event(fields):
         # cumulative per producer: latest wins
         run.bucketing[fields.get("name") or "default"] = dict(fields)
         run.records.append(rec)
+        _remember(rec)
         # a stepless sink-less loop (a bare data-pipeline soak) must
         # not grow records unboundedly
         _cap_records_locked(run)
@@ -826,23 +854,29 @@ def alert_event(fields):
     run, so a watchdog-off (or alert-free) run keeps a byte-identical
     sink."""
     run = _run
-    if run is None:
-        return
-    rec = {"type": "alert", "seq": run.steps,
-           "t": round(time.time() - run.t0_wall, 6)}
-    rec.update(fields)
-    with _lock:
-        if run.alerts is None:
-            run.alerts = []
-        run.alerts.append(dict(fields))
-        # the summary's alert list is bounded: a condition that stays
-        # in breach for days must not grow host memory — the newest
-        # window plus a drop count tells the whole story
-        if len(run.alerts) > _MAX_ALERTS:
-            run.alerts_dropped += len(run.alerts) - _MAX_ALERTS
-            del run.alerts[:len(run.alerts) - _MAX_ALERTS]
-        run.records.append(rec)
-        _cap_records_locked(run)
+    if run is not None:
+        rec = {"type": "alert", "seq": run.steps,
+               "t": round(time.time() - run.t0_wall, 6)}
+        rec.update(fields)
+        with _lock:
+            if run.alerts is None:
+                run.alerts = []
+            run.alerts.append(dict(fields))
+            # the summary's alert list is bounded: a condition that
+            # stays in breach for days must not grow host memory — the
+            # newest window plus a drop count tells the whole story
+            if len(run.alerts) > _MAX_ALERTS:
+                run.alerts_dropped += len(run.alerts) - _MAX_ALERTS
+                del run.alerts[:len(run.alerts) - _MAX_ALERTS]
+            run.records.append(rec)
+            _remember(rec)
+            _cap_records_locked(run)
+    # the flight recorder dumps on the alert edge EVEN WITHOUT a run —
+    # a pure serving process's watchdog breach still deserves a
+    # post-mortem bundle. Called outside the lock.
+    hook = _flight_alert
+    if hook is not None:
+        hook(dict(fields))
 
 
 _MAX_ALERTS = 256
@@ -935,6 +969,7 @@ def memory_breakdown(**kinds):
             rec = {"type": "memory_breakdown", "seq": run.steps}
             rec.update(bd)
             run.records.append(rec)
+            _remember(rec)
 
 
 def _record_memory(run, device, in_use, peak):
@@ -951,6 +986,7 @@ def _record_memory(run, device, in_use, peak):
         wm["last_bytes_in_use"] = in_use
         wm["samples"] += 1
         run.records.append(rec)
+        _remember(rec)
 
 
 # ---------------------------------------------------------------------------

@@ -38,13 +38,27 @@ reconciling the supervised launcher's restart generation
 line (a run killed mid-append) is skipped with a one-line warning;
 the rest of the report renders. This supersedes scraping the same
 facts out of log lines with ``tools/parse_log.py``.
+
+Fleet mode: pointing diagnose at a DIRECTORY (or a shell glob) of
+per-rank/per-worker sinks renders the cross-rank report instead — a
+skew table (per-rank step-time/data_wait deltas with slowest-rank
+attribution and the restart-generation timeline) plus a fleet serving
+rollup that joins router records against replica records across sinks
+(``dispatched == admitted + shed``) and reconciles flight-recorder
+bundles (``mxnet_tpu.flightrec``) against the ``replica_lost`` alerts
+that triggered them. A torn sink or bundle becomes a counted WARNING
+line, never an abort. ``--format json`` mirrors every table — single
+file or fleet — as structured records; the default text output of the
+single-file path is unchanged.
 """
 from __future__ import annotations
 
 import argparse
+import glob as _glob
 import json
 import os
 import platform
+import re
 import subprocess
 import sys
 
@@ -859,22 +873,493 @@ def format_telemetry(tel):
     return "\n".join(lines)
 
 
+def _last_by_name(recs, fallback):
+    """Cumulative-snapshot record streams (serving/decode/router/
+    bucketing): the last record per name is the truth."""
+    by = {}
+    for rec in recs or []:
+        by[rec.get("name") or "default"] = rec
+    if not by and fallback:
+        by = dict(fallback)
+    return by or None
+
+
+def telemetry_json(tel):
+    """The ``--format json`` mirror of :func:`format_telemetry`: every
+    table as one structured record — same aggregation, no layout."""
+    from ..telemetry import percentile
+    run = tel.get("run") or {}
+    summary = tel.get("summary") or {}
+    steps = tel.get("steps") or []
+    durs = [s["dur_ms"] for s in steps if s.get("dur_ms") is not None]
+    out = {"run_id": run.get("run_id") or summary.get("run_id"),
+           "meta": run.get("meta") or None,
+           "skipped_lines": tel.get("skipped_lines", 0)}
+    out["step_time"] = {
+        "steps": len(durs),
+        "mean_ms": sum(durs) / len(durs),
+        "p50_ms": percentile(durs, 50),
+        "p90_ms": percentile(durs, 90),
+        "p99_ms": percentile(durs, 99),
+        "max_ms": max(durs)} if durs else None
+    totals = dict(summary.get("phases_ms") or {})
+    if not totals:
+        for s in steps:
+            for phase, ms in (s.get("phases_ms") or {}).items():
+                totals[phase] = totals.get(phase, 0.0) + ms
+    out["phases_ms"] = totals or None
+    # compilation: the same per-program fold format_telemetry renders
+    compiles = tel.get("compiles") or []
+    sum_compile = summary.get("compile") or {}
+    progs = {}
+    for c in compiles:
+        p = progs.setdefault(c.get("program", "?"),
+                             {"count": 0, "ms": 0.0, "causes": {},
+                              "churn": {}})
+        p["count"] += 1
+        p["ms"] += c.get("dur_ms", 0.0)
+        cause = (c.get("cause") or "?").split(" ", 1)[0]
+        p["causes"][cause] = p["causes"].get(cause, 0) + 1
+        for arg in c.get("changed", ()):
+            p["churn"][arg] = p["churn"].get(arg, 0) + 1
+    if not progs:
+        for name, s in (sum_compile.get("programs") or {}).items():
+            progs[name] = {"count": s.get("count", 0),
+                           "ms": s.get("total_s", 0.0) * 1e3,
+                           "causes": dict(s.get("causes") or {}),
+                           "churn": dict(s.get("churn") or {})}
+    out["compilation"] = {
+        "programs": progs,
+        "storms": sum_compile.get("storms") or [],
+        "cache": sum_compile.get("cache") or None} \
+        if (progs or sum_compile) else None
+    utils = tel.get("utilization") or []
+    sum_util = summary.get("utilization") or {}
+    if utils or sum_util:
+        mfus = [u["mfu"] for u in utils if u.get("mfu") is not None]
+        bwus = [u["bw_util"] for u in utils
+                if u.get("bw_util") is not None]
+        out["utilization"] = {
+            "device_kind": sum_util.get("device_kind"),
+            "n_devices": sum_util.get("n_devices"),
+            "mfu_p50": percentile(mfus, 50) if mfus
+            else (sum_util.get("mfu") or {}).get("p50"),
+            "mfu_p90": percentile(mfus, 90) if mfus
+            else (sum_util.get("mfu") or {}).get("p90"),
+            "bw_p50": percentile(bwus, 50) if bwus else None}
+    else:
+        out["utilization"] = None
+    out["checkpoints"] = tel.get("checkpoints") or \
+        (summary.get("checkpoint") or None)
+    servings = tel.get("serving") or []
+    out["serving"] = servings[-1] if servings \
+        else (summary.get("serving") or None)
+    out["decode"] = _last_by_name(tel.get("decode"),
+                                  summary.get("decode"))
+    out["router"] = _last_by_name(tel.get("router"),
+                                  summary.get("router"))
+    out["bucketing"] = _last_by_name(tel.get("bucketing"),
+                                     summary.get("bucketing"))
+    out["loss_scale"] = tel.get("loss_scale") or None
+    out["alerts"] = tel.get("alerts") or summary.get("alerts") or []
+    skipped = sum(s.get("skipped", 0) for s in steps)
+    out["goodput"] = {
+        "steps": len(steps),
+        "productive": len(steps) - skipped,
+        "skipped": skipped,
+        "retried_ops": sum(s.get("retries", 0) for s in steps),
+        "events": summary.get("events") or {},
+        "fault": summary.get("fault") or {}}
+    watermarks = {}
+    for m in tel.get("memory") or []:
+        dev = m.get("device", "?")
+        peak = max(int(m.get("peak_bytes_in_use", 0) or 0),
+                   int(m.get("bytes_in_use", 0) or 0))
+        watermarks[dev] = max(watermarks.get(dev, 0), peak)
+    if not watermarks and summary.get("memory"):
+        watermarks = {d: w.get("peak_bytes_in_use", 0)
+                      for d, w in summary["memory"].items()}
+    out["memory"] = {
+        "peak_bytes": watermarks or None,
+        "breakdown": summary.get("memory_breakdown")
+        or tel.get("breakdown")}
+    out["comms"] = summary.get("comms") or None
+    return out
+
+
+# ---------------------------------------------------------------------------
+# fleet mode: a directory or glob of per-rank / per-worker sinks
+# ---------------------------------------------------------------------------
+
+# the launcher's per-worker naming convention: rank 0 keeps the
+# configured filename, rank N>0 gets base.workerN.ext (tools/launch.py,
+# telemetry's per-worker sinks, MXNET_TRACE_FILE fan-out)
+_WORKER_RE = re.compile(r"\.worker(\d+)\.[^.]+$")
+
+
+def _sink_rank(name):
+    m = _WORKER_RE.search(name)
+    return int(m.group(1)) if m else 0
+
+
+def read_fleet(paths):
+    """Parse every input in ``paths``: telemetry JSONL sinks plus
+    ``flightrec-*.json`` bundles. An unreadable or torn input becomes a
+    counted entry in ``warnings`` and is skipped — the fleet report
+    renders the survivors, it never aborts on one bad rank."""
+    fleet = {"ranks": [], "bundles": [], "warnings": []}
+    for path in paths:
+        base = os.path.basename(path)
+        if base.startswith("flightrec-") and base.endswith(".json"):
+            try:
+                with open(path) as f:
+                    fleet["bundles"].append({"path": path,
+                                             "bundle": json.load(f)})
+            except (OSError, ValueError) as exc:
+                fleet["warnings"].append(
+                    "torn flight-recorder bundle %s skipped (%s)"
+                    % (base, exc))
+            continue
+        try:
+            tel = read_telemetry(path)
+        except OSError as exc:
+            fleet["warnings"].append(
+                "unreadable sink %s skipped (%s)" % (base, exc))
+            continue
+        fleet["ranks"].append({"path": path, "rank": _sink_rank(base),
+                               "tel": tel})
+        if tel.get("skipped_lines"):
+            fleet["warnings"].append(
+                "%s: skipped %d unparseable line(s) — a killed rank "
+                "strands at most one truncated trailing record"
+                % (base, tel["skipped_lines"]))
+    fleet["ranks"].sort(key=lambda r: (r["rank"], r["path"]))
+    fleet["bundles"].sort(key=lambda b: b["path"])
+    return fleet
+
+
+def _rank_row(entry):
+    """One cross-rank skew table row: the per-rank aggregates."""
+    from ..telemetry import percentile
+    tel = entry["tel"]
+    steps = tel.get("steps") or []
+    summary = tel.get("summary") or {}
+    durs = [s["dur_ms"] for s in steps if s.get("dur_ms") is not None]
+    totals = dict(summary.get("phases_ms") or {})
+    if not totals:
+        for s in steps:
+            for phase, ms in (s.get("phases_ms") or {}).items():
+                totals[phase] = totals.get(phase, 0.0) + ms
+    n = len(durs)
+    return {"rank": entry["rank"],
+            "file": os.path.basename(entry["path"]),
+            "run_id": (tel.get("run") or {}).get("run_id")
+            or summary.get("run_id"),
+            "gen": (summary.get("events") or {}).get(
+                "supervisor_restart_generation", 0),
+            "steps": n,
+            "mean_ms": (sum(durs) / n) if n else None,
+            "p50_ms": percentile(durs, 50) if n else None,
+            "max_ms": max(durs) if n else None,
+            "phase_mean_ms": {k: v / n for k, v in totals.items()}
+            if n else {},
+            "skipped_lines": tel.get("skipped_lines", 0)}
+
+
+def _fleet_skew(rows):
+    """Annotate each row with its delta vs the fastest rank and name
+    the slowest rank, attributing its excess to the phase whose
+    per-step mean exceeds the fleet mean the most."""
+    timed = [r for r in rows if r["mean_ms"] is not None]
+    if not timed:
+        return None
+    best = min(r["mean_ms"] for r in timed)
+    for r in rows:
+        r["delta_ms"] = (r["mean_ms"] - best) \
+            if r["mean_ms"] is not None else None
+    slow = max(timed, key=lambda r: r["mean_ms"])
+    fleet_phase = {}
+    for r in timed:
+        for k, v in r["phase_mean_ms"].items():
+            fleet_phase.setdefault(k, []).append(v)
+    attribution = None
+    if slow["phase_mean_ms"] and len(timed) > 1 and fleet_phase:
+        deltas = {k: slow["phase_mean_ms"].get(k, 0.0)
+                  - sum(vs) / len(vs)
+                  for k, vs in fleet_phase.items()}
+        phase = max(deltas, key=deltas.get)
+        attribution = {"phase": phase, "delta_ms": deltas[phase]}
+    return {"best_mean_ms": best, "slowest_rank": slow["rank"],
+            "slowest_delta_ms": slow["mean_ms"] - best,
+            "attribution": attribution}
+
+
+def _fleet_serving(ranks):
+    """Join router records against replica (decode) records across
+    every sink: the conservation law is ``dispatched == admitted +
+    replica-shed`` — every router dispatch lands in exactly one
+    replica's submit accounting."""
+    routers, servers = {}, {}
+    alerts_lost = 0
+    for e in ranks:
+        tel = e["tel"]
+        summary = tel.get("summary") or {}
+        for name, rec in (_last_by_name(tel.get("router"),
+                                        summary.get("router"))
+                          or {}).items():
+            routers[(e["rank"], name)] = rec
+        for name, rec in (_last_by_name(tel.get("decode"),
+                                        summary.get("decode"))
+                          or {}).items():
+            servers[(e["rank"], name)] = rec
+        for a in tel.get("alerts") or (summary.get("alerts") or []):
+            if a.get("kind") == "replica_lost":
+                alerts_lost += 1
+    if not routers and not servers:
+        return None
+    dispatched = sum(r.get("dispatched", 0) for r in routers.values())
+    admitted = sum(s.get("requests", 0) - s.get("shed", 0)
+                   for s in servers.values())
+    replica_shed = sum(s.get("shed", 0) for s in servers.values())
+    resume = [r.get("failover_resume_ms") for r in routers.values()
+              if r.get("failover_resume_ms")]
+    return {"routers": len(routers), "replicas": len(servers),
+            "sessions": sum(r.get("requests", 0)
+                            for r in routers.values()),
+            "completed": sum(r.get("completed", 0)
+                             for r in routers.values()),
+            "dispatched": dispatched,
+            "router_shed": sum(r.get("shed", 0)
+                               for r in routers.values()),
+            "admitted": admitted, "replica_shed": replica_shed,
+            "reconciled": dispatched == admitted + replica_shed,
+            "replicas_lost": sum(r.get("replicas_lost", 0)
+                                 for r in routers.values()),
+            "failovers": sum(r.get("failovers", 0)
+                             for r in routers.values()),
+            "replay_tokens": sum(r.get("replay_tokens", 0)
+                                 for r in routers.values()),
+            "resume_ms": resume,
+            "replica_lost_alerts": alerts_lost}
+
+
+def _bundle_summary(path, b):
+    alert = b.get("alert") or {}
+    ident = b.get("identity") or {}
+    tr = b.get("trace") or {}
+    return {"file": os.path.basename(path),
+            "reason": b.get("reason"), "time": b.get("time"),
+            "alert_kind": alert.get("kind"),
+            "rank": ident.get("rank"), "gen": ident.get("gen"),
+            "records": len(b.get("records") or ()),
+            "trace_events": len(tr.get("traceEvents") or ()),
+            "run_id": (b.get("run") or {}).get("run_id")}
+
+
+def format_bundle_line(path, b):
+    """The one-line flight-recorder bundle renderer."""
+    s = _bundle_summary(path, b)
+    return ("%-46s %-16s %-14s rank %s gen %s  %4d rec  %6d ev"
+            % (s["file"][:46], (s["reason"] or "?")[:16],
+               (s["alert_kind"] or "-")[:14], s["rank"], s["gen"],
+               s["records"], s["trace_events"]))
+
+
+def format_bundle(path, b):
+    """The single-bundle detail view (diagnose on one
+    ``flightrec-*.json``)."""
+    lines = ["----------Flight-recorder bundle----------",
+             format_bundle_line(path, b),
+             "written      : %s" % (b.get("time") or "?")]
+    alert = b.get("alert")
+    if alert:
+        lines.append("alert        : %s"
+                     % json.dumps(alert, sort_keys=True))
+    run = b.get("run")
+    if run:
+        lines.append("run          : %s"
+                     % json.dumps(run, sort_keys=True))
+    topo = b.get("topology")
+    if topo:
+        lines.append("topology     : %s"
+                     % json.dumps(topo, sort_keys=True))
+    ts = b.get("trace_stats")
+    if ts:
+        lines.append("trace        : %s"
+                     % json.dumps(ts, sort_keys=True))
+    return "\n".join(lines)
+
+
+def _ms(v, sign=False):
+    if v is None:
+        return "-"
+    return ("%+.3f" if sign else "%.3f") % v
+
+
+def format_fleet(fleet):
+    """Render the fleet report: cross-rank skew, restart-generation
+    timeline, the router-vs-replica serving rollup, and one line per
+    flight-recorder bundle."""
+    rows = [_rank_row(e) for e in fleet["ranks"]]
+    skew = _fleet_skew(rows)
+    lines = ["----------Fleet telemetry----------",
+             "sinks        : %d telemetry sink(s), %d flight-recorder "
+             "bundle(s)" % (len(rows), len(fleet["bundles"]))]
+    for w in fleet["warnings"]:
+        lines.append("WARNING      : %s" % w)
+
+    lines.append("----------Cross-rank skew----------")
+    lines.append("%4s %4s %7s %10s %10s %10s %10s %10s  %s"
+                 % ("rank", "gen", "steps", "mean(ms)", "p50(ms)",
+                    "max(ms)", "wait(ms)", "vs best", "sink"))
+    for r in rows:
+        lines.append("%4s %4s %7d %10s %10s %10s %10s %10s  %s"
+                     % (r["rank"], r["gen"], r["steps"],
+                        _ms(r["mean_ms"]), _ms(r["p50_ms"]),
+                        _ms(r["max_ms"]),
+                        _ms(r["phase_mean_ms"].get("data_wait")),
+                        _ms(r.get("delta_ms"), sign=True),
+                        r["file"]))
+    if skew:
+        att = skew.get("attribution")
+        lines.append("slowest      : rank %s (+%.3f ms/step vs best)%s"
+                     % (skew["slowest_rank"],
+                        skew["slowest_delta_ms"],
+                        " — dominated by the '%s' phase (%+.3f ms "
+                        "vs fleet mean)"
+                        % (att["phase"], att["delta_ms"])
+                        if att else ""))
+    gens = sorted({r["gen"] for r in rows})
+    if rows:
+        if len(gens) == 1:
+            lines.append("generations  : all ranks at restart "
+                         "generation %s" % gens[0])
+        else:
+            lines.append("generations  : MIXED — ranks restarted "
+                         "unevenly (a lagging rank resumed from an "
+                         "older incarnation):")
+            for r in rows:
+                lines.append("  rank %-4s : generation %s (%s)"
+                             % (r["rank"], r["gen"], r["file"]))
+
+    sv = _fleet_serving(fleet["ranks"])
+    bundles = [_bundle_summary(b["path"], b["bundle"])
+               for b in fleet["bundles"]]
+    if sv:
+        lines.append("----------Fleet serving----------")
+        lines.append("sessions     : %d submitted across %d router(s) "
+                     "(completed %d, front-door shed %d)"
+                     % (sv["sessions"], sv["routers"],
+                        sv["completed"], sv["router_shed"]))
+        lines.append("reconcile    : dispatched %d %s admitted %d + "
+                     "replica-shed %d  [%s]"
+                     % (sv["dispatched"],
+                        "==" if sv["reconciled"] else "!=",
+                        sv["admitted"], sv["replica_shed"],
+                        "OK" if sv["reconciled"] else "MISMATCH"))
+        lines.append("failover     : %d replica(s) lost, %d session(s) "
+                     "re-homed, %d token(s) replayed by re-prefill"
+                     % (sv["replicas_lost"], sv["failovers"],
+                        sv["replay_tokens"]))
+        for res in sv["resume_ms"]:
+            lines.append("resume       : p50 %.3f ms  p99 %.3f ms  max "
+                         "%.3f ms (loss detection -> first resumed "
+                         "token)"
+                         % (res.get("p50", 0.0), res.get("p99", 0.0),
+                            res.get("max", 0.0)))
+        n_alert_bundles = sum(1 for s in bundles
+                              if s["alert_kind"] == "replica_lost")
+        if sv["replica_lost_alerts"] or n_alert_bundles:
+            ok = n_alert_bundles <= sv["replica_lost_alerts"]
+            lines.append("flight rec   : %d replica_lost bundle(s) vs "
+                         "%d replica_lost alert(s) across sinks  [%s]"
+                         % (n_alert_bundles,
+                            sv["replica_lost_alerts"],
+                            "OK" if ok else "MISMATCH"))
+
+    if fleet["bundles"]:
+        lines.append("----------Flight recorder----------")
+        for b in fleet["bundles"]:
+            lines.append(format_bundle_line(b["path"], b["bundle"]))
+    return "\n".join(lines)
+
+
+def fleet_json(fleet):
+    """The ``--format json`` mirror of :func:`format_fleet`."""
+    rows = [_rank_row(e) for e in fleet["ranks"]]
+    return {"sinks": len(rows),
+            "warnings": list(fleet["warnings"]),
+            "ranks": rows,
+            "skew": _fleet_skew(rows),
+            "serving": _fleet_serving(fleet["ranks"]),
+            "bundles": [_bundle_summary(b["path"], b["bundle"])
+                        for b in fleet["bundles"]]}
+
+
+def _is_bundle_path(path):
+    base = os.path.basename(path)
+    return base.startswith("flightrec-") and base.endswith(".json")
+
+
 def main(argv=None):
     p = argparse.ArgumentParser(
         description="Diagnose the current system, or render a "
                     "telemetry JSONL run.")
     p.add_argument("telemetry", nargs="?", default=None,
-                   help="path to a mxnet_tpu.telemetry JSONL sink; "
-                        "when given, render its tables and exit")
+                   help="path to a mxnet_tpu.telemetry JSONL sink, a "
+                        "flightrec-*.json bundle, or a directory/glob "
+                        "of per-rank sinks (fleet mode); when given, "
+                        "render the tables and exit")
+    p.add_argument("--format", choices=("text", "json"),
+                   default="text", dest="format_",
+                   help="text tables (default) or the same tables "
+                        "mirrored as structured JSON records")
     for choice in ("python", "os", "hardware", "mxnet", "backend"):
         p.add_argument("--" + choice, default=1, type=int)
     p.add_argument("--timeout", default=30, type=int)
     args = p.parse_args(argv)
     if args.telemetry:
-        if not os.path.isfile(args.telemetry):
+        target = args.telemetry
+        paths = None
+        if os.path.isdir(target):
+            paths = sorted(
+                _glob.glob(os.path.join(target, "*.jsonl"))
+                + _glob.glob(os.path.join(target, "flightrec-*.json"))
+                + _glob.glob(os.path.join(target, "*",
+                                          "flightrec-*.json")))
+            if not paths:
+                p.error("no telemetry sinks or flightrec bundles "
+                        "under directory %r" % target)
+        elif not os.path.isfile(target) and \
+                any(ch in target for ch in "*?["):
+            paths = sorted(_glob.glob(target))
+            if not paths:
+                p.error("glob %r matched nothing" % target)
+        elif not os.path.isfile(target):
             p.error("telemetry sink %r not found (expected a "
-                    "mxnet_tpu.telemetry JSONL file)" % args.telemetry)
-        print(format_telemetry(read_telemetry(args.telemetry)))
+                    "mxnet_tpu.telemetry JSONL file)" % target)
+        if paths is not None:
+            fleet = read_fleet(paths)
+            if args.format_ == "json":
+                print(json.dumps(fleet_json(fleet), indent=2,
+                                 sort_keys=True))
+            else:
+                print(format_fleet(fleet))
+            return
+        if _is_bundle_path(target):
+            with open(target) as f:
+                bundle = json.load(f)
+            if args.format_ == "json":
+                print(json.dumps(_bundle_summary(target, bundle),
+                                 indent=2, sort_keys=True))
+            else:
+                print(format_bundle(target, bundle))
+            return
+        if args.format_ == "json":
+            print(json.dumps(telemetry_json(read_telemetry(target)),
+                             indent=2, sort_keys=True))
+        else:
+            print(format_telemetry(read_telemetry(target)))
         return
     if args.python:
         diagnose_python()

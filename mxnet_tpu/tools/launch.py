@@ -99,6 +99,16 @@ def _spawn_workers(num_workers, command, extra_env=(), port=None,
             "DMLC_PS_ROOT_URI": "127.0.0.1",
             "DMLC_PS_ROOT_PORT": str(port),
         })
+        # a shared trace sink would be clobbered N ways at exit; give
+        # each rank its own export (base.workerN.json) so
+        # tracing.merge_exports can clock-align the set afterwards —
+        # the same per-worker split telemetry sinks already get
+        trace_file = env.get("MXNET_TRACE_FILE", "")
+        if trace_file and num_workers > 1 and i != 0:
+            # rank 0 keeps the configured name — the same convention
+            # telemetry's per-worker JSONL sinks use
+            base, ext = os.path.splitext(trace_file)
+            env["MXNET_TRACE_FILE"] = "%s.worker%d%s" % (base, i, ext)
         if extra:
             env.update(extra)
         for kv in extra_env:

@@ -46,7 +46,8 @@ from . import envs
 
 __all__ = ["enabled", "enable", "disable", "reset", "maybe_enable",
            "now", "add", "instant", "span", "context", "track",
-           "export", "stats"]
+           "export", "stats", "wire_context", "adopt_context",
+           "merge_exports"]
 
 _tracer = None          # the active _Trace; module-global None check
 _lock = threading.Lock()
@@ -77,6 +78,10 @@ class _Trace:
         self.max_tracks = max(
             16, envs.get_int("MXNET_TRACE_TRACKS"))
         self.next_tid = 1
+        # clock-offset samples recorded by adopt_context (bounded):
+        # each pairs a peer's wall stamp with ours, so merge_exports
+        # and diagnose can cross-check the wall-anchor alignment
+        self.wire_samples = deque(maxlen=64)
 
 
 class _NullSpan:
@@ -302,6 +307,89 @@ def context():
 
 
 # ---------------------------------------------------------------------------
+# cross-process correlation (the wire context)
+# ---------------------------------------------------------------------------
+
+def process_identity():
+    """This process's fleet identity: ``{"rank", "gen"}`` — the
+    launcher-contract rank (DMLC_WORKER_ID, else MXNET_TPU_RANK, else
+    0) and the supervisor restart generation (MXNET_LAUNCH_RESTART).
+    Cheap enough for per-dispatch use; shared by the wire context,
+    the flight recorder, and the /metrics identity gauge."""
+    if "DMLC_WORKER_ID" in os.environ:
+        try:
+            rank = int(os.environ["DMLC_WORKER_ID"])
+        except ValueError:
+            rank = 0
+    else:
+        rank = envs.get_int("MXNET_TPU_RANK") or 0
+    return {"rank": rank, "gen": envs.get_int("MXNET_LAUNCH_RESTART")}
+
+
+def wire_context(**fields):
+    """A serializable trace context for crossing a process boundary
+    (router→replica dispatch, rank→rank multihost exchange): the
+    sender's pid/rank/restart-generation identity, a paired
+    wall+monotonic clock sample (so the receiver — and later
+    :func:`merge_exports` — can align the two processes' trace
+    clocks), and any caller identity ``fields`` (``request_id``,
+    ``tenant``, ``step``). Plain JSON-safe dict. None when tracing is
+    off or ``MXNET_TRACE_WIRE=0`` — callers forward it unconditionally
+    and receivers treat None as "no context" (one None check)."""
+    t = _tracer
+    if t is None or not envs.get_bool("MXNET_TRACE_WIRE"):
+        return None
+    ident = process_identity()
+    ctx = {"v": 1, "pid": t.pid, "rank": ident["rank"],
+           "gen": ident["gen"], "wall": time.time(),
+           "mono": time.perf_counter()}
+    step = context()
+    if step is not None:
+        ctx["step"] = step["step"]
+    ctx.update(fields)
+    return ctx
+
+
+# the wire-context keys that are transport plumbing, not identity —
+# adopt_context strips these from the span-args view it returns
+_WIRE_CLOCK_KEYS = ("v", "wall", "mono")
+
+
+def adopt_context(ctx, name="ctx:adopt", cat="wire", tid=None):
+    """Adopt a peer's :func:`wire_context` on the receiving side:
+    records one ``i`` event carrying the peer identity plus the
+    observed wall skew, stores a bounded clock-offset sample for
+    export, and returns the identity args (``request_id``/``tenant``/
+    ``origin_pid``/``origin_rank``/``gen``/``step``) for the receiver
+    to stamp onto its own spans so the two processes' events join
+    under one id. None (and no event) when tracing is off or ``ctx``
+    is falsy."""
+    t = _tracer
+    if t is None or not ctx:
+        return None
+    wall_in = time.time()
+    args = {"origin_pid": ctx.get("pid"),
+            "origin_rank": ctx.get("rank")}
+    for k, v in ctx.items():
+        if k not in _WIRE_CLOCK_KEYS and k not in ("pid", "rank"):
+            args[k] = v
+    wall_out = ctx.get("wall")
+    if isinstance(wall_out, (int, float)):
+        # one-way wall delta: ≥ transit time when the hosts' wall
+        # clocks agree; merge_exports uses the samples to report how
+        # trustworthy the wall-anchor alignment is
+        skew = wall_in - wall_out
+        args["wall_skew_ms"] = round(skew * 1e3, 3)
+        with _lock:
+            t.wire_samples.append(
+                {"origin_pid": ctx.get("pid"),
+                 "origin_rank": ctx.get("rank"),
+                 "wall_out": wall_out, "wall_in": wall_in})
+    instant(name, cat, tid=tid, args=args)
+    return args
+
+
+# ---------------------------------------------------------------------------
 # export
 # ---------------------------------------------------------------------------
 
@@ -336,10 +424,100 @@ def export(path=None):
                                           key=lambda kv: kv[1])]
         events = names + list(t.events)
         dropped = t.dropped
+        ident = process_identity()
         meta = {"pid": t.pid, "trace_t0_wall": t.t0_wall,
-                "dropped_events": dropped}
+                "dropped_events": dropped,
+                "rank": ident["rank"], "gen": ident["gen"]}
+        if t.wire_samples:
+            meta["wire_samples"] = list(t.wire_samples)
     trace = {"traceEvents": events, "displayTimeUnit": "ms",
              "otherData": meta}
+    if path is None:
+        return trace
+    tmp = "%s.%d.tmp" % (path, os.getpid())
+    with open(tmp, "w") as f:
+        json.dump(trace, f)
+    os.replace(tmp, path)
+    return path
+
+
+def merge_exports(inputs, path=None):
+    """Clock-align N per-process Chrome-JSON exports into ONE
+    Perfetto-loadable trace. ``inputs`` is a list of export paths (or
+    already-loaded trace dicts). Pure offline function — works with
+    tracing off.
+
+    Alignment uses each export's ``otherData.trace_t0_wall`` anchor
+    (every process stamped its monotonic t0 against the wall clock at
+    enable): the earliest anchor becomes the merged t=0 and every
+    other process's events are shifted by its anchor delta, so a
+    request's router-side and replica-side spans nest causally on the
+    shared timeline. Colliding pids (two processes on different hosts
+    can share one) are remapped, each process track gets a
+    ``process_name`` metadata row (``rank R gen G (pid P)``), and
+    ``otherData.processes`` records the per-input anchor, shift, and
+    any ``wire_samples`` (adopt-time clock-offset observations) so a
+    reader can judge the alignment's trust. With ``path`` the merged
+    trace is written atomically and the path returned; without, the
+    merged dict is returned. Raises ValueError on empty input or an
+    input with no ``trace_t0_wall`` anchor."""
+    traces = []
+    for src in inputs:
+        if isinstance(src, dict):
+            traces.append((str(src.get("otherData", {}).get("pid")),
+                           src))
+        else:
+            with open(src) as f:
+                traces.append((str(src), json.load(f)))
+    if not traces:
+        raise ValueError("merge_exports: no inputs")
+    anchors = []
+    for label, tr in traces:
+        meta = tr.get("otherData") or {}
+        t0 = meta.get("trace_t0_wall")
+        if not isinstance(t0, (int, float)):
+            raise ValueError(
+                "merge_exports: input %s has no trace_t0_wall anchor "
+                "(not a tracing.export file?)" % label)
+        anchors.append(float(t0))
+    base = min(anchors)
+    used_pids = set()
+    meta_events, span_events = [], []
+    processes, dropped = [], 0
+    for (label, tr), t0 in zip(traces, anchors):
+        meta = tr.get("otherData") or {}
+        orig_pid = meta.get("pid")
+        pid = orig_pid if isinstance(orig_pid, int) else 0
+        while pid in used_pids:        # same pid on two hosts
+            pid += 1 << 20
+        used_pids.add(pid)
+        shift_us = (t0 - base) * 1e6
+        for ev in tr.get("traceEvents", []):
+            ev = dict(ev)
+            ev["pid"] = pid
+            if "ts" in ev:
+                ev["ts"] = round(ev["ts"] + shift_us, 3)
+            (meta_events if ev.get("ph") == "M"
+             else span_events).append(ev)
+        pname = "rank %s gen %s (pid %s)" % (
+            meta.get("rank", "?"), meta.get("gen", 0), orig_pid)
+        meta_events.append({"name": "process_name", "ph": "M",
+                            "pid": pid, "args": {"name": pname}})
+        dropped += int(meta.get("dropped_events", 0) or 0)
+        processes.append({"pid": pid, "orig_pid": orig_pid,
+                          "rank": meta.get("rank"),
+                          "gen": meta.get("gen"),
+                          "trace_t0_wall": t0,
+                          "shift_us": round(shift_us, 3),
+                          "wire_samples": meta.get("wire_samples",
+                                                   [])})
+    span_events.sort(key=lambda e: e.get("ts", 0.0))
+    trace = {"traceEvents": meta_events + span_events,
+             "displayTimeUnit": "ms",
+             "otherData": {"merged_from": len(traces),
+                           "trace_t0_wall": base,
+                           "dropped_events": dropped,
+                           "processes": processes}}
     if path is None:
         return trace
     tmp = "%s.%d.tmp" % (path, os.getpid())
