@@ -2892,6 +2892,162 @@ def _int8_kv_record():
     return record
 
 
+def _bench_prefix_cache_case(page_size=16, header_pages=12,
+                             max_new=16, n_requests=20,
+                             shared_frac=0.8, pool_pages=256):
+    """Prefix-cache serving benchmark (BENCH_r22): the SAME
+    80%-shared-prefix request mix (a fleet-style system-prompt
+    header + short per-request suffixes) through one DecodeServer
+    with prefix sharing OFF then ON. Sharing must cut median TTFT
+    (hit requests skip prefill entirely — the suffix feeds through
+    the decode-step program) and raise throughput, with the
+    fixed-program oracle holding in both modes (ON adds exactly one
+    program: the ``decode:cow`` page copy). The capacity half then
+    runs each mode's analytic stream ceiling at the SAME pool byte
+    budget — concurrent streams share the header's pages instead of
+    each carrying a private copy — and must finish with zero
+    preemptions and zero alloc failures."""
+    import numpy as np
+    from mxnet_tpu import compile_watch
+    from mxnet_tpu.serving import DecodeServer, ToyDecoderLM
+
+    compile_watch.enable()
+    n_layers, n_heads, head_dim = 2, 4, 16
+    model = ToyDecoderLM(vocab=128, n_layers=n_layers,
+                         n_heads=n_heads, head_dim=head_dim,
+                         max_len=256)
+    params = model.init_params(seed=0)
+    rs = np.random.RandomState(11)
+    ladder_top = header_pages * page_size + 2 * page_size
+    header = rs.randint(1, 128, size=header_pages * page_size)
+    prompts = []
+    for i in range(n_requests):
+        if i < n_requests * shared_frac:
+            suffix = rs.randint(1, 128, size=int(rs.randint(1, 5)))
+            prompts.append(np.concatenate([header, suffix]))
+        else:
+            prompts.append(rs.randint(
+                1, 128, size=int(rs.randint(20, 60))))
+
+    def run(prefix_on):
+        name = "px_on" if prefix_on else "px_off"
+        srv = DecodeServer(model, params, seq_ladder=[ladder_top],
+                           max_new_tokens=max_new, window=8,
+                           page_size=page_size, pool_pages=pool_pages,
+                           max_queue=n_requests + 4,
+                           prefix_cache=prefix_on, name=name)
+        srv.warmup()
+        warm = compile_watch.site_stats("decode:" + name)
+        t0 = time.perf_counter()
+        reqs = [srv.submit(p, max_new_tokens=max_new)
+                for p in prompts]
+        for r in reqs:
+            r.result(timeout=600)
+        wall = time.perf_counter() - t0
+        st = srv.stats()
+        steady = compile_watch.site_stats("decode:" + name)
+        srv.stop()
+        out = {
+            "wall_s": round(wall, 3),
+            "tokens_per_sec": round(st["tokens_out"] / wall, 2),
+            "ttft_ms_p50": st["ttft_ms"]["p50"],
+            "ttft_ms_p99": st["ttft_ms"]["p99"],
+            "prefill_steps": st["prefill_steps"],
+            "prefix_hits": st["prefix"]["hits"],
+            "prefix_hit_tokens": st["prefix"]["hit_tokens"],
+            "prefix_bytes_saved": st["prefix"]["bytes_saved"],
+            "cow_splits": st["prefix"]["cow_splits"],
+            "programs": {site: s["count"] for site, s in
+                         sorted((steady or {}).items())},
+            "zero_steady_state_recompiles": bool(steady == warm),
+        }
+        return out
+
+    def capacity(prefix_on):
+        """Max concurrent streams at the fixed pool budget: every
+        stream is header + a 1-page suffix-and-generation run."""
+        usable = pool_pages - 1
+        per_stream = header_pages + 1
+        if prefix_on:
+            cap = (usable - header_pages) // 1
+        else:
+            cap = usable // per_stream
+        cap = min(cap, 48)              # keep the CPU run bounded
+        name = "cap_on" if prefix_on else "cap_off"
+        srv = DecodeServer(model, params, seq_ladder=[ladder_top],
+                           max_new_tokens=page_size - 14, window=cap,
+                           page_size=page_size, pool_pages=pool_pages,
+                           max_queue=cap + 4, prefix_cache=prefix_on,
+                           name=name)
+        srv.warmup()
+        if prefix_on:
+            # seed the index once so every measured stream shares
+            srv.submit(header, max_new_tokens=1).result(timeout=600)
+        reqs = []
+        for i in range(cap):
+            suffix = np.asarray([1 + (i % 120)], np.int64)
+            reqs.append(srv.submit(np.concatenate([header, suffix]),
+                                   max_new_tokens=page_size - 14))
+        for r in reqs:
+            r.result(timeout=600)
+        st = srv.stats()
+        srv.stop()
+        return {
+            "max_concurrent_streams": cap,
+            "completed": st["completed"],
+            "preempted": st["preempted"],
+            "alloc_failures": st["kv"]["alloc_failures"],
+            "kv_peak_pages": st["kv"]["peak_used"],
+        }
+
+    def median_run(prefix_on, repeats=3):
+        # CPU wall-clock is noisy (the documented BENCH_r09 band):
+        # take the median-TTFT repeat, each on a fresh server/pool
+        runs = sorted((run(prefix_on) for _ in range(repeats)),
+                      key=lambda r: r["ttft_ms_p50"])
+        return runs[len(runs) // 2]
+
+    out = {"page_size": page_size,
+           "header_tokens": header_pages * page_size,
+           "shared_fraction": shared_frac,
+           "n_requests": n_requests,
+           "max_new_tokens": max_new,
+           "pool_pages": pool_pages,
+           "off": median_run(False), "on": median_run(True),
+           "capacity_off": capacity(False),
+           "capacity_on": capacity(True)}
+    out["ttft_p50_speedup"] = round(
+        out["off"]["ttft_ms_p50"] / max(out["on"]["ttft_ms_p50"],
+                                        1e-9), 2)
+    out["stream_capacity_ratio"] = round(
+        out["capacity_on"]["max_concurrent_streams"]
+        / out["capacity_off"]["max_concurrent_streams"], 2)
+    clean = all(
+        c["completed"] >= c["max_concurrent_streams"]
+        and c["preempted"] == 0 and c["alloc_failures"] == 0
+        for c in (out["capacity_off"], out["capacity_on"]))
+    out["meets_ttft_and_capacity_win"] = bool(
+        out["ttft_p50_speedup"] > 1.0
+        and out["stream_capacity_ratio"] > 1.5 and clean
+        and out["on"]["zero_steady_state_recompiles"]
+        and out["off"]["zero_steady_state_recompiles"])
+    compile_watch.disable()
+    return out
+
+
+def _prefix_cache_record():
+    """The prefix-cache benchmark record (BENCH_r22.json): an
+    80%-shared-prefix serving mix with page sharing off vs on — TTFT
+    and tokens/sec deltas, plus the concurrent-stream ceiling at the
+    same pool byte budget. CPU backend."""
+    record = {"bench": "prefix_cache", "platform": "cpu"}
+    try:
+        record.update(_bench_prefix_cache_case())
+    except Exception as exc:                     # noqa: BLE001
+        record["errors"] = {"prefix_cache": _err_str(exc)}
+    return record
+
+
 def _err_str(exc):
     return "%s: %s" % (type(exc).__name__, str(exc)[:400])
 
@@ -3057,6 +3213,12 @@ if __name__ == "__main__":
         # streams, zero preemptions, fixed program set), one JSON line
         # (the serving half of the BENCH_r20 artifact)
         print(json.dumps(_int8_kv_record()))
+    elif "--prefix-cache" in sys.argv:
+        # CPU-friendly standalone mode: 80%-shared-prefix serving mix
+        # with KV page sharing off vs on — TTFT/throughput deltas and
+        # the concurrent-stream ceiling at the same pool byte budget,
+        # one JSON line (the BENCH_r22 artifact)
+        print(json.dumps(_prefix_cache_record()))
     elif "--decode" in sys.argv:
         # CPU-friendly standalone mode: sequential prefill-then-decode
         # vs continuous batching over the paged-KV DecodeServer —

@@ -1,0 +1,98 @@
+"""Prefix caching and multi-model serving on ONE page pool: two
+decoder LMs attach to a shared KVCachePool with per-model quotas, a
+common system prompt is prefilled exactly once per model generation,
+and every later request enters decode straight on the shared pages —
+paying prefill only for its un-cached suffix.
+
+    python examples/serve_shared_prefix.py
+
+Set MXNET_TELEMETRY_FILE=/tmp/prefix.jsonl first to also get the
+JSONL sink; render it with
+``python -m mxnet_tpu.tools.diagnose /tmp/prefix.jsonl``
+(the Prefix cache table). MXNET_METRICS_PORT=9100 exports the same
+numbers live as ``mxnet_prefix_*`` Prometheus gauges.
+"""
+import json
+import os
+
+import numpy as np
+
+from mxnet_tpu import telemetry
+from mxnet_tpu.serving import DecodeServer, KVCachePool, ToyDecoderLM
+
+
+def main():
+    sink = os.environ.get("MXNET_TELEMETRY_FILE")
+    if sink:
+        telemetry.start(filename=sink)
+
+    chat = ToyDecoderLM(vocab=64, n_layers=2, n_heads=4, head_dim=16,
+                        max_len=256)
+    summarize = ToyDecoderLM(vocab=64, n_layers=2, n_heads=4,
+                             head_dim=16, max_len=256)
+
+    # ONE device pool; each model gets a quota slice and a priority.
+    # Co-tenant models must agree on the page shape
+    # (layers/heads/head_dim) — the pool validates it at attach.
+    pool = KVCachePool(2, 4, 16, page_size=16, n_pages=256)
+    srv_chat = DecodeServer(chat, chat.init_params(seed=0), pool=pool,
+                            prefix_cache=True, share_group="chat",
+                            pool_quota=160, pool_priority=1,
+                            seq_ladder=[32, 64], max_new_tokens=24,
+                            window=8, name="chat")
+    srv_sum = DecodeServer(summarize, summarize.init_params(seed=1),
+                           pool=pool, prefix_cache=True,
+                           pool_quota=96, seq_ladder=[32, 64],
+                           max_new_tokens=24, window=8, name="sum")
+    print("programs compiled:",
+          srv_chat.warmup() + srv_sum.warmup())
+
+    # --- a fleet-style prompt mix: one shared 32-token system header
+    # per model, per-request user suffixes ----------------------------
+    rs = np.random.RandomState(7)
+    header = rs.randint(1, 64, size=32)            # 2 full pages
+    reqs = []
+    for i in range(8):
+        suffix = rs.randint(1, 64, size=rs.randint(4, 24))
+        prompt = np.concatenate([header, suffix])
+        reqs.append(srv_chat.submit(prompt, max_new_tokens=12))
+        reqs.append(srv_sum.submit(prompt, max_new_tokens=12))
+    for r in reqs:
+        r.result(timeout=120)
+
+    for srv in (srv_chat, srv_sum):
+        px = srv.stats()["prefix"]
+        print("%-5s hits=%d misses=%d hit_tokens=%d bytes_saved=%d "
+              "cow_splits=%d"
+              % (srv.stats()["name"], px["hits"], px["misses"],
+                 px["hit_tokens"], px["bytes_saved"],
+                 px["cow_splits"]))
+
+    # per-model occupancy on the ONE pool: quotas hold even when one
+    # tenant's traffic spikes
+    print("pool owners:",
+          json.dumps(pool.stats()["owners"], indent=2))
+    print("prefix index:", json.dumps(pool.prefix_stats()))
+
+    # a multi-turn conversation: the finished first turn left prompt
+    # AND generated tokens in the index, so turn 2 re-prefills nothing
+    # but its new user message
+    turn1 = srv_chat.submit(header, max_new_tokens=12)
+    out1 = [int(t) for t in turn1.result(timeout=120)]
+    turn2_prompt = np.concatenate(
+        [header, out1, rs.randint(1, 64, size=6)])
+    turn2 = srv_chat.submit(turn2_prompt, max_new_tokens=12)
+    turn2.result(timeout=120)
+    print("turn-2 prompt: %d tokens, %d served from cache"
+          % (len(turn2_prompt), turn2.prefix_cached))
+
+    srv_chat.stop()
+    srv_sum.stop()
+    if sink:
+        telemetry.stop()
+        print("telemetry sink:", sink)
+        print("render it:  python -m mxnet_tpu.tools.diagnose", sink)
+
+
+if __name__ == "__main__":
+    main()

@@ -323,6 +323,16 @@ declare("MXNET_KV_DTYPE", "str", "float32",
         "Storage dtype of the paged KV-cache pool: float32 | "
         "bfloat16 | int8 (int8 adds per-page scales and dequantizes "
         "on gather).", _G)
+declare("MXNET_KV_PREFIX_CACHE", "bool", False,
+        "Prefix-aware KV page sharing: completed prefills register "
+        "their page-aligned token runs in a content-hashed index, a "
+        "matching later prompt enters decode on the SHARED pages "
+        "(refcounted, copy-on-write on first divergence) and "
+        "computes only the un-cached suffix.", _G)
+declare("MXNET_KV_MODEL_QUOTA", "int", 0,
+        "Default per-model page quota when several DecodeServers "
+        "share one KVCachePool (0 = no quota); an explicit "
+        "pool_quota= on the server overrides it.", _G)
 declare("MXNET_DECODE_WINDOW", "int", 8,
         "Concurrent decode slots of the continuous batcher (the "
         "decode step's fixed batch size).", _G)

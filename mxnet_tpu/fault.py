@@ -68,6 +68,14 @@ _ACTIONS = ("raise", "hang", "stall", "nan", "inf")
 # planned hang at serve_decode stalls token production so a streaming
 # request ages past its deadline, proving its pages come back through
 # the counted kv_evict reclaim path.
+# kv_share fires once per prefix-index lookup of an admitted prompt
+# and kv_cow once per copy-on-write page split (serving/kvcache.py,
+# serving/decode.py): a planned raise at kv_share is a deterministic
+# hash-collision-style MISS (the request pays a full private prefill),
+# and a planned raise at kv_cow is counted and degrades the request to
+# a private-copy re-prefill of everything it has computed so far —
+# greedy decode makes the degraded stream token-identical, never a
+# wrong token.
 # serve_route/replica_lost are the fleet-router sites (serving/
 # router.py, serving/fleet.py): serve_route fires once per router
 # dispatch — a raise is counted and survived (the session stays queued
@@ -89,7 +97,8 @@ _ACTIONS = ("raise", "hang", "stall", "nan", "inf")
 # never fatal to the process it is post-morteming.
 _SITES = ("push", "pull", "allreduce", "wait", "init", "grad",
           "ckpt_write", "ckpt_fsync", "serve_admit", "serve_dispatch",
-          "serve_decode", "serve_route", "kv_evict", "replica_lost",
+          "serve_decode", "serve_route", "kv_evict", "kv_share",
+          "kv_cow", "replica_lost",
           "proc_hb", "proc_join", "proc_exit", "flightrec")
 # corruption needs a value to corrupt — only the grad site carries one
 _VALUE_SITES = ("grad",)

@@ -424,6 +424,50 @@ def _render_decode(page):
         page.add("mxnet_decode_weight_version",
                  st.get("weight_version"), labels=lab,
                  help_="parameter generation serving new requests")
+        px = st.get("prefix") or {}
+        if px.get("enabled"):
+            page.add("mxnet_prefix_hits_total", px.get("hits"),
+                     labels=lab, kind="counter",
+                     help_="prompts admitted onto shared prefix pages")
+            page.add("mxnet_prefix_misses_total", px.get("misses"),
+                     labels=lab, kind="counter")
+            page.add("mxnet_prefix_hit_rate", px.get("hit_rate"),
+                     labels=lab)
+            page.add("mxnet_prefix_hit_tokens_total",
+                     px.get("hit_tokens"), labels=lab, kind="counter",
+                     help_="prompt tokens served from the index "
+                           "instead of prefill")
+            page.add("mxnet_prefix_bytes_saved_total",
+                     px.get("bytes_saved"), labels=lab,
+                     kind="counter",
+                     help_="K/V bytes not recomputed thanks to "
+                           "sharing")
+            page.add("mxnet_prefix_cow_splits_total",
+                     px.get("cow_splits"), labels=lab, kind="counter",
+                     help_="copy-on-write page splits")
+            page.add("mxnet_prefix_cow_degraded_total",
+                     px.get("cow_degraded"), labels=lab,
+                     kind="counter",
+                     help_="kv_cow faults degraded to private "
+                           "re-prefill")
+            pool = px.get("pool") or {}
+            page.add("mxnet_prefix_entries", pool.get("entries"),
+                     labels=lab, help_="pages held by the index")
+            page.add("mxnet_prefix_shared_pages",
+                     pool.get("shared_pages"), labels=lab,
+                     help_="pages with more than one holder now")
+            page.add("mxnet_prefix_evicted_total", pool.get("evicted"),
+                     labels=lab, kind="counter",
+                     help_="cold index entries reclaimed under "
+                           "pressure")
+        for owner, o in sorted((kv.get("owners") or {}).items()):
+            olab = dict(lab, model=owner)
+            page.add("mxnet_prefix_pool_pages_used", o.get("used"),
+                     labels=olab,
+                     help_="shared-pool pages held per model")
+            if o.get("quota"):
+                page.add("mxnet_prefix_pool_quota", o.get("quota"),
+                         labels=olab)
 
 
 def _render_router(page):

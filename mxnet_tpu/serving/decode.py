@@ -22,6 +22,21 @@ Orca/vLLM-style answer composed from machinery this tree already has:
   demand as generation crosses page boundaries; under pool pressure
   the scheduler preempts the newest lowest-priority active request
   (counted, typed error) rather than stalling everyone.
+- **Prefix sharing & multi-model pools** (``MXNET_KV_PREFIX_CACHE``,
+  ``pool=``) — a completed prefill registers its page-aligned token
+  run in the pool's content-hashed prefix index; a later prompt that
+  matches enters decode on the SHARED refcounted pages and feeds only
+  the un-cached suffix through the one decode-step program (greedy
+  decode makes the shared stream token-identical to an unshared run —
+  the same contract the stepwise-vs-full-forward oracle tests). The
+  first write into a still-shared page copies it first (the ``:cow``
+  program; a q8 page's scales copy with it), and a planned ``kv_cow``
+  raise degrades to a private re-prefill, never a wrong token. Several
+  servers (several models / weight generations) can ``pool=`` ONE
+  process-wide :class:`KVCachePool` under per-model quotas and pool
+  priorities, with cross-server preemption when a higher-pool-priority
+  tenant starves; the pool's ``step_lock`` serializes their compiled
+  steps on the shared arrays.
 - **Continuous batching** — one scheduler loop interleaves at most one
   prefill with every decode step, so decode steps never starve behind
   a burst of long prefills, and a newly-admitted request starts
@@ -126,7 +141,7 @@ class DecodeRequest:
                  "request_id", "t_submit", "pages", "generated",
                  "params", "state", "_cancelled", "_stream", "_event",
                  "_error", "_last_emit", "_t_first", "trace_args",
-                 "_t_trace")
+                 "_t_trace", "pending", "pending_pos", "prefix_cached")
 
     def __init__(self, prompt, max_new, priority, deadline, eos_id,
                  request_id):
@@ -151,6 +166,13 @@ class DecodeRequest:
         self.trace_args = None    # span args while traced (carries an
                                   # adopted router request_id, if any)
         self._t_trace = None      # trace-clock submit stamp
+        # prefix-cache suffix feed: tokens still to run through the
+        # decode-step program (their outputs are discarded until the
+        # last one, which IS the first generated token), and the
+        # absolute position the next one writes at
+        self.pending = None
+        self.pending_pos = 0
+        self.prefix_cached = 0    # prompt tokens served from the index
 
     def done(self):
         return self._event.is_set()
@@ -364,9 +386,11 @@ class DecodeServer:
 
     def __init__(self, model, params, *, seq_ladder=None,
                  max_new_tokens=64, window=None, page_size=None,
-                 pool_pages=None, max_queue=64,
-                 default_deadline_ms=None, record_every=None,
-                 name=None, device=None, start=True):
+                 pool_pages=None, pool=None, pool_quota=None,
+                 pool_priority=0, prefix_cache=None, share_group=None,
+                 max_queue=64, default_deadline_ms=None,
+                 record_every=None, name=None, device=None,
+                 start=True):
         import jax
         from .. import compile_watch
         for attr in ("prefill", "decode", "n_layers", "n_heads",
@@ -389,10 +413,45 @@ class DecodeServer:
         if self._max_new < 1:
             raise MXNetError("DecodeServer: max_new_tokens must be "
                              ">= 1, got %d" % max_new_tokens)
-        self._pool = KVCachePool(model.n_layers, model.n_heads,
-                                 model.head_dim, page_size=page_size,
-                                 n_pages=pool_pages,
-                                 device=self._device)
+        if pool is not None:
+            if pool_pages is not None:
+                raise MXNetError(
+                    "DecodeServer: pool_pages= conflicts with an "
+                    "external pool= — size the shared pool once, "
+                    "where it is built")
+            if page_size is not None \
+                    and int(page_size) != pool.page_size:
+                raise MXNetError(
+                    "DecodeServer: page_size=%d does not match the "
+                    "shared pool's %d" % (int(page_size),
+                                          pool.page_size))
+            if (pool.n_layers, pool.n_heads, pool.head_dim) != \
+                    (int(model.n_layers), int(model.n_heads),
+                     int(model.head_dim)):
+                raise MXNetError(
+                    "DecodeServer: shared pool geometry (layers=%d, "
+                    "heads=%d, head_dim=%d) does not match the "
+                    "model's (%d, %d, %d) — co-tenant models must "
+                    "agree on the page shape"
+                    % (pool.n_layers, pool.n_heads, pool.head_dim,
+                       model.n_layers, model.n_heads, model.head_dim))
+            self._pool = pool
+            self._own_pool = False
+        else:
+            self._pool = KVCachePool(model.n_layers, model.n_heads,
+                                     model.head_dim,
+                                     page_size=page_size,
+                                     n_pages=pool_pages,
+                                     device=self._device)
+            self._own_pool = True
+        self._owner = self._pool.attach(
+            name or "model", quota=pool_quota, priority=pool_priority,
+            preempt=self._pool_preempt_cb)
+        self._prefix_on = bool(prefix_cache) \
+            if prefix_cache is not None \
+            else envs.get_bool("MXNET_KV_PREFIX_CACHE")
+        self._share_group = share_group
+        self._preempt_asks = 0    # co-tenant give-back requests pending
         # prompt rungs fill whole pages; the table width covers the
         # longest prompt plus the full generation budget, so any
         # admitted request fits its table by construction
@@ -448,6 +507,17 @@ class DecodeServer:
             self._prefill_progs[rung] = compile_watch.jit(
                 prefill_fn, "%s:prefill:s%d" % (site, rung),
                 statics=(site, "prefill", rung), cache=False, **donate)
+        # the copy-on-write page copy: one more fixed program, only
+        # ever compiled when the prefix cache is on (warmup covers it)
+        cow_fn = self._cow_fn_q8 if self._pool.quantized \
+            else self._cow_fn
+        cow_donate = {}
+        if jax.default_backend() not in ("cpu",):
+            cow_donate = {"donate_argnums": (0, 1, 2, 3)
+                          if self._pool.quantized else (0, 1)}
+        self._cow_prog = compile_watch.jit(
+            cow_fn, "%s:cow" % site, statics=(site, "cow"),
+            cache=False, **cow_donate)
 
         self._cond = threading.Condition()
         self._queue = deque()
@@ -459,7 +529,10 @@ class DecodeServer:
                        "timeouts": 0, "shed": 0, "errors": 0,
                        "preempted": 0, "prefill_steps": 0,
                        "decode_steps": 0, "decode_faults": 0,
-                       "tokens_out": 0, "queue_peak": 0, "swaps": 0}
+                       "tokens_out": 0, "queue_peak": 0, "swaps": 0,
+                       "prefix_hits": 0, "prefix_misses": 0,
+                       "prefix_hit_tokens": 0, "cow_splits": 0,
+                       "cow_degraded": 0, "cross_preempts": 0}
         self._shed_by_priority = {}
         ring = max(1, envs.get_int("MXNET_SERVING_LATENCY_RING"))
         self._intervals = deque(maxlen=ring)    # inter-token ms
@@ -543,6 +616,44 @@ class DecodeServer:
         tokens_out = jnp.argmax(logits, axis=-1).astype(jnp.int32)
         return tokens_out, k_pages, v_pages, k_scales, v_scales
 
+    # copy-on-write page copy — the whole split is one traced program
+    # (src/dst ride as traced scalars, so any page pair reuses it)
+    def _cow_fn(self, k_pages, v_pages, src, dst):
+        k_pages = k_pages.at[:, dst].set(k_pages[:, src])
+        v_pages = v_pages.at[:, dst].set(v_pages[:, src])
+        return k_pages, v_pages
+
+    def _cow_fn_q8(self, k_pages, v_pages, k_scales, v_scales, src,
+                   dst):
+        # a q8 page's per-page scales are part of its content: the
+        # copy carries them, so the new private page dequantizes
+        # bit-identically to the shared one it forked from
+        k_pages = k_pages.at[:, dst].set(k_pages[:, src])
+        v_pages = v_pages.at[:, dst].set(v_pages[:, src])
+        k_scales = k_scales.at[:, dst].set(k_scales[:, src])
+        v_scales = v_scales.at[:, dst].set(v_scales[:, src])
+        return k_pages, v_pages, k_scales, v_scales
+
+    def _namespace(self, ver):
+        """The prefix-index namespace: share group (defaults to this
+        server's unique pool attachment, so co-tenant models never
+        alias by accident) + weight generation (swapped weights
+        compute different K/V for the same tokens)."""
+        return (self._share_group or self._owner, ver.version)
+
+    def _pool_preempt_cb(self):
+        """A co-tenant's :meth:`KVCachePool.request_preempt` give-back
+        ask. Runs on the REQUESTER's thread, so it only schedules: the
+        victim's own scheduler preempts one of its active requests on
+        its next tick (pages must never be touched cross-thread)."""
+        with self._cond:
+            if self._closed or self._stopping or not self._active:
+                return False
+            self._preempt_asks += 1
+            self._stats["cross_preempts"] += 1
+            self._cond.notify_all()
+        return True
+
     def _pool_args(self):
         """The pool arrays a step program takes (and returns): pages,
         plus the per-page scales in quantized mode."""
@@ -619,7 +730,12 @@ class DecodeServer:
                     "server stopped; request %s dropped"
                     % r.request_id))
         self._closed = True
-        self._emit_record()
+        # NOTE: the prefix index is NOT released here — on a shared
+        # pool the surviving co-tenant servers keep hitting the cached
+        # prefixes (that is the failover story); an owned pool dies
+        # with the server anyway
+        self._emit_record()    # final record still shows our tenancy
+        self._pool.detach(self._owner)
         from .. import livemetrics
         livemetrics.deregister_decode_server(self)
 
@@ -649,22 +765,34 @@ class DecodeServer:
         try:
             n = 0
             zeros_pt = _np.zeros((self._max_pages,), _np.int32)
-            for rung in self._seq_ladder.buckets:
-                toks = _np.zeros((1, rung), _np.int32)
-                out = self._prefill_progs[rung](
-                    self._params.tree, toks, _np.int32(0), zeros_pt,
-                    *self._pool_args())
+            with self._pool.step_lock:
+                for rung in self._seq_ladder.buckets:
+                    toks = _np.zeros((1, rung), _np.int32)
+                    out = self._prefill_progs[rung](
+                        self._params.tree, toks, _np.int32(0),
+                        zeros_pt, *self._pool_args())
+                    jax.block_until_ready(out[0])
+                    self._adopt_pool(out)
+                    n += 1
+                toks = _np.zeros((self._window,), _np.int32)
+                pos = _np.zeros((self._window,), _np.int32)
+                pts = _np.zeros((self._window, self._max_pages),
+                                _np.int32)
+                out = self._decode_prog(self._params.tree, toks, pos,
+                                        pts, *self._pool_args())
                 jax.block_until_ready(out[0])
                 self._adopt_pool(out)
                 n += 1
-            toks = _np.zeros((self._window,), _np.int32)
-            pos = _np.zeros((self._window,), _np.int32)
-            pts = _np.zeros((self._window, self._max_pages), _np.int32)
-            out = self._decode_prog(self._params.tree, toks, pos, pts,
-                                    *self._pool_args())
-            jax.block_until_ready(out[0])
-            self._adopt_pool(out)
-            return n + 1
+                if self._prefix_on:
+                    # the COW copy joins the fixed set only when the
+                    # prefix cache can actually trigger it; dump page
+                    # onto itself = a logical no-op
+                    out = self._cow_prog(*self._pool_args(),
+                                         _np.int32(0), _np.int32(0))
+                    jax.block_until_ready(out[0])
+                    self._adopt_pool(out)
+                    n += 1
+            return n
         finally:
             with self._cond:
                 self._warming = False
@@ -827,9 +955,15 @@ class DecodeServer:
         # next step must never block on a half-loaded tree
         jax.block_until_ready(jax.tree_util.tree_leaves(new_tree))
         with self._cond:
-            new_version = self._params.version + 1
+            old = self._params
+            new_version = old.version + 1
             self._params = _ParamsVersion(new_version, new_tree)
             self._stats["swaps"] += 1
+        if self._prefix_on:
+            # the old generation's cached prefixes can never be hit
+            # again (the namespace carries the version) — release the
+            # index's references so the pages come back
+            self._pool.prefix_release(self._namespace(old))
         telemetry.note("decode_weight_swaps")
         profiler.increment_counter("decode_weight_swaps")
         return new_version
@@ -867,6 +1001,16 @@ class DecodeServer:
         with self._cond:
             if self._warming:          # warmup owns the pool arrays
                 return False
+            asks = self._preempt_asks
+            self._preempt_asks = 0
+        # service co-tenant give-back asks FIRST: preempting one of our
+        # own active requests frees pages a higher-pool-priority model
+        # is starving for (its alloc retries on its next tick)
+        for _ in range(asks):
+            victim = self._pick_victim(below=self._levels)
+            if victim is None:
+                break
+            self._preempt(victim)
         self._reap()
         did = self._admit_one()
         did = self._decode_once() or did
@@ -922,6 +1066,17 @@ class DecodeServer:
                                    else type(error).__name__)))
             req._t_trace = None
         if req.pages:
+            if self._prefix_on and not cancelled and error is None \
+                    and req.params is not None:
+                # a clean completion's K/V is written for every
+                # position except the LAST generated token's (a step
+                # writes its INPUT token) — register the full pages of
+                # prompt + generated[:-1] so later prompts continuing
+                # this conversation share them
+                run = [int(t) for t in req.prompt] \
+                    + [int(t) for t in req.generated[:-1]]
+                self._pool.prefix_insert(
+                    self._namespace(req.params), run, req.pages)
             self._pool.free(req.pages)
             req.pages = []
         with self._cond:
@@ -972,48 +1127,88 @@ class DecodeServer:
             if not self._queue or len(self._active) >= self._window:
                 return False
             req = self._queue[0]
-        need = self._pool.pages_for(len(req.prompt) + 1)
-        pages = self._pool.alloc(need)
+            ver = self._params    # pinned BEFORE the index lookup —
+                                  # a racing swap must not mismatch
+                                  # the namespace and the weights
+        P = len(req.prompt)
+        shared, cached = [], 0
+        if self._prefix_on:
+            shared, cached = self._pool.prefix_lookup(
+                self._namespace(ver), req.prompt)
+            with self._cond:
+                if shared:
+                    self._stats["prefix_hits"] += 1
+                    self._stats["prefix_hit_tokens"] += cached
+                else:
+                    self._stats["prefix_misses"] += 1
+        need = self._pool.pages_for(P + 1) - len(shared)
+        pages = self._pool.alloc(need, owner=self._owner)
         while pages is None:
             victim = self._pick_victim(below=req.priority)
             if victim is None:
-                return False         # wait for pages to free
+                # nothing of ours to evict: ask lower-pool-priority
+                # co-tenants to give pages back, then wait — either
+                # way the retained prefix refs must come back, or the
+                # retry next tick would double-count them
+                self._pool.request_preempt(self._owner)
+                if shared:
+                    self._pool.free(shared)
+                return False
             self._preempt(victim)
-            pages = self._pool.alloc(need)
+            pages = self._pool.alloc(need, owner=self._owner)
         with self._cond:
             if not self._queue or self._queue[0] is not req \
                     or req._cancelled:
                 # reaped or cancelled while we were allocating
-                pages_back = pages
+                pages_back = shared + pages
             else:
                 self._queue.popleft()
-                req.pages = pages
+                req.pages = shared + pages
                 req.state = "active"
-                req.params = self._params
+                req.params = ver
+                req.prefix_cached = cached
                 self._active.append(req)
                 pages_back = None
         if pages_back is not None:
             self._pool.free(pages_back)
             return False
+        if shared:
+            # prefix hit: no prefill program at all. The un-cached
+            # suffix feeds through the decode-step program token by
+            # token (outputs discarded until the last, which IS the
+            # first generated token) — the stepwise≡full-forward
+            # greedy contract makes the stream token-identical to an
+            # unshared run. A fully-cached page-aligned prompt re-runs
+            # only its last token; its write COWs the shared page.
+            start = min(cached, P - 1)
+            req.pending = deque(int(t) for t in req.prompt[start:])
+            req.pending_pos = start
+            return True
         # run the prefill program at the prompt's rung
         t_pre = tracing.now() if req.trace_args is not None else None
-        P = len(req.prompt)
         rung = self._seq_ladder.bucket_for(P)
         tokens = _np.zeros((1, rung), _np.int32)
         tokens[0, :P] = req.prompt
         pt = _np.zeros((self._max_pages,), _np.int32)
         pt[:len(req.pages)] = req.pages
         try:
-            out = self._prefill_progs[rung](
-                req.params.tree, tokens, _np.int32(P), pt,
-                *self._pool_args())
+            with self._pool.step_lock:
+                out = self._prefill_progs[rung](
+                    req.params.tree, tokens, _np.int32(P), pt,
+                    *self._pool_args())
+                token = self._adopt_pool(out)[0]
         except Exception as exc:       # noqa: BLE001 — model errors
             with self._cond:           # belong to the request
                 if req in self._active:
                     self._active.remove(req)
             self._finish(req, exc)
             return True
-        (token,) = self._adopt_pool(out)
+        if self._prefix_on:
+            # the prefill just wrote K/V for every prompt position:
+            # register the full pages so the NEXT same-prefix prompt
+            # shares them (the index retains its own reference)
+            self._pool.prefix_insert(self._namespace(ver), req.prompt,
+                                     req.pages)
         tok = int(token)
         now = time.perf_counter()
         req._t_first = now
@@ -1047,33 +1242,108 @@ class DecodeServer:
         """Grow each row's page table to cover its next write
         position, preempting lower-priority active requests under
         pool pressure (the row itself fails if nothing below it can
-        be evicted). Returns the surviving rows."""
+        be evicted). A write position landing in a still-SHARED page
+        (prefix cache) copies it first — copy-on-write. Returns the
+        surviving rows."""
         survivors = []
         for r in rows:
             if r.state != "active":
                 continue               # preempted earlier in this pass
-            p = len(r.prompt) + len(r.generated) - 1
-            needed = p // self._pool.page_size + 1
             failed = False
-            while len(r.pages) < needed:
-                pg = self._pool.alloc(1)
-                if pg is not None:
-                    r.pages.extend(pg)
-                    continue
-                victim = self._pick_victim(below=r.priority, exclude=r)
-                if victim is None:
-                    with self._cond:
-                        if r in self._active:
-                            self._active.remove(r)
-                    self._preempt(r)
-                    failed = True
+            while True:
+                wp = r.pending_pos if r.pending \
+                    else len(r.prompt) + len(r.generated) - 1
+                needed = wp // self._pool.page_size + 1
+                while len(r.pages) < needed:
+                    pg = self._pool.alloc(1, owner=self._owner)
+                    if pg is not None:
+                        r.pages.extend(pg)
+                        continue
+                    victim = self._pick_victim(below=r.priority,
+                                               exclude=r)
+                    if victim is None:
+                        if self._pool.request_preempt(self._owner):
+                            # a co-tenant will give pages back: skip
+                            # this row's step, it stays active and
+                            # retries next tick
+                            failed = True
+                            break
+                        with self._cond:
+                            if r in self._active:
+                                self._active.remove(r)
+                        self._preempt(r)
+                        failed = True
+                        break
+                    self._preempt(victim)
+                    if victim in survivors:
+                        survivors.remove(victim)
+                if failed:
                     break
-                self._preempt(victim)
-                if victim in survivors:
-                    survivors.remove(victim)
+                if self._prefix_on and \
+                        self._pool.ref(r.pages[wp // self._pool
+                                               .page_size]) > 1:
+                    got = self._cow_row(r, wp // self._pool.page_size)
+                    if got == "died":
+                        failed = True
+                        break
+                    if got == "degraded":
+                        continue   # re-alloc from position 0
+                break
             if not failed:
                 survivors.append(r)
         return survivors
+
+    def _cow_row(self, r, pidx):
+        """Copy-on-write split of ``r``'s still-shared page ``pidx``:
+        copy the page body (q8: and its scales) to a fresh private
+        page with the ``:cow`` program, drop the writer's reference
+        from the shared one, swap the table entry. Visits the
+        ``kv_cow`` fault site; a planned raise there degrades the row
+        to a PRIVATE re-prefill of everything it has computed so far —
+        greedy decode makes the degraded stream token-identical, never
+        a wrong token. Returns "ok" | "degraded" | "died"."""
+        try:
+            fault.inject("kv_cow")
+        except fault.InjectedFault:
+            with self._cond:
+                self._stats["cow_degraded"] += 1
+            self._degrade_private(r)
+            return "degraded"
+        pg = self._pool.alloc(1, owner=self._owner)
+        while pg is None:
+            victim = self._pick_victim(below=r.priority, exclude=r)
+            if victim is None:
+                with self._cond:
+                    if r in self._active:
+                        self._active.remove(r)
+                self._preempt(r)
+                return "died"
+            self._preempt(victim)
+            pg = self._pool.alloc(1, owner=self._owner)
+        old, new = int(r.pages[pidx]), int(pg[0])
+        with self._pool.step_lock:
+            out = self._cow_prog(*self._pool_args(),
+                                 _np.int32(old), _np.int32(new))
+            self._adopt_pool(out)
+        self._pool.cow_release(old)
+        r.pages[pidx] = new
+        with self._cond:
+            self._stats["cow_splits"] += 1
+        return "ok"
+
+    def _degrade_private(self, r):
+        """Fall back to a fully private row: drop every page
+        reference (shared pages just decrement — the other holders
+        keep them) and queue everything the row has computed so far —
+        prompt + generated — through the decode-step program from
+        position 0. Pages re-grow privately as the feed advances."""
+        if r.pages:
+            self._pool.free(r.pages)
+            r.pages = []
+        r.pending = deque(
+            [int(t) for t in r.prompt] + [int(t) for t in r.generated])
+        r.pending_pos = 0
+        r.prefix_cached = 0
 
     def _decode_once(self):
         with self._cond:
@@ -1105,12 +1375,22 @@ class DecodeServer:
         positions = _np.zeros((D,), _np.int32)
         pts = _np.zeros((D, M), _np.int32)
         for i, r in enumerate(rows):
-            tokens[i] = r.generated[-1]
-            positions[i] = len(r.prompt) + len(r.generated) - 1
+            if r.pending:
+                # prefix-cache suffix feed: the next un-cached token
+                # runs through the same step program at its own
+                # absolute position
+                tokens[i] = r.pending[0]
+                positions[i] = r.pending_pos
+            else:
+                tokens[i] = r.generated[-1]
+                positions[i] = len(r.prompt) + len(r.generated) - 1
             pts[i, :len(r.pages)] = r.pages
         try:
-            out = self._decode_prog(
-                ver.tree, tokens, positions, pts, *self._pool_args())
+            with self._pool.step_lock:
+                out = self._decode_prog(
+                    ver.tree, tokens, positions, pts,
+                    *self._pool_args())
+                toks = self._adopt_pool(out)[0]
         except Exception as exc:       # noqa: BLE001 — model errors
             with self._cond:           # belong to the batch's requests
                 for r in rows:
@@ -1119,18 +1399,32 @@ class DecodeServer:
             for r in rows:
                 self._finish(r, exc)
             return
-        (toks,) = self._adopt_pool(out)
         toks = _np.asarray(toks)
         now = time.perf_counter()
+        emitting = []
+        for i, r in enumerate(rows):
+            if r.pending:
+                r.pending.popleft()
+                r.pending_pos += 1
+                if r.pending:
+                    continue   # mid-suffix: the output is discarded
+                r.pending = None
+            emitting.append((i, r))
         finished = []
         with self._cond:
             self._stats["decode_steps"] += 1
-            for i, r in enumerate(rows):
+            for i, r in emitting:
                 self._stats["tokens_out"] += 1
                 if r._last_emit is not None:
                     self._intervals.append((now - r._last_emit) * 1e3)
+                elif r._t_first is None:
+                    # a prefix-hit row's FIRST token lands here, not
+                    # in a prefill — this is its time-to-first-token
+                    r._t_first = now
+                    self._ttft.append(
+                        (time.monotonic() - r.t_submit) * 1e3)
                 r._last_emit = now
-        for i, r in enumerate(rows):
+        for i, r in emitting:
             tok = int(toks[i])
             r.generated.append(tok)
             r._push(tok)
@@ -1211,6 +1505,22 @@ class DecodeServer:
         if shed_pri:
             out["shed_by_priority"] = {str(k): v for k, v
                                        in sorted(shed_pri.items())}
+        lookups = s["prefix_hits"] + s["prefix_misses"]
+        out["prefix"] = {
+            "enabled": self._prefix_on,
+            "owner": self._owner,
+            "hits": s["prefix_hits"],
+            "misses": s["prefix_misses"],
+            "hit_rate": round(s["prefix_hits"] / lookups, 4)
+            if lookups else 0.0,
+            "hit_tokens": s["prefix_hit_tokens"],
+            "bytes_saved": s["prefix_hit_tokens"]
+            * self._pool.token_bytes,
+            "cow_splits": s["cow_splits"],
+            "cow_degraded": s["cow_degraded"],
+            "cross_preempts": s["cross_preempts"],
+            "pool": self._pool.prefix_stats(),
+        }
         return out
 
     def latency_snapshot(self):
@@ -1220,7 +1530,15 @@ class DecodeServer:
             return list(self._intervals)
 
     def _emit_record(self):
-        telemetry.decode_event(self.stats())
+        st = self.stats()
+        telemetry.decode_event(st)
+        if self._prefix_on:
+            px = dict(st["prefix"])
+            px["name"] = st["name"]
+            kv = st.get("kv") or {}
+            if "owners" in kv:
+                px["owners"] = kv["owners"]
+            telemetry.prefix_cache_event(px)
 
 
 def req_deadline(deadline_s):

@@ -55,17 +55,44 @@ dequantized value is always ``q * scale_at_last_write``, and positions
 at/after a row's ``lengths`` are masked by the attention anyway. bf16
 storage (``MXNET_KV_DTYPE=bfloat16``) needs no scales — it is a plain
 dtype choice on the pool arrays.
+
+**Prefix sharing** (``MXNET_KV_PREFIX_CACHE=1`` or ``prefix_cache=``
+on the decode server): pages are REFERENCE-COUNTED, and the pool
+carries a :class:`PrefixIndex` — a content-hashed radix over
+page-aligned token runs. A finished prefill registers its full pages
+under SHA-1 digests of the whole token prefix up to each page boundary
+(namespaced by share group + weight generation, so two models or two
+weight generations can never alias); a later prompt that walks the
+same chain enters decode with its page table pointing at the SHARED
+pages and computes only the un-cached suffix. The first write into a
+still-shared page triggers copy-on-write (the decode server's
+``:cow`` program — a q8 page's per-page scales copy with it). Index
+entries hold one reference each, so cached prefixes survive their
+requests; under pool pressure ``alloc`` evicts COLD entries — pages
+nobody holds beyond the index itself — through the counted
+``kv_evict`` reclaim path. Refcounted pages are never victims.
+
+**Multi-model pools**: :meth:`KVCachePool.attach` registers several
+decode servers (several models / weight generations) on ONE pool with
+per-model page quotas (``MXNET_KV_MODEL_QUOTA`` default) and a pool
+priority; ``alloc(owner=)`` enforces the quota, and
+:meth:`request_preempt` asks lower-pool-priority co-tenants to give
+pages back via their scheduled preemption callbacks. ``step_lock``
+serializes the servers' compiled steps on the shared device arrays.
 """
 from __future__ import annotations
 
+import hashlib
 import threading
+from collections import OrderedDict
 
 from .. import envs, fault
 from ..base import MXNetError
 
-__all__ = ["KVCachePool", "gather_pages", "scatter_token",
-           "scatter_prefill", "pages_for", "gather_pages_q8",
-           "scatter_token_q8", "scatter_prefill_q8"]
+__all__ = ["KVCachePool", "PrefixIndex", "gather_pages",
+           "scatter_token", "scatter_prefill", "pages_for",
+           "gather_pages_q8", "scatter_token_q8",
+           "scatter_prefill_q8"]
 
 _INT8_MAX = 127.0
 _EPS = 1e-8          # scale floor: an all-zero chunk still divides
@@ -211,6 +238,63 @@ def scatter_prefill_q8(pages, scales, page_table_row, seq, n_valid):
 
 
 # ---------------------------------------------------------------------------
+# the prefix index
+# ---------------------------------------------------------------------------
+
+class PrefixIndex:
+    """Content-addressed index over page-aligned token runs — the
+    sharing map of the prefix cache.
+
+    Keys are SHA-1 digests of the FULL token prefix up to each page
+    boundary, computed incrementally and seeded with a namespace
+    (share group + weight generation): a page's K/V content depends on
+    every token before it AND on the weights that computed it, so the
+    key covers exactly that. Values are page ids. Each entry holds ONE
+    pool reference — an indexed page survives the request that filled
+    it (that is the cache) until cold-prefix eviction reclaims it.
+    Entries are LRU-ordered (refreshed on hit and on insert); eviction
+    only ever takes entries whose page has no holder beyond the index
+    itself. All mutation happens under the owning pool's lock."""
+
+    def __init__(self, page_size):
+        self.page_size = int(page_size)
+        self._entries = OrderedDict()    # digest -> (page, namespace)
+        self.hits = 0          # lookups that matched >= 1 page
+        self.misses = 0        # lookups that matched nothing
+        self.hit_tokens = 0    # prompt tokens served from the index
+        self.inserted = 0      # entries ever registered
+        self.evicted = 0       # entries dropped (cold or released)
+
+    def __len__(self):
+        return len(self._entries)
+
+    def digests(self, namespace, tokens):
+        """One digest per FULL page of ``tokens``, each covering the
+        whole prefix up to its page boundary (chain-hashed: page i's
+        digest extends page i-1's)."""
+        import numpy as np
+        arr = np.ascontiguousarray(np.asarray(tokens, np.int32))
+        h = hashlib.sha1(repr(namespace).encode())
+        S = self.page_size
+        out = []
+        for i in range(len(arr) // S):
+            h.update(arr[i * S:(i + 1) * S].tobytes())
+            out.append(h.hexdigest())
+        return out
+
+    def _walk_locked(self, digests):
+        """The pages of the longest consecutive hit run (no refresh,
+        no refcounts — the pool wraps this)."""
+        pages = []
+        for d in digests:
+            ent = self._entries.get(d)
+            if ent is None:
+                break
+            pages.append(ent[0])
+        return pages
+
+
+# ---------------------------------------------------------------------------
 # the pool
 # ---------------------------------------------------------------------------
 
@@ -268,11 +352,26 @@ class KVCachePool:
         self.v = v
         self.k_scale = k_scale
         self.v_scale = v_scale
+        self.n_layers = int(n_layers)
+        self.n_heads = int(n_heads)
+        self.head_dim = int(head_dim)
         self._lock = threading.Lock()
+        # serializes co-tenant servers' compiled steps on the shared
+        # functional arrays — two schedulers must never fork .k/.v
+        self.step_lock = threading.Lock()
         self._free = list(range(self.n_pages - 1, 0, -1))  # pop() -> 1
         self._used_peak = 0
         self._evicted = 0
         self._alloc_failures = 0
+        self._refs = {}          # page -> refcount (absent == free)
+        self._page_owner = {}    # page -> client name (quota credit)
+        self._clients = {}       # name -> {quota, priority, preempt, used}
+        self._cow_splits = 0
+        self._quota_denials = 0
+        self.prefix = PrefixIndex(self.page_size)
+        # bytes one token's K+V occupies across all layers
+        self.token_bytes = (2 * self.n_layers * self.n_heads
+                            * self.head_dim * self.dtype.itemsize)
 
     @property
     def usable_pages(self):
@@ -282,42 +381,240 @@ class KVCachePool:
     def pages_for(self, n_tokens):
         return pages_for(n_tokens, self.page_size)
 
-    def alloc(self, n):
+    def alloc(self, n, owner=None):
         """``n`` page ids (lowest-free-first), or None when the pool
         cannot satisfy the request — the caller decides between
-        waiting, shedding, and preempting a lower-priority holder."""
+        waiting, shedding, and preempting a lower-priority holder.
+
+        With ``owner=`` (an :meth:`attach` name) the pages count
+        against that model's quota; a quota denial fails WITHOUT
+        evicting anyone else's cache. A plain shortfall first evicts
+        COLD prefix-index entries — pages nobody holds beyond the
+        index — through the counted ``kv_evict`` path, then retries."""
         n = int(n)
-        with self._lock:
-            if n > len(self._free):
-                self._alloc_failures += 1
-                return None
-            pages = [self._free.pop() for _ in range(n)]
-            used = self.usable_pages - len(self._free)
-            if used > self._used_peak:
-                self._used_peak = used
-            return pages
+        while True:
+            with self._lock:
+                client = self._clients.get(owner)
+                if client is not None and client["quota"] is not None \
+                        and client["used"] + n > client["quota"]:
+                    self._quota_denials += 1
+                    self._alloc_failures += 1
+                    return None
+                if n <= len(self._free):
+                    pages = [self._free.pop() for _ in range(n)]
+                    for p in pages:
+                        self._refs[p] = 1
+                        if owner is not None:
+                            self._page_owner[p] = owner
+                    if client is not None:
+                        client["used"] += n
+                    used = self.usable_pages - len(self._free)
+                    if used > self._used_peak:
+                        self._used_peak = used
+                    return pages
+                cold = self._pop_cold_prefixes_locked(
+                    n - len(self._free))
+                if not cold:
+                    self._alloc_failures += 1
+                    return None
+            self.free(cold)   # counted kv_evict, outside the lock
 
     def free(self, pages):
-        """Return pages to the pool. Visits the ``kv_evict`` fault
-        site once per page; a planned ``raise`` there is counted and
-        the page is reclaimed anyway — a reclaim fault must never leak
-        memory. Returns the number of pages reclaimed."""
+        """Drop one reference per page. A still-shared page (refcount
+        > 1) just decrements; the LAST holder's drop visits the
+        ``kv_evict`` fault site — a planned ``raise`` there is counted
+        and the page is reclaimed anyway, a reclaim fault must never
+        leak memory. Returns the number of pages actually reclaimed
+        (refcount drops don't count)."""
         reclaimed = 0
         for p in pages:
+            p = int(p)
+            with self._lock:
+                refs = self._refs.get(p, 1)
+                if refs > 1:
+                    self._refs[p] = refs - 1
+                    continue
+                self._refs.pop(p, None)
+                owner = self._page_owner.pop(p, None)
+                client = self._clients.get(owner)
+                if client is not None and client["used"] > 0:
+                    client["used"] -= 1
             try:
                 fault.inject("kv_evict")
             except fault.InjectedFault:
                 pass          # counted in fault.stats(); never a leak
             with self._lock:
-                self._free.append(int(p))
+                self._free.append(p)
                 self._evicted += 1
                 reclaimed += 1
         return reclaimed
 
+    def retain(self, pages):
+        """Add one reference to each page (prefix-share / index)."""
+        with self._lock:
+            for p in pages:
+                p = int(p)
+                self._refs[p] = self._refs.get(p, 1) + 1
+
+    def ref(self, page):
+        """Current refcount of ``page`` (0 if free)."""
+        with self._lock:
+            return self._refs.get(int(page), 0)
+
+    def cow_release(self, page):
+        """Drop the writer's reference from a shared page after a
+        copy-on-write split (the other holders keep it)."""
+        with self._lock:
+            p = int(page)
+            refs = self._refs.get(p, 1)
+            if refs > 1:
+                self._refs[p] = refs - 1
+            self._cow_splits += 1
+
+    # -- multi-model attachment ---------------------------------------
+
+    def attach(self, name, *, quota=None, priority=0, preempt=None):
+        """Register a decode server (a model / weight generation) as a
+        pool tenant. Returns the — uniquified — owner name to pass to
+        ``alloc(owner=)``. ``quota`` caps the tenant's concurrently
+        held pages (default ``MXNET_KV_MODEL_QUOTA``; 0 = unlimited);
+        ``preempt`` is a callback :meth:`request_preempt` may invoke
+        from a HIGHER-priority tenant's thread — it must only schedule
+        work (set a flag), never touch pages directly."""
+        if quota is None:
+            q = envs.get_int("MXNET_KV_MODEL_QUOTA")
+            quota = q if q > 0 else None
+        with self._lock:
+            base = str(name)
+            uniq = base
+            i = 1
+            while uniq in self._clients:
+                i += 1
+                uniq = "%s-%d" % (base, i)
+            self._clients[uniq] = {
+                "quota": int(quota) if quota is not None else None,
+                "priority": int(priority),
+                "preempt": preempt,
+                "used": 0,
+            }
+            return uniq
+
+    def detach(self, name):
+        with self._lock:
+            self._clients.pop(name, None)
+
+    def request_preempt(self, owner):
+        """Ask LOWER-pool-priority co-tenants to give pages back:
+        invokes their preemption callbacks (lowest priority first,
+        outside the pool lock) until one accepts. Returns True if any
+        tenant accepted — the pages come back asynchronously, so the
+        caller retries its alloc on a later tick."""
+        with self._lock:
+            me = self._clients.get(owner)
+            my_pri = me["priority"] if me is not None else 0
+            victims = sorted(
+                ((c["priority"], n, c["preempt"])
+                 for n, c in self._clients.items()
+                 if n != owner and c["preempt"] is not None
+                 and c["priority"] < my_pri and c["used"] > 0),
+                key=lambda t: t[0])
+        for _pri, _name, cb in victims:
+            try:
+                if cb():
+                    return True
+            except Exception:
+                continue
+        return False
+
+    # -- prefix cache --------------------------------------------------
+
+    def prefix_lookup(self, namespace, tokens):
+        """Longest page-aligned cached run of ``tokens`` under
+        ``namespace``: returns ``(pages, n_tokens)`` with one
+        reference RETAINED per returned page (the caller's ``free``
+        drops them). Visits the ``kv_share`` fault site once per
+        would-be hit; a planned raise there is a deterministic
+        hash-collision-style MISS — the request pays a full private
+        prefill, never a wrong token."""
+        digests = self.prefix.digests(namespace, tokens)
+        if not digests:
+            return [], 0
+        with self._lock:
+            if not self.prefix._walk_locked(digests):
+                self.prefix.misses += 1
+                return [], 0
+        try:
+            fault.inject("kv_share")
+        except fault.InjectedFault:
+            with self._lock:
+                self.prefix.misses += 1
+            return [], 0
+        with self._lock:
+            pages = self.prefix._walk_locked(digests)
+            if not pages:          # raced away between the two walks
+                self.prefix.misses += 1
+                return [], 0
+            for i, p in enumerate(pages):
+                self._refs[p] = self._refs.get(p, 1) + 1
+                self.prefix._entries.move_to_end(digests[i])
+            n_tok = len(pages) * self.page_size
+            self.prefix.hits += 1
+            self.prefix.hit_tokens += n_tok
+            return list(pages), n_tok
+
+    def prefix_insert(self, namespace, tokens, pages):
+        """Register ``pages`` (backing ``tokens`` from position 0)
+        under their prefix digests. First writer wins — an existing
+        entry is just refreshed. Each NEW entry retains its page, so
+        the cached prefix survives the request that filled it."""
+        digests = self.prefix.digests(namespace, tokens)
+        with self._lock:
+            for i, d in enumerate(digests):
+                if i >= len(pages):
+                    break
+                if d in self.prefix._entries:
+                    self.prefix._entries.move_to_end(d)
+                    continue
+                p = int(pages[i])
+                if p not in self._refs:
+                    continue      # page already reclaimed elsewhere
+                self._refs[p] = self._refs[p] + 1
+                self.prefix._entries[d] = (p, namespace)
+                self.prefix.inserted += 1
+
+    def prefix_release(self, namespace):
+        """Drop every index entry of ``namespace`` (weight swap /
+        model teardown) and free the index's references."""
+        with self._lock:
+            drop = [(d, ent[0])
+                    for d, ent in self.prefix._entries.items()
+                    if ent[1] == namespace]
+            for d, _p in drop:
+                del self.prefix._entries[d]
+                self.prefix.evicted += 1
+        self.free([p for _d, p in drop])
+
+    def _pop_cold_prefixes_locked(self, n):
+        """Up to ``n`` COLD index pages (refcount 1 — nobody beyond
+        the index holds them), oldest-LRU first. Removes their entries
+        and returns the pages for the caller to ``free`` OUTSIDE the
+        lock. Refcounted (in-use shared) pages are never victims."""
+        out = []
+        for d in list(self.prefix._entries):
+            if len(out) >= n:
+                break
+            page, _ns = self.prefix._entries[d]
+            if self._refs.get(page, 0) != 1:
+                continue
+            del self.prefix._entries[d]
+            self.prefix.evicted += 1
+            out.append(page)
+        return out
+
     def stats(self):
         with self._lock:
             free = len(self._free)
-            return {
+            out = {
                 "page_size": self.page_size,
                 "pages": self.usable_pages,
                 "dtype": str(self.dtype),
@@ -326,4 +623,34 @@ class KVCachePool:
                 "peak_used": self._used_peak,
                 "evicted": self._evicted,
                 "alloc_failures": self._alloc_failures,
+                "shared_pages": sum(
+                    1 for r in self._refs.values() if r > 1),
+                "cow_splits": self._cow_splits,
+                "quota_denials": self._quota_denials,
+            }
+            if self._clients:
+                out["owners"] = {
+                    n: {"used": c["used"], "quota": c["quota"],
+                        "priority": c["priority"]}
+                    for n, c in self._clients.items()}
+            return out
+
+    def prefix_stats(self):
+        """The prefix cache's own counters (the ``prefix_cache``
+        telemetry record body)."""
+        with self._lock:
+            px = self.prefix
+            total = px.hits + px.misses
+            return {
+                "entries": len(px._entries),
+                "hits": px.hits,
+                "misses": px.misses,
+                "hit_rate": px.hits / total if total else 0.0,
+                "hit_tokens": px.hit_tokens,
+                "bytes_saved": px.hit_tokens * self.token_bytes,
+                "inserted": px.inserted,
+                "evicted": px.evicted,
+                "shared_pages": sum(
+                    1 for r in self._refs.values() if r > 1),
+                "cow_splits": self._cow_splits,
             }

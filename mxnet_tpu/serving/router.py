@@ -302,7 +302,8 @@ class Router:
         self._stats = {"requests": 0, "dispatched": 0, "completed": 0,
                        "failed": 0, "cancelled": 0, "shed": 0,
                        "timeouts": 0, "failovers": 0,
-                       "replay_tokens": 0, "replicas_lost": 0,
+                       "replay_tokens": 0, "replay_cached_tokens": 0,
+                       "replicas_lost": 0,
                        "drains": 0, "drain_timeouts": 0,
                        "route_faults": 0, "scale_up_signals": 0,
                        "scale_down_signals": 0}
@@ -871,6 +872,11 @@ class Router:
                 if req._t_lost is not None:
                     self._resume_ms.append(
                         (time.monotonic() - req._t_lost) * 1e3)
+                # with a shared-pool prefix cache, the replay's
+                # re-prefill on the new replica hit the dead one's
+                # still-indexed pages — these tokens were NOT recomputed
+                self._stats["replay_cached_tokens"] += int(
+                    getattr(req._inner, "prefix_cached", 0) or 0)
 
     def _relay_round(self):
         with self._lock:

@@ -108,7 +108,7 @@ __all__ = ["PHASES", "enabled", "start", "stop", "reset", "maybe_start",
            "memory_breakdown", "flush", "report", "quick_stats",
            "percentile", "external_record", "checkpoint_event",
            "serving_event", "decode_event", "router_event",
-           "bucketing_event",
+           "prefix_cache_event", "bucketing_event",
            "alert_event"]
 
 PHASES = ("data_wait", "compute", "optimizer", "sync", "checkpoint",
@@ -178,6 +178,8 @@ class _Run:
                                      # (autoregressive serving) stats
         self.router = None           # per-router cumulative fleet
                                      # (dispatch/failover) stats
+        self.prefix = None           # per-server cumulative KV
+                                     # prefix-cache (page sharing) stats
         self.bucketing = None        # per-producer cumulative bucketing
         self.alerts = None           # SLO-watchdog alert list (lazy,
         self.alerts_dropped = 0      # bounded to _MAX_ALERTS)
@@ -793,6 +795,32 @@ def decode_event(fields):
         _cap_records_locked(run)
 
 
+def prefix_cache_event(fields):
+    """Append one cumulative ``prefix_cache`` record from a
+    ``DecodeServer`` running with KV prefix sharing on (hit rate and
+    hit tokens, bytes of prefill saved, shared / cow / evicted page
+    counts, the per-model split of a shared pool — emitted alongside
+    the ``decode`` record). Latest snapshot per server ``name`` lands
+    in the summary's ``prefix_cache`` block. No-op without a run, so a
+    sharing-off process keeps a byte-identical sink."""
+    run = _run
+    if run is None:
+        return
+    rec = {"type": "prefix_cache", "seq": run.steps,
+           "t": round(time.time() - run.t0_wall, 6)}
+    rec.update(fields)
+    with _lock:
+        if run.prefix is None:
+            run.prefix = {}
+        # cumulative per server name: latest wins
+        run.prefix[fields.get("name") or "default"] = dict(fields)
+        run.records.append(rec)
+        _remember(rec)
+        # a long-lived sharing server in a stepless process must not
+        # grow records unboundedly
+        _cap_records_locked(run)
+
+
 def router_event(fields):
     """Append one cumulative ``router`` record from an
     ``mxnet_tpu.serving.Router`` (dispatches, failovers and replayed
@@ -1093,6 +1121,9 @@ def report():
         if run.router is not None:
             out["router"] = {k: dict(v)
                              for k, v in run.router.items()}
+        if run.prefix is not None:
+            out["prefix_cache"] = {k: dict(v)
+                                   for k, v in run.prefix.items()}
         if run.bucketing is not None:
             out["bucketing"] = {k: dict(v)
                                 for k, v in run.bucketing.items()}

@@ -156,7 +156,8 @@ def read_telemetry(path):
     MXNET_TELEMETRY_FILE) yields the LAST run."""
     out = {"run": None, "steps": [], "memory": [], "compiles": [],
            "utilization": [], "checkpoints": [], "serving": [],
-           "decode": [], "router": [], "bucketing": [], "alerts": [],
+           "decode": [], "router": [], "prefix_cache": [],
+           "bucketing": [], "alerts": [],
            "loss_scale": [], "breakdown": None, "summary": None}
     skipped = 0
     with open(path) as f:
@@ -180,7 +181,8 @@ def read_telemetry(path):
                 out = {"run": rec, "steps": [], "memory": [],
                        "compiles": [], "utilization": [],
                        "checkpoints": [], "serving": [],
-                       "decode": [], "router": [], "bucketing": [],
+                       "decode": [], "router": [],
+                       "prefix_cache": [], "bucketing": [],
                        "alerts": [], "loss_scale": [],
                        "breakdown": None, "summary": None}
                 skipped = 0     # earlier runs' damage is not THIS
@@ -204,6 +206,8 @@ def read_telemetry(path):
                 out["decode"].append(rec)
             elif kind == "router":
                 out["router"].append(rec)
+            elif kind == "prefix_cache":
+                out["prefix_cache"].append(rec)
             elif kind == "bucketing":
                 out["bucketing"].append(rec)
             elif kind == "alert":
@@ -567,6 +571,49 @@ def format_telemetry(tel):
                                         for kv_ in sorted(
                                             shed_pri.items())))
 
+    # -- KV prefix cache (serving.kvcache page sharing) -----------------
+    px_recs = tel.get("prefix_cache") or []
+    # records are cumulative per server name: keep each name's last
+    px = {}
+    for rec in px_recs:
+        px[rec.get("name") or "default"] = rec
+    if not px:
+        px = dict(summary.get("prefix_cache") or {})
+    if px:
+        lines.append("----------Prefix cache----------")
+        for name in sorted(px):
+            p = px[name]
+            hits = p.get("hits", 0)
+            total = hits + p.get("misses", 0)
+            lines.append("%-12s : %d/%d prompt(s) hit (%.1f%%), %d "
+                         "token(s) served from shared pages"
+                         % (name[:12], hits, total,
+                            100.0 * p.get("hit_rate", 0.0),
+                            p.get("hit_tokens", 0)))
+            lines.append("  saved      : %s of prefill K/V not "
+                         "recomputed"
+                         % _fmt_bytes(p.get("bytes_saved", 0)))
+            pool = p.get("pool") or {}
+            lines.append("  pages      : %d indexed, %d shared now, "
+                         "%d cow split(s) (%d degraded), %d cold "
+                         "entr(ies) evicted"
+                         % (pool.get("entries", 0),
+                            pool.get("shared_pages",
+                                     p.get("shared_pages", 0)),
+                            p.get("cow_splits", 0),
+                            p.get("cow_degraded", 0),
+                            pool.get("evicted", 0)))
+            owners = p.get("owners") or {}
+            for oname in sorted(owners):
+                o = owners[oname]
+                quota = o.get("quota")
+                lines.append("  model %-6s: %d page(s) held%s, pool "
+                             "priority %d"
+                             % (oname[:6], o.get("used", 0),
+                                " of %d quota" % quota
+                                if quota else "",
+                                o.get("priority", 0)))
+
     # -- fleet serving router (serving.router) --------------------------
     rt_recs = tel.get("router") or []
     # records are cumulative per router name: keep each name's last
@@ -599,10 +646,13 @@ def format_telemetry(tel):
                                     for p in reps)))
             lines.append("  failover   : %d replica(s) lost, %d "
                          "session(s) re-homed, %d token(s) replayed "
-                         "by re-prefill"
+                         "by re-prefill%s"
                          % (r.get("replicas_lost", 0),
                             r.get("failovers", 0),
-                            r.get("replay_tokens", 0)))
+                            r.get("replay_tokens", 0),
+                            " (%d from shared prefix pages)"
+                            % r.get("replay_cached_tokens", 0)
+                            if r.get("replay_cached_tokens") else ""))
             res = r.get("failover_resume_ms") or {}
             if res:
                 lines.append("  resume     : p50 %.3f ms  p99 %.3f ms "
@@ -958,6 +1008,8 @@ def telemetry_json(tel):
                                   summary.get("decode"))
     out["router"] = _last_by_name(tel.get("router"),
                                   summary.get("router"))
+    out["prefix_cache"] = _last_by_name(tel.get("prefix_cache"),
+                                        summary.get("prefix_cache"))
     out["bucketing"] = _last_by_name(tel.get("bucketing"),
                                      summary.get("bucketing"))
     out["loss_scale"] = tel.get("loss_scale") or None

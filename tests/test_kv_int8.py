@@ -329,3 +329,154 @@ def test_flash_decode_q8_pallas_matches_jnp_reference():
 
     with pytest.raises(ValueError):
         flash_decode(q, k, v, lengths, k_scale=ks)
+
+
+# ---------------------------------------------------------------------------
+# prefix sharing over a quantized pool: scales travel with the pages
+# ---------------------------------------------------------------------------
+
+def test_q8_shared_prefix_hit_deterministic_scales_untouched(
+        monkeypatch):
+    """A prefix hit on an int8 pool reuses the shared pages' per-page
+    scales as-is: the hit run is deterministic (two hits agree
+    exactly) and never rewrites the scales of pages it shares — the
+    re-fed tail token COWs its page instead."""
+    monkeypatch.setenv("MXNET_KV_DTYPE", "int8")
+    model = ToyDecoderLM(vocab=32, n_layers=1, n_heads=2, head_dim=8,
+                         max_len=128)
+    params = model.init_params(seed=3)
+    srv = DecodeServer(model, params, seq_ladder=[16],
+                       max_new_tokens=6, window=2, page_size=8,
+                       pool_pages=32, prefix_cache=True, start=False)
+
+    def _go(prompt, n=4):
+        req = srv.submit(prompt, max_new_tokens=n)
+        steps = 0
+        while not req.done():
+            srv._tick()
+            steps += 1
+            assert steps < 300
+        return [int(t) for t in req.result(timeout=5)], req
+
+    prompt = np.arange(10, 26, dtype=np.int32)     # 2 full pages
+    _go(prompt)                                    # miss: fills index
+    pages = [p for d, (p, _ns)
+             in srv._pool.prefix._entries.items()]
+    ks0 = np.asarray(srv._pool.k_scale)[:, pages].copy()
+    vs0 = np.asarray(srv._pool.v_scale)[:, pages].copy()
+    assert np.all(ks0 > 0) and np.all(vs0 > 0)
+
+    hit1, r1 = _go(prompt)
+    hit2, r2 = _go(prompt)
+    assert hit1 == hit2                            # deterministic
+    assert r1.prefix_cached == 16 and r2.prefix_cached == 16
+    assert srv.stats()["prefix"]["hits"] == 2
+    assert srv.stats()["prefix"]["cow_splits"] == 2
+    # the SHARED pages' scales never moved: hit traffic wrote only
+    # COW copies and fresh suffix pages
+    np.testing.assert_array_equal(
+        np.asarray(srv._pool.k_scale)[:, pages], ks0)
+    np.testing.assert_array_equal(
+        np.asarray(srv._pool.v_scale)[:, pages], vs0)
+    srv.stop()
+
+
+def test_q8_cow_copy_carries_the_scales(monkeypatch):
+    """The q8 COW program copies page BODY and per-page scales
+    together — the private fork dequantizes bit-identically to the
+    shared page it split from."""
+    import jax.numpy as jnp
+    monkeypatch.setenv("MXNET_KV_DTYPE", "int8")
+    model = ToyDecoderLM(vocab=32, n_layers=1, n_heads=2, head_dim=8,
+                         max_len=128)
+    params = model.init_params(seed=3)
+    srv = DecodeServer(model, params, seq_ladder=[16],
+                       max_new_tokens=4, window=2, page_size=8,
+                       pool_pages=8, prefix_cache=True, start=False)
+    rs = np.random.RandomState(1)
+    k = jnp.asarray(rs.randint(-127, 128, size=srv._pool.k.shape),
+                    jnp.int8)
+    v = jnp.asarray(rs.randint(-127, 128, size=srv._pool.v.shape),
+                    jnp.int8)
+    ks = jnp.asarray(rs.uniform(0.004, 0.02,
+                                size=srv._pool.k_scale.shape)
+                     .astype(np.float32))
+    vs = jnp.asarray(rs.uniform(0.004, 0.02,
+                                size=srv._pool.v_scale.shape)
+                     .astype(np.float32))
+    k2, v2, ks2, vs2 = srv._cow_fn_q8(k, v, ks, vs, 2, 5)
+    np.testing.assert_array_equal(np.asarray(k2)[:, 5],
+                                  np.asarray(k)[:, 2])
+    np.testing.assert_array_equal(np.asarray(v2)[:, 5],
+                                  np.asarray(v)[:, 2])
+    np.testing.assert_array_equal(np.asarray(ks2)[:, 5],
+                                  np.asarray(ks)[:, 2])
+    np.testing.assert_array_equal(np.asarray(vs2)[:, 5],
+                                  np.asarray(vs)[:, 2])
+    # dequantized content of the fork == the original, bit for bit
+    deq = lambda p, s, i: np.asarray(p)[:, i].astype(np.float32) \
+        * np.asarray(s)[:, i, None, None, None]
+    np.testing.assert_array_equal(deq(k2, ks2, 5), deq(k, ks, 2))
+    # every other page untouched
+    untouched = [i for i in range(srv._pool.k.shape[1]) if i != 5]
+    np.testing.assert_array_equal(np.asarray(k2)[:, untouched],
+                                  np.asarray(k)[:, untouched])
+    np.testing.assert_array_equal(np.asarray(ks2)[:, untouched],
+                                  np.asarray(ks)[:, untouched])
+    srv.stop()
+
+
+def test_q8_recycled_shared_page_scale_resets(monkeypatch):
+    """A cold shared page evicted under pressure and re-allocated to a
+    NEW prompt gets a FRESH scale from the new content — identical to
+    a never-shared pool serving the same prompt (no stale-scale
+    leak, no monotone carry-over across tenants)."""
+    monkeypatch.setenv("MXNET_KV_DTYPE", "int8")
+    model = ToyDecoderLM(vocab=32, n_layers=1, n_heads=2, head_dim=8,
+                         max_len=128)
+    params = model.init_params(seed=3)
+
+    def _serve(srv, prompt, n=3):
+        req = srv.submit(prompt, max_new_tokens=n)
+        steps = 0
+        while not req.done():
+            srv._tick()
+            steps += 1
+            assert steps < 300
+        return [int(t) for t in req.result(timeout=5)]
+
+    a = np.arange(10, 26, dtype=np.int32)          # 2 full pages
+    b = np.asarray([5, 3, 8, 1, 9, 2, 7, 4, 6, 11, 13, 12, 15, 14,
+                    17, 16], np.int32)
+    # 4 usable pages: A's run leaves 2 cold index pages; B's 3-page
+    # admission must evict them and recycle the SAME page slots
+    tight = DecodeServer(model, params, seq_ladder=[16],
+                         max_new_tokens=4, window=1, page_size=8,
+                         pool_pages=5, prefix_cache=True, start=False)
+    _serve(tight, a)
+    assert tight._pool.prefix_stats()["entries"] == 2
+    got = _serve(tight, b)
+    assert tight._pool.prefix_stats()["evicted"] >= 1
+
+    fresh = DecodeServer(model, params, seq_ladder=[16],
+                         max_new_tokens=4, window=1, page_size=8,
+                         pool_pages=6, prefix_cache=True, start=False)
+    want = _serve(fresh, b)
+    assert got == want                     # stale state changed nothing
+    # B's cached pages (matched by content digest — the tight pool
+    # may still hold a leftover A entry) carry IDENTICAL scales in
+    # both pools: the recycled page's old-tenant scale left no trace
+    te = {d: p for d, (p, _ns) in tight._pool.prefix._entries.items()}
+    fe = {d: p for d, (p, _ns) in fresh._pool.prefix._entries.items()}
+    common = [d for d in fe if d in te]
+    assert len(common) == 2                # both of B's full pages
+    tp = [te[d] for d in common]
+    fp = [fe[d] for d in common]
+    np.testing.assert_array_equal(
+        np.asarray(tight._pool.k_scale)[:, tp],
+        np.asarray(fresh._pool.k_scale)[:, fp])
+    np.testing.assert_array_equal(
+        np.asarray(tight._pool.v_scale)[:, tp],
+        np.asarray(fresh._pool.v_scale)[:, fp])
+    tight.stop()
+    fresh.stop()

@@ -606,3 +606,46 @@ def test_fleet_monitor_starved_judge_abstains_on_slow_only():
     mon2.check([], now=1.0)
     lost = mon2.check([slow, dead], now=2.0)       # 1s gap >> 20ms
     assert lost == [dead]                  # "down" is never suppressed
+
+
+# ---------------------------------------------------------------------------
+# failover through a SHARED prefix pool: replay re-prefills only the
+# un-cached suffix
+# ---------------------------------------------------------------------------
+
+def test_failover_replay_hits_dead_replicas_shared_prefix_pages():
+    """Two replicas serve from ONE KVCachePool with a common share
+    group. The replica that admitted the stream indexed the prompt's
+    full pages; when it dies, the survivor's re-prefill replay HITS
+    those still-indexed pages (a dead server's cache outlives it) —
+    the resume is token-identical and the router accounts the replayed
+    tokens it did NOT have to recompute."""
+    from mxnet_tpu.serving import KVCachePool
+    pool = KVCachePool(1, 2, 8, page_size=8, n_pages=64)
+    reps = [DecodeServer(_MODEL, _PARAMS, seq_ladder=[16, 32],
+                         max_new_tokens=12, window=4, pool=pool,
+                         share_group="m0", prefix_cache=True,
+                         name="rep-%d" % i, start=False)
+            for i in range(2)]
+    r = Router(reps, start=False, probe_interval_ms=1, strikes=2)
+    try:
+        prompt = np.arange(10, 22)         # 12 tokens: 1 full page
+        ref = _reference(prompt, 10)
+        req = r.submit(prompt, max_new_tokens=10)
+        now = 0.0
+        while len(req.emitted) < 3:
+            now += 0.01
+            r.pump(now)
+        req._replica.kill()
+        _run(r, req)
+        assert [int(t) for t in req.result(timeout=1)] == ref
+        st = r.stats()
+        assert st["failovers"] == 1 and st["failed"] == 0
+        # the survivor re-prefilled ONLY the un-cached suffix: the
+        # prompt's full page came straight from the shared index
+        assert st["replay_cached_tokens"] >= 8
+        assert st["replay_tokens"] > st["replay_cached_tokens"]
+        hits = sum(s.stats()["prefix"]["hits"] for s in reps)
+        assert hits >= 1
+    finally:
+        r.stop()
