@@ -216,6 +216,8 @@ class _Watch:
         self.storms = []        # [{"program","arg","compiles","steps"}]
         self.degraded = 0       # staged calls that fell back to jit
         self.dispatches = 0     # watched compiled-call executions
+        self.site_last = {}     # site -> (flops, bytes) of the most
+                                # recent dispatch (metering attribution)
         # current-step accumulators, drained by the telemetry step hook
         self.step_flops = 0.0
         self.step_flops_norm = 0.0   # dtype-factor-normalized flops
@@ -542,7 +544,7 @@ class WatchedFunction:
         out = entry["fn"](*args)
         if w is not None:
             _accrue(w, entry["flops"], entry["flops_norm"],
-                    entry["bytes"])
+                    entry["bytes"], self._site)
         return out
 
     def _compile(self, w, key, args):
@@ -659,7 +661,7 @@ def jit(fn, site, describe=None, counter=None, statics=None,
 # accounting
 # ---------------------------------------------------------------------------
 
-def _accrue(w, flops, flops_norm, nbytes):
+def _accrue(w, flops, flops_norm, nbytes, site=None):
     # run totals accrue at the step boundary (the probe), not here, so
     # they mean "work attributed to this run's steps" — backlog dropped
     # by step_reset() never counts
@@ -669,6 +671,8 @@ def _accrue(w, flops, flops_norm, nbytes):
         w.step_flops += flops
         w.step_flops_norm += flops_norm
         w.step_bytes += nbytes
+        if site is not None:
+            w.site_last[site] = (flops, nbytes)
 
 
 def _step_clock(w):
@@ -976,6 +980,25 @@ def site_stats(prefix=None):
                 agg["cache_hits"] = agg.get("cache_hits", 0) \
                     + p["cache_hits"]
     return out
+
+
+def last_dispatch(site):
+    """Cost of the most recent watched dispatch at ``site`` —
+    ``{"flops", "bytes"}`` straight from the compiled program's
+    ``cost_analysis()`` — or None when the watch is off or the site
+    has not dispatched. This is the metering layer's per-program cost
+    source: a caller that just ran a program under ``site`` reads the
+    dispatch's cost here and attributes each batch row its share.
+    With the watch off, metering's FLOP fields stay 0 (token and
+    page*second conservation are unaffected)."""
+    w = _watch
+    if w is None:
+        return None
+    with _lock:
+        c = w.site_last.get(site)
+    if c is None:
+        return None
+    return {"flops": c[0], "bytes": c[1]}
 
 
 def summary_blocks():

@@ -215,6 +215,16 @@ declare("MXNET_WATCHDOG_QUEUE_FRAC", "float", 0.9,
 declare("MXNET_WATCHDOG_SKEW", "float", 2.0,
         "Max replica service-time skew before the watchdog flags an "
         "unhealthy replica.", _G)
+declare("MXNET_METER_FILE", "path", "",
+        "JSONL ledger for per-request usage records "
+        "(mxnet_tpu.metering); empty keeps the bounded in-memory "
+        "tail only.", _G)
+declare("MXNET_METER_FLUSH_EVERY", "int", 32,
+        "Closed usage records between ledger appends and usage "
+        "telemetry snapshots.", _G)
+declare("MXNET_METER_MAX_RECORDS", "int", 100000,
+        "In-memory cap on closed usage records (the ledger file is "
+        "unbounded; the tail ring is not).", _G)
 
 _G = "fault"
 declare("MXNET_FAULT_PLAN", "str", "",

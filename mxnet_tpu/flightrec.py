@@ -215,7 +215,7 @@ def _versions():
 
 
 def _write_bundle(rec, seq, reason, alert, extra):
-    from . import compile_watch, fault, telemetry, tracing
+    from . import compile_watch, fault, metering, telemetry, tracing
     fault.inject("flightrec")        # the deterministic dumper drill
     run = telemetry._run or telemetry._last_run
     bundle = {
@@ -231,6 +231,10 @@ def _write_bundle(rec, seq, reason, alert, extra):
         "compile_sites": compile_watch.site_stats(),
         "fault": fault.stats(),
         "trace_stats": tracing.stats(),
+        # who-was-being-billed at the crash edge: the meter's
+        # cumulative per-tenant books (None when metering is off —
+        # the key stays so bundle readers need no probing)
+        "metering": metering.snapshot(),
     }
     if extra:
         bundle.update(extra)

@@ -286,6 +286,11 @@ class _FusedCore:
         only host sync the fused step performs, and only in guarded
         runs): roll back update counts for skipped params (the eager
         path never advanced them) and run the per-step bookkeeping."""
+        from . import metering
+        # every fused dispatch is one metered training step — the
+        # run-level cost account (device-seconds, flops/step via the
+        # compile watch, fault-reconciled goodput) integrates here
+        metering.training_step()
         if not guard:
             return
         from . import fault

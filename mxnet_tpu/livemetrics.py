@@ -532,6 +532,58 @@ def _render_router(page):
                            "token")
 
 
+def _render_usage(page):
+    """Per-tenant cost attribution from the process meter
+    (``mxnet_tpu.metering``): attributed tokens/FLOPs/page*seconds,
+    prefix-cache credits, outcome counts, and the dual-entry
+    reconciliation verdict — one gauge the alerting layer can page on
+    when the books stop balancing."""
+    from . import metering
+    st = metering.snapshot()
+    if st is None:
+        return
+    lab = {"meter": st.get("name") or "default"}
+    for key, help_ in (("admitted", "usage records opened"),
+                       ("dispatched", ""), ("closed", ""),
+                       ("throttle_events", "")):
+        page.add("mxnet_usage_%s_total" % key, st.get(key),
+                 labels=lab, kind="counter", help_=help_)
+    page.add("mxnet_usage_open", st.get("open"), labels=lab,
+             help_="requests admitted but not yet closed")
+    rec = st.get("reconcile") or {}
+    page.add("mxnet_usage_reconciled", 1 if rec.get("ok") else 0,
+             labels=lab, help_="1 while sum-over-tenants equals the "
+                               "meter totals for every conserved "
+                               "quantity")
+    for name, t in sorted((st.get("tenants") or {}).items()):
+        tlab = dict(lab, tenant=name)
+        for key, help_ in (
+                ("prompt_tokens", "prompt tokens attributed"),
+                ("generated_tokens", "generated tokens attributed"),
+                ("replay_tokens", "failover re-prefill tokens billed "
+                                  "(exactly once, to the surviving "
+                                  "replica)"),
+                ("replay_cached_tokens", ""),
+                ("prefix_hit_tokens", "tokens credited back by "
+                                      "prefix-cache sharing"),
+                ("prefix_bytes_saved", ""),
+                ("throttle_events", "")):
+            page.add("mxnet_usage_tenant_%s_total" % key, t.get(key),
+                     labels=tlab, kind="counter", help_=help_)
+        page.add("mxnet_usage_tenant_flops_total", t.get("flops"),
+                 labels=tlab, kind="counter",
+                 help_="attributed FLOPs (batch-share of each "
+                       "dispatched program's cost_analysis)")
+        page.add("mxnet_usage_tenant_page_seconds_total",
+                 t.get("page_seconds"), labels=tlab, kind="counter",
+                 help_="KV page*seconds integrated at decode step "
+                       "boundaries")
+        for outcome, n in sorted((t.get("outcomes") or {}).items()):
+            page.add("mxnet_usage_tenant_outcomes_total", n,
+                     labels=dict(tlab, outcome=outcome),
+                     kind="counter")
+
+
 def _render_identity(page):
     """The fleet-join info gauge: constant 1 whose labels say WHO this
     process is — run id, rank, restart generation, jax/jaxlib versions
@@ -562,6 +614,7 @@ def render():
     _render_serving(page)
     _render_decode(page)
     _render_router(page)
+    _render_usage(page)
     return page.text()
 
 

@@ -104,7 +104,8 @@ import numpy as _np
 
 from .. import envs
 from ..base import MXNetError
-from .. import fault, profiler, telemetry, tracing
+from .. import compile_watch, fault, metering, profiler, telemetry, \
+    tracing
 from ..bucketing.ladder import BucketLadder
 from . import kvcache
 from .kvcache import KVCachePool
@@ -1014,6 +1015,14 @@ class DecodeServer:
         self._reap()
         did = self._admit_one()
         did = self._decode_once() or did
+        if metering.enabled():
+            # integrate KV page holdings at the step boundary: each
+            # active request's pages x dt accrue to its tenant AND to
+            # the meter's pool total in one dual-entry pass
+            with self._cond:
+                entries = [(metering.inner_key(self, r.request_id),
+                            len(r.pages)) for r in self._active]
+            metering.request_pages(entries, time.monotonic())
         if did:
             self._steps_since_record += 1
             if self._steps_since_record >= self._record_every:
@@ -1141,6 +1150,13 @@ class DecodeServer:
                     self._stats["prefix_hit_tokens"] += cached
                 else:
                     self._stats["prefix_misses"] += 1
+            if shared:
+                # credited at the SAME point the server's own hit
+                # counters increment, so metering's per-tenant credits
+                # reconcile exactly with prefix_hit_tokens
+                metering.request_prefix(
+                    metering.inner_key(self, req.request_id), cached,
+                    cached * self._pool.token_bytes)
         need = self._pool.pages_for(P + 1) - len(shared)
         pages = self._pool.alloc(need, owner=self._owner)
         while pages is None:
@@ -1203,6 +1219,15 @@ class DecodeServer:
                     self._active.remove(req)
             self._finish(req, exc)
             return True
+        if metering.enabled():
+            # a prefill batch is this one request: the whole program
+            # cost (compile-watch cost_analysis) is its share
+            cost = compile_watch.last_dispatch(
+                "%s:prefill:s%d" % (self._site, rung))
+            if cost is not None:
+                metering.request_flops(
+                    metering.inner_key(self, req.request_id),
+                    cost["flops"], cost["bytes"])
         if self._prefix_on:
             # the prefill just wrote K/V for every prompt position:
             # register the full pages so the NEXT same-prefix prompt
@@ -1400,6 +1425,17 @@ class DecodeServer:
                 self._finish(r, exc)
             return
         toks = _np.asarray(toks)
+        if metering.enabled():
+            # the dispatched step program ran ONE batch over these
+            # rows: each request is billed its share of the program's
+            # cost_analysis FLOPs (equal rows, equal shares)
+            cost = compile_watch.last_dispatch("%s:step" % self._site)
+            if cost is not None:
+                share = 1.0 / len(rows)
+                for r in rows:
+                    metering.request_flops(
+                        metering.inner_key(self, r.request_id),
+                        cost["flops"] * share, cost["bytes"] * share)
         now = time.perf_counter()
         emitting = []
         for i, r in enumerate(rows):

@@ -363,6 +363,8 @@ class KVCachePool:
         self._used_peak = 0
         self._evicted = 0
         self._alloc_failures = 0
+        self._pages_alloced = 0   # cumulative page grants (metering's
+        self._pages_freed = 0     # page-flow conservation inputs)
         self._refs = {}          # page -> refcount (absent == free)
         self._page_owner = {}    # page -> client name (quota credit)
         self._clients = {}       # name -> {quota, priority, preempt, used}
@@ -408,6 +410,7 @@ class KVCachePool:
                             self._page_owner[p] = owner
                     if client is not None:
                         client["used"] += n
+                    self._pages_alloced += n
                     used = self.usable_pages - len(self._free)
                     if used > self._used_peak:
                         self._used_peak = used
@@ -446,6 +449,7 @@ class KVCachePool:
             with self._lock:
                 self._free.append(p)
                 self._evicted += 1
+                self._pages_freed += 1
                 reclaimed += 1
         return reclaimed
 
@@ -623,6 +627,8 @@ class KVCachePool:
                 "peak_used": self._used_peak,
                 "evicted": self._evicted,
                 "alloc_failures": self._alloc_failures,
+                "pages_alloced": self._pages_alloced,
+                "pages_freed": self._pages_freed,
                 "shared_pages": sum(
                     1 for r in self._refs.values() if r > 1),
                 "cow_splits": self._cow_splits,

@@ -84,7 +84,7 @@ from collections import deque
 
 import numpy as _np
 
-from .. import envs, fault, telemetry, tracing
+from .. import envs, fault, metering, telemetry, tracing
 from ..base import MXNetError
 from . import fleet
 from .decode import req_deadline
@@ -420,6 +420,10 @@ class Router:
             rep.state = "drained"
         self._closed = True
         self._emit_record()
+        # the final usage snapshot rides the same stop edge, so a
+        # metered run's sink always ends with books that cover every
+        # session this router retired
+        metering.emit()
         from .. import livemetrics
         livemetrics.deregister_router(self)
 
@@ -485,6 +489,12 @@ class Router:
                     shed = True
             if not shed:
                 t.queue.append(req)
+        # every submission opens a usage record — including the ones
+        # shed right back — so metering's admitted count reconciles
+        # with _stats["requests"] and every outcome lands in exactly
+        # one tenant account
+        metering.request_admitted(req.tenant, rid, len(prompt),
+                                  max_new, priority)
         if victim is not None:
             tracing.instant(
                 "router:shed", "router",
@@ -492,6 +502,7 @@ class Router:
                       "tenant": victim.tenant,
                       "priority": victim.priority,
                       "displaced_by": rid})
+            metering.request_closed(victim.request_id, "shed")
             victim._complete(ServerOverloadedError(
                 "router: session %s (priority %d, tenant %s) shed for "
                 "a priority-%d arrival — tenant queue full (bound %d)"
@@ -502,6 +513,7 @@ class Router:
                 "router:shed", "router",
                 args={"request_id": rid, "tenant": req.tenant,
                       "priority": priority})
+            metering.request_closed(rid, "shed")
             raise ServerOverloadedError(
                 "router: session %s (priority %d, tenant %s) shed — "
                 "tenant queue full (bound %d) and no lower-priority "
@@ -658,6 +670,9 @@ class Router:
             req._resume_pending = True
             self._tenant_state(req.tenant).queue.appendleft(req)
             self._stats["failovers"] += 1
+        # restamp the queue clock: the session's SECOND wait counts
+        # toward its queue_ms, and the failover marks its record
+        metering.request_requeued(req.request_id)
         if tracing.enabled():
             req._t_trace = tracing.now()    # the replay queue span
             tracing.instant(
@@ -755,6 +770,8 @@ class Router:
         with self._lock:
             for name in throttled:
                 self._tenants[name].throttled += 1
+        for name in throttled:
+            metering.tenant_throttled(name)
         if throttled and tracing.enabled():
             for name in throttled:
                 tracing.instant("router:throttle", "router",
@@ -842,6 +859,14 @@ class Router:
                 start = max(t.finish, self._vtime)
                 t.finish = start + cost / t.weight
                 self._vtime = start
+        # a replay dispatch bills its re-prefilled tokens exactly once,
+        # to the record now bound to the SURVIVING replica; a first
+        # dispatch bills none (mirrors the replay_tokens counter above)
+        metering.request_dispatched(
+            req.request_id,
+            metering.inner_key(rep.server, inner.request_id),
+            rep.name, replay=bool(replay),
+            replay_tokens=int(len(prompt)) if replay else 0)
         if req._t_trace is not None:
             # close the router-side queue span and mark the dispatch
             # edge on the session's own track; a failover requeue
@@ -875,8 +900,10 @@ class Router:
                 # with a shared-pool prefix cache, the replay's
                 # re-prefill on the new replica hit the dead one's
                 # still-indexed pages — these tokens were NOT recomputed
-                self._stats["replay_cached_tokens"] += int(
+                cached = int(
                     getattr(req._inner, "prefix_cached", 0) or 0)
+                self._stats["replay_cached_tokens"] += cached
+                metering.request_resumed(req.request_id, cached)
 
     def _relay_round(self):
         with self._lock:
@@ -943,6 +970,24 @@ class Router:
             else:
                 self._stats["failed"] += 1
                 t.failed += 1
+        # every session's terminal edge runs through here (and the two
+        # shed branches in submit) — one close, one outcome, one
+        # tenant account. The fine-grained outcome groups back onto
+        # the router counters: completed/cancelled map 1:1, "shed"
+        # only ever comes from submit, and timeout/preempted/failed
+        # together equal _stats["failed"].
+        if cancelled:
+            outcome = "cancelled"
+        elif error is None:
+            outcome = "completed"
+        elif isinstance(error, RequestTimeoutError):
+            outcome = "timeout"
+        elif isinstance(error, ServerOverloadedError):
+            outcome = "preempted"
+        else:
+            outcome = "failed"
+        metering.request_closed(req.request_id, outcome,
+                                generated_tokens=len(req._emitted))
         req._complete(error, state="cancelled" if cancelled else None)
 
     # -- drain -------------------------------------------------------------

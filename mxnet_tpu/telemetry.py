@@ -109,7 +109,7 @@ __all__ = ["PHASES", "enabled", "start", "stop", "reset", "maybe_start",
            "percentile", "external_record", "checkpoint_event",
            "serving_event", "decode_event", "router_event",
            "prefix_cache_event", "bucketing_event",
-           "alert_event"]
+           "alert_event", "usage_event"]
 
 PHASES = ("data_wait", "compute", "optimizer", "sync", "checkpoint",
           "eval")
@@ -181,6 +181,8 @@ class _Run:
         self.prefix = None           # per-server cumulative KV
                                      # prefix-cache (page sharing) stats
         self.bucketing = None        # per-producer cumulative bucketing
+        self.usage = None            # per-meter cumulative usage
+                                     # (tenant cost-attribution) stats
         self.alerts = None           # SLO-watchdog alert list (lazy,
         self.alerts_dropped = 0      # bounded to _MAX_ALERTS)
         self.fault_counters = {"skipped_steps": 0, "retries": 0,
@@ -874,6 +876,34 @@ def bucketing_event(fields):
         _cap_records_locked(run)
 
 
+def usage_event(fields):
+    """Append one cumulative ``usage`` record from a
+    ``mxnet_tpu.metering.Meter`` — per-tenant attributed tokens,
+    FLOPs, KV page*seconds, prefix-cache credits, outcome counts, and
+    the meter's dual-entry reconciliation verdict (the meter emits
+    every ``MXNET_METER_FLUSH_EVERY`` closed records and at
+    ``metering.stop()``). Latest snapshot per meter ``name`` lands in
+    the summary's ``usage`` block; diagnose reconciles it against the
+    router's own counters. No-op without a run, so an unmetered
+    process keeps a byte-identical sink."""
+    run = _run
+    if run is None:
+        return
+    rec = {"type": "usage", "seq": run.steps,
+           "t": round(time.time() - run.t0_wall, 6)}
+    rec.update(fields)
+    with _lock:
+        if run.usage is None:
+            run.usage = {}
+        # cumulative per meter name: latest wins
+        run.usage[fields.get("name") or "default"] = dict(fields)
+        run.records.append(rec)
+        _remember(rec)
+        # a long-lived metered fleet front door in a stepless process
+        # must not grow records unboundedly
+        _cap_records_locked(run)
+
+
 def alert_event(fields):
     """Append one structured ``alert`` record from the SLO watchdog
     (``mxnet_tpu.livemetrics``) — kind, message, and the breach's
@@ -1127,6 +1157,9 @@ def report():
         if run.bucketing is not None:
             out["bucketing"] = {k: dict(v)
                                 for k, v in run.bucketing.items()}
+        if run.usage is not None:
+            out["usage"] = {k: dict(v)
+                            for k, v in run.usage.items()}
         if run.alerts is not None:
             out["alerts"] = [dict(a) for a in run.alerts]
             if run.alerts_dropped:

@@ -157,9 +157,11 @@ def read_telemetry(path):
     out = {"run": None, "steps": [], "memory": [], "compiles": [],
            "utilization": [], "checkpoints": [], "serving": [],
            "decode": [], "router": [], "prefix_cache": [],
-           "bucketing": [], "alerts": [],
+           "bucketing": [], "alerts": [], "usage": [],
+           "usage_records": [],
            "loss_scale": [], "breakdown": None, "summary": None}
     skipped = 0
+    unknown = {}
     with open(path) as f:
         for line in f:
             line = line.strip()
@@ -183,11 +185,13 @@ def read_telemetry(path):
                        "checkpoints": [], "serving": [],
                        "decode": [], "router": [],
                        "prefix_cache": [], "bucketing": [],
-                       "alerts": [], "loss_scale": [],
+                       "alerts": [], "usage": [],
+                       "usage_records": [], "loss_scale": [],
                        "breakdown": None, "summary": None}
                 skipped = 0     # earlier runs' damage is not THIS
                                 # run's — the warning describes the
                                 # run being rendered
+                unknown = {}
             elif kind == "step":
                 out["steps"].append(rec)
             elif kind == "memory":
@@ -214,9 +218,24 @@ def read_telemetry(path):
                 out["alerts"].append(rec)
             elif kind == "loss_scale":
                 out["loss_scale"].append(rec)
+            elif kind == "usage":
+                out["usage"].append(rec)
+            elif kind == "usage_record":
+                # one closed per-request ledger line (the
+                # MXNET_METER_FILE format) — diagnose pointed straight
+                # at a ledger renders the bill from these
+                out["usage_records"].append(rec)
             elif kind == "summary":
                 out["summary"] = rec
+            else:
+                # a record kind this diagnose does not know — written
+                # by a NEWER sink. Count it per kind instead of
+                # dropping it silently, so a version skew between
+                # producer and reader is visible in the report.
+                key = kind if isinstance(kind, str) else "?"
+                unknown[key] = unknown.get(key, 0) + 1
     out["skipped_lines"] = skipped
+    out["unknown_kinds"] = unknown
     return out
 
 
@@ -252,6 +271,15 @@ def format_telemetry(tel):
                      "a killed run strands at most one truncated "
                      "trailing record; the rest renders below"
                      % tel["skipped_lines"])
+    if tel.get("unknown_kinds"):
+        unk = tel["unknown_kinds"]
+        lines.append("WARNING      : ignored %d record(s) of unknown "
+                     "kind (%s) — the sink was written by a newer "
+                     "mxnet_tpu than this diagnose understands; "
+                     "everything else renders below"
+                     % (sum(unk.values()),
+                        ", ".join("%s x%d" % kv
+                                  for kv in sorted(unk.items()))))
 
     compiles = tel.get("compiles") or []
     lines.append("----------Step time----------")
@@ -686,6 +714,75 @@ def format_telemetry(tel):
                              % (r.get("scale_up_signals", 0),
                                 r.get("scale_down_signals", 0)))
 
+    # -- usage metering & cost attribution (mxnet_tpu.metering) ---------
+    usage = _usage_view(tel, summary)
+    if usage:
+        router_rec = next(iter(rt.values())) if len(rt) == 1 else None
+        lines.append("----------Usage----------")
+        for mname in sorted(usage):
+            u = usage[mname]
+            lines.append("%-12s : %d request(s) metered (closed %d, "
+                         "open %d%s)%s"
+                         % (mname[:12], u.get("admitted", 0),
+                            u.get("closed", 0), u.get("open", 0),
+                            ", dispatched %d" % u["dispatched"]
+                            if u.get("dispatched") is not None else "",
+                            " — synthesized from raw ledger lines"
+                            if u.get("synthesized") else ""))
+            for tname in sorted(u.get("tenants") or {}):
+                t = (u.get("tenants") or {})[tname]
+                ocs = " ".join("%s:%d" % kv for kv in
+                               sorted((t.get("outcomes") or {})
+                                      .items()))
+                lines.append("  tenant %-5s: %d+%d tok (prompt+gen), "
+                             "%s, %.3f page*s, %d tok credited, %d "
+                             "replayed%s"
+                             % (tname[:5],
+                                t.get("prompt_tokens", 0),
+                                t.get("generated_tokens", 0),
+                                _fmt_flops(t.get("flops", 0) or 0),
+                                t.get("page_seconds", 0) or 0,
+                                t.get("prefix_hit_tokens", 0),
+                                t.get("replay_tokens", 0),
+                                " — " + ocs if ocs else ""))
+            train = u.get("training")
+            if train:
+                goodput = train.get("goodput")
+                lines.append("  training   : %d step(s), %.3f "
+                             "device*s%s%s"
+                             % (train.get("steps", 0),
+                                train.get("device_seconds", 0.0),
+                                ", %s total"
+                                % _fmt_flops(train["total_flops"])
+                                if train.get("total_flops") else "",
+                                ", goodput %.1f%% (%d wasted -> "
+                                "effective %.3f device*s)"
+                                % (100.0 * goodput,
+                                   train.get("wasted_steps", 0),
+                                   train.get(
+                                       "effective_device_seconds",
+                                       0.0))
+                                if goodput is not None else ""))
+            ledger = u.get("ledger")
+            if isinstance(ledger, dict) and ledger.get("path"):
+                lines.append("  ledger     : %d record(s) -> %s "
+                             "(%d write error(s))"
+                             % (ledger.get("written", 0),
+                                ledger.get("path"),
+                                ledger.get("errors", 0)))
+            checks = _usage_checks(u, router_rec)
+            if checks:
+                ok = all(c[3] for c in checks)
+                bad = ["%s (%s != %s)" % (c[0], c[1], c[2])
+                       for c in checks if not c[3]]
+                lines.append("  reconcile  : %d/%d conservation "
+                             "check(s) hold (dual-entry books + "
+                             "router counters)%s  [%s]"
+                             % (sum(1 for c in checks if c[3]),
+                                len(checks),
+                                " — " + ", ".join(bad) if bad else "",
+                                "OK" if ok else "MISMATCH"))
+
     # -- SLO watchdog alerts (mxnet_tpu.livemetrics) --------------------
     alerts = tel.get("alerts") or []
     if not alerts and summary.get("alerts"):
@@ -934,6 +1031,86 @@ def _last_by_name(recs, fallback):
     return by or None
 
 
+_USAGE_SUM_FIELDS = ("prompt_tokens", "generated_tokens",
+                     "replay_tokens", "replay_cached_tokens", "flops",
+                     "page_seconds", "prefix_hit_tokens",
+                     "prefix_bytes_saved", "queue_ms", "failovers")
+
+
+def _usage_view(tel, summary):
+    """Latest ``usage`` meter snapshot per name (falling back to the
+    summary block) — or, when diagnose is pointed straight at a
+    ``MXNET_METER_FILE`` ledger, one snapshot synthesized from its
+    raw per-request ``usage_record`` lines."""
+    us = _last_by_name(tel.get("usage"), (summary or {}).get("usage"))
+    if us:
+        return us
+    recs = tel.get("usage_records") or []
+    if not recs:
+        return None
+    tenants = {}
+    outcomes = {}
+    totals = {k: 0 for k in _USAGE_SUM_FIELDS}
+    for r in recs:
+        t = tenants.get(r.get("tenant") or "?")
+        if t is None:
+            t = tenants[r.get("tenant") or "?"] = dict(
+                {k: 0 for k in _USAGE_SUM_FIELDS},
+                outcomes={}, closed=0, open=0)
+        for k in _USAGE_SUM_FIELDS:
+            t[k] += r.get(k, 0) or 0
+            totals[k] += r.get(k, 0) or 0
+        oc = r.get("outcome") or "?"
+        t["outcomes"][oc] = t["outcomes"].get(oc, 0) + 1
+        outcomes[oc] = outcomes.get(oc, 0) + 1
+        t["closed"] += 1
+    return {"ledger": {
+        "name": "ledger", "admitted": len(recs),
+        "closed": len(recs), "open": 0, "dispatched": None,
+        "tenants": tenants, "outcomes": outcomes, "totals": totals,
+        "synthesized": True}}
+
+
+def _usage_checks(u, router):
+    """The conservation cross-checks for one meter snapshot:
+    ``(name, lhs, rhs, ok)`` tuples — the meter's own dual-entry
+    verdict plus its totals against the Router's independently
+    incremented counters (when a router record is in the same
+    sink)."""
+    checks = []
+    rc = u.get("reconcile") or {}
+    if rc:
+        checks.append(("books", "sum-over-tenants", "totals",
+                       bool(rc.get("ok"))))
+    if router and u.get("dispatched") is not None:
+        tot = u.get("totals") or {}
+        oc = u.get("outcomes") or {}
+        failed_group = oc.get("timeout", 0) + oc.get("preempted", 0) \
+            + oc.get("failed", 0)
+        for name, lhs, rhs in (
+                ("admitted", u.get("admitted"),
+                 router.get("requests")),
+                ("dispatched", u.get("dispatched"),
+                 router.get("dispatched")),
+                ("completed", oc.get("completed", 0),
+                 router.get("completed")),
+                ("cancelled", oc.get("cancelled", 0),
+                 router.get("cancelled")),
+                ("shed", oc.get("shed", 0), router.get("shed")),
+                ("failed", failed_group, router.get("failed")),
+                ("replay_tokens", tot.get("replay_tokens"),
+                 router.get("replay_tokens")),
+                ("replay_cached_tokens",
+                 tot.get("replay_cached_tokens"),
+                 router.get("replay_cached_tokens")),
+                ("throttles", u.get("throttle_events"),
+                 router.get("throttles"))):
+            if rhs is None:
+                continue
+            checks.append((name, lhs, rhs, lhs == rhs))
+    return checks
+
+
 def telemetry_json(tel):
     """The ``--format json`` mirror of :func:`format_telemetry`: every
     table as one structured record — same aggregation, no layout."""
@@ -944,7 +1121,8 @@ def telemetry_json(tel):
     durs = [s["dur_ms"] for s in steps if s.get("dur_ms") is not None]
     out = {"run_id": run.get("run_id") or summary.get("run_id"),
            "meta": run.get("meta") or None,
-           "skipped_lines": tel.get("skipped_lines", 0)}
+           "skipped_lines": tel.get("skipped_lines", 0),
+           "unknown_kinds": tel.get("unknown_kinds") or {}}
     out["step_time"] = {
         "steps": len(durs),
         "mean_ms": sum(durs) / len(durs),
@@ -1012,6 +1190,18 @@ def telemetry_json(tel):
                                         summary.get("prefix_cache"))
     out["bucketing"] = _last_by_name(tel.get("bucketing"),
                                      summary.get("bucketing"))
+    usage = _usage_view(tel, summary)
+    if usage:
+        rt = out["router"] or {}
+        router_rec = next(iter(rt.values())) if len(rt) == 1 else None
+        for u in usage.values():
+            checks = _usage_checks(u, router_rec)
+            u["reconcile_checks"] = [
+                {"check": c[0], "meter": c[1], "counter": c[2],
+                 "ok": c[3]} for c in checks]
+            u["reconciled"] = all(c[3] for c in checks) \
+                if checks else None
+    out["usage"] = usage
     out["loss_scale"] = tel.get("loss_scale") or None
     out["alerts"] = tel.get("alerts") or summary.get("alerts") or []
     skipped = sum(s.get("skipped", 0) for s in steps)

@@ -1,0 +1,401 @@
+"""Usage metering & cost attribution (mxnet_tpu.metering): one
+immutable usage record per routed request, per-tenant cumulative
+accounts, a durable JSONL ledger, and — the load-bearing contract —
+CONSERVATION: every metered quantity is debited to exactly one tenant
+at the instant it is credited to the global totals, so
+sum-over-tenants == totals and the meter's books cross-check against
+the router's independently-incremented counters.
+
+The headline drill: a replica is killed mid-stream under two-tenant
+load; failover replay tokens must be billed EXACTLY ONCE (to the
+surviving replica's record), and ``diagnose`` must render the Usage
+reconciliation line ``[OK]``."""
+import json
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import compile_watch, fault, livemetrics, metering, telemetry
+from mxnet_tpu.serving import DecodeServer, Router, ToyDecoderLM
+
+
+@pytest.fixture(autouse=True)
+def _clean_state():
+    fault.reset()
+    telemetry.reset()
+    compile_watch.disable()
+    metering.stop()
+    yield
+    metering.stop()
+    fault.reset()
+    telemetry.reset()
+    compile_watch.disable()
+
+
+_MODEL = ToyDecoderLM(vocab=32, n_layers=1, n_heads=2, head_dim=8,
+                      max_len=128)
+_PARAMS = _MODEL.init_params(seed=3)
+
+
+def _replica(name, **kw):
+    kw.setdefault("seq_ladder", [16, 32])
+    kw.setdefault("max_new_tokens", 12)
+    kw.setdefault("window", 4)
+    if "pool" not in kw:
+        kw.setdefault("page_size", 8)
+        kw.setdefault("pool_pages", 64)
+    kw.setdefault("start", False)
+    return DecodeServer(_MODEL, _PARAMS, name=name, **kw)
+
+
+def _router(n=2, **kw):
+    kw.setdefault("start", False)
+    kw.setdefault("probe_interval_ms", 1)
+    return Router([_replica("rep-%d" % i) for i in range(n)], **kw)
+
+
+def _run(router, *reqs, limit=2000, dt=0.01, now=0.0):
+    n = 0
+    while not all(r.done() for r in reqs):
+        now += dt
+        router.pump(now)
+        n += 1
+        assert n < limit, "router made no progress"
+    return now
+
+
+# ---------------------------------------------------------------------------
+# the headline drill: replica kill, exactly-once replay billing, [OK]
+# ---------------------------------------------------------------------------
+
+def test_replica_kill_failover_ledger_reconciles_ok(tmp_path, capsys):
+    """The acceptance drill: two tenants on a three-replica fleet
+    serving from ONE shared prefix pool, one replica killed
+    mid-stream. The meter's dual-entry books must balance, failover
+    replay tokens must equal the router's own replay counter (billed
+    once, never per-attempt), prefix credits must equal the pool's
+    hit counters, and the diagnose Usage table must render ``[OK]``."""
+    from mxnet_tpu.serving import KVCachePool
+    sink = str(tmp_path / "run.jsonl")
+    ledger = str(tmp_path / "ledger.jsonl")
+    telemetry.start(filename=sink)
+    compile_watch.enable()
+    metering.start(name="fleet", path=ledger, flush_every=4)
+    pool = KVCachePool(1, 2, 8, page_size=8, n_pages=96)
+    servers = [_replica("rep-%d" % i, pool=pool, share_group="m0",
+                        prefix_cache=True) for i in range(3)]
+    r = Router(servers, start=False, probe_interval_ms=1, strikes=2)
+    rs = np.random.RandomState(0)
+    try:
+        base = rs.randint(1, 32, size=8)       # one shared full page
+        prompts = [np.concatenate([base,
+                                   rs.randint(1, 32,
+                                              size=rs.randint(1, 6))])
+                   for _ in range(8)]
+        reqs = [r.submit(p, max_new_tokens=8,
+                         tenant="acme" if i % 2 else "zeta")
+                for i, p in enumerate(prompts)]
+        now = 0.0
+        while min(len(q.emitted) for q in reqs) < 2:
+            now += 0.01
+            r.pump(now)
+        # prefix hits finish some streams early — pick a victim that
+        # still has live sessions bound to it
+        victim = next(q._replica for q in reqs
+                      if not q.done() and q._replica is not None)
+        victim.kill()
+        _run(r, *reqs, now=now)
+        st = r.stats()
+        assert st["failed"] == 0 and st["completed"] == 8
+        assert st["replicas_lost"] == 1 and st["failovers"] >= 1
+
+        snap = metering.snapshot()
+        rec = snap["reconcile"]
+        assert rec["ok"], rec
+        # the meter's admitted/closed mirror the router's counters
+        assert snap["admitted"] == st["requests"] == 8
+        assert snap["closed"] == 8 and snap["open"] == 0
+        assert snap["outcomes"] == {"completed": 8}
+        # exactly-once replay billing: the meter's replay total IS the
+        # router's — a per-attempt double bill would exceed it
+        assert snap["totals"]["replay_tokens"] == st["replay_tokens"]
+        assert snap["totals"]["failovers"] == st["failovers"]
+        # prefix sharing flowed through the books: the shared first
+        # page made later admits (and failover replays) cache hits,
+        # and the meter's credits equal the pools' own counters
+        assert snap["totals"]["replay_cached_tokens"] \
+            == st["replay_cached_tokens"]
+        hit_tokens = sum(s.stats()["prefix"]["hit_tokens"]
+                         for s in servers)
+        assert hit_tokens > 0
+        assert snap["totals"]["prefix_hit_tokens"] == hit_tokens
+        # compute attribution flowed: every tenant paid > 0 FLOPs and
+        # the tenant column sums to the totals column
+        assert snap["totals"]["flops"] > 0
+        assert snap["totals"]["page_seconds"] > 0
+        for t in snap["tenants"].values():
+            assert t["flops"] > 0 and t["page_seconds"] > 0
+        assert abs(sum(t["flops"] for t in snap["tenants"].values())
+                   - snap["totals"]["flops"]) < 1e-3
+        # nothing leaked to the unattributed bucket: every decode-side
+        # attribution resolved through the replica-qualified inner key
+        assert metering.UNATTRIBUTED not in snap["tenants"]
+    finally:
+        r.stop()
+    metering.stop()
+    telemetry.stop()
+
+    # the durable ledger: one immutable record per request, replay
+    # tokens conserved across records
+    with open(ledger) as f:
+        lines = [json.loads(l) for l in f]
+    assert len(lines) == 8
+    assert all(l["type"] == "usage_record" for l in lines)
+    assert sum(l["replay_tokens"] for l in lines) == st["replay_tokens"]
+    assert sum(l["failovers"] for l in lines) == st["failovers"]
+    replayed = [l for l in lines if l["failovers"]]
+    assert replayed and all(l["replica"] != victim.name
+                            for l in replayed)
+
+    # diagnose renders the Usage table with the conservation verdict
+    from mxnet_tpu.tools import diagnose as diag_mod
+    diag_mod.main([sink])
+    out = capsys.readouterr().out
+    assert "----------Usage----------" in out
+    assert "[OK]" in out and "[MISMATCH]" not in out
+    assert "tenant acme" in out and "tenant zeta" in out
+
+    # and the JSON surface carries the machine-checkable verdict
+    tel = diag_mod.read_telemetry(sink)
+    j = diag_mod.telemetry_json(tel)
+    assert j["usage"]["fleet"]["reconciled"] is True
+    assert j["usage"]["fleet"]["reconcile_checks"]
+
+
+def test_diagnose_reads_raw_ledger_directly(tmp_path, capsys):
+    """``diagnose <MXNET_METER_FILE>`` renders a Usage table
+    synthesized from the raw usage_record lines — no telemetry run
+    wrapper needed to audit a bill."""
+    ledger = str(tmp_path / "ledger.jsonl")
+    metering.start(name="fleet", path=ledger, flush_every=1)
+    r = _router(n=1)
+    try:
+        req = r.submit(np.arange(1, 6), max_new_tokens=4,
+                       tenant="acme")
+        _run(r, req)
+    finally:
+        r.stop()
+    metering.stop()
+    from mxnet_tpu.tools import diagnose as diag_mod
+    diag_mod.main([ledger])
+    out = capsys.readouterr().out
+    assert "----------Usage----------" in out
+    assert "synthesized from raw ledger lines" in out
+    assert "tenant acme" in out
+
+
+# ---------------------------------------------------------------------------
+# prefix-cache credits reconcile with the pool's own counters
+# ---------------------------------------------------------------------------
+
+def test_prefix_hit_credit_equals_pool_hit_counters():
+    """A prefix-hit prompt is credited the exact tokens/bytes the
+    pool's own hit counters record — the credit fires at the SAME
+    point the server increments ``prefix_hit_tokens``."""
+    metering.start(name="fleet")
+    srv = _replica("rep-0", prefix_cache=True, seq_ladder=[32],
+                   max_new_tokens=4)
+    r = Router([srv], start=False, probe_interval_ms=1)
+    base = np.arange(1, 13)                    # 12 tokens, page 8
+    try:
+        req1 = r.submit(base, max_new_tokens=4, tenant="acme")
+        now = _run(r, req1)
+        req2 = r.submit(np.concatenate([base, [13, 14]]),
+                        max_new_tokens=4, tenant="acme")
+        _run(r, req2, now=now)
+        st = srv.stats()["prefix"]
+        assert st["hits"] == 1 and st["hit_tokens"] > 0
+        snap = metering.snapshot()
+        acct = snap["tenants"]["acme"]
+        assert acct["prefix_hit_tokens"] == st["hit_tokens"]
+        assert acct["prefix_bytes_saved"] == st["bytes_saved"]
+        assert snap["totals"]["prefix_hit_tokens"] == st["hit_tokens"]
+        assert snap["reconcile"]["ok"]
+    finally:
+        r.stop()
+
+
+# ---------------------------------------------------------------------------
+# off-path, ledger mechanics, training accounting
+# ---------------------------------------------------------------------------
+
+def test_meter_off_every_hook_is_a_noop():
+    """With no meter installed every hook returns without effect —
+    the serving path stays on its zero-cost fast path."""
+    assert not metering.enabled()
+    metering.request_admitted("t", "r1", 5, 8, 0)
+    metering.request_dispatched("r1", "k1", "rep-0")
+    metering.request_requeued("r1")
+    metering.request_resumed("r1", 3)
+    metering.request_closed("r1", "completed", generated_tokens=2)
+    metering.request_pages([("k1", 2)], 1.0)
+    metering.request_flops("k1", 1e6)
+    metering.request_prefix("k1", 4, 64)
+    metering.tenant_throttled("t")
+    metering.training_step()
+    assert metering.snapshot() is None
+    assert metering.emit() is None
+
+
+def test_unknown_inner_id_bills_unattributed_not_crash():
+    """Decode-side attribution for an inner id the router never
+    linked lands in the ``(unattributed)`` bucket — the books stay
+    balanced instead of dropping the quantity."""
+    metering.start(name="m")
+    metering.request_flops("stray", 100.0, 10.0)
+    metering.request_pages([("stray", 2)], 1.0)
+    metering.request_pages([("stray", 2)], 2.0)
+    snap = metering.snapshot()
+    acct = snap["tenants"][metering.UNATTRIBUTED]
+    assert acct["flops"] == 100.0
+    assert acct["page_seconds"] == pytest.approx(2.0)
+    assert snap["reconcile"]["ok"]
+
+
+def test_ledger_flush_every_and_bounded_tail(tmp_path):
+    ledger = str(tmp_path / "l.jsonl")
+    m = metering.start(name="m", path=ledger, flush_every=3,
+                       max_records=4)
+    for i in range(7):
+        rid = "r%d" % i
+        metering.request_admitted("t", rid, 4, 2, 0)
+        metering.request_closed(rid, "completed", generated_tokens=2)
+    # 6 flushed at the cadence; the 7th is pending until stop()
+    with open(ledger) as f:
+        assert len(f.read().splitlines()) == 6
+    assert len(m.records()) == 4        # bounded in-memory tail
+    snap = metering.stop()
+    with open(ledger) as f:
+        lines = [json.loads(l) for l in f.read().splitlines()]
+    assert len(lines) == 7
+    assert snap["ledger"]["written"] == 7
+    assert snap["ledger"]["errors"] == 0
+    assert snap["reconcile"]["ok"]
+
+
+def test_training_accounting_reconciles_wasted_steps():
+    """Run-level cost: device-seconds, FLOPs/step, and the restart
+    tax — steps that bought nothing (``fault.stats`` skipped) inflate
+    the effective device-seconds by 1/goodput."""
+    metering.start(name="train")
+    for _ in range(10):
+        metering.training_step()
+    tr = metering.snapshot()["training"]
+    assert tr["steps"] == 10
+    assert tr["wasted_steps"] == 0 and tr["goodput"] == 1.0
+    assert tr["effective_device_seconds"] == tr["device_seconds"]
+    with fault._lock:                      # the guard skipped 2 steps
+        fault._stats["skipped_steps"] += 2
+    tr = metering.snapshot()["training"]
+    assert tr["wasted_steps"] == 2
+    assert tr["goodput"] == pytest.approx(0.8)
+    # snapshot values are rounded to 1e-6 s — compare at that grain
+    assert tr["effective_device_seconds"] == pytest.approx(
+        tr["device_seconds"] / 0.8, abs=2e-6)
+
+
+def test_fused_step_drives_training_meter(monkeypatch):
+    """The fused executor's ``_post_step`` ticks the training meter —
+    one tick per optimizer step, fused or guarded alike."""
+    monkeypatch.setenv("MXNET_FUSED_STEP", "1")
+    metering.start(name="train")
+    rng = np.random.RandomState(3)
+    data = mx.sym.var("data")
+    net = mx.sym.FullyConnected(data, num_hidden=4, name="fc")
+    net = mx.sym.SoftmaxOutput(net, mx.sym.var("softmax_label"),
+                               name="softmax")
+    mod = mx.module.Module(net, context=mx.cpu())
+    mod.bind(data_shapes=[("data", (4, 6))],
+             label_shapes=[("softmax_label", (4,))])
+    mod.init_params(initializer=mx.init.Xavier())
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.05})
+    batch = mx.io.DataBatch(
+        data=[mx.nd.array(rng.uniform(0, 1, (4, 6)))],
+        label=[mx.nd.array(rng.randint(0, 4, (4,)).astype(float))])
+    for _ in range(3):
+        mod.forward_backward(batch)
+        mod.update()
+    assert metering.snapshot()["training"]["steps"] == 3
+
+
+# ---------------------------------------------------------------------------
+# surfaces: telemetry record, /metrics families, flight recorder
+# ---------------------------------------------------------------------------
+
+def test_usage_flows_to_telemetry_metrics_and_flightrec(tmp_path):
+    telemetry.start(filename=str(tmp_path / "run.jsonl"))
+    metering.start(name="fleet")
+    metering.request_admitted("acme", "r1", 5, 8, 0)
+    metering.request_closed("r1", "completed", generated_tokens=8)
+    metering.emit()
+    rep = telemetry.report()
+    assert rep["usage"]["fleet"]["admitted"] == 1
+    assert rep["usage"]["fleet"]["reconcile"]["ok"]
+
+    page = livemetrics.render()
+    assert 'mxnet_usage_admitted_total{meter="fleet"} 1' in page
+    assert 'mxnet_usage_reconciled{meter="fleet"} 1' in page
+    assert ('mxnet_usage_tenant_generated_tokens_total'
+            '{meter="fleet",tenant="acme"} 8') in page
+
+    from mxnet_tpu import flightrec
+    flightrec.enable(str(tmp_path / "fr"))
+    try:
+        path = flightrec.crash_dump("test")
+        bundle = flightrec.read_bundle(path)
+        assert bundle["metering"]["admitted"] == 1
+        assert bundle["metering"]["reconcile"]["ok"]
+    finally:
+        flightrec.disable()
+    telemetry.stop()
+
+
+# ---------------------------------------------------------------------------
+# satellite: diagnose counts unknown record kinds instead of silently
+# dropping them
+# ---------------------------------------------------------------------------
+
+def test_diagnose_warns_on_unknown_record_kinds(tmp_path, capsys):
+    """A sink written by a NEWER mxnet_tpu may contain record kinds
+    this diagnose predates — they must surface as ONE counted warning
+    line, not vanish silently; a run_start resets the count to the
+    run being rendered."""
+    sink = str(tmp_path / "run.jsonl")
+    telemetry.start(filename=sink)
+    telemetry.step_begin()
+    telemetry.step_end(samples=4)
+    telemetry.stop()
+    with open(sink) as f:
+        intact = f.read()
+    with open(sink, "w") as f:
+        f.write('{"type": "from_the_future", "x": 1}\n')   # pre-run
+        f.write(intact)
+        f.write('{"type": "from_the_future", "x": 2}\n')
+        f.write('{"type": "also_new"}\n')
+        f.write('{"type": 7}\n')                           # non-str kind
+    from mxnet_tpu.tools import diagnose as diag_mod
+    tel = diag_mod.read_telemetry(sink)
+    # the pre-run record was reset by run_start — only THIS run's skew
+    assert tel["unknown_kinds"] == {"from_the_future": 1,
+                                    "also_new": 1, "?": 1}
+    assert len(tel["steps"]) == 1
+    diag_mod.main([sink])
+    out = capsys.readouterr().out
+    assert "ignored 3 record(s) of unknown kind" in out
+    assert "from_the_future x1" in out
+    assert "steps        : 1" in out           # everything else renders
+    j = diag_mod.telemetry_json(tel)
+    assert j["unknown_kinds"] == tel["unknown_kinds"]
